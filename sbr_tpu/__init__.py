@@ -1,0 +1,62 @@
+"""sbr_tpu — TPU-native framework for social-bank-run equilibria.
+
+A from-scratch JAX/XLA rebuild of the capabilities of the reference replication
+package for "The Social Determinants of Bank Runs" (reference layout: Julia,
+`src/baseline/{model,learning,solver}.jl` + three extensions). The design is
+TPU-first, not a port:
+
+- Fixed static-shape time grids instead of adaptive ODE grids (reference
+  `src/baseline/learning.jl:51` inherits an adaptive grid everywhere).
+- Closed-form Stage-1 logistic learning where the reference integrates an ODE
+  (`src/baseline/learning.jl:41-54`), making Stage 1 exact and grid-free.
+- Branchless masked compute (status codes) instead of data-dependent control
+  flow (`scripts/1_baseline.jl:147-163`, `src/baseline/solver.jl:341-372`).
+- vmap over economic parameters and shard_map over a `jax.sharding.Mesh` for
+  comparative-statics sweeps (`scripts/1_baseline.jl:224-267` is a sequential
+  double loop in the reference).
+
+Subpackage map (reference component in parens):
+
+- ``core``     — numerics substrate: interpolation, quadrature, crossing
+                 detection, bisection, fixed-step ODE integrators.
+- ``models``   — validated parameter/result pytrees (``src/baseline/model.jl``,
+                 ``*_model.jl``).
+- ``baseline`` — Stage 1-3 pipeline (``learning.jl``, ``solver.jl``).
+- ``hetero``   — K-group heterogeneous learning speeds
+                 (``extensions/heterogeneity/``).
+- ``interest`` — positive interest rates via HJB value function
+                 (``extensions/interest_rates/``).
+- ``social``   — social learning: damped fixed point
+                 (``extensions/social_learning/``) plus the explicit-agent
+                 graph simulation (new capability).
+- ``sweeps``   — vmapped / mesh-sharded comparative statics
+                 (``scripts/1_baseline.jl`` sweeps).
+- ``parallel`` — mesh construction, sharding specs, collective helpers.
+- ``figures``  — matplotlib parity layer for the 13 reference figures
+                 (``src/baseline/plotting.jl``, script-inline figures).
+- ``utils``    — timing/profiling, status codes, tile checkpointing.
+"""
+
+from sbr_tpu.models.params import (
+    EconomicParams,
+    LearningParams,
+    ModelParams,
+    make_model_params,
+    with_overrides,
+)
+from sbr_tpu.baseline.learning import solve_learning
+from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+
+__version__ = "0.1.0"
+
+
+def enable_x64() -> None:
+    """Enable float64 end to end (the reference runs at machine-eps float64).
+
+    TPU executes f64 with a throughput penalty; the big sweeps default to f32
+    with a re-derived tolerance ladder, while parity/correctness paths call
+    this first.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)

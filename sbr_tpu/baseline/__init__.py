@@ -1,0 +1,12 @@
+"""Baseline three-stage pipeline (reference `src/baseline/learning.jl`,
+`src/baseline/solver.jl`)."""
+
+from sbr_tpu.baseline.learning import logistic_cdf, logistic_pdf, solve_learning
+from sbr_tpu.baseline.solver import (
+    compute_xi,
+    get_aw,
+    hazard_rate,
+    optimal_buffer,
+    solve_equilibrium_baseline,
+    solve_equilibrium_core,
+)

@@ -1,0 +1,88 @@
+"""Stage 1 — learning dynamics.
+
+The reference integrates the logistic SI ODE dx/dt = βx(1-x) with an adaptive
+solver at machine-eps tolerance and interpolates the adaptive grid
+(`src/baseline/learning.jl:41-54`). The ODE has the exact solution
+
+    G(t) = x0 / (x0 + (1 - x0) * exp(-β t)),
+
+so the TPU build evaluates Stage 1 in closed form: exact, grid-free,
+overflow-safe for βt up to ~1e4·30 (the Figure-5 sweep reaches β = 1e4,
+`scripts/1_baseline.jl:210-211`), and exactly differentiable. The PDF is the
+symbolic g(t) = β·G·(1-G) the reference also uses
+(`src/baseline/learning.jl:161-173`). A fixed-grid RK4 fallback exists for
+dynamics with no closed form (hetero, HJB, forced social learning).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sbr_tpu.models.params import LearningParams, SolverConfig
+from sbr_tpu.models.results import LearningSolution
+
+
+def logistic_cdf(t, beta, x0):
+    """Exact SI-model CDF G(t) = x0 / (x0 + (1-x0)·e^{-βt}).
+
+    Written in the decaying-exponential form so large βt saturates to 1
+    instead of overflowing (the naive x0·e^{βt} form overflows at βt ≈ 709).
+    """
+    return x0 / (x0 + (1.0 - x0) * jnp.exp(-beta * t))
+
+
+def logistic_pdf(t, beta, x0):
+    """Exact SI-model PDF g(t) = β·G(t)·(1-G(t)) (`learning.jl:167-170`)."""
+    g = logistic_cdf(t, beta, x0)
+    return beta * g * (1.0 - g)
+
+
+def solve_learning(
+    params: LearningParams,
+    config: SolverConfig = SolverConfig(),
+    dtype=jnp.float64,
+) -> LearningSolution:
+    """Solve Stage 1 on a static uniform grid (reference `solve_learning`,
+    `learning.jl:109-124`).
+
+    Returns a `LearningSolution` whose CDF/PDF evaluators use the closed form;
+    the grid samples exist for plotting and for stages that consume sampled
+    curves (e.g. as the social-learning initial guess,
+    `social_learning_solver.jl:90-94`).
+    """
+    dtype = jnp.zeros((), dtype=dtype).dtype  # canonicalize under x64 disabled
+    t0, t1 = params.tspan
+    grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
+    beta = jnp.asarray(params.beta, dtype=dtype)
+    x0 = jnp.asarray(params.x0, dtype=dtype)
+    cdf = logistic_cdf(grid, beta, x0)
+    pdf = logistic_pdf(grid, beta, x0)
+    return LearningSolution(
+        grid=grid,
+        cdf=cdf,
+        pdf=pdf,
+        t0=jnp.asarray(t0, dtype=dtype),
+        dt=grid[1] - grid[0],
+        beta=beta,
+        x0=x0,
+        closed_form=True,
+    )
+
+
+def learning_solution_from_samples(grid, cdf, pdf) -> LearningSolution:
+    """Wrap sampled curves on a uniform grid (ODE-backed stages).
+
+    Mirrors the reference pattern of re-wrapping extension dynamics as a
+    baseline `LearningResults` (`social_learning_solver.jl:135-137`).
+    """
+    grid = jnp.asarray(grid)
+    return LearningSolution(
+        grid=grid,
+        cdf=jnp.asarray(cdf),
+        pdf=jnp.asarray(pdf),
+        t0=grid[0],
+        dt=grid[1] - grid[0],
+        beta=jnp.asarray(jnp.nan, dtype=grid.dtype),
+        x0=cdf[0],
+        closed_form=False,
+    )

@@ -1,0 +1,298 @@
+"""Stages 2+3 — hazard rate, optimal buffers, equilibrium crash time, and the
+aggregate-withdrawal curves (reference `src/baseline/solver.jl`).
+
+Design notes vs the reference:
+
+- The hazard normalization integral is a parallel cumulative quadrature
+  (`core.integrate`) instead of the sequential trapezoid loop
+  (`solver.jl:172-175`). With the closed-form Stage-1 PDF the integrand is
+  analytic, so composite Gauss-Legendre makes the hazard essentially exact.
+- Buffer times come from vectorized crossing detection (`core.rootfind`)
+  replacing the forward/backward scans of `solver.jl:229-261`.
+- ξ comes from fixed-iteration bisection plus a post-hoc classification into
+  the reference's 5 cases (`solver.jl:341-372`) as status codes: no branch
+  diverges under vmap, and no-run cells surface as NaN exactly like the
+  reference's sweeps expect (`scripts/1_baseline.jl:157-163`).
+- Everything is a pure function of arrays: a single u-sweep is
+  `vmap(solve_equilibrium_core, in_axes=(None, 0, ...))` with Stage 1 shared,
+  the algebraic split the reference exploits manually at
+  `scripts/1_baseline.jl:169`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sbr_tpu.baseline.learning import logistic_pdf
+from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre
+from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
+from sbr_tpu.models.params import EconomicParams, SolverConfig
+from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
+
+
+def _root_tol(dtype) -> float:
+    """Root-acceptance tolerance on |AW(ξ*) - κ|.
+
+    The reference exits bisection at 10·eps(κ) (`solver.jl:310,438`). Fixed
+    90-halving bisection lands far inside that when a root exists and far
+    outside when none does, so the threshold only needs to separate the two
+    regimes; SURVEY §7.3 notes 10·eps at f32 (~7e-7) is too tight, hence the
+    dtype-aware ladder. The f64 value allows for TPU f64 transcendentals,
+    whose composite error floor is ~5e-9 (measured on v5e: exp+divide chains
+    land ~2e-9 off the CPU value).
+    """
+    return 1e-7 if jnp.dtype(dtype) == jnp.float64 else 1e-4
+
+
+def _hazard_parts(p, lam, ls: LearningSolution, eta, config: SolverConfig):
+    """Hazard grid, values, and the cumulative normalization integral."""
+    dtype = ls.cdf.dtype
+    eta = jnp.asarray(eta, dtype=dtype)
+    p = jnp.asarray(p, dtype=dtype)
+    lam = jnp.asarray(lam, dtype=dtype)
+    tau_grid = jnp.linspace(jnp.zeros((), dtype), eta, config.n_grid)
+
+    if ls.closed_form:
+        beta, x0 = ls.beta, ls.x0
+
+        def integrand(ts):
+            return jnp.exp(lam * ts) * logistic_pdf(ts, beta, x0)
+
+        integ = cumulative_gauss_legendre(integrand, tau_grid, order=config.quad_order)
+        g_tau = logistic_pdf(tau_grid, beta, x0)
+    else:
+        g_tau = ls.pdf_at(tau_grid)
+        eg = jnp.exp(lam * tau_grid) * g_tau
+        integ = cumtrapz(eg, x=tau_grid)
+
+    int_eta = integ[-1]
+    hr = (p * jnp.exp(lam * tau_grid) * g_tau) / (p * integ + (1.0 - p) * int_eta)
+    return tau_grid, hr, integ, int_eta
+
+
+def hazard_rate(p, lam, ls: LearningSolution, eta, config: SolverConfig = SolverConfig()):
+    """Hazard rate h(τ̄) on a static [0, η] grid (`solver.jl:153-185`).
+
+    h(τ̄) = p·e^{λτ̄}·g(τ̄) / (p·∫₀^τ̄ e^{λs}g(s)ds + (1-p)·∫₀^η e^{λs}g(s)ds)
+
+    Returns (tau_grid, hr). For p=1 the value at τ̄=0 is +inf, matching the
+    reference's division by a zero integral (used only by the plotting layer's
+    h_f decomposition, `plotting.jl:62-132`).
+    """
+    tau_grid, hr, _, _ = _hazard_parts(p, lam, ls, eta, config)
+    return tau_grid, hr
+
+
+def _make_hazard_at(p, lam, ls: LearningSolution, tau_grid, integ, int_eta, config: SolverConfig):
+    """Continuous exact hazard evaluator for closed-form Stage 1.
+
+    h at arbitrary τ̄ needs the normalization integral at τ̄; it is recovered as
+    the precomputed knot value plus a single Gauss-Legendre panel over the
+    sub-interval — exact for the analytic integrand, so buffer crossings can be
+    refined to machine precision instead of the grid-linear-interp accuracy the
+    reference settles for (`solver.jl:233-250`).
+    """
+    import numpy as np
+
+    dtype = tau_grid.dtype
+    nodes, weights = np.polynomial.legendre.leggauss(config.quad_order)
+    nodes = jnp.asarray(nodes, dtype=dtype)
+    weights = jnp.asarray(weights, dtype=dtype)
+    dtau = tau_grid[1] - tau_grid[0]
+    n = tau_grid.shape[0]
+    beta, x0 = ls.beta, ls.x0
+    p = jnp.asarray(p, dtype=dtype)
+    lam = jnp.asarray(lam, dtype=dtype)
+
+    def hazard_at(tau):
+        i = jnp.clip(jnp.floor(tau / dtau).astype(jnp.int32), 0, n - 2)
+        a = tau_grid[i]
+        half = 0.5 * (tau - a)
+        mid = 0.5 * (tau + a)
+        xs = mid + half * nodes
+        vals = jnp.exp(lam * xs) * logistic_pdf(xs, beta, x0)
+        i_loc = integ[i] + half * jnp.dot(weights, vals)
+        num = p * jnp.exp(lam * tau) * logistic_pdf(tau, beta, x0)
+        return num / (p * i_loc + (1.0 - p) * int_eta)
+
+    return hazard_at
+
+
+def optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=None, refine_iters: int = 60):
+    """Unconstrained buffer times (τ̄_IN, τ̄_OUT) where h crosses u
+    (`solver.jl:211-264`), with the reference's boundary fallbacks.
+
+    With ``hazard_at`` (continuous exact hazard), genuine crossings are
+    refined by bisection within ±one grid interval of the coarse estimate;
+    fallback lanes keep their grid values.
+    """
+    default = jnp.asarray(tspan_end, dtype=hr.dtype)
+    t_in, has_up = first_upcrossing(tau_grid, hr, u, default, return_flag=True)
+    t_out, has_dn = last_downcrossing(tau_grid, hr, u, default, return_flag=True)
+    if hazard_at is None:
+        return t_in, t_out
+
+    dtau = tau_grid[1] - tau_grid[0]
+    eta = tau_grid[-1]
+
+    def bracket(t):
+        return jnp.clip(t - dtau, 0.0, eta), jnp.clip(t + dtau, 0.0, eta)
+
+    lo_i, hi_i = bracket(t_in)
+    t_in_ref = bisect(lambda t: hazard_at(t) - u, lo_i, hi_i, num_iters=refine_iters)
+    lo_o, hi_o = bracket(t_out)
+    # down-crossing: u - h is locally increasing
+    t_out_ref = bisect(lambda t: u - hazard_at(t), lo_o, hi_o, num_iters=refine_iters)
+    return jnp.where(has_up, t_in_ref, t_in), jnp.where(has_dn, t_out_ref, t_out)
+
+
+def compute_xi(
+    tau_bar_in_unc,
+    tau_bar_out_unc,
+    ls: LearningSolution,
+    kappa,
+    config: SolverConfig = SolverConfig(),
+    lo=None,
+    hi=None,
+    x0=None,
+):
+    """Bisection for AW(ξ)=κ with first-crossing validation (`solver.jl:308-376`).
+
+    AW(ξ) = G(min(ξ, τ̄_OUT)) - G(min(ξ, τ̄_IN)).
+
+    Returns (xi_candidate, abs_error, root_ok, is_increasing):
+    - root_ok: |AW(ξ*)-κ| under the dtype tolerance ladder — False reproduces
+      the reference's interval-collapse / non-convergence NaN path.
+    - is_increasing: finite-difference slope of the withdrawal path at ξ* with
+      ε = the learning-grid spacing (`solver.jl:336-343`); False is the
+      reference's "false equilibrium" (root on the decreasing branch).
+    """
+    dtype = ls.cdf.dtype
+    kappa = jnp.asarray(kappa, dtype=dtype)
+    lo = tau_bar_in_unc if lo is None else lo
+    hi = tau_bar_out_unc if hi is None else hi
+
+    def aw_of(xi):
+        t_out = jnp.minimum(tau_bar_out_unc, xi)
+        t_in = jnp.minimum(tau_bar_in_unc, xi)
+        return ls.cdf_at(t_out) - ls.cdf_at(t_in)
+
+    xi = bisect(lambda x: aw_of(x) - kappa, lo, hi, num_iters=config.bisect_iters, x0=x0)
+
+    aw = aw_of(xi)
+    err = jnp.abs(aw - kappa)
+    root_ok = err <= _root_tol(dtype)
+
+    eps = ls.dt
+    t_out = jnp.minimum(tau_bar_out_unc, xi)
+    t_in = jnp.minimum(tau_bar_in_unc, xi)
+    aw_eps = ls.cdf_at(t_out + eps) - ls.cdf_at(t_in + eps)
+    is_increasing = aw_eps >= aw
+    return xi, err, root_ok, is_increasing
+
+
+def get_aw(xi, tau_bar_in_unc, tau_bar_out_unc, tau_grid, ls: LearningSolution):
+    """Aggregate-withdrawal curves on the hazard grid (`solver.jl:495-532`).
+
+    AW_cum(t) = G(t-ξ+τ̄_OUT^CON) - G(t-ξ+τ̄_IN^CON) + G(0), with each branch
+    zeroed before its own start time exactly as the reference's ifelse masks.
+    Returns (aw_cum, aw_out, aw_in).
+    """
+    zero = jnp.zeros((), dtype=tau_grid.dtype)
+    tau_in_con = jnp.minimum(tau_bar_in_unc, xi)
+    tau_out_con = jnp.minimum(tau_bar_out_unc, xi)
+
+    shift_in = tau_grid - xi + tau_in_con
+    aw_in = jnp.where(shift_in >= 0, ls.cdf_at(jnp.maximum(shift_in, zero)), zero)
+    shift_out = tau_grid - xi + tau_out_con
+    aw_out = jnp.where(shift_out >= 0, ls.cdf_at(jnp.maximum(shift_out, zero)), zero)
+
+    aw_cum = aw_out - aw_in + ls.cdf_at(zero)
+    return aw_cum, aw_out, aw_in
+
+
+def solve_equilibrium_core(
+    ls: LearningSolution,
+    u,
+    p,
+    kappa,
+    lam,
+    eta,
+    tspan_end,
+    config: SolverConfig = SolverConfig(),
+) -> EquilibriumResult:
+    """Scalar-parameter equilibrium solve — the vmap/pjit unit of the sweeps.
+
+    Faithful to `solve_equilibrium_baseline` (`solver.jl:413-462`) including
+    the trivial no-crossing branch, expressed branchlessly via status codes.
+    """
+    dtype = ls.cdf.dtype
+    u = jnp.asarray(u, dtype=dtype)
+    nan = jnp.asarray(jnp.nan, dtype=dtype)
+
+    tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
+    hazard_at = (
+        _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config) if ls.closed_form else None
+    )
+    tau_in_unc, tau_out_unc = optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=hazard_at)
+
+    no_crossing = tau_in_unc == tau_out_unc
+
+    xi_c, err, root_ok, increasing = compute_xi(tau_in_unc, tau_out_unc, ls, kappa, config)
+
+    run = jnp.logical_and(jnp.logical_not(no_crossing), jnp.logical_and(root_ok, increasing))
+    status = jnp.where(
+        no_crossing,
+        Status.NO_CROSSING,
+        jnp.where(
+            jnp.logical_not(root_ok),
+            Status.NO_ROOT,
+            jnp.where(increasing, Status.RUN, Status.FALSE_EQ),
+        ),
+    ).astype(jnp.int32)
+
+    xi = jnp.where(run, xi_c, nan)
+    converged = jnp.logical_or(no_crossing, run)  # `solver.jl:432,447-455`
+    tolerance = jnp.where(
+        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
+    )
+
+    aw_cum, aw_out, aw_in = get_aw(xi, tau_in_unc, tau_out_unc, tau_grid, ls)
+    aw_cum = jnp.where(run, aw_cum, nan)
+    aw_out = jnp.where(run, aw_out, nan)
+    aw_in = jnp.where(run, aw_in, nan)
+    aw_max = jnp.where(run, jnp.max(aw_cum), nan)
+
+    return EquilibriumResult(
+        xi=xi,
+        tau_bar_in_unc=tau_in_unc,
+        tau_bar_out_unc=tau_out_unc,
+        tau_in=jnp.maximum(xi - tau_in_unc, 0.0),
+        tau_out=jnp.maximum(xi - tau_out_unc, 0.0),
+        bankrun=run,
+        status=status,
+        converged=converged,
+        tolerance=tolerance,
+        tau_grid=tau_grid,
+        hr=hr,
+        aw_cum=aw_cum,
+        aw_out=aw_out,
+        aw_in=aw_in,
+        aw_max=aw_max,
+    )
+
+
+def solve_equilibrium_baseline(
+    ls: LearningSolution,
+    econ: EconomicParams,
+    config: SolverConfig = SolverConfig(),
+    tspan_end=None,
+) -> EquilibriumResult:
+    """Convenience entry mirroring `solve_equilibrium_baseline(lr, econ)`
+    (`solver.jl:413`). ``tspan_end`` defaults to the learning grid's end, the
+    reference's `lr.params.tspan[2]` (`solver.jl:421`)."""
+    if tspan_end is None:
+        tspan_end = ls.grid[-1]
+    return solve_equilibrium_core(
+        ls, econ.u, econ.p, econ.kappa, econ.lam, econ.eta, tspan_end, config
+    )

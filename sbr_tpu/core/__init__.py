@@ -1,0 +1,16 @@
+"""Numerics substrate shared by every solver stage.
+
+Replaces the reference's Interpolations.jl objects + adaptive-grid idioms
+(`src/baseline/solver.jl:153-264`, `src/baseline/learning.jl:52`) with
+static-shape, jit/vmap-safe primitives.
+"""
+
+from sbr_tpu.core.interp import interp, interp_uniform
+from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre, trapz
+from sbr_tpu.core.rootfind import (
+    bisect,
+    first_upcrossing,
+    last_downcrossing,
+    threshold_crossings,
+)
+from sbr_tpu.core.ode import rk4

@@ -1,0 +1,64 @@
+"""Quadrature primitives.
+
+The reference computes the hazard-rate normalization with a sequential
+cumulative trapezoid over its adaptive grid (`src/baseline/solver.jl:172-175`).
+On TPU that loop is a `cumsum` of per-interval trapezoid increments — fully
+parallel. For integrands known in closed form (the baseline logistic PDF) a
+composite Gauss-Legendre rule gives near-exact cumulative integrals at the
+same O(n) cost.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def trapz(y, x=None, dx=1.0):
+    """Trapezoid integral along the last axis."""
+    if x is not None:
+        d = jnp.diff(x)
+    else:
+        d = dx
+    return jnp.sum(0.5 * (y[..., 1:] + y[..., :-1]) * d, axis=-1)
+
+
+def cumtrapz(y, x=None, dx=1.0):
+    """Cumulative trapezoid along the last axis, zero at the first knot.
+
+    Matches the reference recurrence
+    ``int[i] = int[i-1] + 0.5*(f(t[i-1])+f(t[i]))*(t[i]-t[i-1])``
+    (`src/baseline/solver.jl:172-175`) as one parallel cumsum.
+    """
+    if x is not None:
+        d = jnp.diff(x)
+    else:
+        d = dx
+    inc = 0.5 * (y[..., 1:] + y[..., :-1]) * d
+    csum = jnp.cumsum(inc, axis=-1)
+    zero = jnp.zeros(csum.shape[:-1] + (1,), dtype=csum.dtype)
+    return jnp.concatenate([zero, csum], axis=-1)
+
+
+def cumulative_gauss_legendre(f, grid, order: int = 8):
+    """Cumulative integral of callable ``f`` at the knots of ``grid``.
+
+    Composite Gauss-Legendre with ``order`` nodes per interval: error
+    O(h^{2*order}) per interval, effectively exact for the analytic integrands
+    in this model (e^{λt} g(t) with closed-form g). ``f`` must accept an array
+    of evaluation points and broadcast.
+
+    Returns an array shaped like ``grid`` with value 0 at ``grid[0]``.
+    """
+    nodes, weights = np.polynomial.legendre.leggauss(order)
+    a = grid[:-1]
+    b = grid[1:]
+    half = 0.5 * (b - a)
+    mid = 0.5 * (a + b)
+    # (order, n-1) evaluation points
+    xs = mid[None, :] + half[None, :] * jnp.asarray(nodes, dtype=grid.dtype)[:, None]
+    vals = f(xs)
+    seg = half * jnp.tensordot(jnp.asarray(weights, dtype=grid.dtype), vals, axes=(0, 0))
+    csum = jnp.cumsum(seg, axis=-1)
+    zero = jnp.zeros(csum.shape[:-1] + (1,), dtype=csum.dtype)
+    return jnp.concatenate([zero, csum], axis=-1)

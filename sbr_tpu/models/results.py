@@ -1,0 +1,98 @@
+"""Result pytrees.
+
+The reference carries solutions in mutable structs with lazily-cached
+interpolation objects (`SolvedModel`, `src/baseline/solver.jl:55-109`;
+`get_AW_functions!` cache at :553-576). Here results are immutable pytrees of
+arrays: every derived curve is computed inside jit and XLA dead-code-eliminates
+whatever the caller does not use, which subsumes the lazy-cache mechanism
+without mutation. No-run cells carry NaN plus an integer status code instead
+of Julia-side branching (`solver.jl:341-372`), preserving the reference's NaN
+semantics inside vmap.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+from flax import struct
+
+
+class Status(enum.IntEnum):
+    """Per-cell outcome codes (SURVEY §5.5: structured status instead of prints).
+
+    - RUN: valid bank-run equilibrium (reference: bankrun=true).
+    - NO_CROSSING: u at/above the max of the (effective) hazard, buffers
+      coincide — trivially no run (`solver.jl:429-433`).
+    - NO_ROOT: bisection found no root of AW(ξ)=κ in the bracket
+      (`solver.jl:316-324` interval-collapse / non-convergence → NaN).
+    - FALSE_EQ: root lies on the decreasing branch of the withdrawal path —
+      slope check rejected it (`solver.jl:353-362`).
+    """
+
+    RUN = 0
+    NO_CROSSING = 1
+    NO_ROOT = 2
+    FALSE_EQ = 3
+
+
+@struct.dataclass
+class LearningSolution:
+    """Stage-1 output (reference `LearningResults`, `learning.jl:74-81`).
+
+    Uniform-grid samples of the CDF/PDF plus, when ``closed_form`` is set,
+    the exact logistic parameters — in which case evaluators bypass
+    interpolation entirely (the reference always interpolates its adaptive
+    grid, `learning.jl:52`).
+    """
+
+    grid: jnp.ndarray  # (n,) uniform time grid over tspan
+    cdf: jnp.ndarray  # (n,) G(t) samples
+    pdf: jnp.ndarray  # (n,) g(t) samples
+    t0: jnp.ndarray  # scalar, grid start
+    dt: jnp.ndarray  # scalar, grid spacing
+    beta: jnp.ndarray  # scalar learning rate (closed-form evaluation)
+    x0: jnp.ndarray  # scalar initial condition
+    closed_form: bool = struct.field(pytree_node=False, default=False)
+
+    def cdf_at(self, t):
+        from sbr_tpu.baseline.learning import logistic_cdf
+        from sbr_tpu.core.interp import interp_uniform
+
+        if self.closed_form:
+            return logistic_cdf(t, self.beta, self.x0)
+        return interp_uniform(t, self.t0, self.dt, self.cdf)
+
+    def pdf_at(self, t):
+        from sbr_tpu.baseline.learning import logistic_pdf
+        from sbr_tpu.core.interp import interp_uniform
+
+        if self.closed_form:
+            return logistic_pdf(t, self.beta, self.x0)
+        return interp_uniform(t, self.t0, self.dt, self.pdf)
+
+
+@struct.dataclass
+class EquilibriumResult:
+    """Stage-2/3 output (reference `SolvedModel`, `solver.jl:55-109`).
+
+    Scalars are 0-d arrays so a vmapped sweep yields batched results; curve
+    fields live on the [0, η] hazard grid. ``xi`` is NaN when no run occurs,
+    with ``status`` recording why.
+    """
+
+    xi: jnp.ndarray
+    tau_bar_in_unc: jnp.ndarray  # reversed-time re-entry buffer
+    tau_bar_out_unc: jnp.ndarray  # reversed-time exit buffer
+    tau_in: jnp.ndarray  # normal time, max(ξ - τ̄_IN, 0) (`solver.jl:82`)
+    tau_out: jnp.ndarray  # normal time, max(ξ - τ̄_OUT, 0)
+    bankrun: jnp.ndarray  # bool
+    status: jnp.ndarray  # int32 Status code
+    converged: jnp.ndarray  # bool (reference `SolvedModel.converged`)
+    tolerance: jnp.ndarray  # achieved |AW(ξ)-κ| (Inf when no root)
+    tau_grid: jnp.ndarray  # (n,) hazard grid on [0, η]
+    hr: jnp.ndarray  # (n,) hazard rate h(τ̄)
+    aw_cum: jnp.ndarray  # (n,) cumulative aggregate withdrawals AW(t)
+    aw_out: jnp.ndarray  # (n,) exits
+    aw_in: jnp.ndarray  # (n,) re-entries
+    aw_max: jnp.ndarray  # max of aw_cum (reference `AW_max`)

@@ -1,0 +1,8 @@
+"""Comparative-statics sweeps (reference `scripts/1_baseline.jl:137-285`)."""
+
+from sbr_tpu.sweeps.baseline_sweeps import (
+    GridSweepResult,
+    USweepResult,
+    beta_u_grid,
+    u_sweep,
+)

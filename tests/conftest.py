@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Forces an 8-device virtual CPU platform BEFORE jax backend init so
+multi-chip sharding paths (mesh tests) execute without TPU hardware, and
+enables x64 — the reference's correctness envelope is machine-eps float64
+(`src/baseline/learning.jl:43,51`).
+
+Note: this image's axon sitecustomize force-registers the TPU plugin and
+overrides the JAX_PLATFORMS env var, so the platform must be pinned via
+jax.config after import (verified: env alone is ignored).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,108 @@
+"""Independent high-precision CPU oracle for the baseline pipeline.
+
+A deliberately boring scipy implementation of the same mathematics the
+reference solves (closed-form logistic Stage 1, adaptive quadrature for the
+hazard normalization `src/baseline/solver.jl:172-182`, brentq root-finding for
+buffers `solver.jl:211-264` and for ξ `solver.jl:308-376`). Accuracy ~1e-10,
+so agreement of the TPU framework with this oracle to 1e-6 is the BASELINE.md
+CPU-match criterion without needing a Julia runtime in the image.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+from scipy.integrate import quad
+from scipy.optimize import brentq
+
+
+def G(t, beta, x0):
+    return x0 / (x0 + (1.0 - x0) * np.exp(-beta * np.asarray(t, dtype=float)))
+
+
+def g(t, beta, x0):
+    Gt = G(t, beta, x0)
+    return beta * Gt * (1.0 - Gt)
+
+
+@dataclasses.dataclass
+class OracleSolution:
+    xi: float
+    tau_bar_in: float
+    tau_bar_out: float
+    bankrun: bool
+    aw_max: float
+    hr_max: float
+
+
+def hazard_fn(p, lam, beta, x0, eta):
+    """Returns h(τ̄) as a callable using adaptive quadrature."""
+
+    def eg(s):
+        return np.exp(lam * s) * g(s, beta, x0)
+
+    int_eta = quad(eg, 0.0, eta, limit=200)[0]
+
+    def h(tau):
+        i = quad(eg, 0.0, tau, limit=200)[0]
+        return (p * np.exp(lam * tau) * g(tau, beta, x0)) / (p * i + (1.0 - p) * int_eta)
+
+    return h
+
+
+def solve_oracle(beta=1.0, x0=1e-4, u=0.1, p=0.5, kappa=0.6, lam=0.01, eta=15.0, tspan_end=None, n_scan=4000):
+    """Full baseline solve: hazard crossings -> buffers -> ξ -> AW_max."""
+    if tspan_end is None:
+        tspan_end = 2.0 * eta
+    h = hazard_fn(p, lam, beta, x0, eta)
+
+    taus = np.linspace(0.0, eta, n_scan)
+    hvals = np.array([h(t) for t in taus])
+    above = hvals > u
+
+    if not above.any():
+        return OracleSolution(np.nan, tspan_end, tspan_end, False, np.nan, hvals.max())
+
+    # first up-crossing
+    up = np.where(~above[:-1] & above[1:])[0]
+    if len(up):
+        i = up[0]
+        tau_in = brentq(lambda t: h(t) - u, taus[i], taus[i + 1], xtol=1e-13)
+    else:
+        tau_in = taus[np.argmax(above)]
+    # last down-crossing
+    dn = np.where(above[:-1] & ~above[1:])[0]
+    if len(dn):
+        i = dn[-1]
+        tau_out = brentq(lambda t: h(t) - u, taus[i], taus[i + 1], xtol=1e-13)
+    else:
+        tau_out = taus[len(above) - 1 - np.argmax(above[::-1])]
+
+    if tau_in == tau_out:
+        return OracleSolution(np.nan, tau_in, tau_out, False, np.nan, hvals.max())
+
+    def aw(xi):
+        return G(min(xi, tau_out), beta, x0) - G(min(xi, tau_in), beta, x0) - kappa
+
+    if aw(tau_in) * aw(tau_out) > 0:
+        return OracleSolution(np.nan, tau_in, tau_out, False, np.nan, hvals.max())
+
+    xi = brentq(aw, tau_in, tau_out, xtol=1e-14)
+
+    # first-crossing (slope) validation: withdrawal-path slope at ξ
+    slope = g(min(xi, tau_out), beta, x0) - g(min(xi, tau_in), beta, x0)
+    if slope < 0:
+        return OracleSolution(np.nan, tau_in, tau_out, False, np.nan, hvals.max())
+
+    # AW_max over the [0, eta] grid (reference evaluates on the HR grid,
+    # `solver.jl:495-532`)
+    tgrid = np.linspace(0.0, eta, 20001)
+    t_in_con = min(tau_in, xi)
+    t_out_con = min(tau_out, xi)
+    s_in = tgrid - xi + t_in_con
+    aw_in = np.where(s_in >= 0, G(np.maximum(s_in, 0.0), beta, x0), 0.0)
+    s_out = tgrid - xi + t_out_con
+    aw_out = np.where(s_out >= 0, G(np.maximum(s_out, 0.0), beta, x0), 0.0)
+    aw_cum = aw_out - aw_in + G(0.0, beta, x0)
+    return OracleSolution(xi, tau_in, tau_out, True, aw_cum.max(), hvals.max())

@@ -1,0 +1,156 @@
+"""Baseline pipeline gate tests (SURVEY §7.2 step 2).
+
+The oracle in `oracle.py` is an independent scipy implementation accurate to
+~1e-10; agreement to 1e-6 is the BASELINE.md Figure-3 CPU-match criterion.
+Reference workload parameters come from `scripts/1_baseline.jl:34-44,106,118`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline, with_overrides
+from sbr_tpu.baseline.solver import solve_equilibrium_core
+from sbr_tpu.models.params import SolverConfig
+from sbr_tpu.models.results import Status
+
+from oracle import solve_oracle
+
+TOL = 1e-6
+
+
+def _solve_jax(m, config=SolverConfig()):
+    ls = solve_learning(m.learning, config)
+    return solve_equilibrium_baseline(ls, m.economic, config)
+
+
+def _assert_matches_oracle(m, config=SolverConfig()):
+    res = _solve_jax(m, config)
+    orc = solve_oracle(
+        beta=m.learning.beta,
+        x0=m.learning.x0,
+        u=m.economic.u,
+        p=m.economic.p,
+        kappa=m.economic.kappa,
+        lam=m.economic.lam,
+        eta=m.economic.eta,
+        tspan_end=m.learning.tspan[1],
+    )
+    assert bool(res.bankrun) == orc.bankrun
+    if orc.bankrun:
+        assert abs(float(res.xi) - orc.xi) < TOL, (float(res.xi), orc.xi)
+        assert abs(float(res.tau_bar_in_unc) - orc.tau_bar_in) < TOL
+        assert abs(float(res.tau_bar_out_unc) - orc.tau_bar_out) < TOL
+        assert abs(float(res.aw_max) - orc.aw_max) < 1e-5
+    else:
+        assert np.isnan(float(res.xi))
+    return res, orc
+
+
+def test_figure3_main_equilibrium():
+    """Gate: β=1, η_bar=15, u=0.1, p=0.5, κ=0.6, λ=0.01 (`1_baseline.jl:34-44`)."""
+    m = make_model_params()
+    res, orc = _assert_matches_oracle(m)
+    assert bool(res.converged)
+    assert int(res.status) == Status.RUN
+    # derived normal-time quantities (`solver.jl:82-83`)
+    assert abs(float(res.tau_in) - max(orc.xi - orc.tau_bar_in, 0.0)) < TOL
+    assert abs(float(res.tau_out) - max(orc.xi - orc.tau_bar_out, 0.0)) < TOL
+
+
+def test_figure3bis_fast_communication():
+    """β=3 via copy-with-overrides — η stays pinned at 15 (`1_baseline.jl:106`)."""
+    base = make_model_params()
+    m = with_overrides(base, beta=3.0)
+    assert m.economic.eta == 15.0  # the copy-ctor quirk (model.jl:189-211)
+    _assert_matches_oracle(m)
+
+
+def test_figure3ter_low_u():
+    m = with_overrides(make_model_params(), u=0.01)
+    _assert_matches_oracle(m)
+
+
+def test_no_run_when_u_above_hazard_max():
+    """u above max h ⇒ buffers coincide at tspan end ⇒ trivially no run
+    (`solver.jl:221-223,429-433`)."""
+    m = with_overrides(make_model_params(), u=5.0)
+    res = _solve_jax(m)
+    assert not bool(res.bankrun)
+    assert int(res.status) == Status.NO_CROSSING
+    assert bool(res.converged)  # trivial case counts as converged
+    assert float(res.tolerance) == 0.0
+    assert np.isnan(float(res.xi))
+    assert np.isnan(float(res.aw_max))
+
+
+def test_no_root_when_kappa_unreachable():
+    """κ above the reachable AW range ⇒ bisection finds no root ⇒ NaN
+    (`solver.jl:316-324` non-convergence path)."""
+    m = with_overrides(make_model_params(), kappa=0.99, u=0.2)
+    res = _solve_jax(m)
+    orc = solve_oracle(u=0.2, kappa=0.99)
+    assert not orc.bankrun
+    assert not bool(res.bankrun)
+    assert int(res.status) in (Status.NO_ROOT, Status.NO_CROSSING)
+    assert not bool(res.converged) or int(res.status) == Status.NO_CROSSING
+
+
+def test_vmap_over_u_matches_scalar():
+    """The u-sweep unit: Stage 1 shared, Stages 2-3 vmapped (`1_baseline.jl:169`)."""
+    m = make_model_params()
+    config = SolverConfig()
+    ls = solve_learning(m.learning, config)
+    u_vals = jnp.asarray([0.01, 0.05, 0.1, 0.15, 0.5])
+    e = m.economic
+
+    batched = jax.vmap(
+        lambda u: solve_equilibrium_core(
+            ls, u, e.p, e.kappa, e.lam, e.eta, m.learning.tspan[1], config
+        )
+    )(u_vals)
+
+    for i, u in enumerate(np.asarray(u_vals)):
+        single = solve_equilibrium_core(
+            ls, u, e.p, e.kappa, e.lam, e.eta, m.learning.tspan[1], config
+        )
+        np.testing.assert_allclose(
+            np.asarray(batched.xi)[i], float(single.xi), rtol=0, atol=1e-12, equal_nan=True
+        )
+        assert int(np.asarray(batched.status)[i]) == int(single.status)
+
+
+def test_jit_compiles_and_matches_eager():
+    m = make_model_params()
+    config = SolverConfig()
+    ls = solve_learning(m.learning, config)
+    e = m.economic
+
+    fn = jax.jit(
+        lambda u: solve_equilibrium_core(
+            ls, u, e.p, e.kappa, e.lam, e.eta, m.learning.tspan[1], config
+        ).xi
+    )
+    assert abs(float(fn(0.1)) - float(_solve_jax(m).xi)) < 1e-12
+
+
+def test_f32_path_close_to_f64():
+    """The sweep dtype ladder: f32 results within ~1e-3 of f64 (SURVEY §7.3)."""
+    m = make_model_params()
+    config = SolverConfig()
+    ls64 = solve_learning(m.learning, config, dtype=jnp.float64)
+    ls32 = solve_learning(m.learning, config, dtype=jnp.float32)
+    r64 = solve_equilibrium_baseline(ls64, m.economic, config)
+    r32 = solve_equilibrium_baseline(ls32, m.economic, config)
+    assert bool(r32.bankrun) == bool(r64.bankrun)
+    assert abs(float(r32.xi) - float(r64.xi)) < 5e-3
+
+
+def test_aw_at_xi_equals_kappa():
+    """Equilibrium condition AW(ξ)=κ holds on the returned curve."""
+    m = make_model_params()
+    res = _solve_jax(m)
+    ls = solve_learning(m.learning)
+    aw_at_xi = float(ls.cdf_at(res.xi) - ls.cdf_at(jnp.minimum(res.tau_bar_in_unc, res.xi)))
+    assert abs(aw_at_xi - m.economic.kappa) < 1e-9

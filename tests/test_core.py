@@ -1,0 +1,101 @@
+"""Core numerics substrate tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from scipy.integrate import cumulative_trapezoid
+
+from sbr_tpu.core import (
+    bisect,
+    cumtrapz,
+    cumulative_gauss_legendre,
+    first_upcrossing,
+    interp,
+    interp_uniform,
+    last_downcrossing,
+    rk4,
+    threshold_crossings,
+)
+
+
+def test_interp_matches_numpy():
+    xp = np.linspace(0.0, 3.0, 57)
+    fp = np.sin(xp) + 0.3 * xp
+    x = np.linspace(-0.5, 3.5, 201)  # includes out-of-range (clamped)
+    got = np.asarray(interp(x, xp, fp))
+    want = np.interp(x, xp, fp)
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
+def test_interp_uniform_matches_general():
+    t0, t1, n = 0.0, 30.0, 512
+    xp = np.linspace(t0, t1, n)
+    fp = np.cos(xp)
+    x = np.linspace(-1.0, 31.0, 777)
+    got = np.asarray(interp_uniform(x, t0, xp[1] - xp[0], jnp.asarray(fp)))
+    want = np.interp(x, xp, fp)
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+
+
+def test_cumtrapz_matches_scipy():
+    x = np.sort(np.random.default_rng(0).uniform(0, 10, 300))
+    y = np.exp(-0.3 * x) * np.sin(x)
+    got = np.asarray(cumtrapz(jnp.asarray(y), x=jnp.asarray(x)))
+    want = cumulative_trapezoid(y, x, initial=0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
+
+
+def test_cumulative_gauss_legendre_exact():
+    grid = jnp.linspace(0.0, 5.0, 64)
+    got = np.asarray(cumulative_gauss_legendre(lambda t: jnp.exp(t), grid, order=8))
+    want = np.exp(np.asarray(grid)) - 1.0
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_threshold_crossings_interior():
+    # hump crossing level 0.5 at exactly t=1 and t=3 for y = 1-(t-2)^2/... pick
+    x = np.linspace(0.0, 4.0, 4001)
+    y = 1.0 - (x - 2.0) ** 2 / 2.0  # crosses 0.5 at 1 and 3
+    t_in, t_out = threshold_crossings(jnp.asarray(x), jnp.asarray(y), 0.5, 99.0)
+    assert abs(float(t_in) - 1.0) < 1e-5
+    assert abs(float(t_out) - 3.0) < 1e-5
+
+
+def test_threshold_crossings_boundaries():
+    x = jnp.linspace(0.0, 1.0, 100)
+    y_low = jnp.zeros(100)
+    t_in, t_out = threshold_crossings(x, y_low, 0.5, 42.0)
+    assert float(t_in) == 42.0 and float(t_out) == 42.0
+    y_high = jnp.ones(100)
+    t_in, t_out = threshold_crossings(x, y_high, 0.5, 42.0)
+    assert float(t_in) == 0.0 and float(t_out) == 1.0
+
+
+def test_crossing_fallbacks_partial():
+    # starts above, single down-crossing: first_up falls back to first above knot
+    x = np.linspace(0.0, 1.0, 101)
+    y = 1.0 - x  # crosses 0.5 at exactly 0.5, starts above
+    t_in = float(first_upcrossing(jnp.asarray(x), jnp.asarray(y), 0.5, 9.0))
+    t_out = float(last_downcrossing(jnp.asarray(x), jnp.asarray(y), 0.5, 9.0))
+    assert t_in == 0.0
+    assert abs(t_out - 0.5) < 1e-12
+
+
+def test_bisect_root():
+    f = lambda x: x**3 - 2.0
+    got = float(bisect(f, jnp.asarray(0.0), jnp.asarray(2.0), num_iters=90))
+    assert abs(got - 2.0 ** (1.0 / 3.0)) < 1e-14
+
+
+def test_bisect_vmappable():
+    targets = jnp.linspace(1.0, 8.0, 16)
+    roots = jax.vmap(lambda c: bisect(lambda x: x**2 - c, 0.0, 10.0, num_iters=80))(targets)
+    np.testing.assert_allclose(np.asarray(roots), np.sqrt(np.asarray(targets)), rtol=1e-12)
+
+
+def test_rk4_logistic_vs_closed_form():
+    beta, x0 = 1.3, 1e-4
+    ts = jnp.linspace(0.0, 20.0, 2001)
+    ys = rk4(lambda t, y, a: a * y * (1 - y), jnp.asarray(x0), ts, args=beta, substeps=2)
+    want = x0 / (x0 + (1 - x0) * np.exp(-beta * np.asarray(ts)))
+    np.testing.assert_allclose(np.asarray(ys), want, atol=1e-10)

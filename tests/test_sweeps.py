@@ -1,0 +1,75 @@
+"""Sweep tests: Figure-4 u-sweep and Figure-5 β×u grid, including the
+8-virtual-device mesh path (SURVEY §7.2 step 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline, with_overrides
+from sbr_tpu.models.params import SolverConfig
+from sbr_tpu.models.results import Status
+from sbr_tpu.sweeps import beta_u_grid, u_sweep
+
+
+def test_u_sweep_matches_scalar_solves():
+    m = make_model_params()
+    cfg = SolverConfig()
+    ls = solve_learning(m.learning, cfg)
+    u_values = np.linspace(0.001, 0.2, 40)
+    res = u_sweep(ls, u_values, m.economic, cfg)
+
+    for i in [0, 7, 20, 39]:
+        mi = with_overrides(m, u=float(u_values[i]))
+        single = solve_equilibrium_baseline(ls, mi.economic, cfg)
+        np.testing.assert_allclose(
+            float(res.collapse_times[i]), float(single.xi), atol=1e-12, equal_nan=True
+        )
+        np.testing.assert_allclose(
+            float(res.max_withdrawals[i]), float(single.aw_max), atol=1e-12, equal_nan=True
+        )
+        assert int(res.status[i]) == int(single.status)
+
+
+def test_u_sweep_no_run_region_is_nan():
+    """High-u tail must be NaN with NO_* status — the region the reference
+    fills via early termination (`1_baseline.jl:147-163`)."""
+    m = make_model_params()
+    ls = solve_learning(m.learning)
+    res = u_sweep(ls, np.linspace(0.15, 0.5, 16), m.economic)
+    assert np.isnan(np.asarray(res.max_withdrawals)[-1])
+    assert int(np.asarray(res.status)[-1]) != Status.RUN
+
+
+def test_beta_u_grid_matches_cellwise():
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=1024)
+    betas = np.array([0.5, 1.0, 2.0, 4.0])
+    us = np.linspace(0.01, 0.3, 8)
+    grid = beta_u_grid(betas, us, m, cfg)
+    assert grid.xi.shape == (4, 8)
+
+    for bi in [0, 2]:
+        mb = with_overrides(m, beta=float(betas[bi]))
+        assert mb.economic.eta == m.economic.eta  # pinned-η sweep semantics
+        ls = solve_learning(mb.learning, cfg)
+        for ui in [0, 5]:
+            mu = with_overrides(mb, u=float(us[ui]))
+            single = solve_equilibrium_baseline(ls, mu.economic, cfg)
+            np.testing.assert_allclose(
+                float(np.asarray(grid.xi)[bi, ui]), float(single.xi), atol=1e-10, equal_nan=True
+            )
+
+
+def test_beta_u_grid_on_mesh_matches_single_device():
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = jax.sharding.Mesh(devs, ("b", "u"))
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=512)
+    betas = np.linspace(0.5, 4.0, 8)
+    us = np.linspace(0.01, 0.3, 6)
+    plain = beta_u_grid(betas, us, m, cfg)
+    sharded = beta_u_grid(betas, us, m, cfg, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(plain.xi), np.asarray(sharded.xi), atol=1e-12, equal_nan=True
+    )
+    np.testing.assert_array_equal(np.asarray(plain.status), np.asarray(sharded.status))
