@@ -1,0 +1,18 @@
+"""Heterogeneous learning-speed extension (reference
+`src/extensions/heterogeneity/`).
+
+The group axis is a leading array dimension instead of a Julia vector-of-
+interpolants: the coupled K-ODE is one `lax.scan` over a (K,) state, Stage 2
+is `vmap` over group rows, and the weighted aggregate-withdrawal reduction in
+Stage 3 is a dot product that becomes a `psum` when the group axis is sharded
+over the mesh (SURVEY §5.8).
+"""
+
+from sbr_tpu.hetero.learning import solve_learning_hetero
+from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
+
+__all__ = [
+    "solve_learning_hetero",
+    "solve_equilibrium_hetero",
+    "get_aw_hetero",
+]

@@ -1,0 +1,219 @@
+"""Stages 2+3 for K heterogeneous groups (reference
+`src/extensions/heterogeneity/heterogeneity_solver.jl`).
+
+Structure vs the reference:
+
+- Per-group hazard rates: the reference loops groups calling the baseline
+  `hazard_rate` (`heterogeneity_solver.jl:255`); here all K rows compute in
+  one broadcast cumulative trapezoid on the shared [0, η] grid.
+- Per-group buffers: `vmap` of the baseline crossing detector over group rows
+  (`heterogeneity_solver.jl:258-263`).
+- ξ bisection on the dist-weighted AW (`compute_ξ_hetero`,
+  `heterogeneity_solver.jl:48-144`): bracket [0, 2·max τ̄_OUT], start from the
+  dist-weighted midpoint guess, fixed halvings; the AW evaluation reduces the
+  group axis with a dot product (a psum under a sharded group axis).
+- First-crossing validation (`is_valid_equilibrium_hetero`,
+  `heterogeneity_solver.jl:175-210`): the reference's backward scan for a
+  down-crossing of κ before ξ* becomes a masked boolean-transition reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sbr_tpu.baseline.solver import _root_tol
+from sbr_tpu.core.integrate import cumtrapz
+from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
+from sbr_tpu.models.params import EconomicParams, SolverConfig
+from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero, Status
+
+
+def hazard_rates_hetero(p, lam, lsh: LearningSolutionHetero, eta, config: SolverConfig):
+    """All K hazard rates on a shared static [0, η] grid.
+
+    h_k(τ̄) = p·e^{λτ̄}·g_k(τ̄) / (p·∫₀^τ̄ e^{λs}g_k ds + (1-p)·∫₀^η e^{λs}g_k ds)
+
+    (baseline formula applied per group, `heterogeneity_solver.jl:255` →
+    `solver.jl:153-185`). Returns (tau_grid (n,), hrs (K, n)).
+    """
+    dtype = lsh.cdfs.dtype
+    eta = jnp.asarray(eta, dtype=dtype)
+    p = jnp.asarray(p, dtype=dtype)
+    lam = jnp.asarray(lam, dtype=dtype)
+    tau_grid = jnp.linspace(jnp.zeros((), dtype), eta, config.n_grid)
+
+    g = lsh.pdf_at(tau_grid)  # (K, n)
+    eg = jnp.exp(lam * tau_grid)[None, :] * g
+    integ = cumtrapz(eg, x=tau_grid)  # (K, n)
+    int_eta = integ[:, -1:]
+    hrs = (p * jnp.exp(lam * tau_grid)[None, :] * g) / (p * integ + (1.0 - p) * int_eta)
+    return tau_grid, hrs
+
+
+def _cdf_rows_at(lsh: LearningSolutionHetero, t):
+    """G_k(t_k) for per-group times t (K,): row-wise interpolation."""
+    return jax.vmap(lambda row, tk: jnp.interp(tk, lsh.grid, row))(lsh.cdfs, t)
+
+
+def compute_xi_hetero(
+    tau_bar_in_uncs,
+    tau_bar_out_uncs,
+    lsh: LearningSolutionHetero,
+    kappa,
+    config: SolverConfig = SolverConfig(),
+):
+    """Bisection for the weighted AW root (`compute_ξ_hetero`,
+    `heterogeneity_solver.jl:48-144`).
+
+    Returns (xi, err, root_ok, is_increasing, first_crossing_ok).
+    """
+    dtype = lsh.cdfs.dtype
+    kappa = jnp.asarray(kappa, dtype=dtype)
+    dist = lsh.dist
+
+    def aw_of(xi):
+        t_out = jnp.minimum(tau_bar_out_uncs, xi)
+        t_in = jnp.minimum(tau_bar_in_uncs, xi)
+        return jnp.dot(dist, _cdf_rows_at(lsh, t_out) - _cdf_rows_at(lsh, t_in))
+
+    # Reference bracket/guess: ξ∈[0, 2·max τ̄_OUT], ξ₀ = Σ dist·(τ̄_IN+τ̄_OUT)/2
+    # (`heterogeneity_solver.jl:53-60`).
+    lo = jnp.zeros((), dtype=dtype)
+    hi = 2.0 * jnp.max(tau_bar_out_uncs)
+    x0 = jnp.dot(dist, 0.5 * (tau_bar_in_uncs + tau_bar_out_uncs))
+
+    xi = bisect(lambda x: aw_of(x) - kappa, lo, hi, num_iters=config.bisect_iters, x0=x0)
+
+    aw = aw_of(xi)
+    err = jnp.abs(aw - kappa)
+    root_ok = err <= _root_tol(dtype)
+
+    # Slope check with ε = local grid spacing (`heterogeneity_solver.jl:77-81`
+    # — uniform grid here, so ε = dt).
+    eps = lsh.dt
+    t_out = jnp.minimum(tau_bar_out_uncs, xi)
+    t_in = jnp.minimum(tau_bar_in_uncs, xi)
+    aw_eps = jnp.dot(dist, _cdf_rows_at(lsh, t_out + eps) - _cdf_rows_at(lsh, t_in + eps))
+    is_increasing = aw_eps >= aw
+
+    first_ok = _first_crossing_ok(xi, tau_bar_in_uncs, lsh, kappa)
+    return xi, err, root_ok, is_increasing, first_ok
+
+
+def _first_crossing_ok(xi_star, tau_bar_in_uncs, lsh: LearningSolutionHetero, kappa):
+    """Reject roots that are not the FIRST up-crossing of κ
+    (`is_valid_equilibrium_hetero`, `heterogeneity_solver.jl:175-210`).
+
+    AW(t; ξ*) may be multimodal across groups; if the path dips back below κ
+    anywhere before ξ*, an earlier crossing must exist and the root is a false
+    equilibrium. The reference's backward scan becomes a masked reduction over
+    boolean transitions on the learning grid.
+    """
+    t = lsh.grid  # all groups share the grid (`heterogeneity_solver.jl:178`)
+    tau_i = jnp.maximum(0.0, xi_star - tau_bar_in_uncs)  # (K,) τ_I_k
+    # AW_path(t) = Σ_k dist_k·(G_k(t) − G_k(max(0, t − τ_I_k)))
+    shifted = jnp.maximum(0.0, t[None, :] - tau_i[:, None])  # (K, n)
+    g_shift = _cdf_rows_at(lsh, shifted)
+    aw_path = jnp.einsum("k,kn->n", lsh.dist, lsh.cdfs - g_shift)
+
+    in_range = t <= xi_star
+    above = jnp.logical_and(aw_path > kappa, in_range)
+    # Down-crossing entirely inside the masked range ⇒ invalid.
+    down = jnp.logical_and(jnp.logical_and(above[:-1], ~above[1:]), in_range[1:])
+    return ~jnp.any(down)
+
+
+def solve_equilibrium_hetero(
+    lsh: LearningSolutionHetero,
+    econ: EconomicParams,
+    config: SolverConfig = SolverConfig(),
+    tspan_end=None,
+) -> EquilibriumResultHetero:
+    """Full hetero equilibrium (`solve_equilibrium_hetero`,
+    `heterogeneity_solver.jl:241-293`), branchless with status codes."""
+    dtype = lsh.cdfs.dtype
+    if tspan_end is None:
+        tspan_end = lsh.grid[-1]
+    u = jnp.asarray(econ.u, dtype=dtype)
+    nan = jnp.asarray(jnp.nan, dtype=dtype)
+
+    tau_grid, hrs = hazard_rates_hetero(econ.p, econ.lam, lsh, econ.eta, config)
+
+    default = jnp.asarray(tspan_end, dtype=dtype)
+    tau_in_uncs = jax.vmap(lambda hr: first_upcrossing(tau_grid, hr, u, default))(hrs)
+    tau_out_uncs = jax.vmap(lambda hr: last_downcrossing(tau_grid, hr, u, default))(hrs)
+
+    # No group can optimally exit (`heterogeneity_solver.jl:266-272`).
+    no_crossing = jnp.all(tau_in_uncs == tau_out_uncs)
+
+    xi_c, err, root_ok, increasing, first_ok = compute_xi_hetero(
+        tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config
+    )
+
+    valid = jnp.logical_and(root_ok, jnp.logical_and(increasing, first_ok))
+    run = jnp.logical_and(~no_crossing, valid)
+    status = jnp.where(
+        no_crossing,
+        Status.NO_CROSSING,
+        jnp.where(
+            ~root_ok,
+            Status.NO_ROOT,
+            jnp.where(jnp.logical_and(increasing, first_ok), Status.RUN, Status.FALSE_EQ),
+        ),
+    ).astype(jnp.int32)
+
+    xi = jnp.where(run, xi_c, nan)
+    converged = jnp.logical_or(no_crossing, run)
+    tolerance = jnp.where(
+        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
+    )
+
+    return EquilibriumResultHetero(
+        xi=xi,
+        tau_bar_in_uncs=tau_in_uncs,
+        tau_bar_out_uncs=tau_out_uncs,
+        hrs=hrs,
+        tau_grid=tau_grid,
+        bankrun=run,
+        status=status,
+        converged=converged,
+        tolerance=tolerance,
+    )
+
+
+def get_aw_hetero(result: EquilibriumResultHetero, lsh: LearningSolutionHetero) -> AWHetero:
+    """Group-decomposed AW curves on the learning grid (`get_AW_hetero`,
+    `heterogeneity_solver.jl:316-375`).
+
+    AW_k(t) = G_k(max(0, t−ξ+τ̄_OUT_k^CON)) − G_k(max(0, t−ξ+τ̄_IN_k^CON)),
+    each branch zeroed before its own start; total is the dist-weighted sum.
+    NaN ξ (no run) propagates NaN curves, mirroring the reference returning
+    `nothing` (`heterogeneity_solver.jl:317-319`).
+    """
+    t = lsh.grid
+    xi = result.xi
+    nan_lane = jnp.isnan(xi)
+    tau_in_con = jnp.minimum(result.tau_bar_in_uncs, xi)  # (K,)
+    tau_out_con = jnp.minimum(result.tau_bar_out_uncs, xi)
+
+    def branch(tau_con):
+        shift = t[None, :] - xi + tau_con[:, None]  # (K, n)
+        vals = _cdf_rows_at(lsh, jnp.maximum(shift, 0.0))
+        # shift>=0 is False for NaN, which would silently zero no-run lanes;
+        # re-inject NaN so no-run propagates as the sentinel, not as "zero
+        # withdrawals".
+        return jnp.where(nan_lane, jnp.nan, jnp.where(shift >= 0, vals, 0.0))
+
+    aw_in_groups = branch(tau_in_con)
+    aw_out_groups = branch(tau_out_con)
+    aw_groups = aw_out_groups - aw_in_groups
+    aw_cum = jnp.einsum("k,kn->n", lsh.dist, aw_groups)
+    return AWHetero(
+        t_grid=t,
+        aw_cum=aw_cum,
+        aw_out_groups=aw_out_groups,
+        aw_in_groups=aw_in_groups,
+        aw_groups=aw_groups,
+        aw_max=jnp.max(aw_cum),
+    )

@@ -1,0 +1,16 @@
+"""Positive-interest-rate extension (reference
+`src/extensions/interest_rates/`).
+
+Design (mirroring the reference's substitution trick,
+`interest_rate_solver.jl:26-29`): solve the HJB value function V(τ̄) on the
+hazard grid, replace the buffer threshold curve with the effective hazard
+h − rV, and run the baseline Stages 2-3 machinery unchanged. Because V at
+r=0 makes h − rV ≡ h, the r=0 fallback branch of the reference
+(`interest_rate_solver.jl:89-101`) is the identity here — no branch needed,
+which keeps the solver vmappable over r for policy sweeps.
+"""
+
+from sbr_tpu.interest.solver import solve_equilibrium_interest
+from sbr_tpu.interest.value_function import solve_value_function
+
+__all__ = ["solve_equilibrium_interest", "solve_value_function"]

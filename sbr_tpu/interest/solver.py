@@ -1,0 +1,128 @@
+"""Equilibrium with positive interest rates (reference
+`interest_rate_solver.jl:51-150`).
+
+Pipeline: baseline hazard → HJB value function on the hazard grid → effective
+hazard h − rV for the buffer crossings → baseline ξ bisection and AW curves
+unchanged. The reference branches on r>0 (`interest_rate_solver.jl:71-101`);
+here V is always computed — at r=0 the effective hazard is identically h, so
+the baseline fallback is algebraic rather than a code path, and r stays a
+traced value (vmappable for (β,u,r) policy sweeps, BASELINE.md stretch
+config).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from sbr_tpu.baseline.solver import _hazard_parts, compute_xi, get_aw, optimal_buffer
+from sbr_tpu.interest.value_function import solve_value_function
+from sbr_tpu.models.params import EconomicParamsInterest, SolverConfig
+from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
+
+
+@struct.dataclass
+class EquilibriumResultInterest:
+    """Reference `SolvedModelInterest` (`interest_rate_model.jl:200-245`):
+    baseline result + the value function and effective hazard on tau_grid."""
+
+    base: EquilibriumResult
+    v: jnp.ndarray  # (n,) value function V(τ̄) on tau_grid
+    hr_effective: jnp.ndarray  # (n,) h − rV used for the buffer crossings
+
+
+def solve_equilibrium_interest_core(
+    ls: LearningSolution,
+    u,
+    p,
+    kappa,
+    lam,
+    eta,
+    r,
+    delta,
+    tspan_end,
+    config: SolverConfig = SolverConfig(),
+) -> EquilibriumResultInterest:
+    """Scalar-parameter interest-rate solve — the vmap unit for policy sweeps."""
+    dtype = ls.cdf.dtype
+    u = jnp.asarray(u, dtype=dtype)
+    r = jnp.asarray(r, dtype=dtype)
+    nan = jnp.asarray(jnp.nan, dtype=dtype)
+
+    tau_grid, hr, _, _ = _hazard_parts(p, lam, ls, eta, config)
+    v = solve_value_function(tau_grid, hr, delta, r, u, config)
+    hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
+
+    # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`);
+    # no closed-form refinement — V is known only on the grid.
+    tau_in_unc, tau_out_unc = optimal_buffer(u, tau_grid, hr_eff, tspan_end, hazard_at=None)
+    no_crossing = tau_in_unc == tau_out_unc
+
+    # ξ and AW use the baseline machinery on the word-of-mouth CDF unchanged
+    # (`interest_rate_solver.jl:122`, `get_AW_functions_interest!:161-184`).
+    xi_c, err, root_ok, increasing = compute_xi(tau_in_unc, tau_out_unc, ls, kappa, config)
+
+    run = jnp.logical_and(~no_crossing, jnp.logical_and(root_ok, increasing))
+    status = jnp.where(
+        no_crossing,
+        Status.NO_CROSSING,
+        jnp.where(
+            ~root_ok,
+            Status.NO_ROOT,
+            jnp.where(increasing, Status.RUN, Status.FALSE_EQ),
+        ),
+    ).astype(jnp.int32)
+
+    xi = jnp.where(run, xi_c, nan)
+    converged = jnp.logical_or(no_crossing, run)
+    tolerance = jnp.where(
+        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
+    )
+
+    aw_cum, aw_out, aw_in = get_aw(xi, tau_in_unc, tau_out_unc, tau_grid, ls)
+    aw_cum = jnp.where(run, aw_cum, nan)
+    aw_out = jnp.where(run, aw_out, nan)
+    aw_in = jnp.where(run, aw_in, nan)
+
+    base = EquilibriumResult(
+        xi=xi,
+        tau_bar_in_unc=tau_in_unc,
+        tau_bar_out_unc=tau_out_unc,
+        tau_in=jnp.maximum(xi - tau_in_unc, 0.0),
+        tau_out=jnp.maximum(xi - tau_out_unc, 0.0),
+        bankrun=run,
+        status=status,
+        converged=converged,
+        tolerance=tolerance,
+        tau_grid=tau_grid,
+        hr=hr,
+        aw_cum=aw_cum,
+        aw_out=aw_out,
+        aw_in=aw_in,
+        aw_max=jnp.where(run, jnp.max(aw_cum), nan),
+    )
+    return EquilibriumResultInterest(base=base, v=v, hr_effective=hr_eff)
+
+
+def solve_equilibrium_interest(
+    ls: LearningSolution,
+    econ: EconomicParamsInterest,
+    config: SolverConfig = SolverConfig(),
+    tspan_end=None,
+) -> EquilibriumResultInterest:
+    """Convenience entry mirroring `solve_equilibrium_interest(lr, econ, model)`
+    (`interest_rate_solver.jl:51`)."""
+    if tspan_end is None:
+        tspan_end = ls.grid[-1]
+    return solve_equilibrium_interest_core(
+        ls,
+        econ.u,
+        econ.p,
+        econ.kappa,
+        econ.lam,
+        econ.eta,
+        econ.r,
+        econ.delta,
+        tspan_end,
+        config,
+    )

@@ -73,6 +73,37 @@ class LearningSolution:
 
 
 @struct.dataclass
+class LearningSolutionHetero:
+    """K-group Stage-1 output (reference `LearningResultsHetero`,
+    `heterogeneity_model.jl:195-211`).
+
+    The group axis is the leading dimension of ``cdfs``/``pdfs`` — the
+    reference's vector of per-group interpolation objects
+    (`heterogeneity_learning.jl:80-89`) collapsed into batched arrays sharing
+    one static grid (the reference's groups also share the adaptive grid).
+    """
+
+    grid: jnp.ndarray  # (n,) shared uniform time grid over tspan
+    cdfs: jnp.ndarray  # (K, n) per-group G_k(t)
+    pdfs: jnp.ndarray  # (K, n) per-group g_k(t)
+    t0: jnp.ndarray  # scalar, grid start
+    dt: jnp.ndarray  # scalar, grid spacing
+    betas: jnp.ndarray  # (K,) group learning rates
+    dist: jnp.ndarray  # (K,) group weights (simplex)
+
+    def cdf_at(self, t):
+        """G_k at time(s) t: output shape (K, *t.shape)."""
+        from sbr_tpu.core.interp import interp_uniform
+
+        return interp_uniform(t, self.t0, self.dt, self.cdfs)
+
+    def pdf_at(self, t):
+        from sbr_tpu.core.interp import interp_uniform
+
+        return interp_uniform(t, self.t0, self.dt, self.pdfs)
+
+
+@struct.dataclass
 class EquilibriumResult:
     """Stage-2/3 output (reference `SolvedModel`, `solver.jl:55-109`).
 
@@ -96,3 +127,37 @@ class EquilibriumResult:
     aw_out: jnp.ndarray  # (n,) exits
     aw_in: jnp.ndarray  # (n,) re-entries
     aw_max: jnp.ndarray  # max of aw_cum (reference `AW_max`)
+
+
+@struct.dataclass
+class EquilibriumResultHetero:
+    """K-group Stage-2/3 output (reference `SolvedModelHetero`,
+    `heterogeneity_model.jl:238-294`).
+
+    Group-resolved fields carry a leading K axis; ``status`` extends the
+    baseline codes with the hetero-specific first-crossing rejection
+    (`heterogeneity_solver.jl:175-210`), which maps onto FALSE_EQ.
+    """
+
+    xi: jnp.ndarray
+    tau_bar_in_uncs: jnp.ndarray  # (K,)
+    tau_bar_out_uncs: jnp.ndarray  # (K,)
+    hrs: jnp.ndarray  # (K, n) per-group hazard rates on tau_grid
+    tau_grid: jnp.ndarray  # (n,) hazard grid on [0, η]
+    bankrun: jnp.ndarray  # bool
+    status: jnp.ndarray  # int32 Status code
+    converged: jnp.ndarray  # bool
+    tolerance: jnp.ndarray  # achieved |AW(ξ)-κ|
+
+
+@struct.dataclass
+class AWHetero:
+    """Group-decomposed aggregate-withdrawal curves on the learning grid
+    (reference `get_AW_hetero`, `heterogeneity_solver.jl:316-375`)."""
+
+    t_grid: jnp.ndarray  # (n,) learning grid
+    aw_cum: jnp.ndarray  # (n,) Σ_k dist_k · AW_k
+    aw_out_groups: jnp.ndarray  # (K, n)
+    aw_in_groups: jnp.ndarray  # (K, n)
+    aw_groups: jnp.ndarray  # (K, n) net per-group withdrawals
+    aw_max: jnp.ndarray  # scalar

@@ -106,3 +106,177 @@ def solve_oracle(beta=1.0, x0=1e-4, u=0.1, p=0.5, kappa=0.6, lam=0.01, eta=15.0,
     aw_out = np.where(s_out >= 0, G(np.maximum(s_out, 0.0), beta, x0), 0.0)
     aw_cum = aw_out - aw_in + G(0.0, beta, x0)
     return OracleSolution(xi, tau_in, tau_out, True, aw_cum.max(), hvals.max())
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneity oracle (reference `src/extensions/heterogeneity/`)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OracleHeteroSolution:
+    xi: float
+    tau_bar_ins: np.ndarray
+    tau_bar_outs: np.ndarray
+    bankrun: bool
+    cdfs: object  # callable t -> (K,)
+
+
+def solve_hetero_learning_oracle(betas, dist, x0, tspan):
+    """Coupled SI ODE dG_k = (1-G_k)·β_k·(dist·G) via scipy with dense output
+    (`heterogeneity_learning.jl:49-94`)."""
+    from scipy.integrate import solve_ivp
+
+    betas = np.asarray(betas, dtype=float)
+    dist = np.asarray(dist, dtype=float)
+
+    def rhs(t, G):
+        omega = dist @ G
+        return (1.0 - G) * betas * omega
+
+    sol = solve_ivp(
+        rhs,
+        tspan,
+        np.full(len(betas), x0),
+        method="LSODA",
+        rtol=1e-12,
+        atol=1e-14,
+        dense_output=True,
+    )
+    cdfs = sol.sol
+
+    def pdfs(t):
+        Gt = np.clip(cdfs(t), 0.0, 1.0)
+        return (1.0 - Gt) * betas * (dist @ Gt)
+
+    return cdfs, pdfs
+
+
+def solve_hetero_oracle(betas, dist, x0=1e-4, u=0.1, p=0.9, kappa=0.3, lam=0.1, eta_bar=30.0, n_scan=4000):
+    """Full heterogeneity pipeline: per-group hazard/buffers, weighted-AW root
+    at the FIRST up-crossing (`heterogeneity_solver.jl:48-210`)."""
+    betas = np.asarray(betas, dtype=float)
+    dist = np.asarray(dist, dtype=float)
+    K = len(betas)
+    beta_ave = float(betas @ dist)
+    eta = eta_bar / beta_ave
+    tspan = (0.0, 2.0 * eta)
+    cdfs, pdfs = solve_hetero_learning_oracle(betas, dist, x0, tspan)
+
+    taus = np.linspace(0.0, eta, n_scan)
+    tau_ins = np.full(K, tspan[1])
+    tau_outs = np.full(K, tspan[1])
+    for k in range(K):
+        def eg(s, k=k):
+            return np.exp(lam * s) * pdfs(s)[k]
+
+        int_eta = quad(eg, 0.0, eta, limit=400)[0]
+
+        def h(tau, k=k, int_eta=int_eta, eg=eg):
+            i = quad(eg, 0.0, tau, limit=400)[0]
+            return (p * np.exp(lam * tau) * pdfs(tau)[k]) / (p * i + (1.0 - p) * int_eta)
+
+        hvals = np.array([h(t) for t in taus])
+        above = hvals > u
+        if not above.any():
+            continue
+        up = np.where(~above[:-1] & above[1:])[0]
+        if len(up):
+            i = up[0]
+            tau_ins[k] = brentq(lambda t: h(t) - u, taus[i], taus[i + 1], xtol=1e-13)
+        else:
+            tau_ins[k] = taus[np.argmax(above)]
+        dn = np.where(above[:-1] & ~above[1:])[0]
+        if len(dn):
+            i = dn[-1]
+            tau_outs[k] = brentq(lambda t: h(t) - u, taus[i], taus[i + 1], xtol=1e-13)
+        else:
+            tau_outs[k] = taus[len(above) - 1 - np.argmax(above[::-1])]
+
+    if np.all(tau_ins == tau_outs):
+        return OracleHeteroSolution(np.nan, tau_ins, tau_outs, False, cdfs)
+
+    def aw(xi):
+        t_out = np.minimum(tau_outs, xi)
+        t_in = np.minimum(tau_ins, xi)
+        per = np.array([cdfs(t_out[k])[k] - cdfs(t_in[k])[k] for k in range(K)])
+        return float(dist @ per) - kappa
+
+    # First up-crossing of AW(ξ)=κ in [0, 2·max τ̄_OUT] — the root the
+    # reference's first-crossing validation accepts.
+    xis = np.linspace(0.0, 2.0 * tau_outs.max(), 8000)
+    vals = np.array([aw(x) for x in xis])
+    up = np.where((vals[:-1] < 0) & (vals[1:] >= 0))[0]
+    if len(up) == 0:
+        return OracleHeteroSolution(np.nan, tau_ins, tau_outs, False, cdfs)
+    i = up[0]
+    xi = brentq(aw, xis[i], xis[i + 1], xtol=1e-13)
+    return OracleHeteroSolution(xi, tau_ins, tau_outs, True, cdfs)
+
+
+# ---------------------------------------------------------------------------
+# Interest-rate oracle (reference `src/extensions/interest_rates/`)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OracleInterestSolution:
+    xi: float
+    tau_bar_in: float
+    tau_bar_out: float
+    bankrun: bool
+    v_at: object  # callable τ̄ -> V
+
+
+def solve_interest_oracle(
+    beta=1.0, x0=1e-4, u=0.0, p=0.5, kappa=0.6, lam=0.01, eta=15.0, r=0.06, delta=0.1, n_scan=4000
+):
+    """HJB value function + effective-hazard pipeline
+    (`value_function_solver.jl:66-112`, `interest_rate_solver.jl:51-150`)."""
+    from scipy.integrate import solve_ivp
+
+    tspan_end = 2.0 * eta
+    h = hazard_fn(p, lam, beta, x0, eta)
+
+    def hjb(tau, V):
+        ht = h(tau)
+        return (ht + delta) * (1.0 - V[0]) + max(u + r * V[0] - ht, 0.0)
+
+    v0 = (u + delta) / (r + delta)
+    sol = solve_ivp(
+        hjb, (0.0, eta), [v0], method="LSODA", rtol=1e-11, atol=1e-13, dense_output=True
+    )
+    v_at = lambda t: float(sol.sol(t)[0])
+
+    def h_eff(tau):
+        return h(tau) - r * v_at(tau)
+
+    taus = np.linspace(0.0, eta, n_scan)
+    hvals = np.array([h_eff(t) for t in taus])
+    above = hvals > u
+    if not above.any():
+        return OracleInterestSolution(np.nan, tspan_end, tspan_end, False, v_at)
+
+    up = np.where(~above[:-1] & above[1:])[0]
+    if len(up):
+        i = up[0]
+        tau_in = brentq(lambda t: h_eff(t) - u, taus[i], taus[i + 1], xtol=1e-13)
+    else:
+        tau_in = taus[np.argmax(above)]
+    dn = np.where(above[:-1] & ~above[1:])[0]
+    if len(dn):
+        i = dn[-1]
+        tau_out = brentq(lambda t: h_eff(t) - u, taus[i], taus[i + 1], xtol=1e-13)
+    else:
+        tau_out = taus[len(above) - 1 - np.argmax(above[::-1])]
+
+    if tau_in == tau_out:
+        return OracleInterestSolution(np.nan, tau_in, tau_out, False, v_at)
+
+    def aw(xi):
+        return G(min(xi, tau_out), beta, x0) - G(min(xi, tau_in), beta, x0) - kappa
+
+    if aw(tau_in) * aw(tau_out) > 0:
+        return OracleInterestSolution(np.nan, tau_in, tau_out, False, v_at)
+    xi = brentq(aw, tau_in, tau_out, xtol=1e-14)
+    return OracleInterestSolution(xi, tau_in, tau_out, True, v_at)

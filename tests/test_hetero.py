@@ -1,0 +1,138 @@
+"""Heterogeneity extension tests.
+
+Oracles (SURVEY §4): the K=1 degeneracy — one group with dist=[1.0] reduces
+the coupled ODE to the baseline logistic (`heterogeneity_learning.jl:61-66`)
+— and an independent scipy pipeline for the reference's two-group Figure
+configuration (`scripts/2_heterogeneity.jl:38-49`).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu.baseline.learning import logistic_cdf, solve_learning
+from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+from sbr_tpu.hetero import get_aw_hetero, solve_equilibrium_hetero, solve_learning_hetero
+from sbr_tpu.models.params import SolverConfig, make_hetero_params, make_model_params
+from sbr_tpu.models.results import Status
+
+from oracle import solve_hetero_oracle
+
+CONFIG = SolverConfig(n_grid=4096)
+
+
+@pytest.fixture(scope="module")
+def ref_config_solution():
+    """Two-group reference configuration (`2_heterogeneity.jl:38-49`)."""
+    m = make_hetero_params(
+        betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=0.1, p=0.9, kappa=0.3, lam=0.1
+    )
+    lsh = solve_learning_hetero(m.learning, CONFIG)
+    res = solve_equilibrium_hetero(lsh, m.economic, CONFIG)
+    return m, lsh, res
+
+
+class TestHeteroLearning:
+    def test_k1_reduces_to_baseline_logistic(self):
+        """dist=[1.0] ⇒ dG = (1-G)·β·G, the baseline SI ODE."""
+        m = make_hetero_params(betas=[1.0], dist=[1.0], eta_bar=15.0)
+        lsh = solve_learning_hetero(m.learning, CONFIG)
+        exact = logistic_cdf(lsh.grid, 1.0, 1e-4)
+        np.testing.assert_allclose(np.asarray(lsh.cdfs[0]), np.asarray(exact), atol=1e-9)
+
+    def test_two_group_cdfs_match_scipy(self):
+        m = make_hetero_params(
+            betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=0.1, p=0.9, kappa=0.3, lam=0.1
+        )
+        lsh = solve_learning_hetero(m.learning, CONFIG)
+        from oracle import solve_hetero_learning_oracle
+
+        cdfs, _ = solve_hetero_learning_oracle([0.125, 12.5], [0.9, 0.1], 1e-4, m.learning.tspan)
+        # Compare at grid knots where both are solver-exact (off-knot values
+        # add O(h²·G'') linear-interp error ~2e-6 on both sides).
+        knots = np.asarray(lsh.grid)
+        ref = np.clip(cdfs(knots), 0.0, 1.0)
+        np.testing.assert_allclose(np.asarray(lsh.cdfs), ref, atol=1e-9)
+
+    def test_cdfs_monotone_and_bounded(self, ref_config_solution):
+        _, lsh, _ = ref_config_solution
+        cdfs = np.asarray(lsh.cdfs)
+        assert (np.diff(cdfs, axis=1) >= -1e-12).all()
+        assert (cdfs >= 0).all() and (cdfs <= 1).all()
+
+    def test_fast_group_learns_first(self, ref_config_solution):
+        _, lsh, _ = ref_config_solution
+        mid = CONFIG.n_grid // 4
+        assert float(lsh.cdfs[1, mid]) > float(lsh.cdfs[0, mid])
+
+
+class TestHeteroEquilibrium:
+    def test_k1_matches_baseline_solver(self):
+        """One group ≡ baseline pipeline end to end."""
+        mb = make_model_params()
+        ls = solve_learning(mb.learning, CONFIG)
+        base = solve_equilibrium_baseline(ls, mb.economic, CONFIG)
+
+        mh = make_hetero_params(betas=[1.0], dist=[1.0], eta_bar=15.0)
+        lsh = solve_learning_hetero(mh.learning, CONFIG)
+        het = solve_equilibrium_hetero(lsh, mh.economic, CONFIG)
+
+        assert bool(het.bankrun) == bool(base.bankrun)
+        np.testing.assert_allclose(float(het.xi), float(base.xi), atol=2e-5)
+        np.testing.assert_allclose(
+            float(het.tau_bar_in_uncs[0]), float(base.tau_bar_in_unc), atol=2e-5
+        )
+        np.testing.assert_allclose(
+            float(het.tau_bar_out_uncs[0]), float(base.tau_bar_out_unc), atol=2e-5
+        )
+
+    def test_two_group_matches_oracle(self, ref_config_solution):
+        _, _, res = ref_config_solution
+        oracle = solve_hetero_oracle([0.125, 12.5], [0.9, 0.1])
+        assert bool(res.bankrun) == oracle.bankrun
+        np.testing.assert_allclose(float(res.xi), oracle.xi, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(res.tau_bar_in_uncs), oracle.tau_bar_ins, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.tau_bar_out_uncs), oracle.tau_bar_outs, atol=1e-4
+        )
+
+    def test_aw_at_xi_equals_kappa(self, ref_config_solution):
+        m, lsh, res = ref_config_solution
+        xi = res.xi
+        t_out = jnp.minimum(res.tau_bar_out_uncs, xi)
+        t_in = jnp.minimum(res.tau_bar_in_uncs, xi)
+        import jax
+
+        per = jax.vmap(lambda row, t: jnp.interp(t, lsh.grid, row))(lsh.cdfs, t_out) - jax.vmap(
+            lambda row, t: jnp.interp(t, lsh.grid, row)
+        )(lsh.cdfs, t_in)
+        aw = float(jnp.dot(lsh.dist, per))
+        np.testing.assert_allclose(aw, m.economic.kappa, atol=1e-7)
+
+    def test_no_run_when_u_above_hazard(self):
+        """u above every group's hazard peak ⇒ NO_CROSSING, NaN ξ."""
+        m = make_hetero_params(
+            betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=50.0, p=0.9, kappa=0.3, lam=0.1
+        )
+        lsh = solve_learning_hetero(m.learning, CONFIG)
+        res = solve_equilibrium_hetero(lsh, m.economic, CONFIG)
+        assert not bool(res.bankrun)
+        assert int(res.status) == Status.NO_CROSSING
+        assert np.isnan(float(res.xi))
+        # NaN propagates through the AW decomposition (reference returns
+        # `nothing` for no-run, `heterogeneity_solver.jl:317-319`)
+        aw = get_aw_hetero(res, lsh)
+        assert np.isnan(float(aw.aw_max))
+        assert np.isnan(np.asarray(aw.aw_cum)).all()
+
+    def test_aw_decomposition(self, ref_config_solution):
+        m, lsh, res = ref_config_solution
+        aw = get_aw_hetero(res, lsh)
+        # total is the dist-weighted sum of group curves
+        recon = np.einsum("k,kn->n", np.asarray(lsh.dist), np.asarray(aw.aw_groups))
+        np.testing.assert_allclose(np.asarray(aw.aw_cum), recon, atol=1e-12)
+        # peak withdrawal reaches at least κ (a run happened)
+        assert float(aw.aw_max) >= m.economic.kappa - 1e-6
+        assert (np.asarray(aw.aw_groups) >= -1e-9).all()

@@ -1,0 +1,116 @@
+"""Interest-rate extension tests.
+
+Oracles (SURVEY §4): the r=0 ⇒ baseline degeneracy — the reference's own
+implicit regression oracle (`interest_rate_solver.jl:89-101`) — plus an
+independent scipy HJB + effective-hazard pipeline at the reference Figure
+configuration (`scripts/3_interest_rates.jl:37-46`: r=0.06, δ=0.1, u=0).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu.baseline.learning import solve_learning
+from sbr_tpu.baseline.solver import _hazard_parts, solve_equilibrium_baseline
+from sbr_tpu.interest import solve_equilibrium_interest, solve_value_function
+from sbr_tpu.models.params import SolverConfig, make_interest_params
+
+from oracle import solve_interest_oracle
+
+CONFIG = SolverConfig(n_grid=4096)
+
+
+@pytest.fixture(scope="module")
+def ref_solution():
+    """Reference interest configuration (`3_interest_rates.jl:37-46`)."""
+    m = make_interest_params(beta=1.0, eta_bar=15.0, u=0.0, p=0.5, kappa=0.6, lam=0.01, r=0.06, delta=0.1)
+    ls = solve_learning(m.learning, CONFIG)
+    res = solve_equilibrium_interest(ls, m.economic, CONFIG)
+    return m, ls, res
+
+
+class TestValueFunction:
+    def test_boundary_condition(self, ref_solution):
+        m, _, res = ref_solution
+        econ = m.economic
+        expected = (econ.u + econ.delta) / (econ.r + econ.delta)
+        np.testing.assert_allclose(float(res.v[0]), expected, rtol=1e-12)
+
+    def test_matches_scipy_hjb(self, ref_solution):
+        m, _, res = ref_solution
+        oracle = solve_interest_oracle()
+        taus = np.asarray(res.base.tau_grid)
+        v_ref = np.array([oracle.v_at(t) for t in taus])
+        np.testing.assert_allclose(np.asarray(res.v), v_ref, atol=5e-7)
+
+    def test_value_bounded(self, ref_solution):
+        """With u=0 the HJB rest point (h→0, reentry active) is V*=δ/(δ−r);
+        V stays within (0, V*]. (V is NOT monotone: where V>1 and h is large,
+        the (h+δ)(1−V) term turns negative — observed dip ~2e-7 at the hazard
+        peak.)"""
+        m, _, res = ref_solution
+        econ = m.economic
+        v = np.asarray(res.v)
+        v_star = econ.delta / (econ.delta - econ.r)
+        assert (v > 0).all() and (v <= v_star + 1e-9).all()
+
+
+class TestInterestEquilibrium:
+    def test_r0_reduces_to_baseline(self):
+        """r=0 ⇒ h−rV ≡ h ⇒ exact baseline result (`interest_rate_solver.jl:89-101`)."""
+        m = make_interest_params(r=0.0, delta=0.1)  # baseline defaults otherwise
+        ls = solve_learning(m.learning, CONFIG)
+        res_i = solve_equilibrium_interest(ls, m.economic, CONFIG)
+        res_b = solve_equilibrium_baseline(ls, m.economic, CONFIG)
+        # Buffer detection runs on grid-sampled h here vs refined closed-form
+        # hazard in the baseline path, hence 1e-6 not exact-equality.
+        np.testing.assert_allclose(float(res_i.base.xi), float(res_b.xi), atol=1e-6)
+        np.testing.assert_allclose(
+            float(res_i.base.tau_bar_in_unc), float(res_b.tau_bar_in_unc), atol=1e-5
+        )
+        assert bool(res_i.base.bankrun) == bool(res_b.bankrun)
+
+    def test_reference_config_matches_oracle(self, ref_solution):
+        _, _, res = ref_solution
+        oracle = solve_interest_oracle()
+        assert bool(res.base.bankrun) == oracle.bankrun
+        np.testing.assert_allclose(float(res.base.xi), oracle.xi, atol=1e-5)
+        np.testing.assert_allclose(float(res.base.tau_bar_in_unc), oracle.tau_bar_in, atol=1e-4)
+        np.testing.assert_allclose(float(res.base.tau_bar_out_unc), oracle.tau_bar_out, atol=1e-4)
+
+    def test_effective_hazard_below_hazard(self, ref_solution):
+        """h − rV < h strictly when r > 0 (V > 0)."""
+        _, _, res = ref_solution
+        assert (np.asarray(res.hr_effective) < np.asarray(res.base.hr)).all()
+
+    def test_interest_delays_exit_vs_u0_baseline(self, ref_solution):
+        """Positive r raises the option value of staying: the exit buffer
+        τ̄_OUT under h−rV is smaller than the baseline u=0 exit buffer
+        (agents exit later in normal time)."""
+        m, ls, res = ref_solution
+        base = solve_equilibrium_baseline(ls, m.economic, CONFIG)
+        assert float(res.base.tau_bar_out_unc) < float(base.tau_bar_out_unc)
+
+    def test_vmap_over_r(self):
+        """r is a traced scalar: a policy sweep over r is one vmap."""
+        import jax
+
+        from sbr_tpu.interest.solver import solve_equilibrium_interest_core
+
+        m = make_interest_params(u=0.0, r=0.06, delta=0.1)
+        ls = solve_learning(m.learning, CONFIG)
+        econ = m.economic
+        rs = jnp.linspace(0.0, 0.09, 8)
+
+        def cell(r):
+            res = solve_equilibrium_interest_core(
+                ls, econ.u, econ.p, econ.kappa, econ.lam, econ.eta, r, econ.delta,
+                ls.grid[-1], CONFIG,
+            )
+            return res.base.xi, res.base.status
+
+        xi, status = jax.jit(jax.vmap(cell))(rs)
+        assert xi.shape == (8,)
+        # r=0 lane equals the scalar baseline path
+        res0 = solve_equilibrium_baseline(ls, econ, CONFIG)
+        np.testing.assert_allclose(float(xi[0]), float(res0.xi), atol=1e-6)
