@@ -1,0 +1,33 @@
+"""Social-learning extension: agents learn from observing withdrawals.
+
+Two tiers, per SURVEY §7.2.6:
+
+- :mod:`dynamics` / :mod:`solver` — the representative-agent damped
+  fixed-point equilibrium of the reference
+  (`src/extensions/social_learning/`), run entirely on device as one
+  `lax.while_loop`.
+- :mod:`agents` — the explicit-population extension (north star): 10^6
+  agents on Erdős–Rényi / scale-free graphs, neighbor-withdrawal learning
+  via `segment_sum`, sharded over a device mesh.
+"""
+
+from sbr_tpu.social.dynamics import solve_forced_learning
+from sbr_tpu.social.solver import SocialFixedPointResult, solve_equilibrium_social
+from sbr_tpu.social.agents import (
+    AgentSimConfig,
+    AgentSimResult,
+    erdos_renyi_edges,
+    scale_free_edges,
+    simulate_agents,
+)
+
+__all__ = [
+    "solve_forced_learning",
+    "SocialFixedPointResult",
+    "solve_equilibrium_social",
+    "AgentSimConfig",
+    "AgentSimResult",
+    "erdos_renyi_edges",
+    "scale_free_edges",
+    "simulate_agents",
+]

@@ -1,0 +1,56 @@
+"""Forced learning dynamics dG/dt = (1-G)·β·AW(t)
+(reference `src/extensions/social_learning/social_learning_dynamics.jl:58-78`).
+
+The reference integrates this with an adaptive machine-eps ODE solver against
+a piecewise-linear interpolant of AW. But the equation is separable: for ANY
+forcing A W with cumulative integral A(t) = ∫₀ᵗ AW(s) ds,
+
+    G(t) = 1 - (1 - x0) · exp(-β · A(t)).
+
+For the piecewise-linear AW the reference actually feeds the solver, A(t) is
+the trapezoid cumulative — exact. So Stage 1 of every fixed-point iteration
+collapses to a `cumsum` + `exp`: no scan, no adaptive stepping, and the result
+is the exact solution of the same forced ODE the reference approximates. The
+PDF is the symbolic g = (1-G)·β·AW the reference also uses
+(`social_learning_dynamics.jl:98-114`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sbr_tpu.core.integrate import cumtrapz
+from sbr_tpu.models.results import LearningSolution
+
+
+def solve_forced_learning(beta, aw_samples, grid, x0) -> LearningSolution:
+    """Exact solve of dG/dt=(1-G)·β·AW(t) for piecewise-linear AW samples.
+
+    Args:
+      beta: learning rate (sensitivity to observed withdrawals).
+      aw_samples: (n,) AW(t) sampled on ``grid``.
+      grid: (n,) uniform time grid.
+      x0: initial informed fraction G(0).
+
+    Returns a `LearningSolution` with sampled CDF/PDF (closed_form=False —
+    downstream consumers interpolate, exactly as the reference wraps the
+    forced solution in a baseline `LearningResults`,
+    `social_learning_solver.jl:135-137`).
+    """
+    dtype = jnp.asarray(aw_samples).dtype
+    beta = jnp.asarray(beta, dtype=dtype)
+    x0 = jnp.asarray(x0, dtype=dtype)
+    dt = grid[1] - grid[0]
+    big_a = cumtrapz(aw_samples, dx=dt)
+    cdf = 1.0 - (1.0 - x0) * jnp.exp(-beta * big_a)
+    pdf = (1.0 - cdf) * beta * aw_samples
+    return LearningSolution(
+        grid=grid,
+        cdf=cdf,
+        pdf=pdf,
+        t0=grid[0],
+        dt=dt,
+        beta=beta,
+        x0=x0,
+        closed_form=False,
+    )

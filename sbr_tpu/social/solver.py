@@ -1,0 +1,168 @@
+"""Damped fixed-point equilibrium for social learning
+(reference `src/extensions/social_learning/social_learning_solver.jl:63-263`).
+
+Algorithm (faithful to the reference, SURVEY §3.5):
+
+1. tspan is overridden to (0, η) (`social_learning_solver.jl:81`).
+2. Init AW⁽⁰⁾ = baseline word-of-mouth CDF (`:90-94`).
+3. Iterate: forced learning from AW⁽ⁿ⁻¹⁾ → baseline equilibrium → candidate
+   AW; on inner no-run, ξ⁽ⁿ⁾ = ξ⁽ⁿ⁻¹⁾ + η/500, aborting past η (`:149-191`);
+   sup-norm convergence checked on the UNDAMPED candidate (`:168-171`),
+   else damp AW⁽ⁿ⁾ = ½AW⁽ⁿ⁻¹⁾ + ½AW̃⁽ⁿ⁾ (`:183-187`).
+
+TPU-native differences (SURVEY §7.3 "fixed-point loop on device"):
+
+- The entire iteration is ONE `lax.while_loop` inside jit — no host
+  round-trips between the ~40 outer iterations.
+- Every curve lives on one static uniform grid over [0, η], so the
+  reference's separate 1000-pt comparison grid (`:105`) is unnecessary: the
+  sup-norm is taken on the native grid (finer, so the criterion is at least
+  as strict).
+- The forced ODE is solved exactly (see `dynamics.py`), not adaptively.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from sbr_tpu.baseline.learning import logistic_cdf
+from sbr_tpu.baseline.solver import get_aw, solve_equilibrium_core
+from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.models.results import EquilibriumResult, LearningSolution
+from sbr_tpu.social.dynamics import solve_forced_learning
+
+
+@struct.dataclass
+class SocialFixedPointResult:
+    """Fixed-point output: the last inner equilibrium (what the reference
+    returns, `social_learning_solver.jl:262`) plus the iteration metadata the
+    reference computes but drops (`LearningResultsSocial` is defined yet
+    unused — SURVEY §3.5 note)."""
+
+    equilibrium: EquilibriumResult  # from the final inner solve
+    learning: LearningSolution  # final forced-learning curves on the grid
+    aw: jnp.ndarray  # (n,) final AW samples on [0, η]
+    grid: jnp.ndarray  # (n,) uniform grid over [0, η]
+    xi: jnp.ndarray  # final ξ iterate (incl. no-run increments)
+    iterations: jnp.ndarray  # int32
+    converged: jnp.ndarray  # bool — fixed-point convergence
+    aborted: jnp.ndarray  # bool — ξ search exceeded η (`:155-160`)
+    error: jnp.ndarray  # last undamped sup-norm error
+
+
+@struct.dataclass
+class _LoopState:
+    aw: jnp.ndarray
+    xi: jnp.ndarray
+    it: jnp.ndarray
+    converged: jnp.ndarray
+    aborted: jnp.ndarray
+    err: jnp.ndarray
+    res: EquilibriumResult
+    ls: LearningSolution
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fixed_point(config: SolverConfig, tol: float, max_iter: int, damping: float):
+    """Jitted fixed-point program, cached per static numerics config."""
+
+    @jax.jit
+    def run(beta, x0, u, p, kappa, lam, eta, grid):
+        dtype = grid.dtype
+        tol_ = jnp.asarray(tol, dtype=dtype)
+        alpha = jnp.asarray(damping, dtype=dtype)
+
+        def step(aw, xi_prev):
+            ls = solve_forced_learning(beta, aw, grid, x0)
+            res = solve_equilibrium_core(ls, u, p, kappa, lam, eta, eta, config)
+            # inner no-run: increment ξ by η/500 (`social_learning_solver.jl:155`)
+            xi_new = jnp.where(res.bankrun, res.xi, xi_prev + eta / 500.0)
+            exceeded = jnp.logical_and(~res.bankrun, xi_new > eta)
+            aw_new, _, _ = get_aw(xi_new, res.tau_bar_in_unc, res.tau_bar_out_unc, grid, ls)
+            return ls, res, xi_new, exceeded, aw_new
+
+        def cond(s: _LoopState):
+            return (s.it < max_iter) & (~s.converged) & (~s.aborted)
+
+        def body(s: _LoopState):
+            ls, res, xi_new, exceeded, aw_new = step(s.aw, s.xi)
+            err = jnp.max(jnp.abs(aw_new - s.aw))
+            conv = jnp.logical_and(err < tol_, ~exceeded)
+            aw_next = jnp.where(conv, aw_new, (1.0 - alpha) * s.aw + alpha * aw_new)
+            aw_next = jnp.where(exceeded, s.aw, aw_next)
+            return _LoopState(
+                aw=aw_next,
+                xi=xi_new,
+                it=s.it + 1,
+                converged=conv,
+                aborted=exceeded,
+                err=err,
+                res=res,
+                ls=ls,
+            )
+
+        aw0 = logistic_cdf(grid, beta, x0)  # word-of-mouth init (`:90-94`)
+        shapes = jax.eval_shape(lambda a, x: step(a, x)[:2], aw0, jnp.zeros((), dtype))
+        ls0, res0 = jax.tree_util.tree_map(lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes)
+        init = _LoopState(
+            aw=aw0,
+            xi=jnp.zeros((), dtype),
+            it=jnp.zeros((), jnp.int32),
+            converged=jnp.zeros((), bool),
+            aborted=jnp.zeros((), bool),
+            err=jnp.asarray(jnp.inf, dtype),
+            res=res0,
+            ls=ls0,
+        )
+        final = jax.lax.while_loop(cond, body, init)
+        return SocialFixedPointResult(
+            equilibrium=final.res,
+            learning=final.ls,
+            aw=final.aw,
+            grid=grid,
+            xi=final.xi,
+            iterations=final.it,
+            converged=final.converged,
+            aborted=final.aborted,
+            error=final.err,
+        )
+
+    return run
+
+
+def solve_equilibrium_social(
+    model: ModelParams,
+    config: SolverConfig = SolverConfig(),
+    tol: float = 1e-4,
+    max_iter: int = 250,
+    damping: float = 0.5,
+    dtype=None,
+) -> SocialFixedPointResult:
+    """Solve the social-learning equilibrium
+    (`solve_equilibrium_social_learning`, `social_learning_solver.jl:63`).
+
+    Defaults mirror the reference signature (tol=1e-4, max_iter=250, α=0.5);
+    the Figure-12/13 script calls with max_iter=500
+    (`scripts/4_social_learning.jl:55-56`).
+    """
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jnp.zeros((), dtype=dtype).dtype
+    econ = model.economic
+    eta = econ.eta
+    grid = jnp.linspace(jnp.zeros((), dtype), jnp.asarray(eta, dtype), config.n_grid)
+    run = _build_fixed_point(config, float(tol), int(max_iter), float(damping))
+    return run(
+        jnp.asarray(model.learning.beta, dtype),
+        jnp.asarray(model.learning.x0, dtype),
+        jnp.asarray(econ.u, dtype),
+        jnp.asarray(econ.p, dtype),
+        jnp.asarray(econ.kappa, dtype),
+        jnp.asarray(econ.lam, dtype),
+        jnp.asarray(eta, dtype),
+        grid,
+    )

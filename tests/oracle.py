@@ -280,3 +280,113 @@ def solve_interest_oracle(
         return OracleInterestSolution(np.nan, tau_in, tau_out, False, v_at)
     xi = brentq(aw, tau_in, tau_out, xtol=1e-14)
     return OracleInterestSolution(xi, tau_in, tau_out, True, v_at)
+
+
+# ---------------------------------------------------------------------------
+# Social-learning fixed-point oracle (reference
+# `src/extensions/social_learning/social_learning_solver.jl:63-263`)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OracleSocialSolution:
+    xi: float
+    bankrun: bool
+    converged: bool
+    iterations: int
+    aw: np.ndarray  # final AW samples on grid
+    grid: np.ndarray
+    aw_max: float
+
+
+def _np_cumtrapz(y, dx):
+    from scipy.integrate import cumulative_trapezoid
+
+    return cumulative_trapezoid(y, dx=dx, initial=0.0)
+
+
+def solve_social_oracle(
+    beta=0.9, x0=1e-4, u=0.5, p=0.99, kappa=0.25, lam=0.25, eta=30.0 / 0.9,
+    tol=1e-4, max_iter=500, n=16384,
+):
+    """Independent numpy mirror of the damped fixed point: forced learning in
+    closed form, trapezoid hazard, brentq for buffers and xi, the no-run
+    xi + eta/500 fallback, sup-norm convergence on the undamped candidate,
+    alpha = 0.5 damping."""
+    t = np.linspace(0.0, eta, n)
+    dx = t[1] - t[0]
+    aw = G(t, beta, x0)  # word-of-mouth init
+    xi = 0.0
+    converged = False
+    bankrun = False
+    it = 0
+    for it in range(1, max_iter + 1):
+        aw_old = aw.copy()
+        big_a = _np_cumtrapz(aw_old, dx)
+        cdf = 1.0 - (1.0 - x0) * np.exp(-beta * big_a)
+        pdf = (1.0 - cdf) * beta * aw_old
+
+        eg = np.exp(lam * t) * pdf
+        integ = _np_cumtrapz(eg, dx)
+        hr = (p * eg) / (p * integ + (1.0 - p) * integ[-1])
+
+        def h_of(tau):
+            return np.interp(tau, t, hr)
+
+        above = hr > u
+        bankrun = False
+        tau_in = tau_out = eta
+        if above.any():
+            up = np.where(~above[:-1] & above[1:])[0]
+            if len(up):
+                i = up[0]
+                tau_in = brentq(lambda s: h_of(s) - u, t[i], t[i + 1], xtol=1e-13)
+            else:
+                tau_in = t[np.argmax(above)]
+            dn = np.where(above[:-1] & ~above[1:])[0]
+            if len(dn):
+                i = dn[-1]
+                tau_out = brentq(lambda s: h_of(s) - u, t[i], t[i + 1], xtol=1e-13)
+            else:
+                tau_out = t[len(above) - 1 - np.argmax(above[::-1])]
+
+        def G_of(s):
+            return np.interp(s, t, cdf)
+
+        if tau_in != tau_out:
+            def aw_err(x):
+                return G_of(min(x, tau_out)) - G_of(min(x, tau_in)) - kappa
+
+            if aw_err(tau_in) * aw_err(tau_out) <= 0:
+                xi_c = brentq(aw_err, tau_in, tau_out, xtol=1e-14)
+                eps = dx
+                a0 = G_of(min(xi_c, tau_out)) - G_of(min(xi_c, tau_in))
+                a1 = G_of(min(xi_c, tau_out) + eps) - G_of(min(xi_c, tau_in) + eps)
+                if a1 >= a0:
+                    bankrun = True
+                    xi = xi_c
+
+        if not bankrun:
+            xi = xi + eta / 500.0
+            if xi > eta:
+                break
+
+        t_in_con = min(tau_in, xi)
+        t_out_con = min(tau_out, xi)
+        s_in = t - xi + t_in_con
+        aw_in = np.where(s_in >= 0, G_of(np.maximum(s_in, 0.0)), 0.0)
+        s_out = t - xi + t_out_con
+        aw_out = np.where(s_out >= 0, G_of(np.maximum(s_out, 0.0)), 0.0)
+        aw_new = aw_out - aw_in + G_of(0.0)
+
+        err = np.max(np.abs(aw_new - aw_old))
+        if err < tol:
+            aw = aw_new
+            converged = True
+            break
+        aw = 0.5 * aw_old + 0.5 * aw_new
+
+    return OracleSocialSolution(
+        xi=xi, bankrun=bankrun, converged=converged, iterations=it,
+        aw=aw, grid=t, aw_max=float(np.nanmax(aw)),
+    )
