@@ -1,0 +1,82 @@
+"""Benchmark: equilibria/sec on the Figure-5 β×u comparative-statics grid.
+
+The headline workload (SURVEY §6, BASELINE.md): the reference solves the
+500×500 β×u grid sequentially in the bulk of its 5-15 min replication run
+(`scripts/1_baseline.jl:209-285`) and reports ~0.5 s per single equilibrium
+solve (paper Appendix C.5.3) — i.e. a baseline of 2 equilibria/sec. Here the
+whole grid is one jitted vmap² program on the accelerator; `vs_baseline` is
+(our equilibria/sec) / 2.
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    n_beta, n_u = 640, 640  # 409.6k cells — 40× the north-star 10^4 points
+    config = SolverConfig(n_grid=1024, bisect_iters=60)
+    base = make_model_params()  # Figure-5 base: β=1, η̄=15, κ=.6 (η pinned 15)
+
+    # Reference grid domain (`scripts/1_baseline.jl:210-213`):
+    # β = 1/ave_meeting_time, ave_meeting_time ∈ [1e-4, 1]; u ∈ [0.001, 1].
+    amt = np.linspace(1e-4, 1.0, n_beta)
+    betas = 1.0 / amt
+
+    def run(rep: int):
+        # Perturb u by 1e-6 per rep: physics-identical to the metric's
+        # precision, but ensures each rep is a distinct computation. Fetch a
+        # scalar reduction to host inside the timed region — on the axon TPU
+        # tunnel `block_until_ready` returns before device work completes, so
+        # a device→host read is the only honest fence.
+        us = np.linspace(0.001, 1.0, n_u) + rep * 1e-6
+        grid = beta_u_grid(betas, us, base, config=config, dtype=jnp.float32)
+        fence = float(
+            jnp.sum(grid.status) + jnp.nansum(grid.max_aw) + jnp.nansum(grid.xi)
+        )
+        return grid, fence
+
+    t0 = time.perf_counter()
+    grid, _ = run(0)  # includes compile
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for rep in range(1, 4):
+        t0 = time.perf_counter()
+        grid, _ = run(rep)
+        times.append(time.perf_counter() - t0)
+    elapsed = min(times)
+
+    n_cells = n_beta * n_u
+    eq_per_sec = n_cells / elapsed
+    n_run = int(np.sum(np.asarray(grid.status) == 0))
+    print(
+        f"[bench] {n_cells} cells in {elapsed:.3f}s (first call {compile_s:.1f}s "
+        f"incl. compile) on {jax.devices()[0].platform}; {n_run} run cells",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "beta_u_grid_equilibria_per_sec",
+                "value": round(eq_per_sec, 1),
+                "unit": "equilibria/sec",
+                "vs_baseline": round(eq_per_sec / 2.0, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -58,11 +58,11 @@ def erdos_renyi_edges(n: int, avg_degree: float, seed: int = 0) -> Tuple[np.ndar
     rng = np.random.default_rng(seed)
     p = avg_degree / max(n - 1, 1)
     e = rng.binomial(n * (n - 1), p)
-    src = rng.integers(0, n, size=e, dtype=np.int64)
-    dst = rng.integers(0, n, size=e, dtype=np.int64)
+    src = rng.integers(0, n, size=e, dtype=np.int32)
+    dst = rng.integers(0, n, size=e, dtype=np.int32)
     loops = src == dst
     dst[loops] = (dst[loops] + 1 + rng.integers(0, n - 1, size=loops.sum())) % n
-    return src.astype(np.int32), dst.astype(np.int32)
+    return src, dst
 
 
 def scale_free_edges(
@@ -70,19 +70,21 @@ def scale_free_edges(
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Directed scale-free graph via the Chung–Lu power-law model.
 
-    Endpoint i is drawn with probability ∝ w_i = (i+1)^{-1/(γ-1)}, giving a
-    degree distribution with tail exponent γ. Fully vectorized — no
-    preferential-attachment loop — so 10^6-node graphs build in seconds.
+    Both endpoints are drawn with probability ∝ w_i = (i+1)^{-1/(γ-1)}, so
+    in- AND out-degree distributions have tail exponent γ — in-degree is the
+    side that drives the learning dynamics (frac_i normalizes by indegree_i),
+    so it must carry the heavy tail. Fully vectorized — no preferential-
+    attachment loop — so 10^6-node graphs build in seconds.
     """
     rng = np.random.default_rng(seed)
     e = int(n * avg_degree)
     w = (np.arange(1, n + 1, dtype=np.float64)) ** (-1.0 / (gamma - 1.0))
     w /= w.sum()
-    src = rng.choice(n, size=e, p=w).astype(np.int64)
-    dst = rng.integers(0, n, size=e, dtype=np.int64)
+    src = rng.choice(n, size=e, p=w).astype(np.int32)
+    dst = rng.choice(n, size=e, p=w).astype(np.int32)
     loops = src == dst
     dst[loops] = (dst[loops] + 1 + rng.integers(0, n - 1, size=loops.sum())) % n
-    return src.astype(np.int32), dst.astype(np.int32)
+    return src, dst
 
 
 # ---------------------------------------------------------------------------
@@ -126,7 +128,9 @@ class AgentSimResult:
     withdrawn_frac: jnp.ndarray  # (n_steps,)
     informed: jnp.ndarray  # (N,) bool, final
     t_inf: jnp.ndarray  # (N,) informed times (inf when never informed)
-    agent_steps: jnp.ndarray  # scalar: N_true * n_steps (bench accounting)
+    # Static host-side int (not a device array: N·n_steps overflows int32 at
+    # the advertised 10^6-agent scale under default x32).
+    agent_steps: int = struct.field(pytree_node=False, default=0)
 
 
 def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
@@ -147,7 +151,7 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype):
     indeg = np.bincount(dst, minlength=n).astype(dtype)
     rng = np.random.default_rng(seed)
     informed0 = rng.random(n) < x0
-    if not informed0.any():  # guarantee at least one seed, as x0>0 implies
+    if x0 > 0 and not informed0.any():  # guarantee ≥1 seed when x0>0 implies
         informed0[rng.integers(0, n)] = True
     return betas, src, dst, indeg, informed0
 
@@ -189,7 +193,7 @@ def _single_device_sim(config: AgentSimConfig):
             withdrawn_frac=aws,
             informed=informed,
             t_inf=t_inf,
-            agent_steps=jnp.asarray(n * config.n_steps),
+            agent_steps=n * config.n_steps,
         )
 
     return run
@@ -269,7 +273,8 @@ def simulate_agents(
         the agent-level generalization of the hetero extension's K groups).
       src, dst: directed edge lists; dst learns from src's actions.
       n: number of agents.
-      x0: initial informed fraction (Bernoulli seeds, ≥1 guaranteed).
+      x0: initial informed fraction (Bernoulli seeds; ≥1 agent guaranteed
+        when x0 > 0, while x0 = 0 runs a genuinely seedless control).
       mesh: optional 1-D device mesh; shards agents and edges (see module
         docstring). Without it, runs single-device.
 
@@ -319,6 +324,12 @@ def simulate_agents(
         for a in (betas_h, src_h, dst_h, indeg_h, informed0_h)
     ]
     gs, aws, informed, t_inf = fn(*args, keys)
+    if n_pad:
+        # The padding trim [:n] is not shard-aligned; all-gather the final
+        # per-agent state (output-only, O(N) bytes) so the slice is local.
+        replicated = NamedSharding(mesh, P())
+        informed = jax.device_put(informed, replicated)
+        t_inf = jax.device_put(t_inf, replicated)
     t_grid = jnp.arange(config.n_steps, dtype=gs.dtype) * config.dt
     return AgentSimResult(
         t_grid=t_grid,
@@ -326,5 +337,5 @@ def simulate_agents(
         withdrawn_frac=aws,
         informed=informed[:n],
         t_inf=t_inf[:n],
-        agent_steps=jnp.asarray(n * config.n_steps),
+        agent_steps=n * config.n_steps,
     )

@@ -20,6 +20,7 @@ only at the host boundary.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -61,6 +62,24 @@ def _lean_cell(ls: LearningSolution, u, p, kappa, lam, eta, tspan_end, config: S
     return r.xi, r.tau_bar_in_unc, r.aw_max, r.status
 
 
+@functools.lru_cache(maxsize=None)
+def _u_sweep_fn(config: SolverConfig):
+    """Jitted u-sweep, cached by config so repeated sweeps (and the bench
+    harness) reuse one traced program instead of retracing per call. The
+    learning solution and economics enter as traced arguments; jit dead-code-
+    eliminates the discarded per-cell curves instead of materializing
+    (n_u, n_grid) temporaries."""
+
+    @jax.jit
+    def fn(ls, u_values, p, kappa, lam, eta, tspan_end):
+        def cell(u):
+            return _lean_cell(ls, u, p, kappa, lam, eta, tspan_end, config)
+
+        return jax.vmap(cell)(u_values)
+
+    return fn
+
+
 def u_sweep(
     ls: LearningSolution,
     u_values,
@@ -72,19 +91,18 @@ def u_sweep(
     (`1_baseline.jl:44,169`), Stages 2-3 vmapped."""
     if tspan_end is None:
         tspan_end = ls.grid[-1]
-    u_values = jnp.asarray(u_values, dtype=ls.cdf.dtype)
+    dtype = ls.cdf.dtype
+    u_values = jnp.asarray(u_values, dtype=dtype)
 
-    # jit so the discarded per-cell curves are dead-code-eliminated instead of
-    # materialized as (n_u, n_grid) temporaries.
-    sweep_fn = jax.jit(
-        jax.vmap(
-            lambda u, t_end: _lean_cell(
-                ls, u, econ.p, econ.kappa, econ.lam, econ.eta, t_end, config
-            ),
-            in_axes=(0, None),
-        )
+    xi, tau_in, aw_max, status = _u_sweep_fn(config)(
+        ls,
+        u_values,
+        jnp.asarray(econ.p, dtype),
+        jnp.asarray(econ.kappa, dtype),
+        jnp.asarray(econ.lam, dtype),
+        jnp.asarray(econ.eta, dtype),
+        jnp.asarray(tspan_end, dtype),
     )
-    xi, tau_in, aw_max, status = sweep_fn(u_values, jnp.asarray(tspan_end, dtype=ls.cdf.dtype))
     return USweepResult(
         u_values=u_values,
         max_withdrawals=aw_max,
@@ -122,34 +140,22 @@ def beta_u_grid(
     x0 = base.learning.x0
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jnp.zeros((), dtype=dtype).dtype
 
     beta_values = jnp.asarray(beta_values, dtype=dtype)
     u_values = jnp.asarray(u_values, dtype=dtype)
 
-    def cell(beta, u):
-        ls = solve_learning(
-            # LearningParams is validated host-side; build the solution
-            # directly from traced scalars via the closed form.
-            _TracedLearning(beta=beta, tspan=tspan, x0=x0),
-            config,
-            dtype=dtype,
-        )
-        return _lean_cell(ls, u, econ.p, econ.kappa, econ.lam, econ.eta, tspan[1], config)
-
-    grid_fn = jax.vmap(jax.vmap(cell, in_axes=(None, 0)), in_axes=(0, None))
-
     if mesh is not None:
-        pspec = jax.sharding.PartitionSpec(*mesh_axes)
-        out_sharding = jax.sharding.NamedSharding(mesh, pspec)
         b_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[0]))
         u_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[1]))
         beta_values = jax.device_put(beta_values, b_sharding)
         u_values = jax.device_put(u_values, u_sharding)
-        grid_fn = jax.jit(grid_fn, out_shardings=(out_sharding,) * 4)
-    else:
-        grid_fn = jax.jit(grid_fn)
 
-    xi, tau_in, aw_max, status = grid_fn(beta_values, u_values)
+    grid_fn = _grid_fn(config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None)
+    scalars = tuple(
+        jnp.asarray(v, dtype) for v in (econ.p, econ.kappa, econ.lam, econ.eta, tspan[0], tspan[1], x0)
+    )
+    xi, tau_in, aw_max, status = grid_fn(beta_values, u_values, *scalars)
     return GridSweepResult(
         beta_values=beta_values, u_values=u_values, max_aw=aw_max, xi=xi, status=status
     )
@@ -162,3 +168,30 @@ class _TracedLearning:
         self.beta = beta
         self.tspan = tspan
         self.x0 = x0
+
+
+@functools.lru_cache(maxsize=None)
+def _grid_fn(config: SolverConfig, dtype_name: str, mesh, mesh_axes):
+    """Jitted β×u grid program, cached by (config, dtype, mesh) so repeated
+    sweeps — tiled runs, the bench harness — reuse one traced program.
+    Model parameters enter as traced scalars; Stage 1 is rebuilt per cell via
+    the closed form, which is free."""
+    dtype = jnp.dtype(dtype_name)
+
+    def cell(beta, u, p, kappa, lam, eta, t0, t1, x0):
+        ls = solve_learning(
+            # LearningParams is validated host-side; build the solution
+            # directly from traced scalars via the closed form.
+            _TracedLearning(beta=beta, tspan=(t0, t1), x0=x0),
+            config,
+            dtype=dtype,
+        )
+        return _lean_cell(ls, u, p, kappa, lam, eta, t1, config)
+
+    bcast = (None,) * 7
+    fn = jax.vmap(jax.vmap(cell, in_axes=(None, 0) + bcast), in_axes=(0, None) + bcast)
+
+    if mesh is not None:
+        out_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*mesh_axes))
+        return jax.jit(fn, out_shardings=(out_sharding,) * 4)
+    return jax.jit(fn)

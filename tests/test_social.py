@@ -199,7 +199,7 @@ class TestAgentSimulation:
     def test_sharded_matches_physics(self):
         """8-way sharded run (edge-count sharding + psum) also recovers the
         logistic limit and returns exactly-shaped unpadded outputs."""
-        n = 10000  # not divisible by 8 → exercises agent padding
+        n = 10001  # not divisible by 8 → exercises agent padding
         src, dst = erdos_renyi_edges(n, 100.0, seed=6)
         mesh = jax.make_mesh((8,), ("agents",))
         cfg = AgentSimConfig(n_steps=200, dt=0.05)
