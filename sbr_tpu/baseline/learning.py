@@ -16,6 +16,7 @@ dynamics with no closed form (hetero, HJB, forced social learning).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from sbr_tpu.models.params import LearningParams, SolverConfig
@@ -50,7 +51,7 @@ def solve_learning(
     curves (e.g. as the social-learning initial guess,
     `social_learning_solver.jl:90-94`).
     """
-    dtype = jnp.zeros((), dtype=dtype).dtype  # canonicalize under x64 disabled
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))  # x64-aware
     t0, t1 = params.tspan
     grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
     beta = jnp.asarray(params.beta, dtype=dtype)

@@ -1,0 +1,28 @@
+"""Figure parity layer — matplotlib equivalents of the reference's 13 figures.
+
+Reference: `src/baseline/plotting.jl` (four shared plot functions) plus the
+extension scripts' inline figures (`scripts/2_heterogeneity.jl:97-124`,
+`scripts/3_interest_rates.jl:80-183`, `scripts/4_social_learning.jl:101-119`).
+The CLI runner (`python -m sbr_tpu.figures.master`) is the MASTER.jl
+equivalent: it produces every figure PDF plus `replication_figures.tex`.
+"""
+
+from sbr_tpu.figures.plotting import (
+    plot_aw_hetero,
+    plot_comp_stat_withdrawals_and_collapse,
+    plot_equilibrium,
+    plot_hazard_rate_decomposition,
+    plot_heatmap_aw,
+    plot_learning_distribution,
+    plot_value_function,
+)
+
+__all__ = [
+    "plot_aw_hetero",
+    "plot_comp_stat_withdrawals_and_collapse",
+    "plot_equilibrium",
+    "plot_hazard_rate_decomposition",
+    "plot_heatmap_aw",
+    "plot_learning_distribution",
+    "plot_value_function",
+]

@@ -1,0 +1,336 @@
+"""MASTER runner — the reference `MASTER.jl` equivalent as a CLI.
+
+Runs the four workload sections (baseline, heterogeneity, interest rates,
+social learning — reference `scripts/1_baseline.jl` … `4_social_learning.jl`)
+and writes the 13 figure PDFs plus `replication_figures.tex` under the
+output directory, printing the same kind of manifest/timing summary
+(`MASTER.jl:31-110`).
+
+Where the reference loops sequentially with early termination
+(`1_baseline.jl:147-163,236-244`), this runner calls the vmapped sweeps and
+recovers the early-termination accounting from the status grids.
+
+Usage:
+    python -m sbr_tpu.figures.master [--output DIR] [--sections 1,2,3,4]
+                                     [--fast] [--f32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+# The 13 reference figures (`MASTER.jl:31-88`), keyed by section.
+MANIFEST = {
+    1: [
+        "baseline/learning_dynamics.pdf",
+        "baseline/hazard_rate.pdf",
+        "baseline/equilibrium_dynamics_main.pdf",
+        "baseline/equilibrium_dynamics_fast.pdf",
+        "baseline/equilibrium_dynamics_low_u.pdf",
+        "baseline/comp_stat_u_panel_a.pdf",
+        "baseline/comp_stat_u_panel_b.pdf",
+        "baseline/comp_stat_cross_heatmap_AW.pdf",
+    ],
+    2: ["heterogeneity/aggregate_withdrawals_hetero.pdf"],
+    3: ["interest_rates/value_function.pdf", "interest_rates/hazard_decomposition.pdf"],
+    4: [
+        "social_learning/social_learning_equilibrium.pdf",
+        "social_learning/baseline_equilibrium.pdf",
+    ],
+}
+
+
+def _save(fig, path: Path) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, bbox_inches="tight")
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+    print(f"  ✓ saved {path}")
+
+
+def run_baseline(figdir: Path, fast: bool) -> None:
+    """Section 1: Figures 1-5 (`scripts/1_baseline.jl`)."""
+    import numpy as np
+
+    from sbr_tpu import make_model_params, solve_learning, with_overrides
+    from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+    from sbr_tpu.figures.plotting import (
+        plot_comp_stat_withdrawals_and_collapse,
+        plot_equilibrium,
+        plot_hazard_rate_decomposition,
+        plot_heatmap_aw,
+        plot_learning_distribution,
+    )
+    from sbr_tpu.models.params import LearningParams
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid, u_sweep
+
+    m_base = make_model_params(beta=1.0, eta_bar=15.0, u=0.1, p=0.5, kappa=0.6, lam=0.01)
+    lr_base = solve_learning(m_base.learning)
+
+    # Figure 1: learning CDFs for β ∈ {0.5, 1, 2} on (0, 20)
+    # (`1_baseline.jl:60-74`).
+    print("Figure 1: learning dynamics")
+    beta_values = [0.5, 1.0, 2.0]
+    curves = [
+        solve_learning(LearningParams(beta=b, tspan=(0.0, 20.0), x0=1e-4)) for b in beta_values
+    ]
+    _save(
+        plot_learning_distribution(curves, (0.0, 20.0), beta_values),
+        figdir / "baseline/learning_dynamics.pdf",
+    )
+
+    # Figures 2-3: main equilibrium (`1_baseline.jl:82-97`).
+    print("Figures 2-3: main equilibrium")
+    result = solve_equilibrium_baseline(lr_base, m_base.economic)
+    print(f"  ξ* = {float(result.xi):.2f}, bankrun = {bool(result.bankrun)}")
+    _save(
+        plot_equilibrium(result, lr_base, m_base.economic, x_range=(0, 15)),
+        figdir / "baseline/equilibrium_dynamics_main.pdf",
+    )
+    _save(
+        plot_hazard_rate_decomposition(result, lr_base, m_base.economic),
+        figdir / "baseline/hazard_rate.pdf",
+    )
+
+    # Figures 3bis/3ter: fast communication and low u (`1_baseline.jl:106-126`).
+    print("Figures 3bis/3ter: fast β and low u")
+    for name, overrides in (("fast", dict(beta=3.0)), ("low_u", dict(u=0.01))):
+        m_alt = with_overrides(m_base, **overrides)
+        lr_alt = solve_learning(m_alt.learning)
+        res_alt = solve_equilibrium_baseline(lr_alt, m_alt.economic)
+        print(f"  {name}: ξ* = {float(res_alt.xi):.2f}, bankrun = {bool(res_alt.bankrun)}")
+        _save(
+            plot_equilibrium(res_alt, lr_alt, m_alt.economic, x_range=(0, 15)),
+            figdir / f"baseline/equilibrium_dynamics_{name}.pdf",
+        )
+
+    # Figure 4: u-sweep, paper resolution 5000 points over [0.001, 0.2]
+    # (`1_baseline.jl:137-200`), vmapped with Stage 1 shared.
+    n_u = 500 if fast else 5000
+    print(f"Figure 4: u-sweep ({n_u} points)")
+    sweep = u_sweep(lr_base, np.linspace(0.001, 0.2, n_u), m_base.economic)
+    n_run = int((np.asarray(sweep.status) == 0).sum())
+    print(f"  {n_run}/{n_u} run cells (no-run region recovered from status grid)")
+    fig_a, fig_b = plot_comp_stat_withdrawals_and_collapse(
+        sweep.u_values,
+        sweep.max_withdrawals,
+        sweep.collapse_times,
+        m_base.economic.kappa,
+        return_times=sweep.return_times,
+    )
+    _save(fig_a, figdir / "baseline/comp_stat_u_panel_a.pdf")
+    _save(fig_b, figdir / "baseline/comp_stat_u_panel_b.pdf")
+
+    # Figure 5: β×u heatmap, replication resolution 500×500
+    # (`1_baseline.jl:210-284`); x-axis is average meeting time = 1/β.
+    n_grid = 100 if fast else 500
+    print(f"Figure 5: β×u heatmap ({n_grid}×{n_grid})")
+    amt = np.linspace(1e-4, 1.0, n_grid)
+    u_vals = np.linspace(0.001, 1.0, n_grid)
+    grid = beta_u_grid(1.0 / amt, u_vals, m_base)
+    skipped = int((np.asarray(grid.status) != 0).sum())
+    print(f"  no-run cells: {skipped}/{n_grid * n_grid}")
+    # Reference stores (U, B) (`1_baseline.jl:213`); ours is (B, U).
+    _save(
+        plot_heatmap_aw(amt, u_vals, np.asarray(grid.max_aw).T),
+        figdir / "baseline/comp_stat_cross_heatmap_AW.pdf",
+    )
+
+
+def run_heterogeneity(figdir: Path, fast: bool) -> None:
+    """Section 2: two-group model figure (`scripts/2_heterogeneity.jl`)."""
+    from sbr_tpu.figures.plotting import plot_aw_hetero
+    from sbr_tpu.hetero.learning import solve_learning_hetero
+    from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
+    from sbr_tpu.models.params import make_hetero_params
+
+    # `2_heterogeneity.jl:38-49`: slow/fast learners.
+    m = make_hetero_params(
+        betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=0.1, p=0.9, kappa=0.3, lam=0.1
+    )
+    lsh = solve_learning_hetero(m.learning)
+    result = solve_equilibrium_hetero(lsh, m.economic)
+    print(f"  hetero: ξ* = {float(result.xi):.2f}, bankrun = {bool(result.bankrun)}")
+    aw = get_aw_hetero(result, lsh)
+    print(f"  max AW = {float(aw.aw_max):.3f}")
+    _save(
+        plot_aw_hetero(result, aw, m.economic, m.learning.betas),
+        figdir / "heterogeneity/aggregate_withdrawals_hetero.pdf",
+    )
+
+
+def run_interest(figdir: Path, fast: bool) -> None:
+    """Section 3: value function + hazard decomposition with the rV
+    threshold (`scripts/3_interest_rates.jl`)."""
+    import numpy as np
+
+    from sbr_tpu import solve_learning
+    from sbr_tpu.figures.plotting import plot_hazard_rate_decomposition, plot_value_function
+    from sbr_tpu.interest.solver import solve_equilibrium_interest
+    from sbr_tpu.models.params import make_interest_params
+
+    # `3_interest_rates.jl:37-46`.
+    m = make_interest_params(
+        beta=1.0, eta_bar=15.0, u=0.0, p=0.5, kappa=0.6, lam=0.01, r=0.06, delta=0.1
+    )
+    ls = solve_learning(m.learning)
+    result = solve_equilibrium_interest(ls, m.economic)
+    print(f"  interest: ξ* = {float(result.base.xi):.2f}, bankrun = {bool(result.base.bankrun)}")
+    _save(plot_value_function(result, m.economic), figdir / "interest_rates/value_function.pdf")
+    # Threshold curve u + rV(τ̄) on the hazard grid (`3_interest_rates.jl:141-146`).
+    threshold = m.economic.u + m.economic.r * np.asarray(result.v)
+    _save(
+        plot_hazard_rate_decomposition(
+            result.base, ls, m.economic, threshold_curve=threshold, threshold_label=r"$rV(\tau)$"
+        ),
+        figdir / "interest_rates/hazard_decomposition.pdf",
+    )
+
+
+def run_social(figdir: Path, fast: bool) -> None:
+    """Section 4: social-learning fixed point vs word-of-mouth baseline
+    (`scripts/4_social_learning.jl`)."""
+    from sbr_tpu import make_model_params, solve_learning
+    from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+    from sbr_tpu.figures.plotting import plot_equilibrium
+    from sbr_tpu.social.solver import solve_equilibrium_social
+
+    # `4_social_learning.jl:36-43`.
+    m = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+    social = solve_equilibrium_social(m, tol=1e-4, max_iter=500)
+    lr_wom = solve_learning(m.learning)
+    baseline = solve_equilibrium_baseline(lr_wom, m.economic)
+
+    # Cross-model comparison the reference prints (`4_social_learning.jl:65-81`).
+    xi_s, xi_b = float(social.equilibrium.xi), float(baseline.xi)
+    print(f"  social: ξ* = {xi_s:.2f} ({int(social.iterations)} iterations, "
+          f"converged = {bool(social.converged)})")
+    print(f"  baseline (WOM): ξ* = {xi_b:.2f}")
+    if social.equilibrium.bankrun and baseline.bankrun:
+        timing = "later" if xi_s > xi_b else "earlier"
+        print(f"  Δξ* = {xi_s - xi_b:.3f} ({timing} with social learning)")
+
+    if bool(social.equilibrium.bankrun):
+        _save(
+            plot_equilibrium(social.equilibrium, social.learning, m.economic),
+            figdir / "social_learning/social_learning_equilibrium.pdf",
+        )
+    if bool(baseline.bankrun):
+        _save(
+            plot_equilibrium(baseline, lr_wom, m.economic),
+            figdir / "social_learning/baseline_equilibrium.pdf",
+        )
+
+
+def write_tex(outdir: Path, sections: list) -> Path:
+    """Generate `replication_figures.tex` with the same section/figure
+    structure as the reference (`output/replication_figures.tex:23-127`)."""
+    titles = {
+        1: "Baseline Model",
+        2: "Heterogeneity Extension",
+        3: "Interest Rates Extension",
+        4: "Social Learning Extension",
+    }
+    captions = {
+        "baseline/learning_dynamics.pdf": r"Learning dynamics for different communication speeds $\beta$",
+        "baseline/hazard_rate.pdf": "Hazard rate decomposition: total hazard, belief fragility, and conditional hazard",
+        "baseline/equilibrium_dynamics_main.pdf": "Equilibrium dynamics: aggregate withdrawals (main calibration)",
+        "baseline/equilibrium_dynamics_fast.pdf": r"Equilibrium dynamics with fast communication ($\beta = 3$)",
+        "baseline/equilibrium_dynamics_low_u.pdf": "Equilibrium dynamics with low deposit utility ($u = 0.01$)",
+        "baseline/comp_stat_u_panel_a.pdf": "Comparative statics in $u$: peak withdrawals",
+        "baseline/comp_stat_u_panel_b.pdf": "Comparative statics in $u$: collapse and return times",
+        "baseline/comp_stat_cross_heatmap_AW.pdf": r"Peak withdrawals over the $\beta \times u$ grid",
+        "heterogeneity/aggregate_withdrawals_hetero.pdf": "Aggregate withdrawals with heterogeneous learning speeds",
+        "interest_rates/value_function.pdf": "Depositor value function with positive interest",
+        "interest_rates/hazard_decomposition.pdf": "Hazard decomposition with the $rV$ threshold",
+        "social_learning/social_learning_equilibrium.pdf": "Equilibrium under social learning from withdrawals",
+        "social_learning/baseline_equilibrium.pdf": "Baseline (word-of-mouth) equilibrium at the same parameters",
+    }
+    lines = [
+        r"\documentclass[12pt]{article}",
+        r"\usepackage{graphicx}",
+        r"\usepackage{float}",
+        r"\usepackage[margin=1in]{geometry}",
+        r"\title{Replication Figures\\The Social Determinants of Bank Runs\\"
+        r"(sbr\_tpu TPU-native framework)}",
+        r"\date{\today}",
+        r"\begin{document}",
+        r"\maketitle",
+        r"\section*{Note}",
+        "This document collects all figures generated by the sbr\\_tpu",
+        "replication run, organized as in the reference package: the baseline",
+        "model and its three extensions.",
+    ]
+    for sec in sections:
+        lines.append(rf"\section{{{titles[sec]}}}")
+        for fig in MANIFEST[sec]:
+            lines += [
+                r"\begin{figure}[H]",
+                r"    \centering",
+                rf"    \includegraphics[width=0.7\textwidth]{{figures/{fig}}}",
+                rf"    \caption{{{captions[fig]}}}",
+                r"\end{figure}",
+            ]
+    lines.append(r"\end{document}")
+    tex_path = outdir / "replication_figures.tex"
+    tex_path.write_text("\n".join(lines) + "\n")
+    return tex_path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="Generate all replication figures (MASTER.jl equivalent)")
+    parser.add_argument("--output", default="output", help="output directory (default: output/)")
+    parser.add_argument("--sections", default="1,2,3,4", help="comma-separated sections to run")
+    parser.add_argument("--fast", action="store_true", help="reduced sweep resolutions for smoke runs")
+    parser.add_argument("--f32", action="store_true", help="run in float32 (default float64 parity mode)")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if not args.f32:
+        jax.config.update("jax_enable_x64", True)
+    # Persistent compilation cache: the run is compile-dominated (execution
+    # is ms; the f64 vmapped sweeps and the fixed-point while_loop take
+    # minutes to compile), so reruns should pay zero.
+    jax.config.update("jax_compilation_cache_dir", str(Path.home() / ".cache/sbr_tpu_xla"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    outdir = Path(args.output)
+    figdir = outdir / "figures"
+    sections = sorted({int(s) for s in args.sections.split(",") if s.strip()})
+    runners = {1: run_baseline, 2: run_heterogeneity, 3: run_interest, 4: run_social}
+    names = {1: "Baseline", 2: "Heterogeneity", 3: "Interest Rates", 4: "Social Learning"}
+
+    t_start = time.time()
+    for sec in sections:
+        print("=" * 70)
+        print(f"SECTION {sec}/4: {names[sec]}")
+        print("=" * 70)
+        t0 = time.time()
+        runners[sec](figdir, args.fast)
+        print(f"  section time: {time.time() - t0:.1f}s")
+
+    tex_path = write_tex(outdir, sections)
+    total = time.time() - t_start
+
+    print("=" * 70)
+    print("REPLICATION COMPLETE")
+    print(f"Total execution time: {total:.1f} seconds")
+    generated = [f for sec in sections for f in MANIFEST[sec]]
+    print(f"Generated {len(generated)} figures:")
+    missing = []
+    for fig in generated:
+        ok = (figdir / fig).exists()
+        print(f"  {'✓' if ok else '✗'} {figdir / fig}")
+        if not ok:
+            missing.append(fig)
+    print(f"  ✓ {tex_path}")
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

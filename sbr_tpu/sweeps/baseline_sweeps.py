@@ -140,7 +140,7 @@ def beta_u_grid(
     x0 = base.learning.x0
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    dtype = jnp.zeros((), dtype=dtype).dtype
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
 
     beta_values = jnp.asarray(beta_values, dtype=dtype)
     u_values = jnp.asarray(u_values, dtype=dtype)
