@@ -113,8 +113,9 @@ def run_baseline(figdir: Path, fast: bool) -> None:
     n_u = 500 if fast else 5000
     print(f"Figure 4: u-sweep ({n_u} points)")
     sweep = u_sweep(lr_base, np.linspace(0.001, 0.2, n_u), m_base.economic)
-    n_run = int((np.asarray(sweep.status) == 0).sum())
-    print(f"  {n_run}/{n_u} run cells (no-run region recovered from status grid)")
+    from sbr_tpu.utils.status import status_summary
+
+    print(f"  {status_summary(sweep.status)} (no-run region recovered from status grid)")
     fig_a, fig_b = plot_comp_stat_withdrawals_and_collapse(
         sweep.u_values,
         sweep.max_withdrawals,
@@ -131,9 +132,10 @@ def run_baseline(figdir: Path, fast: bool) -> None:
     print(f"Figure 5: β×u heatmap ({n_grid}×{n_grid})")
     amt = np.linspace(1e-4, 1.0, n_grid)
     u_vals = np.linspace(0.001, 1.0, n_grid)
+    from sbr_tpu.utils.status import status_summary
+
     grid = beta_u_grid(1.0 / amt, u_vals, m_base)
-    skipped = int((np.asarray(grid.status) != 0).sum())
-    print(f"  no-run cells: {skipped}/{n_grid * n_grid}")
+    print(f"  {status_summary(grid.status)}")
     # Reference stores (U, B) (`1_baseline.jl:213`); ours is (B, U).
     _save(
         plot_heatmap_aw(amt, u_vals, np.asarray(grid.max_aw).T),
@@ -214,21 +216,34 @@ def run_social(figdir: Path, fast: bool) -> None:
         timing = "later" if xi_s > xi_b else "earlier"
         print(f"  Δξ* = {xi_s - xi_b:.3f} ({timing} with social learning)")
 
+    # A no-run outcome legitimately skips its figure
+    # (`4_social_learning.jl:104-118`); report the skip so the manifest
+    # check can distinguish it from a failure.
+    skipped = set()
     if bool(social.equilibrium.bankrun):
         _save(
             plot_equilibrium(social.equilibrium, social.learning, m.economic),
             figdir / "social_learning/social_learning_equilibrium.pdf",
         )
+    else:
+        print("  ! no social-learning equilibrium to plot (no bank run)")
+        skipped.add("social_learning/social_learning_equilibrium.pdf")
     if bool(baseline.bankrun):
         _save(
             plot_equilibrium(baseline, lr_wom, m.economic),
             figdir / "social_learning/baseline_equilibrium.pdf",
         )
+    else:
+        print("  ! no baseline equilibrium to plot (no bank run)")
+        skipped.add("social_learning/baseline_equilibrium.pdf")
+    return skipped
 
 
-def write_tex(outdir: Path, sections: list) -> Path:
+def write_tex(outdir: Path, sections: list, skip=()) -> Path:
     """Generate `replication_figures.tex` with the same section/figure
-    structure as the reference (`output/replication_figures.tex:23-127`)."""
+    structure as the reference (`output/replication_figures.tex:23-127`).
+    Figures in ``skip`` (intentionally not generated, e.g. no-run outcomes)
+    are omitted so the document always compiles."""
     titles = {
         1: "Baseline Model",
         2: "Heterogeneity Extension",
@@ -268,6 +283,8 @@ def write_tex(outdir: Path, sections: list) -> Path:
     for sec in sections:
         lines.append(rf"\section{{{titles[sec]}}}")
         for fig in MANIFEST[sec]:
+            if fig in skip:
+                continue
             lines += [
                 r"\begin{figure}[H]",
                 r"    \centering",
@@ -306,24 +323,35 @@ def main(argv=None) -> int:
     names = {1: "Baseline", 2: "Heterogeneity", 3: "Interest Rates", 4: "Social Learning"}
 
     t_start = time.time()
+    skipped = set()
     for sec in sections:
         print("=" * 70)
         print(f"SECTION {sec}/4: {names[sec]}")
         print("=" * 70)
         t0 = time.time()
-        runners[sec](figdir, args.fast)
+        skipped |= runners[sec](figdir, args.fast) or set()
         print(f"  section time: {time.time() - t0:.1f}s")
 
-    tex_path = write_tex(outdir, sections)
+    # The tex document reflects everything present on disk (not just the
+    # sections run now), so partial --sections runs extend rather than
+    # clobber a previously generated full document.
+    not_on_disk = {
+        f for sec in MANIFEST for f in MANIFEST[sec] if not (figdir / f).exists()
+    }
+    tex_sections = [s for s in MANIFEST if set(MANIFEST[s]) - not_on_disk]
+    tex_path = write_tex(outdir, tex_sections, skip=not_on_disk)
     total = time.time() - t_start
 
     print("=" * 70)
     print("REPLICATION COMPLETE")
     print(f"Total execution time: {total:.1f} seconds")
-    generated = [f for sec in sections for f in MANIFEST[sec]]
-    print(f"Generated {len(generated)} figures:")
+    expected = [f for sec in sections for f in MANIFEST[sec]]
+    print(f"Figures ({len(expected)} expected):")
     missing = []
-    for fig in generated:
+    for fig in expected:
+        if fig in skipped:
+            print(f"  - {figdir / fig} (skipped: no-run outcome)")
+            continue
         ok = (figdir / fig).exists()
         print(f"  {'✓' if ok else '✗'} {figdir / fig}")
         if not ok:

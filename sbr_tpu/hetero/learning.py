@@ -16,6 +16,7 @@ double loop: all groups evaluate in one broadcast.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from sbr_tpu.core.ode import rk4
@@ -43,7 +44,7 @@ def solve_learning_hetero(
     2_heterogeneity.jl:38`); RK4 at that resolution sits far below the
     pipeline's downstream tolerances.
     """
-    dtype = jnp.zeros((), dtype=dtype).dtype
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
     t0, t1 = params.tspan
     grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
     betas = jnp.asarray(params.betas, dtype=dtype)

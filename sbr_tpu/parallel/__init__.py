@@ -1,0 +1,13 @@
+"""Mesh construction and sharding helpers.
+
+The reference has no parallelism of any kind (SURVEY §2: sweeps are
+sequential loops, `scripts/1_baseline.jl:151,224`); this package is the
+TPU-native subsystem that fills that absence — device meshes for the
+embarrassingly-parallel sweep axes and the sharded agent/edge axis of the
+social-learning simulation (`jax.sharding` + shard_map; collectives ride
+ICI).
+"""
+
+from sbr_tpu.parallel.mesh import balanced_2d, make_agent_mesh, make_grid_mesh
+
+__all__ = ["balanced_2d", "make_agent_mesh", "make_grid_mesh"]

@@ -1,0 +1,59 @@
+"""Device-mesh construction for the framework's two parallel programs.
+
+- 2-D (b, u) meshes for comparative-statics grids (`sweeps.beta_u_grid`):
+  cells are independent, so the grid shards with no collectives and scales
+  linearly across chips.
+- 1-D agent meshes for the explicit-agent simulation (`social.agents`):
+  agents/edges shard over one axis with psum/all_gather inside shard_map.
+
+Nothing here is TPU-specific: the same meshes build over the virtual-CPU
+platform for tests (tests/conftest.py) and the driver's multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def balanced_2d(n: int) -> Tuple[int, int]:
+    """Most-square factorization (a, b) of n with a ≤ b.
+
+    Used to fold a flat device list into a (b, u) grid mesh so both sweep
+    axes scale; degenerates to (1, n) for primes.
+    """
+    for a in range(int(math.isqrt(n)), 0, -1):
+        if n % a == 0:
+            return a, n // a
+    return 1, n
+
+
+def _devices(devices=None):
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    return list(devices)
+
+
+def make_grid_mesh(
+    devices: Optional[Sequence] = None,
+    axis_names: Tuple[str, str] = ("b", "u"),
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """2-D mesh over all (or the given) devices for β×u grid sweeps."""
+    devices = _devices(devices)
+    if shape is None:
+        shape = balanced_2d(len(devices))
+    if shape[0] * shape[1] != len(devices):
+        raise ValueError(f"Mesh shape {shape} does not use {len(devices)} devices")
+    return Mesh(np.asarray(devices).reshape(shape), axis_names)
+
+
+def make_agent_mesh(devices: Optional[Sequence] = None, axis_name: str = "agents") -> Mesh:
+    """1-D mesh over all (or the given) devices for agent/edge sharding."""
+    devices = _devices(devices)
+    return Mesh(np.asarray(devices), (axis_name,))

@@ -151,7 +151,7 @@ def solve_equilibrium_social(
     """
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    dtype = jnp.zeros((), dtype=dtype).dtype
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
     econ = model.economic
     eta = econ.eta
     grid = jnp.linspace(jnp.zeros((), dtype), jnp.asarray(eta, dtype), config.n_grid)

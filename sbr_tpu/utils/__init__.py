@@ -1,0 +1,21 @@
+"""Auxiliary subsystems: timing/profiling, status accounting, and sweep
+checkpointing.
+
+The reference's equivalents are hand-rolled `time()` deltas stored as
+`solve_time` (`src/baseline/learning.jl:110,121`), `println` progress
+accounting (`scripts/1_baseline.jl:188-191,261-271`), and no checkpointing
+at all (every run recomputes everything — SURVEY §5.4). Here:
+
+- ``timing``     — wall-clock stage timers with honest device fences and
+                   `jax.profiler` trace capture.
+- ``status``     — structured per-cell status accounting (the jit-safe
+                   replacement for the reference's early-termination prints).
+- ``checkpoint`` — tiled sweep execution with on-disk resume and per-tile
+                   retry, so paper-resolution grids survive interruption.
+"""
+
+from sbr_tpu.utils.checkpoint import run_tiled_grid
+from sbr_tpu.utils.status import status_counts, status_summary
+from sbr_tpu.utils.timing import StageTimer, trace
+
+__all__ = ["StageTimer", "run_tiled_grid", "status_counts", "status_summary", "trace"]

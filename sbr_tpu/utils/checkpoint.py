@@ -1,0 +1,174 @@
+"""Tiled sweep execution with on-disk resume (SURVEY §5.4).
+
+The reference recomputes everything on every run — its only reuse is
+in-memory (`scripts/1_baseline.jl:44,169`). For paper-resolution grids
+(5000×5000, "a couple hours" on the reference's CPU,
+`1_baseline.jl:209-210`) the TPU build persists finished tiles so an
+interrupted sweep resumes instead of restarting, and a failed tile is
+retried rather than aborting the grid (the multi-host sweep-driver
+failure-detection analogue, SURVEY §5.3).
+
+Format: one ``.npz`` per tile (atomic rename) holding the four result
+grids, keyed by tile indices; a resumed run recomputes nothing for tiles
+already on disk. Tiles are plain numpy — checkpoints are device- and
+dtype-portable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.sweeps.baseline_sweeps import GridSweepResult, beta_u_grid
+
+_FIELDS = ("max_aw", "xi", "status")
+
+
+def _tile_path(ckpt_dir: Path, bi: int, ui: int) -> Path:
+    return ckpt_dir / f"tile_b{bi:05d}_u{ui:05d}.npz"
+
+
+def _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype) -> str:
+    """Hash of everything that determines tile contents, so a checkpoint dir
+    can never silently serve results for different parameters."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(beta_values, dtype=np.float64)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(u_values, dtype=np.float64)).tobytes())
+    h.update(repr((base, config, tuple(tile_shape), str(dtype))).encode())
+    return h.hexdigest()
+
+
+def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
+    manifest = ckpt / "manifest.json"
+    if manifest.exists():
+        stored = json.loads(manifest.read_text()).get("fingerprint")
+        if stored != fingerprint:
+            raise ValueError(
+                f"Checkpoint dir {ckpt} holds tiles for a different sweep "
+                "(grid values, model, config, tile shape, or dtype changed). "
+                "Use a fresh checkpoint_dir or delete the stale one."
+            )
+    else:
+        manifest.write_text(json.dumps({"fingerprint": fingerprint}))
+
+
+def _save_atomic(path: Path, arrays: dict) -> None:
+    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+    try:
+        # Write via the open handle: np.savez appends ".npz" to bare paths,
+        # which would break the atomic rename.
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def run_tiled_grid(
+    beta_values,
+    u_values,
+    base: ModelParams,
+    config: SolverConfig = SolverConfig(),
+    tile_shape: Tuple[int, int] = (256, 256),
+    checkpoint_dir: Optional[str] = None,
+    mesh=None,
+    dtype=None,
+    max_retries: int = 2,
+    verbose: bool = False,
+) -> GridSweepResult:
+    """β×u grid in tiles with optional on-disk resume.
+
+    Semantically identical to one `beta_u_grid` call over the full grid
+    (cells are independent); tiling bounds device-memory footprint at
+    paper resolution and gives the checkpoint/retry granularity.
+    """
+    beta_values = np.asarray(beta_values)
+    u_values = np.asarray(u_values)
+    nb, nu = len(beta_values), len(u_values)
+    tb, tu = tile_shape
+
+    if mesh is not None:
+        # Every tile (including ragged edge tiles) must satisfy
+        # beta_u_grid's divisibility precondition; validate up front so a
+        # deterministic sharding error is not retried.
+        mb, mu = (mesh.shape[a] for a in mesh.axis_names)
+        tile_dims = {min(tb, nb - bi) for bi in range(0, nb, tb)}, {
+            min(tu, nu - ui) for ui in range(0, nu, tu)
+        }
+        if any(d % mb for d in tile_dims[0]) or any(d % mu for d in tile_dims[1]):
+            raise ValueError(
+                f"Tile sizes {sorted(tile_dims[0])}×{sorted(tile_dims[1])} must be "
+                f"divisible by the mesh axes {mb}×{mu}; choose tile_shape/grid "
+                "sizes that are multiples of the mesh shape."
+            )
+
+    ckpt = None
+    if checkpoint_dir is not None:
+        ckpt = Path(checkpoint_dir)
+        ckpt.mkdir(parents=True, exist_ok=True)
+        _check_fingerprint(
+            ckpt, _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype)
+        )
+
+    out = {f: np.full((nb, nu), np.nan) for f in _FIELDS}
+    out["status"] = np.full((nb, nu), -1, dtype=np.int32)
+
+    n_cached = 0
+    for bi in range(0, nb, tb):
+        for ui in range(0, nu, tu):
+            bs = slice(bi, min(bi + tb, nb))
+            us = slice(ui, min(ui + tu, nu))
+            path = _tile_path(ckpt, bi, ui) if ckpt is not None else None
+
+            if path is not None and path.exists():
+                data = np.load(path)
+                for f in _FIELDS:
+                    out[f][bs, us] = data[f]
+                n_cached += 1
+                continue
+
+            last_err = None
+            for _ in range(max_retries + 1):
+                try:
+                    tile = beta_u_grid(
+                        beta_values[bs], u_values[us], base, config=config, mesh=mesh, dtype=dtype
+                    )
+                    arrays = {f: np.asarray(getattr(tile, f)) for f in _FIELDS}
+                    break
+                except Exception as err:  # retry analogue of SURVEY §5.3
+                    last_err = err
+            else:
+                raise RuntimeError(
+                    f"Tile ({bi},{ui}) failed after {max_retries + 1} attempts"
+                ) from last_err
+
+            for f in _FIELDS:
+                out[f][bs, us] = arrays[f]
+            if path is not None:
+                _save_atomic(path, arrays)
+            if verbose:
+                done = (bi // tb) * ((nu + tu - 1) // tu) + ui // tu + 1
+                total = ((nb + tb - 1) // tb) * ((nu + tu - 1) // tu)
+                print(f"  tile {done}/{total} done")
+
+    if verbose and n_cached:
+        print(f"  resumed {n_cached} tiles from {ckpt}")
+
+    import jax.numpy as jnp
+
+    return GridSweepResult(
+        beta_values=jnp.asarray(beta_values),
+        u_values=jnp.asarray(u_values),
+        max_aw=jnp.asarray(out["max_aw"]),
+        xi=jnp.asarray(out["xi"]),
+        status=jnp.asarray(out["status"]),
+    )

@@ -1,0 +1,34 @@
+"""Structured status accounting (SURVEY §5.5).
+
+The reference reports sweep outcomes through prints: percent-progress
+counters and early-termination totals (`scripts/1_baseline.jl:188-191,
+261-271`). Under jit there are no prints; every sweep instead returns an
+int32 status array (`models.results.Status`), and these helpers turn it
+into the same accounting after the fact.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from sbr_tpu.models.results import Status
+
+
+def status_counts(status) -> Dict[str, int]:
+    """Histogram of `Status` codes in a sweep's status array."""
+    status = np.asarray(status)
+    return {s.name: int((status == int(s)).sum()) for s in Status}
+
+
+def status_summary(status) -> str:
+    """One-line summary matching the reference's accounting: run cells vs
+    the no-run region it skips via early termination
+    (`1_baseline.jl:269-271`)."""
+    counts = status_counts(status)
+    total = int(np.asarray(status).size)
+    run = counts.get("RUN", 0)
+    parts = [f"{run}/{total} run"]
+    parts += [f"{v} {k.lower()}" for k, v in counts.items() if k != "RUN" and v]
+    return ", ".join(parts)

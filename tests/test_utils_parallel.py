@@ -1,0 +1,144 @@
+"""Tests for the auxiliary subsystems (utils/) and mesh helpers (parallel/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.models.results import Status
+from sbr_tpu.parallel import balanced_2d, make_agent_mesh, make_grid_mesh
+from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+from sbr_tpu.utils import StageTimer, run_tiled_grid, status_counts, status_summary
+from sbr_tpu.utils.timing import fence
+
+CFG = SolverConfig(n_grid=512, bisect_iters=60)
+
+
+class TestMesh:
+    def test_balanced_2d(self):
+        assert balanced_2d(8) == (2, 4)
+        assert balanced_2d(16) == (4, 4)
+        assert balanced_2d(7) == (1, 7)
+        assert balanced_2d(1) == (1, 1)
+        for n in (2, 6, 12, 24):
+            a, b = balanced_2d(n)
+            assert a * b == n and a <= b
+
+    def test_make_grid_mesh(self):
+        mesh = make_grid_mesh()
+        assert set(mesh.axis_names) == {"b", "u"}
+        assert mesh.devices.size == len(jax.devices())
+
+    def test_make_grid_mesh_bad_shape(self):
+        with pytest.raises(ValueError):
+            make_grid_mesh(shape=(3, 5))  # 15 != 8 devices
+
+    def test_make_agent_mesh(self):
+        mesh = make_agent_mesh()
+        assert mesh.axis_names == ("agents",)
+
+    def test_grid_sweep_on_helper_mesh(self):
+        """The helper-built mesh drives a sharded sweep end to end."""
+        mesh = make_grid_mesh()
+        base = make_model_params()
+        a, b = mesh.devices.shape
+        grid = beta_u_grid(
+            np.linspace(0.5, 2.0, 2 * a), np.linspace(0.05, 0.5, 2 * b), base, config=CFG, mesh=mesh
+        )
+        assert grid.max_aw.shape == (2 * a, 2 * b)
+        assert int((np.asarray(grid.status) == int(Status.RUN)).sum()) > 0
+
+
+class TestStatus:
+    def test_counts_and_summary(self):
+        status = jnp.asarray([0, 0, 1, 2, 3, 0], dtype=jnp.int32)
+        counts = status_counts(status)
+        assert counts["RUN"] == 3
+        assert counts["NO_CROSSING"] == 1
+        assert counts["NO_ROOT"] == 1
+        assert counts["FALSE_EQ"] == 1
+        s = status_summary(status)
+        assert "3/6 run" in s
+
+    def test_summary_matches_sweep(self):
+        base = make_model_params()
+        grid = beta_u_grid(np.linspace(0.5, 2.0, 4), np.linspace(0.05, 2.0, 8), base, config=CFG)
+        counts = status_counts(grid.status)
+        assert sum(counts.values()) == 32
+        # High u region must contain no-run cells, low u must run.
+        assert counts["RUN"] > 0
+        assert counts["RUN"] < 32
+
+
+class TestTiming:
+    def test_stage_timer(self):
+        timer = StageTimer()
+        with timer.stage("a"):
+            x = jnp.ones((64,)) * 2.0
+            timer.sync(x)
+        with timer.stage("b"):
+            pass
+        assert timer.times["a"] >= 0.0
+        assert set(timer.times) == {"a", "b"}
+        rep = timer.report()
+        assert "a" in rep and "total" in rep
+
+    def test_fence_handles_nan_and_ints(self):
+        fence(jnp.asarray([1.0, jnp.nan]), jnp.asarray([1, 2], dtype=jnp.int32), jnp.asarray([True]))
+
+
+class TestTiledCheckpoint:
+    def _grids(self):
+        return np.linspace(0.5, 2.0, 6), np.linspace(0.02, 1.0, 8)
+
+    def test_matches_monolithic(self):
+        betas, us = self._grids()
+        base = make_model_params()
+        mono = beta_u_grid(betas, us, base, config=CFG)
+        tiled = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(4, 3))
+        np.testing.assert_allclose(
+            np.asarray(tiled.max_aw), np.asarray(mono.max_aw), rtol=1e-12, equal_nan=True
+        )
+        np.testing.assert_array_equal(np.asarray(tiled.status), np.asarray(mono.status))
+
+    def test_resume_from_disk(self, tmp_path):
+        betas, us = self._grids()
+        base = make_model_params()
+        first = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(3, 4), checkpoint_dir=tmp_path)
+        tiles = sorted(tmp_path.glob("tile_*.npz"))
+        assert len(tiles) == 2 * 2
+
+        # Corrupt-resistant resume: poison one stored tile, delete another;
+        # the poisoned one must be served from disk (proving no recompute),
+        # the deleted one recomputed.
+        poisoned = np.load(tiles[0])
+        arrays = {k: poisoned[k].copy() for k in poisoned.files}
+        arrays["xi"] = np.full_like(arrays["xi"], 123.0)
+        with open(tiles[0], "wb") as f:
+            np.savez(f, **arrays)
+        tiles[1].unlink()
+
+        second = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(3, 4), checkpoint_dir=tmp_path)
+        assert np.all(np.asarray(second.xi)[:3, :4] == 123.0)
+        # The rest of the grid still matches the first run.
+        np.testing.assert_allclose(
+            np.asarray(second.max_aw)[3:, :], np.asarray(first.max_aw)[3:, :],
+            rtol=1e-12, equal_nan=True,
+        )
+
+    def test_retry_then_raise(self, monkeypatch):
+        betas, us = self._grids()
+        base = make_model_params()
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("injected")
+
+        import sbr_tpu.utils.checkpoint as ckpt
+
+        monkeypatch.setattr(ckpt, "beta_u_grid", boom)
+        with pytest.raises(RuntimeError, match="failed after 3 attempts"):
+            run_tiled_grid(betas, us, base, config=CFG, tile_shape=(6, 8), max_retries=2)
+        assert calls["n"] == 3
