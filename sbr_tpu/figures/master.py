@@ -110,11 +110,11 @@ def run_baseline(figdir: Path, fast: bool) -> None:
 
     # Figure 4: u-sweep, paper resolution 5000 points over [0.001, 0.2]
     # (`1_baseline.jl:137-200`), vmapped with Stage 1 shared.
+    from sbr_tpu.utils.status import status_summary
+
     n_u = 500 if fast else 5000
     print(f"Figure 4: u-sweep ({n_u} points)")
     sweep = u_sweep(lr_base, np.linspace(0.001, 0.2, n_u), m_base.economic)
-    from sbr_tpu.utils.status import status_summary
-
     print(f"  {status_summary(sweep.status)} (no-run region recovered from status grid)")
     fig_a, fig_b = plot_comp_stat_withdrawals_and_collapse(
         sweep.u_values,
@@ -132,8 +132,6 @@ def run_baseline(figdir: Path, fast: bool) -> None:
     print(f"Figure 5: β×u heatmap ({n_grid}×{n_grid})")
     amt = np.linspace(1e-4, 1.0, n_grid)
     u_vals = np.linspace(0.001, 1.0, n_grid)
-    from sbr_tpu.utils.status import status_summary
-
     grid = beta_u_grid(1.0 / amt, u_vals, m_base)
     print(f"  {status_summary(grid.status)}")
     # Reference stores (U, B) (`1_baseline.jl:213`); ours is (B, U).
@@ -193,7 +191,7 @@ def run_interest(figdir: Path, fast: bool) -> None:
     )
 
 
-def run_social(figdir: Path, fast: bool) -> None:
+def run_social(figdir: Path, fast: bool) -> set:
     """Section 4: social-learning fixed point vs word-of-mouth baseline
     (`scripts/4_social_learning.jl`)."""
     from sbr_tpu import make_model_params, solve_learning

@@ -49,13 +49,30 @@ def solve_equilibrium_interest_core(
     r = jnp.asarray(r, dtype=dtype)
     nan = jnp.asarray(jnp.nan, dtype=dtype)
 
-    tau_grid, hr, _, _ = _hazard_parts(p, lam, ls, eta, config)
+    tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
     v = solve_value_function(tau_grid, hr, delta, r, u, config)
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
 
-    # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`);
-    # no closed-form refinement — V is known only on the grid.
-    tau_in_unc, tau_out_unc = optimal_buffer(u, tau_grid, hr_eff, tspan_end, hazard_at=None)
+    # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`).
+    # With closed-form Stage 1, crossings refine against the exact hazard
+    # minus r·V̂ (V linearly interpolated — it is known only on the grid);
+    # at r = 0 this is bit-identical to the baseline's refined path, the
+    # reference's r=0 fallback oracle (`interest_rate_solver.jl:89-101`).
+    hazard_eff_at = None
+    if ls.closed_form:
+        from sbr_tpu.baseline.solver import _make_hazard_at
+        from sbr_tpu.core.interp import interp_uniform
+
+        hazard_at = _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config)
+        t0 = tau_grid[0]
+        dt = tau_grid[1] - tau_grid[0]
+
+        def hazard_eff_at(tau):
+            return hazard_at(tau) - r * interp_uniform(tau, t0, dt, v)
+
+    tau_in_unc, tau_out_unc = optimal_buffer(
+        u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at
+    )
     no_crossing = tau_in_unc == tau_out_unc
 
     # ξ and AW use the baseline machinery on the word-of-mouth CDF unchanged

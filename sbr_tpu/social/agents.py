@@ -8,8 +8,13 @@ actions of its in-neighbors. Per step of size dt:
 
     withdrawn_i(t) = informed_i ∧ (t ≥ t_inf_i + exit_delay)
                                 ∧ (t < t_inf_i + reentry_delay)
-    frac_i(t)      = (Σ_{j→i} withdrawn_j) / indegree_i      ← segment_sum
-    P(i informs)   = 1 - exp(-β_i · frac_i · dt)             ← exact hazard
+    frac_i(t)      = (Σ_{j→i} withdrawn_j) / indegree_i    ← segmented reduce
+    P(i informs)   = 1 - exp(-β_i · frac_i · dt)           ← exact hazard
+
+The segmented reduction over dst-sorted edges is an exact int32 prefix sum
+plus row-pointer gathers (`_seg_counts`) — the TPU-native form; a
+`segment_sum` scatter-add serializes on TPU (~200 ms/step at 10^7 edges
+measured on v5e, vs milliseconds for the prefix-sum form).
 
 The withdrawal window mirrors the equilibrium strategy: from `get_AW`
 (`src/baseline/solver.jl:495-532`), an agent informed at time s is withdrawn
@@ -23,9 +28,10 @@ to the baseline logistic dG/dt = β·G·(1-G) — the validation oracle
 Sharding (SURVEY §7.3 "million-agent graph sharding"): edges are sorted by
 destination and sharded BY EDGE COUNT (balanced under scale-free degree
 skew), agents block-sharded by id. Each device all-gathers the global
-withdrawn bitmask (N bools — small), segment-sums its local edges into a
-full-length count vector, and a `psum` over the mesh resolves destinations
-whose edge lists straddle shards. All collectives are XLA natives riding ICI.
+withdrawn bitmask (N bools — small), reduces its local edge chunk into a
+full-length count vector via its own row-pointer table, and a `psum` over
+the mesh resolves destinations whose edge lists straddle shards. All
+collectives are XLA natives riding ICI.
 """
 
 from __future__ import annotations
@@ -138,22 +144,41 @@ def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
 
 
 def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype):
-    """Host-side canonicalization: per-agent β, in-degrees, initial seeds.
+    """Host-side canonicalization: per-agent β, in-degrees, dst-sorted edges
+    with their row-pointer table, initial seeds.
 
-    Edges are sorted by destination so the per-step `segment_sum` scatter
-    runs with ``indices_are_sorted=True`` — the difference between a random
-    scatter-add and a segmented reduction on TPU."""
+    Edges are sorted by destination so the per-step neighbor aggregation is
+    a segmented reduction over contiguous edge ranges. On TPU that is
+    implemented as an exact int32 prefix sum plus two row-pointer gathers —
+    NOT `segment_sum`, whose scatter-add lowering serializes on TPU
+    (measured ~200 ms/step at 10^7 edges vs ~ms for the cumsum form).
+    ``row_ptr[i]`` is the first edge index with dst ≥ i, so edges of agent i
+    occupy [row_ptr[i], row_ptr[i+1])."""
     betas = np.broadcast_to(np.asarray(betas, dtype=dtype), (n,)).copy()
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
     order = np.argsort(dst, kind="stable")
     src, dst = src[order], dst[order]
     indeg = np.bincount(dst, minlength=n).astype(dtype)
+    row_ptr = np.searchsorted(dst, np.arange(n + 1), side="left").astype(np.int32)
     rng = np.random.default_rng(seed)
     informed0 = rng.random(n) < x0
     if x0 > 0 and not informed0.any():  # guarantee ≥1 seed when x0>0 implies
         informed0[rng.integers(0, n)] = True
-    return betas, src, dst, indeg, informed0
+    return betas, src, dst, indeg, row_ptr, informed0
+
+
+def _seg_counts(active_src, row_ptr):
+    """Per-destination neighbor counts from a dst-sorted edge activity mask.
+
+    Exact integer prefix sum: P[k] = Σ_{e<k} active[e], counts_i =
+    P[row_ptr[i+1]] − P[row_ptr[i]]. Exact for up to 2^31 edges; log-depth
+    cumsum + gathers, all TPU-friendly primitives.
+    """
+    prefix = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(active_src.astype(jnp.int32))]
+    )
+    return prefix[row_ptr[1:]] - prefix[row_ptr[:-1]]
 
 
 @functools.lru_cache(maxsize=None)
@@ -161,7 +186,7 @@ def _single_device_sim(config: AgentSimConfig):
     dt = config.dt
 
     @jax.jit
-    def run(betas, src, dst, indeg, informed0, key):
+    def run(betas, src, row_ptr, indeg, informed0, key):
         n = betas.shape[0]
         dtype = betas.dtype
         t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(dtype)
@@ -171,10 +196,8 @@ def _single_device_sim(config: AgentSimConfig):
             informed, t_inf, key = carry
             t = k.astype(dtype) * dt
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
-            counts = jax.ops.segment_sum(
-                wd[src].astype(dtype), dst, num_segments=n, indices_are_sorted=True
-            )
-            frac = counts / safe_deg
+            counts = _seg_counts(wd[src], row_ptr)
+            frac = counts.astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
             key, sub = jax.random.split(key)
             newly = (~informed) & (jax.random.uniform(sub, (n,), dtype=dtype) < p_inf)
@@ -202,17 +225,20 @@ def _single_device_sim(config: AgentSimConfig):
 @functools.lru_cache(maxsize=None)
 def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int):
     """shard_map kernel: agents block-sharded, edges count-sharded (sorted by
-    dst), counts resolved across shards with one psum per step."""
+    dst), counts resolved across shards with one psum per step. Neighbor
+    aggregation uses the same prefix-sum/row-pointer form as the
+    single-device kernel (`_seg_counts`), with a per-shard row-pointer table
+    over the global segment ids (edge ranges are contiguous per shard)."""
     dt = config.dt
     n_dev = mesh.shape[axis]
 
-    def shard_fn(betas, src, dst, indeg, informed0, key):
+    def shard_fn(betas, src, row_ptr, indeg, informed0, key):
         nb = betas.shape[0]  # local agent block
         dtype = betas.dtype
         idx = lax.axis_index(axis)
         offset = idx * nb
-        n_global = nb * n_dev  # static: num_segments must be a Python int
         key = jax.random.fold_in(key[0], idx)
+        row_ptr = row_ptr[0]  # (N_global + 2,): local edge ranges incl. pad segment
         t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(dtype)
         safe_deg = jnp.maximum(indeg, 1.0)
         inv_n = 1.0 / n_true
@@ -222,13 +248,11 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int):
             t = k.astype(dtype) * dt
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
             wd_global = lax.all_gather(wd, axis, tiled=True)  # (N,) bool
-            # local edges: global dst ids; padded rows carry dst = N (dropped)
-            contrib = wd_global[src].astype(dtype)
-            counts = jax.ops.segment_sum(
-                contrib, dst, num_segments=n_global + 1, indices_are_sorted=True
-            )[:-1]
+            # local edges carry global dst ids; the pad segment (dst = N) is
+            # the last row of the pointer table and is dropped here.
+            counts = _seg_counts(wd_global[src], row_ptr)[:-1]
             counts = lax.psum(counts, axis)  # straddling dst ranges
-            frac = lax.dynamic_slice(counts, (offset,), (nb,)) / safe_deg
+            frac = lax.dynamic_slice(counts, (offset,), (nb,)).astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
             key, sub = jax.random.split(key)
             newly = (~informed) & (jax.random.uniform(sub, (nb,), dtype=dtype) < p_inf)
@@ -282,7 +306,7 @@ def simulate_agents(
     ≥10^4 agents, where Monte-Carlo error dominates rounding by orders of
     magnitude — the f32 sweet spot for TPU (SURVEY §7.3 precision ladder).
     """
-    betas_h, src_h, dst_h, indeg_h, informed0_h = _prep_inputs(
+    betas_h, src_h, dst_h, indeg_h, row_ptr_h, informed0_h = _prep_inputs(
         n, betas, x0, src, dst, seed, np.dtype(dtype)
     )
     key = jax.random.PRNGKey(seed)
@@ -292,7 +316,7 @@ def simulate_agents(
         return run(
             jnp.asarray(betas_h),
             jnp.asarray(src_h),
-            jnp.asarray(dst_h),
+            jnp.asarray(row_ptr_h),
             jnp.asarray(indeg_h),
             jnp.asarray(informed0_h),
             key,
@@ -309,10 +333,22 @@ def simulate_agents(
     # edges arrive dst-sorted from _prep_inputs (contiguous destination
     # ranges per shard); pad with sentinel dst = N_padded (an extra segment
     # dropped inside the kernel).
+    n_gl = n + n_pad
     e_pad = (-len(src_h)) % n_dev
     if e_pad:
         src_h = np.concatenate([src_h, np.zeros(e_pad, np.int32)])
-        dst_h = np.concatenate([dst_h, np.full(e_pad, n + n_pad, np.int32)])
+        dst_h = np.concatenate([dst_h, np.full(e_pad, n_gl, np.int32)])
+    # Per-shard row-pointer tables over the global segment ids (plus the pad
+    # segment): each shard's edge chunk is dst-sorted, so its pointers are a
+    # searchsorted over that chunk.
+    e_local = len(dst_h) // n_dev
+    seg_ids = np.arange(n_gl + 2)
+    row_ptrs_h = np.stack(
+        [
+            np.searchsorted(dst_h[d * e_local : (d + 1) * e_local], seg_ids, side="left")
+            for d in range(n_dev)
+        ]
+    ).astype(np.int32)
 
     fn = _sharded_sim(config, mesh, mesh_axis, n)
     shard = NamedSharding(mesh, P(mesh_axis))
@@ -321,7 +357,7 @@ def simulate_agents(
     )
     args = [
         jax.device_put(jnp.asarray(a), shard)
-        for a in (betas_h, src_h, dst_h, indeg_h, informed0_h)
+        for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h)
     ]
     gs, aws, informed, t_inf = fn(*args, keys)
     if n_pad:

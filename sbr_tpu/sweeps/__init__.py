@@ -1,4 +1,5 @@
-"""Comparative-statics sweeps (reference `scripts/1_baseline.jl:137-285`)."""
+"""Comparative-statics sweeps (reference `scripts/1_baseline.jl:137-285`)
+and the (β, u, r) interest-rate policy grids (no reference counterpart)."""
 
 from sbr_tpu.sweeps.baseline_sweeps import (
     GridSweepResult,
@@ -6,3 +7,4 @@ from sbr_tpu.sweeps.baseline_sweeps import (
     beta_u_grid,
     u_sweep,
 )
+from sbr_tpu.sweeps.policy_sweeps import PolicySweepResult, policy_sweep_interest

@@ -1,0 +1,114 @@
+"""Policy sweeps over the interest-rate extension: vmapped (β, u, r) grids.
+
+The reference has no policy-sweep machinery — its interest-rate script
+solves a single calibration (`scripts/3_interest_rates.jl:37-64`). This
+module provides the stretch-config workload from BASELINE.md: a 10^3-point
+(β, u, r) grid of interest-rate equilibria as one jitted program, the
+r-axis analogue of the baseline β×u sweep (`sweeps.baseline_sweeps`).
+
+Structure exploited: Stage 1 depends only on β (closed form, free per
+cell); the HJB value function and Stages 2-3 depend on (u, r, δ) and are
+recomputed per cell — each cell is a `solve_equilibrium_interest_core`
+call, so r = 0 cells degrade to exactly the baseline solver's answer
+(`interest_rate_solver.jl:89-101` regression oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from sbr_tpu.baseline.learning import solve_learning
+from sbr_tpu.interest.solver import solve_equilibrium_interest_core
+from sbr_tpu.models.params import ModelParamsInterest, SolverConfig
+from sbr_tpu.sweeps.baseline_sweeps import _TracedLearning
+
+
+@struct.dataclass
+class PolicySweepResult:
+    """(B, U, R) grids of equilibrium scalars."""
+
+    beta_values: jnp.ndarray
+    u_values: jnp.ndarray
+    r_values: jnp.ndarray
+    xi: jnp.ndarray  # (B, U, R)
+    aw_max: jnp.ndarray  # (B, U, R)
+    status: jnp.ndarray  # (B, U, R) int32
+
+
+@functools.lru_cache(maxsize=None)
+def _policy_fn(config: SolverConfig, dtype_name: str):
+    """Jitted (β, u, r) program, cached by (config, dtype)."""
+    dtype = jnp.dtype(dtype_name)
+
+    def cell(beta, u, r, p, kappa, lam, eta, delta, t0, t1, x0):
+        ls = solve_learning(_TracedLearning(beta=beta, tspan=(t0, t1), x0=x0), config, dtype=dtype)
+        res = solve_equilibrium_interest_core(ls, u, p, kappa, lam, eta, r, delta, t1, config)
+        return res.base.xi, res.base.aw_max, res.base.status
+
+    bcast = (None,) * 8
+    fn = jax.vmap(  # β axis
+        jax.vmap(  # u axis
+            jax.vmap(cell, in_axes=(None, None, 0) + bcast),  # r axis
+            in_axes=(None, 0, None) + bcast,
+        ),
+        in_axes=(0, None, None) + bcast,
+    )
+    return jax.jit(fn)
+
+
+def policy_sweep_interest(
+    beta_values,
+    u_values,
+    r_values,
+    base: ModelParamsInterest,
+    config: SolverConfig = SolverConfig(),
+    dtype=None,
+) -> PolicySweepResult:
+    """(β, u, r) policy grid of interest-rate equilibria.
+
+    η/tspan/δ stay pinned at the base model's resolved values for every
+    cell, matching the copy-constructor semantics of the baseline sweeps
+    (`models.params.with_overrides` docstring). All r must satisfy r < δ.
+    """
+    econ = base.economic
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+
+    import numpy as np
+
+    if float(np.max(np.asarray(r_values))) >= econ.delta:
+        raise ValueError(f"All r values must be < delta = {econ.delta}")
+
+    beta_values = jnp.asarray(beta_values, dtype=dtype)
+    u_values = jnp.asarray(u_values, dtype=dtype)
+    r_values = jnp.asarray(r_values, dtype=dtype)
+    tspan = base.learning.tspan
+
+    scalars = tuple(
+        jnp.asarray(v, dtype)
+        for v in (
+            econ.p,
+            econ.kappa,
+            econ.lam,
+            econ.eta,
+            econ.delta,
+            tspan[0],
+            tspan[1],
+            base.learning.x0,
+        )
+    )
+    xi, aw_max, status = _policy_fn(config, dtype.name)(beta_values, u_values, r_values, *scalars)
+    return PolicySweepResult(
+        beta_values=beta_values,
+        u_values=u_values,
+        r_values=r_values,
+        xi=xi,
+        aw_max=aw_max,
+        status=status,
+    )

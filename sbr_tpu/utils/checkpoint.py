@@ -55,6 +55,14 @@ def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
                 "(grid values, model, config, tile shape, or dtype changed). "
                 "Use a fresh checkpoint_dir or delete the stale one."
             )
+    elif any(ckpt.glob("tile_*.npz")):
+        # Tiles without a manifest cannot be attributed to any sweep — fail
+        # closed rather than silently adopting them.
+        raise ValueError(
+            f"Checkpoint dir {ckpt} contains tiles but no manifest.json; "
+            "cannot confirm they belong to this sweep. Use a fresh "
+            "checkpoint_dir or delete the unattributed tiles."
+        )
     else:
         manifest.write_text(json.dumps({"fingerprint": fingerprint}))
 
@@ -100,7 +108,9 @@ def run_tiled_grid(
         # Every tile (including ragged edge tiles) must satisfy
         # beta_u_grid's divisibility precondition; validate up front so a
         # deterministic sharding error is not retried.
-        mb, mu = (mesh.shape[a] for a in mesh.axis_names)
+        # beta_u_grid shards by the axes NAMED "b" and "u" (its default
+        # mesh_axes), regardless of their order in the mesh.
+        mb, mu = mesh.shape["b"], mesh.shape["u"]
         tile_dims = {min(tb, nb - bi) for bi in range(0, nb, tb)}, {
             min(tu, nu - ui) for ui in range(0, nu, tu)
         }
@@ -119,8 +129,11 @@ def run_tiled_grid(
             ckpt, _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype)
         )
 
-    out = {f: np.full((nb, nu), np.nan) for f in _FIELDS}
-    out["status"] = np.full((nb, nu), -1, dtype=np.int32)
+    out = {
+        "max_aw": np.full((nb, nu), np.nan),
+        "xi": np.full((nb, nu), np.nan),
+        "status": np.full((nb, nu), -1, dtype=np.int32),
+    }
 
     n_cached = 0
     for bi in range(0, nb, tb):

@@ -136,3 +136,51 @@ class TestHeteroEquilibrium:
         # peak withdrawal reaches at least κ (a run happened)
         assert float(aw.aw_max) >= m.economic.kappa - 1e-6
         assert (np.asarray(aw.aw_groups) >= -1e-9).all()
+
+
+class TestThousandGroups:
+    """BASELINE.md parity config: K=1000 learning-speed groups."""
+
+    def test_k1000_solves(self):
+        cfg = SolverConfig(n_grid=1024, bisect_iters=60)
+        k = 1000
+        rng = np.random.default_rng(0)
+        betas = np.exp(rng.uniform(np.log(0.2), np.log(5.0), k))
+        dist = rng.dirichlet(np.ones(k))
+        # exact simplex normalization for the 1e-10 constructor check
+        dist = dist / dist.sum()
+        m = make_hetero_params(
+            betas=betas, dist=dist, eta_bar=15.0, u=0.1, p=0.5, kappa=0.6, lam=0.01
+        )
+        lsh = solve_learning_hetero(m.learning, cfg)
+        assert lsh.cdfs.shape == (k, cfg.n_grid)
+        res = solve_equilibrium_hetero(lsh, m.economic, cfg)
+        assert bool(res.bankrun)
+        assert res.hrs.shape == (k, cfg.n_grid)
+        aw = get_aw_hetero(res, lsh)
+        # equilibrium condition holds for the 1000-group weighted AW
+        assert abs(float(aw.aw_max)) <= 1.0
+        assert float(aw.aw_max) >= m.economic.kappa - 1e-6
+
+    def test_k1000_uniform_groups_degenerate_to_baseline(self):
+        """1000 identical groups must equal the single-group baseline —
+        the K=1 degeneracy oracle at scale (SURVEY §4(b))."""
+        from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline
+
+        # full-resolution grid: the comparison measures RK4+interp error
+        # against the closed form, which is O(h^2) in the grid spacing
+        cfg = SolverConfig(n_grid=4096, bisect_iters=60)
+        k = 1000
+        dist = np.full(k, 1.0 / k)
+        dist = dist / dist.sum()
+        m = make_hetero_params(
+            betas=np.full(k, 1.0), dist=dist, eta_bar=15.0, u=0.1, p=0.5, kappa=0.6, lam=0.01
+        )
+        lsh = solve_learning_hetero(m.learning, cfg)
+        res = solve_equilibrium_hetero(lsh, m.economic, cfg)
+
+        mb = make_model_params(beta=1.0, eta_bar=15.0, u=0.1, p=0.5, kappa=0.6, lam=0.01)
+        ls = solve_learning(mb.learning, cfg)
+        base = solve_equilibrium_baseline(ls, mb.economic, cfg)
+        # RK4-sampled CDF vs closed form, then identical downstream machinery
+        np.testing.assert_allclose(float(res.xi), float(base.xi), atol=1e-5)

@@ -1,0 +1,86 @@
+"""Tests for the (β, u, r) interest-rate policy sweep."""
+
+import numpy as np
+import pytest
+
+from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline
+from sbr_tpu.interest.solver import solve_equilibrium_interest
+from sbr_tpu.models.params import SolverConfig, make_interest_params
+from sbr_tpu.models.results import Status
+from sbr_tpu.sweeps import policy_sweep_interest
+
+CFG = SolverConfig(n_grid=1024, bisect_iters=60)
+
+
+def test_policy_sweep_matches_scalar_solves():
+    base = make_interest_params(u=0.0, delta=0.1)
+    betas = np.asarray([0.8, 1.0, 1.5])
+    us = np.asarray([0.0, 0.05])
+    rs = np.asarray([0.0, 0.03, 0.06])
+    sweep = policy_sweep_interest(betas, us, rs, base, CFG)
+    assert sweep.xi.shape == (3, 2, 3)
+
+    for bi, ui, ri in [(0, 0, 0), (1, 0, 2), (2, 1, 1)]:
+        m = make_interest_params(
+            beta=float(betas[bi]),
+            # η/tspan pinned at base resolved values, like the sweep.
+            eta=base.economic.eta,
+            tspan=base.learning.tspan,
+            u=float(us[ui]),
+            r=float(rs[ri]),
+            delta=0.1,
+        )
+        ls = solve_learning(m.learning, CFG)
+        single = solve_equilibrium_interest(ls, m.economic, CFG)
+        np.testing.assert_allclose(
+            float(sweep.xi[bi, ui, ri]), float(single.base.xi), rtol=1e-10, equal_nan=True
+        )
+        assert int(sweep.status[bi, ui, ri]) == int(single.base.status)
+
+
+def test_r_zero_plane_matches_baseline_sweep():
+    """The r=0 plane must reproduce the baseline solver exactly — the
+    reference's r=0 fallback oracle (`interest_rate_solver.jl:89-101`)."""
+    base = make_interest_params(u=0.1, delta=0.1)
+    betas = np.asarray([1.0, 2.0])
+    us = np.asarray([0.05, 0.1, 0.3])
+    sweep = policy_sweep_interest(betas, us, np.asarray([0.0]), base, CFG)
+
+    for bi, beta in enumerate(betas):
+        m = make_model_params(beta=float(beta), eta=base.economic.eta, tspan=base.learning.tspan)
+        ls = solve_learning(m.learning, CFG)
+        for ui, u in enumerate(us):
+            from sbr_tpu.models.params import EconomicParams
+
+            econ = EconomicParams(
+                u=float(u),
+                p=m.economic.p,
+                kappa=m.economic.kappa,
+                lam=m.economic.lam,
+                eta_bar=m.economic.eta_bar,
+                eta=m.economic.eta,
+            )
+            single = solve_equilibrium_baseline(ls, econ, CFG)
+            np.testing.assert_allclose(
+                float(sweep.xi[bi, ui, 0]), float(single.xi), rtol=1e-10, equal_nan=True
+            )
+
+
+def test_r_raises_collapse_threshold_monotonicity():
+    """Higher r raises the continuation value, delaying/removing runs: the
+    run region can only shrink as r grows (economic sanity check)."""
+    base = make_interest_params(u=0.0, delta=0.1)
+    rs = np.linspace(0.0, 0.09, 4)
+    sweep = policy_sweep_interest(
+        np.asarray([1.0]), np.linspace(0.0, 0.4, 24), rs, base, CFG
+    )
+    run = np.asarray(sweep.status) == int(Status.RUN)
+    counts = run.sum(axis=(0, 1))  # per-r run counts
+    assert (np.diff(counts) <= 0).all()
+    assert counts[0] > 0
+
+
+def test_r_above_delta_rejected():
+    base = make_interest_params(delta=0.1)
+    with pytest.raises(ValueError, match="must be < delta"):
+        policy_sweep_interest([1.0], [0.1], [0.2], base, CFG)
