@@ -1,0 +1,124 @@
+"""Native host-side runtime components, bound via ctypes.
+
+The reference package is pure Julia with no native layer (SURVEY §2); the
+rebuild's accelerator path is XLA, and this package covers the host side of
+the data pipeline where numpy is the bottleneck: graph preprocessing for
+the 10^6-agent simulation (counting sort of 10^8-edge lists, O(E + N),
+replacing numpy's O(E log E) argsort).
+
+The shared library compiles lazily on first use with the system g++ and is
+cached next to the source, keyed by source mtime. Every entry point has a
+pure-numpy fallback, so the framework works identically (slower) where no
+compiler is available. `sbr_tpu.social.agents` picks these up
+automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "graphgen.cpp"
+_LIB_NAME = "libsbr_graphgen.so"
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    """Compile (if stale/missing) and load the native library; None on any
+    failure — callers fall back to numpy."""
+    cache_dir = Path(
+        os.environ.get("SBR_TPU_NATIVE_CACHE", Path.home() / ".cache" / "sbr_tpu_native")
+    )
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        lib_path = cache_dir / _LIB_NAME
+        if not lib_path.exists() or lib_path.stat().st_mtime < _SRC.stat().st_mtime:
+            with tempfile.TemporaryDirectory(dir=cache_dir) as tmp:
+                tmp_so = Path(tmp) / _LIB_NAME
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(tmp_so)],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp_so, lib_path)
+        lib = ctypes.CDLL(str(lib_path))
+    except Exception:
+        return None
+
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.sort_edges_by_dst.restype = ctypes.c_int
+    lib.sort_edges_by_dst.argtypes = [
+        i32p, i32p, ctypes.c_int64, ctypes.c_int32, i32p, i32p, i64p, i32p,
+    ]
+    lib.er_edges.restype = None
+    lib.er_edges.argtypes = [ctypes.c_int32, ctypes.c_int64, ctypes.c_uint64, i32p, i32p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib = _build_lib()
+        _lib_tried = True
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def sort_edges_by_dst(
+    src, dst, n: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Sort edges by destination; return (src, dst, indeg, row_ptr).
+
+    Stable in source order, matching ``np.argsort(dst, kind="stable")``.
+    ``row_ptr`` is int64 of length n+1 with edges of dst i in
+    [row_ptr[i], row_ptr[i+1]). Uses the native counting sort when the
+    library is available, numpy otherwise.
+    """
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    e = src.shape[0]
+
+    lib = get_lib()
+    if lib is not None:
+        src_out = np.empty(e, np.int32)
+        dst_out = np.empty(e, np.int32)
+        row_ptr = np.empty(n + 1, np.int64)
+        indeg = np.empty(n, np.int32)
+        rc = lib.sort_edges_by_dst(src, dst, e, n, src_out, dst_out, row_ptr, indeg)
+        if rc != 0:
+            raise ValueError(f"dst ids out of range [0, {n})")
+        return src_out, dst_out, indeg, row_ptr
+
+    if e and (dst.min() < 0 or dst.max() >= n):  # match the native path's check
+        raise ValueError(f"dst ids out of range [0, {n})")
+    order = np.argsort(dst, kind="stable")
+    src_out, dst_out = src[order], dst[order]
+    indeg = np.bincount(dst, minlength=n).astype(np.int32)
+    row_ptr = np.zeros(n + 1, np.int64)
+    np.cumsum(indeg, out=row_ptr[1:])
+    return src_out, dst_out, indeg, row_ptr
+
+
+def er_edges_native(n: int, e: int, seed: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native uniform edge sampling (self-loops re-drawn); None when the
+    library is unavailable. Deterministic in seed, independent of numpy's
+    RNG stream."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.empty(e, np.int32)
+    dst = np.empty(e, np.int32)
+    lib.er_edges(n, e, seed, src, dst)
+    return src, dst

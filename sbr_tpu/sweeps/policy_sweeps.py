@@ -16,7 +16,6 @@ call, so r = 0 cells degrade to exactly the baseline solver's answer
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -129,11 +129,10 @@ def run_tiled_grid(
             ckpt, _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype)
         )
 
-    out = {
-        "max_aw": np.full((nb, nu), np.nan),
-        "xi": np.full((nb, nu), np.nan),
-        "status": np.full((nb, nu), -1, dtype=np.int32),
-    }
+    # Keyed off _FIELDS so the accumulator, tile save, and cache load stay in
+    # lockstep: adding a field without an init entry fails loudly here.
+    field_init = {"max_aw": (np.nan, np.float64), "xi": (np.nan, np.float64), "status": (-1, np.int32)}
+    out = {f: np.full((nb, nu), *field_init[f]) for f in _FIELDS}
 
     n_cached = 0
     for bi in range(0, nb, tb):
