@@ -1,13 +1,25 @@
-"""Benchmark: equilibria/sec on the Figure-5 β×u comparative-statics grid.
+"""Benchmark: the two headline workloads from BASELINE.md.
 
-The headline workload (SURVEY §6, BASELINE.md): the reference solves the
-500×500 β×u grid sequentially in the bulk of its 5-15 min replication run
-(`scripts/1_baseline.jl:209-285`) and reports ~0.5 s per single equilibrium
-solve (paper Appendix C.5.3) — i.e. a baseline of 2 equilibria/sec. Here the
-whole grid is one jitted vmap² program on the accelerator; `vs_baseline` is
-(our equilibria/sec) / 2.
+1. equilibria/sec on the Figure-5 β×u comparative-statics grid — the
+   reference solves the 500×500 grid sequentially in the bulk of its
+   5-15 min replication run (`scripts/1_baseline.jl:209-285`) and reports
+   ~0.5 s per single equilibrium solve (paper Appendix C.5.3), i.e. a
+   baseline of 2 equilibria/sec. Here the whole grid is one jitted vmap²
+   program on the accelerator; `vs_baseline` is (our equilibria/sec) / 2.
+2. agent-steps/sec on the 10^6-agent explicit social-learning simulation
+   (the north-star extension; the reference has no per-agent code, its
+   representative-agent fixed point is ~20 s on CPU).
 
-Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+Prints exactly ONE JSON line on stdout (primary metric = equilibria/sec,
+agent-steps/sec carried in "extra"); diagnostics go to stderr.
+
+Defensive setup (round-1 postmortem, VERDICT §missing-1): the TPU backend
+behind the axon tunnel can fail or hang on first contact, and the vmap²
+program's cold compile is minutes. So: persistent XLA compile cache (same
+dir the figures CLI uses), backend init retried with backoff, crossing
+refinement OFF in the sweep path (SolverConfig.refine_crossings — the
+grid is interpolation-bound anyway), and compile vs execute reported
+separately on stderr.
 """
 
 from __future__ import annotations
@@ -15,18 +27,95 @@ from __future__ import annotations
 import json
 import sys
 import time
+from pathlib import Path
 
 
-def main() -> None:
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _probe_accelerator(timeout_s: float) -> str:
+    """Ask a SUBPROCESS what platform jax.devices() lands on.
+
+    The axon TPU tunnel does not just fail — it can HANG jax.devices()
+    indefinitely (observed in-session; round 1's capture died exactly here,
+    BENCH_r01 rc=1). A hang inside this process would be unrecoverable
+    (backend init is global and blocking), so the first contact happens in a
+    child process that a hard timeout can kill. Returns the platform name,
+    or "" when the probe failed or timed out.
+    """
+    import subprocess
+
+    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        if out.returncode == 0 and platform:
+            return platform
+        _log(f"probe rc={out.returncode}, stderr tail: {out.stderr.strip()[-200:]!r}")
+        return ""
+    except subprocess.TimeoutExpired:
+        _log(f"probe timed out after {timeout_s:.0f}s (accelerator backend hung)")
+        return ""
+
+
+def _init_backend(retries: int = 2, backoff_s: float = 10.0, probe_timeout_s: float = 120.0):
+    """Bring up a backend that is guaranteed not to hang this process.
+
+    Strategy: probe the default (TPU) backend in a killable subprocess with
+    retry/backoff; only if a probe succeeds is the in-process backend
+    allowed to touch the accelerator. Otherwise pin the CPU platform — a
+    degraded-but-real measurement beats the rc!=0 / no-output outcomes of
+    round 1. ``SBR_BENCH_PLATFORM=cpu|tpu`` overrides the probe.
+    """
+    import os
+
+    forced = os.environ.get("SBR_BENCH_PLATFORM", "").strip().lower()
+    platform = forced
+    if not forced:
+        for attempt in range(1, retries + 1):
+            platform = _probe_accelerator(probe_timeout_s)
+            if platform:
+                break
+            if attempt < retries:
+                _log(f"probe attempt {attempt}/{retries} failed; backing off {backoff_s:.0f}s")
+                time.sleep(backoff_s)
+    if not platform:
+        platform = "cpu"
+        _log("accelerator unreachable after all probes — falling back to CPU")
+
     import jax
+
+    if platform == "cpu":
+        # Must go through jax.config: this image's sitecustomize overrides
+        # the JAX_PLATFORMS env var (see tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", str(Path.home() / ".cache/sbr_tpu_xla"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    devices = jax.devices()
+    _log(f"backend up: {len(devices)}x {devices[0].platform}")
+    return jax, devices
+
+
+def bench_grid(platform: str) -> dict:
+    """Equilibria/sec on the β×u grid (f32 sweep path, refinement off)."""
     import jax.numpy as jnp
     import numpy as np
 
     from sbr_tpu.models.params import SolverConfig, make_model_params
     from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
 
-    n_beta, n_u = 640, 640  # 409.6k cells — 40× the north-star 10^4 points
-    config = SolverConfig(n_grid=1024, bisect_iters=60)
+    if platform == "cpu":  # degraded fallback: still ≥ the 10^4-point north star
+        n_beta, n_u = 128, 128
+    else:
+        n_beta, n_u = 640, 640  # 409.6k cells — 40× the north-star 10^4 points
+    config = SolverConfig(n_grid=1024, bisect_iters=60, refine_crossings=False)
     base = make_model_params()  # Figure-5 base: β=1, η̄=15, κ=.6 (η pinned 15)
 
     # Reference grid domain (`scripts/1_baseline.jl:210-213`):
@@ -48,8 +137,8 @@ def main() -> None:
         return grid, fence
 
     t0 = time.perf_counter()
-    grid, _ = run(0)  # includes compile
-    compile_s = time.perf_counter() - t0
+    grid, _ = run(0)  # includes compile (or a persistent-cache hit)
+    first_s = time.perf_counter() - t0
 
     times = []
     for rep in range(1, 4):
@@ -59,23 +148,93 @@ def main() -> None:
     elapsed = min(times)
 
     n_cells = n_beta * n_u
-    eq_per_sec = n_cells / elapsed
     n_run = int(np.sum(np.asarray(grid.status) == 0))
-    print(
-        f"[bench] {n_cells} cells in {elapsed:.3f}s (first call {compile_s:.1f}s "
-        f"incl. compile) on {jax.devices()[0].platform}; {n_run} run cells",
-        file=sys.stderr,
+    _log(
+        f"grid: {n_cells} cells in {elapsed:.3f}s steady-state "
+        f"(first call {first_s:.1f}s = compile+execute, so compile ≈ "
+        f"{first_s - elapsed:.1f}s); {n_run} run cells"
     )
-    print(
-        json.dumps(
-            {
-                "metric": "beta_u_grid_equilibria_per_sec",
-                "value": round(eq_per_sec, 1),
-                "unit": "equilibria/sec",
-                "vs_baseline": round(eq_per_sec / 2.0, 1),
-            }
-        )
+    return {
+        "eq_per_sec": n_cells / elapsed,
+        "n_cells": n_cells,
+        "first_call_s": first_s,
+        "steady_s": elapsed,
+    }
+
+
+def bench_agents(platform: str) -> dict:
+    """Agent-steps/sec: 10^6 agents, Erdős–Rényi deg 10, 200 steps, f32."""
+    from sbr_tpu.social import AgentSimConfig, erdos_renyi_edges, simulate_agents
+
+    if platform == "cpu":  # degraded fallback size
+        n, n_steps = 100_000, 100
+    else:
+        n, n_steps = 1_000_000, 200
+    t0 = time.perf_counter()
+    src, dst = erdos_renyi_edges(n, 10.0, seed=0)
+    _log(f"agents: graph built ({len(src)} edges) in {time.perf_counter() - t0:.1f}s")
+    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+
+    def run(seed: int):
+        res = simulate_agents(1.0, src, dst, n, x0=1e-4, config=cfg, seed=seed)
+        fence = float(res.informed_frac[-1])  # device→host read as the fence
+        return res, fence
+
+    t0 = time.perf_counter()
+    _, frac0 = run(0)
+    first_s = time.perf_counter() - t0
+    times = []
+    for seed in (1, 2):
+        t0 = time.perf_counter()
+        _, _ = run(seed)
+        times.append(time.perf_counter() - t0)
+    elapsed = min(times)
+
+    steps = n * n_steps
+    _log(
+        f"agents: {steps} agent-steps in {elapsed:.3f}s steady-state "
+        f"(first call {first_s:.1f}s incl. compile); final G = {frac0:.4f}"
     )
+    return {
+        "agent_steps_per_sec": steps / elapsed,
+        "n_agents": n,
+        "first_call_s": first_s,
+        "steady_s": elapsed,
+    }
+
+
+def main() -> None:
+    _, devices = _init_backend()
+    platform = devices[0].platform
+
+    grid = bench_grid(platform)
+    try:
+        agents = bench_agents(platform)
+    except Exception as err:
+        # The primary metric must still land even if the second workload
+        # fails (graceful-degradation analogue of the sweeps' NaN cells).
+        _log(f"agent bench failed: {err!r}")
+        agents = None
+
+    eq_per_sec = grid["eq_per_sec"]
+    out = {
+        "metric": "beta_u_grid_equilibria_per_sec",
+        "value": round(eq_per_sec, 1),
+        "unit": "equilibria/sec",
+        "vs_baseline": round(eq_per_sec / 2.0, 1),
+        "extra": {
+            "platform": platform,
+            "grid_cells": grid["n_cells"],
+            "grid_first_call_s": round(grid["first_call_s"], 2),
+            "grid_steady_s": round(grid["steady_s"], 3),
+        },
+    }
+    if agents is not None:
+        out["extra"]["agent_steps_per_sec"] = round(agents["agent_steps_per_sec"], 1)
+        out["extra"]["n_agents"] = agents["n_agents"]
+        out["extra"]["agents_first_call_s"] = round(agents["first_call_s"], 2)
+        out["extra"]["agents_steady_s"] = round(agents["steady_s"], 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

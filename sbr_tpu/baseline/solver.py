@@ -232,7 +232,9 @@ def solve_equilibrium_core(
 
     tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
     hazard_at = (
-        _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config) if ls.closed_form else None
+        _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config)
+        if (ls.closed_form and config.refine_crossings)
+        else None
     )
     tau_in_unc, tau_out_unc = optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=hazard_at)
 

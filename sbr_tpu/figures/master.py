@@ -22,6 +22,10 @@ import sys
 import time
 from pathlib import Path
 
+# Paper-resolution extras, generated only with --paper (the reference ships
+# this as the "couple hours" 5000×5000 grid, `1_baseline.jl:209-210`).
+PAPER_HEATMAP = "baseline/comp_stat_cross_heatmap_AW_large.pdf"
+
 # The 13 reference figures (`MASTER.jl:31-88`), keyed by section.
 MANIFEST = {
     1: [
@@ -141,6 +145,45 @@ def run_baseline(figdir: Path, fast: bool) -> None:
     )
 
 
+def run_paper_heatmap(figdir: Path, ckpt_dir: Path, res: int, tile: int) -> None:
+    """Paper-resolution Figure-5 heatmap through the tiled checkpoint/resume
+    machinery (`utils.checkpoint.run_tiled_grid`).
+
+    The reference generates this 5000×5000 grid in "a couple hours" with no
+    resume — a crash restarts from zero (`1_baseline.jl:209-210`). Here
+    finished tiles persist under ``ckpt_dir``: re-running after an interrupt
+    recomputes only missing tiles (kill it mid-run and re-invoke to see).
+    Runs the f32 sweep path, validated against f64 at grid scale by
+    tests/test_sweeps.py::test_f32_grid_reproduces_f64_no_run_region.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu import make_model_params
+    from sbr_tpu.figures.plotting import plot_heatmap_aw
+    from sbr_tpu.utils.checkpoint import run_tiled_grid
+    from sbr_tpu.utils.status import status_summary
+
+    m_base = make_model_params(beta=1.0, eta_bar=15.0, u=0.1, p=0.5, kappa=0.6, lam=0.01)
+    amt = np.linspace(1e-4, 1.0, res)
+    u_vals = np.linspace(0.001, 1.0, res)
+    print(f"Paper heatmap: {res}×{res} grid, {tile}×{tile} tiles, resume dir {ckpt_dir}")
+    grid = run_tiled_grid(
+        1.0 / amt,
+        u_vals,
+        m_base,
+        tile_shape=(tile, tile),
+        checkpoint_dir=str(ckpt_dir),
+        dtype=jnp.float32,
+        verbose=True,
+    )
+    print(f"  {status_summary(grid.status)}")
+    _save(
+        plot_heatmap_aw(amt, u_vals, np.asarray(grid.max_aw).T),
+        figdir / PAPER_HEATMAP,
+    )
+
+
 def run_heterogeneity(figdir: Path, fast: bool) -> None:
     """Section 2: two-group model figure (`scripts/2_heterogeneity.jl`)."""
     from sbr_tpu.figures.plotting import plot_aw_hetero
@@ -249,6 +292,7 @@ def write_tex(outdir: Path, sections: list, skip=()) -> Path:
         4: "Social Learning Extension",
     }
     captions = {
+        PAPER_HEATMAP: r"Peak withdrawals over the $\beta \times u$ grid (paper resolution)",
         "baseline/learning_dynamics.pdf": r"Learning dynamics for different communication speeds $\beta$",
         "baseline/hazard_rate.pdf": "Hazard rate decomposition: total hazard, belief fragility, and conditional hazard",
         "baseline/equilibrium_dynamics_main.pdf": "Equilibrium dynamics: aggregate withdrawals (main calibration)",
@@ -278,9 +322,12 @@ def write_tex(outdir: Path, sections: list, skip=()) -> Path:
         "replication run, organized as in the reference package: the baseline",
         "model and its three extensions.",
     ]
+    figdir = outdir / "figures"
     for sec in sections:
         lines.append(rf"\section{{{titles[sec]}}}")
-        for fig in MANIFEST[sec]:
+        # --paper extras join their section when present on disk.
+        extras = [PAPER_HEATMAP] if sec == 1 and (figdir / PAPER_HEATMAP).exists() else []
+        for fig in MANIFEST[sec] + extras:
             if fig in skip:
                 continue
             lines += [
@@ -302,7 +349,26 @@ def main(argv=None) -> int:
     parser.add_argument("--sections", default="1,2,3,4", help="comma-separated sections to run")
     parser.add_argument("--fast", action="store_true", help="reduced sweep resolutions for smoke runs")
     parser.add_argument("--f32", action="store_true", help="run in float32 (default float64 parity mode)")
+    parser.add_argument(
+        "--paper",
+        action="store_true",
+        help="also generate the paper-resolution heatmap via tiled checkpoint/resume "
+        "(the reference's 'couple hours' 5000x5000 grid; interruptible + resumable)",
+    )
+    parser.add_argument("--paper-res", type=int, default=5000, help="paper heatmap resolution")
+    parser.add_argument("--paper-tile", type=int, default=500, help="paper heatmap tile size")
+    parser.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="tile checkpoint dir for --paper (default: OUTPUT/checkpoints/heatmap_large)",
+    )
     args = parser.parse_args(argv)
+
+    # Headless backend for the CLI only — library imports leave the
+    # user's matplotlib backend alone (sbr_tpu.figures.plotting docstring).
+    import matplotlib
+
+    matplotlib.use("Agg")
 
     import jax
 
@@ -330,13 +396,27 @@ def main(argv=None) -> int:
         skipped |= runners[sec](figdir, args.fast) or set()
         print(f"  section time: {time.time() - t0:.1f}s")
 
+    if args.paper:
+        print("=" * 70)
+        print("PAPER-RESOLUTION HEATMAP (tiled, resumable)")
+        print("=" * 70)
+        t0 = time.time()
+        ckpt = Path(args.checkpoint_dir) if args.checkpoint_dir else outdir / "checkpoints/heatmap_large"
+        run_paper_heatmap(figdir, ckpt, args.paper_res, args.paper_tile)
+        print(f"  paper heatmap time: {time.time() - t0:.1f}s")
+
     # The tex document reflects everything present on disk (not just the
     # sections run now), so partial --sections runs extend rather than
     # clobber a previously generated full document.
     not_on_disk = {
         f for sec in MANIFEST for f in MANIFEST[sec] if not (figdir / f).exists()
     }
-    tex_sections = [s for s in MANIFEST if set(MANIFEST[s]) - not_on_disk]
+    tex_sections = [
+        s
+        for s in MANIFEST
+        if set(MANIFEST[s]) - not_on_disk
+        or (s == 1 and (figdir / PAPER_HEATMAP).exists())
+    ]
     tex_path = write_tex(outdir, tex_sections, skip=not_on_disk)
     total = time.time() - t_start
 
@@ -344,6 +424,8 @@ def main(argv=None) -> int:
     print("REPLICATION COMPLETE")
     print(f"Total execution time: {total:.1f} seconds")
     expected = [f for sec in sections for f in MANIFEST[sec]]
+    if args.paper:
+        expected.append(PAPER_HEATMAP)
     print(f"Figures ({len(expected)} expected):")
     missing = []
     for fig in expected:

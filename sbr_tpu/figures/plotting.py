@@ -7,18 +7,15 @@ reference's plotting code (`src/baseline/plotting.jl`, script-inline
 figures) whose *content* these reproduce; the implementation is matplotlib
 idiom, not a port of Plots.jl calls.
 
-Matplotlib is used with the non-interactive Agg backend so figure
-generation works headless (the reference forces the GR backend similarly,
-`scripts/1_baseline.jl:19`).
+The non-interactive Agg backend is selected by the master CLI entry point
+(the reference forces the GR backend similarly, `scripts/1_baseline.jl:19`);
+importing this module does NOT switch the backend, so interactive sessions
+that import sbr_tpu.figures keep whatever backend they had.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Sequence
-
-import matplotlib
-
-matplotlib.use("Agg")
 
 import matplotlib.pyplot as plt
 import numpy as np

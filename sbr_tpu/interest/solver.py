@@ -59,7 +59,7 @@ def solve_equilibrium_interest_core(
     # at r = 0 this is bit-identical to the baseline's refined path, the
     # reference's r=0 fallback oracle (`interest_rate_solver.jl:89-101`).
     hazard_eff_at = None
-    if ls.closed_form:
+    if ls.closed_form and config.refine_crossings:
         from sbr_tpu.baseline.solver import _make_hazard_at
         from sbr_tpu.core.interp import interp_uniform
 

@@ -259,12 +259,19 @@ class SolverConfig:
     - ode_substeps: RK4 substeps per save interval for ODE-backed stages.
     - quad_order: Gauss-Legendre nodes per interval for closed-form
       integrands.
+    - refine_crossings: refine buffer-time crossings to machine precision by
+      bisection on the continuous exact hazard (closed-form Stage 1 only).
+      Default True for scalar parity solves; the sweep entry points default
+      it OFF — grid AW_max accuracy is interpolation-bound anyway, and the
+      embedded per-cell bisection-with-quadrature dominates the vmap²
+      program's compile time.
     """
 
     n_grid: int = 4096
     bisect_iters: int = 90
     ode_substeps: int = 2
     quad_order: int = 8
+    refine_crossings: bool = True
 
     def __post_init__(self):
         _check(self.n_grid >= 16, "n_grid too small")

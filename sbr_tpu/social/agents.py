@@ -177,6 +177,19 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype):
     return betas, src, dst, indeg, row_ptr, informed0
 
 
+def _agent_uniforms(key, step_k, ids, dtype):
+    """Per-agent uniform draw as a pure function of (key, step, GLOBAL agent id).
+
+    Keying the stream by global agent id — not by device or array position —
+    makes the simulation invariant to sharding: a single-device run and an
+    n-device run draw bit-identical randomness per agent, so the two paths
+    are exactly equivalent (tested), not merely statistically close.
+    """
+    step_key = jax.random.fold_in(key, step_k)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(step_key, ids)
+    return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
+
+
 def _seg_counts(active_src, row_ptr):
     """Per-destination neighbor counts from a dst-sorted edge activity mask.
 
@@ -201,22 +214,24 @@ def _single_device_sim(config: AgentSimConfig):
         t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(dtype)
         safe_deg = jnp.maximum(indeg, 1.0)
 
+        ids = jnp.arange(n, dtype=jnp.uint32)
+
         def step(carry, k):
-            informed, t_inf, key = carry
+            informed, t_inf = carry
             t = k.astype(dtype) * dt
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
             counts = _seg_counts(wd[src], row_ptr)
             frac = counts.astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            key, sub = jax.random.split(key)
-            newly = (~informed) & (jax.random.uniform(sub, (n,), dtype=dtype) < p_inf)
+            draws = _agent_uniforms(key, k, ids, dtype)
+            newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)
             obs = (jnp.mean(informed.astype(dtype)), jnp.mean(wd.astype(dtype)))
-            return (informed2, t_inf2, key), obs
+            return (informed2, t_inf2), obs
 
-        (informed, t_inf, _), (gs, aws) = lax.scan(
-            step, (informed0, t_inf0, key), jnp.arange(config.n_steps)
+        (informed, t_inf), (gs, aws) = lax.scan(
+            step, (informed0, t_inf0), jnp.arange(config.n_steps)
         )
         t_grid = jnp.arange(config.n_steps, dtype=dtype) * dt
         return AgentSimResult(
@@ -246,14 +261,17 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int):
         dtype = betas.dtype
         idx = lax.axis_index(axis)
         offset = idx * nb
-        key = jax.random.fold_in(key[0], idx)
+        # GLOBAL agent ids for this shard: the RNG stream is keyed by global
+        # id (`_agent_uniforms`), so draws match the single-device kernel
+        # bit-for-bit regardless of mesh size.
+        ids = (offset + jnp.arange(nb)).astype(jnp.uint32)
         row_ptr = row_ptr[0]  # (N_global + 2,): local edge ranges incl. pad segment
         t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(dtype)
         safe_deg = jnp.maximum(indeg, 1.0)
         inv_n = 1.0 / n_true
 
         def step(carry, k):
-            informed, t_inf, key = carry
+            informed, t_inf = carry
             t = k.astype(dtype) * dt
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
             wd_global = lax.all_gather(wd, axis, tiled=True)  # (N,) bool
@@ -263,16 +281,16 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int):
             counts = lax.psum(counts, axis)  # straddling dst ranges
             frac = lax.dynamic_slice(counts, (offset,), (nb,)).astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            key, sub = jax.random.split(key)
-            newly = (~informed) & (jax.random.uniform(sub, (nb,), dtype=dtype) < p_inf)
+            draws = _agent_uniforms(key, k, ids, dtype)
+            newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)
             g = lax.psum(jnp.sum(informed.astype(dtype)), axis) * inv_n
             aw = lax.psum(jnp.sum(wd.astype(dtype)), axis) * inv_n
-            return (informed2, t_inf2, key), (g, aw)
+            return (informed2, t_inf2), (g, aw)
 
-        (informed, t_inf, _), (gs, aws) = lax.scan(
-            step, (informed0, t_inf0, key), jnp.arange(config.n_steps)
+        (informed, t_inf), (gs, aws) = lax.scan(
+            step, (informed0, t_inf0), jnp.arange(config.n_steps)
         )
         return gs, aws, informed, t_inf
 
@@ -280,7 +298,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int):
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P(), P(axis), P(axis)),
         )
     )
@@ -361,14 +379,12 @@ def simulate_agents(
 
     fn = _sharded_sim(config, mesh, mesh_axis, n)
     shard = NamedSharding(mesh, P(mesh_axis))
-    keys = jax.device_put(
-        jnp.broadcast_to(key, (n_dev,) + key.shape), shard
-    )
+    key_repl = jax.device_put(key, NamedSharding(mesh, P()))
     args = [
         jax.device_put(jnp.asarray(a), shard)
         for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h)
     ]
-    gs, aws, informed, t_inf = fn(*args, keys)
+    gs, aws, informed, t_inf = fn(*args, key_repl)
     if n_pad:
         # The padding trim [:n] is not shard-aligned; all-gather the final
         # per-agent state (output-only, O(N) bytes) so the slice is local.

@@ -116,7 +116,7 @@ def beta_u_grid(
     beta_values,
     u_values,
     base: ModelParams,
-    config: SolverConfig = SolverConfig(),
+    config: Optional[SolverConfig] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
     mesh_axes: tuple = ("b", "u"),
     dtype=None,
@@ -132,7 +132,13 @@ def beta_u_grid(
     independent so no collectives are required and the program scales across
     chips linearly. Axis sizes must divide the mesh axis sizes (pad the value
     arrays if needed).
+
+    ``config`` defaults to crossing refinement OFF (see SolverConfig): grid
+    outputs (AW_max, ξ, status) are interpolation-bound, and the per-cell
+    refinement bisection dominates the vmap² program's compile time.
     """
+    if config is None:
+        config = SolverConfig(refine_crossings=False)
     # with_overrides pins eta/tspan to the base's resolved values for every
     # beta (see models.params), so the pinned economics are exactly base's.
     econ = base.economic

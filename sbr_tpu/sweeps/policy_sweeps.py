@@ -16,6 +16,7 @@ call, so r = 0 cells degrade to exactly the baseline solver's answer
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -65,7 +66,7 @@ def policy_sweep_interest(
     u_values,
     r_values,
     base: ModelParamsInterest,
-    config: SolverConfig = SolverConfig(),
+    config: Optional[SolverConfig] = None,
     dtype=None,
 ) -> PolicySweepResult:
     """(β, u, r) policy grid of interest-rate equilibria.
@@ -73,7 +74,13 @@ def policy_sweep_interest(
     η/tspan/δ stay pinned at the base model's resolved values for every
     cell, matching the copy-constructor semantics of the baseline sweeps
     (`models.params.with_overrides` docstring). All r must satisfy r < δ.
+
+    ``config`` defaults to crossing refinement OFF (see SolverConfig): grid
+    outputs are interpolation-bound, and the per-cell refinement bisection
+    dominates the vmap³ program's compile time.
     """
+    if config is None:
+        config = SolverConfig(refine_crossings=False)
     econ = base.economic
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32

@@ -19,7 +19,9 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
 import tempfile
+import time
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -85,7 +87,7 @@ def run_tiled_grid(
     beta_values,
     u_values,
     base: ModelParams,
-    config: SolverConfig = SolverConfig(),
+    config: Optional[SolverConfig] = None,
     tile_shape: Tuple[int, int] = (256, 256),
     checkpoint_dir: Optional[str] = None,
     mesh=None,
@@ -99,6 +101,8 @@ def run_tiled_grid(
     (cells are independent); tiling bounds device-memory footprint at
     paper resolution and gives the checkpoint/retry granularity.
     """
+    if config is None:  # sweep default: refinement off (see beta_u_grid)
+        config = SolverConfig(refine_crossings=False)
     beta_values = np.asarray(beta_values)
     u_values = np.asarray(u_values)
     nb, nu = len(beta_values), len(u_values)
@@ -149,15 +153,26 @@ def run_tiled_grid(
                 continue
 
             last_err = None
-            for _ in range(max_retries + 1):
+            for attempt in range(max_retries + 1):
                 try:
                     tile = beta_u_grid(
                         beta_values[bs], u_values[us], base, config=config, mesh=mesh, dtype=dtype
                     )
                     arrays = {f: np.asarray(getattr(tile, f)) for f in _FIELDS}
                     break
-                except Exception as err:  # retry analogue of SURVEY §5.3
+                except (ValueError, TypeError):
+                    # Deterministic shape/param/dtype bugs: retrying the
+                    # identical call just burns attempts — fail immediately.
+                    raise
+                except Exception as err:  # transient device/runtime failure
                     last_err = err
+                    print(
+                        f"  tile ({bi},{ui}) attempt {attempt + 1}/{max_retries + 1} "
+                        f"failed: {err!r}",
+                        file=sys.stderr,
+                    )
+                    if attempt < max_retries:
+                        time.sleep(1.0 * (attempt + 1))  # brief backoff
             else:
                 raise RuntimeError(
                     f"Tile ({bi},{ui}) failed after {max_retries + 1} attempts"

@@ -1,0 +1,51 @@
+"""Master CLI tests for the --paper tiled-heatmap path (VERDICT r1 missing-#2):
+the paper-resolution artifact is produced through the checkpoint/resume
+machinery, and an interrupted run resumes from finished tiles instead of
+restarting (the reference's 5000×5000 grid restarts from zero,
+`scripts/1_baseline.jl:209-210`)."""
+
+from pathlib import Path
+
+
+def _run_paper(out: Path, ckpt: Path, res: int = 24, tile: int = 8) -> int:
+    from sbr_tpu.figures import master
+
+    return master.main(
+        [
+            "--output",
+            str(out),
+            "--sections",
+            "",
+            "--paper",
+            "--paper-res",
+            str(res),
+            "--paper-tile",
+            str(tile),
+            "--checkpoint-dir",
+            str(ckpt),
+        ]
+    )
+
+
+def test_paper_heatmap_generates_and_resumes(tmp_path, capsys):
+    out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+    pdf = out / "figures" / "baseline/comp_stat_cross_heatmap_AW_large.pdf"
+
+    assert _run_paper(out, ckpt) == 0
+    assert pdf.exists()
+    tiles = sorted(ckpt.glob("tile_*.npz"))
+    assert len(tiles) == 9  # 24/8 × 24/8
+    capsys.readouterr()
+
+    # Simulated interrupt: artifact gone, some tiles lost — the rerun must
+    # recompute only the missing tiles and regenerate the artifact.
+    pdf.unlink()
+    tiles[0].unlink()
+    tiles[4].unlink()
+    assert _run_paper(out, ckpt) == 0
+    assert pdf.exists()
+    assert "resumed 7 tiles" in capsys.readouterr().out
+
+    # The tex document picks the paper heatmap up once it exists on disk.
+    tex = (out / "replication_figures.tex").read_text()
+    assert "comp_stat_cross_heatmap_AW_large.pdf" in tex

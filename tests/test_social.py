@@ -207,12 +207,20 @@ class TestAgentSimulation:
         assert res.informed.shape == (n,)
         t = np.asarray(res.t_grid)
         got = np.asarray(res.informed_frac)
-        want = np.asarray(logistic_cdf(jnp.asarray(t), 1.0, 2e-3))
+        # same realized-seed methodology as the dense test: the logistic
+        # preserves the initial perturbation, so the oracle starts from the
+        # REALIZED Bernoulli seed fraction, not the nominal x0
+        x0_eff = float(got[0])
+        want = np.asarray(logistic_cdf(jnp.asarray(t), 1.0, x0_eff))
         assert abs(got[-1] - want[-1]) < 0.03
         # monotone non-decreasing informed fraction
         assert (np.diff(got) >= -1e-7).all()
 
-    def test_sharded_vs_single_device_shapes(self):
+    def test_sharded_is_bit_exact_vs_single_device(self):
+        """RNG keyed by global agent id ⇒ the 8-device run equals the
+        single-device run EXACTLY (per-agent state and informed times),
+        not merely statistically — the sharding layer is a pure refactor
+        of the same computation."""
         n = 1024
         src, dst = scale_free_edges(n, 16.0, seed=7)
         mesh = jax.make_mesh((8,), ("agents",))
@@ -220,5 +228,24 @@ class TestAgentSimulation:
         r1 = simulate_agents(1.0, src, dst, n, x0=0.02, config=cfg, seed=0)
         r8 = simulate_agents(1.0, src, dst, n, x0=0.02, config=cfg, seed=0, mesh=mesh)
         assert r1.informed_frac.shape == r8.informed_frac.shape
-        # same initial seeds, same physics: trajectories statistically close
-        assert abs(float(r1.informed_frac[-1]) - float(r8.informed_frac[-1])) < 0.15
+        np.testing.assert_array_equal(np.asarray(r1.informed), np.asarray(r8.informed))
+        np.testing.assert_array_equal(np.asarray(r1.t_inf), np.asarray(r8.t_inf))
+        # aggregates differ only by float reduction order (mean vs psum-of-sums)
+        np.testing.assert_allclose(
+            np.asarray(r1.informed_frac), np.asarray(r8.informed_frac), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(r1.withdrawn_frac), np.asarray(r8.withdrawn_frac), atol=1e-6
+        )
+
+    def test_sharded_bit_exact_with_padding(self):
+        """Exact equivalence also holds when N is not divisible by the mesh
+        (padded inert agents draw randomness but never activate)."""
+        n = 1001
+        src, dst = erdos_renyi_edges(n, 12.0, seed=8)
+        mesh = jax.make_mesh((8,), ("agents",))
+        cfg = AgentSimConfig(n_steps=40, dt=0.1)
+        r1 = simulate_agents(1.0, src, dst, n, x0=0.02, config=cfg, seed=3)
+        r8 = simulate_agents(1.0, src, dst, n, x0=0.02, config=cfg, seed=3, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(r1.informed), np.asarray(r8.informed))
+        np.testing.assert_array_equal(np.asarray(r1.t_inf), np.asarray(r8.t_inf))

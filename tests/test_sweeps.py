@@ -60,6 +60,60 @@ def test_beta_u_grid_matches_cellwise():
             )
 
 
+def test_f32_grid_reproduces_f64_no_run_region():
+    """The f32 sweep path (what bench.py and the README numbers run) must
+    reproduce the f64 run/no-run frontier at grid scale — the semantics the
+    reference's early-termination accounting depends on
+    (`1_baseline.jl:236-244`). Status may legitimately flip only in the
+    frontier band (cells adjacent to an f64 status change, where the root
+    error |AW(ξ*)-κ| sits within one tolerance step of _root_tol); off the
+    frontier the two dtypes must agree exactly, and AW_max must be close
+    where both run."""
+
+    def binary_dilation(mask):
+        """8-neighborhood dilation by one cell (3×3 max over the padded grid)."""
+        p = np.pad(mask, 1)
+        h, w = mask.shape
+        out = np.zeros_like(mask)
+        for di in (0, 1, 2):
+            for dj in (0, 1, 2):
+                out |= p[di : di + h, dj : dj + w]
+        return out
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=1024, bisect_iters=60, refine_crossings=False)
+    # 128×128 subgrid of the Figure-5 domain (β = 1/amt, amt ∈ [1e-4, 1]).
+    amt = np.linspace(1e-4, 1.0, 128)
+    us = np.linspace(0.001, 1.0, 128)
+    g64 = beta_u_grid(1.0 / amt, us, m, cfg, dtype=jnp.float64)
+    g32 = beta_u_grid(1.0 / amt, us, m, cfg, dtype=jnp.float32)
+
+    run64 = np.asarray(g64.status) == Status.RUN
+    run32 = np.asarray(g32.status) == Status.RUN
+
+    # Frontier band: cells within one step of an f64 run/no-run change
+    # (where the dilations of the region and its complement overlap).
+    frontier = binary_dilation(run64) & binary_dilation(~run64)
+
+    mismatch = run64 != run32
+    # every dtype flip must lie in the frontier band …
+    assert (mismatch <= frontier).all(), (
+        f"{(mismatch & ~frontier).sum()} f32/f64 status flips OFF the frontier"
+    )
+    # … and the band itself must be thin (quantified, not hand-waved)
+    assert mismatch.mean() < 0.01, f"frontier flip rate {mismatch.mean():.3%}"
+
+    # AW_max agrees where both dtypes run (interpolation-bound ⇒ ~1e-3).
+    both = run64 & run32
+    assert both.sum() > 1000  # the run region is a substantial patch
+    aw64 = np.asarray(g64.max_aw)[both]
+    aw32 = np.asarray(g32.max_aw)[both]
+    np.testing.assert_allclose(aw32, aw64, atol=5e-3)
+    xi64 = np.asarray(g64.xi)[both]
+    xi32 = np.asarray(g32.xi)[both]
+    np.testing.assert_allclose(xi32, xi64, atol=5e-2)
+
+
 def test_beta_u_grid_on_mesh_matches_single_device():
     devs = np.array(jax.devices()[:8]).reshape(4, 2)
     mesh = jax.sharding.Mesh(devs, ("b", "u"))
