@@ -1,0 +1,76 @@
+"""Measure the sharded agent-sim collective strategies against each other.
+
+Compares, per step of the sharded kernel (`social/agents.py::_sharded_sim`):
+
+- "scatter": bitpacked all_gather (N/8 bytes) + psum_scatter (4N/n_dev B)
+- "allgather_psum": bool all_gather (N bytes) + full-N int32 psum (4N B)
+
+Bytes over the mesh per device per step (N agents, D devices):
+
+    scatter:         N/8 · (D-1)/D  +  4N/D          ≈ 0.625·N at D=8
+    allgather_psum:  N   · (D-1)/D  +  2·4N·(D-1)/D  ≈ 7.9·N   at D=8
+
+i.e. ~12.6× fewer collective bytes. This script measures wall-clock on
+whatever mesh is available (the 8-virtual-device CPU mesh in CI — memcpy
+"collectives", so the gap here UNDERSTATES the ICI gap on real multi-chip
+hardware, where bandwidth is the constraint).
+
+Run:  python benchmarks/agent_comm.py [n_agents] [avg_degree] [n_steps]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    if os.environ.get("SBR_COMM_BENCH_PLATFORM", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from sbr_tpu.social import AgentSimConfig, erdos_renyi_edges, simulate_agents
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    deg = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 50
+
+    devs = jax.devices()
+    mesh = jax.make_mesh((len(devs),), ("agents",))
+    print(f"platform={devs[0].platform} n_dev={len(devs)} n={n} deg={deg} steps={n_steps}")
+
+    t0 = time.perf_counter()
+    src, dst = erdos_renyi_edges(n, deg, seed=0)
+    print(f"graph: {len(src)} edges in {time.perf_counter() - t0:.1f}s")
+    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+
+    results = {}
+    for comm in ("scatter", "allgather_psum"):
+        # warm (compile)
+        r = simulate_agents(1.0, src, dst, n, x0=1e-3, config=cfg, seed=0, mesh=mesh, comm=comm)
+        float(r.informed_frac[-1])
+        times = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            r = simulate_agents(
+                1.0, src, dst, n, x0=1e-3, config=cfg, seed=rep + 1, mesh=mesh, comm=comm
+            )
+            float(r.informed_frac[-1])  # device→host fence
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        results[comm] = best
+        print(f"{comm:>16}: {best:.3f}s ({n * n_steps / best / 1e6:.1f}M agent-steps/s)")
+
+    speedup = results["allgather_psum"] / results["scatter"]
+    print(f"scatter speedup vs allgather_psum: {speedup:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

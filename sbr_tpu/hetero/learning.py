@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from sbr_tpu.core.ode import rk4
 from sbr_tpu.models.params import LearningParamsHetero, SolverConfig
@@ -25,11 +26,68 @@ from sbr_tpu.models.results import LearningSolutionHetero
 
 
 def hetero_rhs(t, G, args):
-    """Coupled SI rhs (`heterogeneity_learning.jl:57-67`). G: (K,)."""
+    """Coupled SI rhs (`heterogeneity_learning.jl:57-67`). G: (K,).
+
+    With ``axis_name`` set (group axis sharded under shard_map), the ω
+    reduction completes across shards with a psum — the only collective the
+    coupled system needs (SURVEY §5.8(a))."""
     del t
-    betas, dist = args
+    betas, dist, axis_name = args
     omega = jnp.dot(dist, G)
+    if axis_name is not None:
+        omega = lax.psum(omega, axis_name)
     return (1.0 - G) * betas * omega
+
+
+def hetero_substeps(params: LearningParamsHetero, config: SolverConfig) -> int:
+    """RK4 substeps keeping β_max · h ≲ 0.015 per microstep: global error
+    ~(βh)^4 then sits near 1e-8, inside the 1e-6 CPU-match envelope even for
+    the fast-group configs (reference example β_max=12.5,
+    `scripts/2_heterogeneity.jl:38`)."""
+    t0, t1 = params.tspan
+    h0 = (t1 - t0) / (config.n_grid - 1)
+    beta_max = float(max(params.betas))
+    return max(config.ode_substeps, int(jnp.ceil(beta_max * h0 / 0.015)))
+
+
+def solve_learning_hetero_arrays(
+    betas: jnp.ndarray,
+    dist: jnp.ndarray,
+    x0: float,
+    grid: jnp.ndarray,
+    substeps: int,
+    axis_name=None,
+) -> LearningSolutionHetero:
+    """Array-level coupled solve — the shard_map-compatible core.
+
+    ``betas``/``dist`` are the (local slice of the) group axis; with
+    ``axis_name`` the ω reductions psum across the sharded axis, so every
+    shard integrates its groups against the GLOBAL mixing field.
+    """
+    dtype = betas.dtype
+    g0 = jnp.full(betas.shape, x0, dtype=dtype)
+    if axis_name is not None:
+        # The scan carry becomes device-varying (it mixes in the sharded
+        # betas); mark the constant-filled initial state as varying too so
+        # shard_map's manual-axes check accepts the loop.
+        g0 = lax.pcast(g0, (axis_name,), to="varying")
+    cdfs = rk4(hetero_rhs, g0, grid, args=(betas, dist, axis_name), substeps=substeps)  # (n, K)
+    cdfs = jnp.clip(cdfs.T, 0.0, 1.0)  # (K, n)
+
+    omega = jnp.einsum("k,kn->n", dist, cdfs)
+    if axis_name is not None:
+        omega = lax.psum(omega, axis_name)
+    pdfs = (1.0 - cdfs) * betas[:, None] * omega[None, :]
+
+    return LearningSolutionHetero(
+        grid=grid,
+        cdfs=cdfs,
+        pdfs=pdfs,
+        t0=grid[0],
+        dt=grid[1] - grid[0],
+        betas=betas,
+        dist=dist,
+    )
 
 
 def solve_learning_hetero(
@@ -37,39 +95,12 @@ def solve_learning_hetero(
     config: SolverConfig = SolverConfig(),
     dtype=jnp.float64,
 ) -> LearningSolutionHetero:
-    """Solve the coupled K-group system on a static uniform grid.
-
-    Substeps are scaled so the max per-microstep β·h stays small even for the
-    fast-group configs (reference example β_max=12.5, `scripts/
-    2_heterogeneity.jl:38`); RK4 at that resolution sits far below the
-    pipeline's downstream tolerances.
-    """
+    """Solve the coupled K-group system on a static uniform grid."""
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
     t0, t1 = params.tspan
     grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
     betas = jnp.asarray(params.betas, dtype=dtype)
     dist = jnp.asarray(params.dist, dtype=dtype)
-    k = betas.shape[0]
-    g0 = jnp.full((k,), params.x0, dtype=dtype)
-
-    # Keep β_max · h ≲ 0.015 per microstep: RK4 global error ~(βh)^4 then sits
-    # near 1e-8, inside the 1e-6 CPU-match envelope for the fast-group configs.
-    h0 = (t1 - t0) / (config.n_grid - 1)
-    beta_max = float(max(params.betas))
-    substeps = max(config.ode_substeps, int(jnp.ceil(beta_max * h0 / 0.015)))
-
-    cdfs = rk4(hetero_rhs, g0, grid, args=(betas, dist), substeps=substeps)  # (n, K)
-    cdfs = jnp.clip(cdfs.T, 0.0, 1.0)  # (K, n)
-
-    omega = jnp.einsum("k,kn->n", dist, cdfs)
-    pdfs = (1.0 - cdfs) * betas[:, None] * omega[None, :]
-
-    return LearningSolutionHetero(
-        grid=grid,
-        cdfs=cdfs,
-        pdfs=pdfs,
-        t0=jnp.asarray(t0, dtype=dtype),
-        dt=grid[1] - grid[0],
-        betas=betas,
-        dist=dist,
+    return solve_learning_hetero_arrays(
+        betas, dist, params.x0, grid, hetero_substeps(params, config)
     )

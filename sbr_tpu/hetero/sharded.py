@@ -1,0 +1,110 @@
+"""K-axis sharded heterogeneity pipeline (SURVEY §5.8, §7.2 step 4).
+
+The reference's heterogeneity extension is a sequential K-group loop on one
+CPU (`heterogeneity_solver.jl:255-263`); its only cross-group couplings are
+the ω mixing field in the learning ODE (`heterogeneity_learning.jl:61`) and
+the dist-weighted AW sum in bisection (`heterogeneity_solver.jl:87-97`).
+Here the group axis shards over a 1-D device mesh via `shard_map`: every
+per-group stage (ODE rows, hazards, buffer crossings, AW decomposition)
+stays device-local, and exactly those two couplings — plus the bracket max
+and the all-groups no-crossing test — cross shards as psum/pmax collectives
+riding ICI. ξ and the status scalars come out replicated on every device;
+the K=1000 parity config (BASELINE.md) runs at 125 groups/device on a
+v4-8's 8 chips.
+
+Equivalence with the single-device path is exact up to psum reduction
+order (tested to 1e-9 at K=1000 on the 8-virtual-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sbr_tpu.hetero.learning import hetero_substeps, solve_learning_hetero_arrays
+from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
+from sbr_tpu.models.params import ModelParamsHetero, SolverConfig
+from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero
+
+
+def solve_hetero_sharded(
+    params: ModelParamsHetero,
+    mesh: Mesh,
+    config: SolverConfig = SolverConfig(),
+    axis: str = "k",
+    dtype=jnp.float64,
+    with_aw: bool = True,
+) -> Tuple[LearningSolutionHetero, EquilibriumResultHetero, Optional[AWHetero]]:
+    """Full hetero solve with the group axis sharded over ``mesh[axis]``.
+
+    Returns (learning, equilibrium, aw) with the same structure as the
+    single-device `solve_learning_hetero` → `solve_equilibrium_hetero` →
+    `get_aw_hetero` pipeline; per-group arrays come back sharded over the
+    mesh, scalars and shared-grid curves replicated. K must be divisible by
+    the mesh axis size (the K=1000 / 8-device parity config is).
+    """
+    k = params.learning.n_groups
+    n_dev = mesh.shape[axis]
+    if k % n_dev:
+        raise ValueError(
+            f"K = {k} groups must divide evenly over the {n_dev}-device mesh "
+            f"axis {axis!r}; pad the group list or choose a compatible mesh."
+        )
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+    t0, t1 = params.learning.tspan
+    x0 = params.learning.x0
+    substeps = hetero_substeps(params.learning, config)
+    econ = params.economic
+
+    def fn(betas_l, dist_l):
+        grid = jnp.linspace(
+            jnp.asarray(t0, dtype=dtype), jnp.asarray(t1, dtype=dtype), config.n_grid
+        )
+        lsh = solve_learning_hetero_arrays(betas_l, dist_l, x0, grid, substeps, axis_name=axis)
+        res = solve_equilibrium_hetero(lsh, econ, config, axis_name=axis)
+        aw = get_aw_hetero(res, lsh, axis_name=axis) if with_aw else None
+        return lsh, res, aw
+
+    spec_lsh = LearningSolutionHetero(
+        grid=P(), cdfs=P(axis), pdfs=P(axis), t0=P(), dt=P(), betas=P(axis), dist=P(axis)
+    )
+    spec_res = EquilibriumResultHetero(
+        xi=P(),
+        tau_bar_in_uncs=P(axis),
+        tau_bar_out_uncs=P(axis),
+        hrs=P(axis),
+        tau_grid=P(),
+        bankrun=P(),
+        status=P(),
+        converged=P(),
+        tolerance=P(),
+    )
+    spec_aw = (
+        AWHetero(
+            t_grid=P(),
+            aw_cum=P(),
+            aw_out_groups=P(axis),
+            aw_in_groups=P(axis),
+            aw_groups=P(axis),
+            aw_max=P(),
+        )
+        if with_aw
+        else None
+    )
+
+    shard = NamedSharding(mesh, P(axis))
+    betas = jax.device_put(jnp.asarray(params.learning.betas, dtype=dtype), shard)
+    dist = jax.device_put(jnp.asarray(params.learning.dist, dtype=dtype), shard)
+
+    fn_sharded = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(spec_lsh, spec_res, spec_aw),
+        )
+    )
+    return fn_sharded(betas, dist)

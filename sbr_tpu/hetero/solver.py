@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from sbr_tpu.baseline.solver import _root_tol
 from sbr_tpu.core.integrate import cumtrapz
@@ -56,17 +57,27 @@ def _cdf_rows_at(lsh: LearningSolutionHetero, t):
     return jax.vmap(lambda row, tk: jnp.interp(tk, lsh.grid, row))(lsh.cdfs, t)
 
 
+def _wreduce(x, axis_name):
+    """Complete a group-axis reduction across shards when the axis is sharded
+    (SURVEY §5.8(b): the weighted AW sum is a psum under a sharded K)."""
+    return lax.psum(x, axis_name) if axis_name is not None else x
+
+
 def compute_xi_hetero(
     tau_bar_in_uncs,
     tau_bar_out_uncs,
     lsh: LearningSolutionHetero,
     kappa,
     config: SolverConfig = SolverConfig(),
+    axis_name=None,
 ):
     """Bisection for the weighted AW root (`compute_ξ_hetero`,
     `heterogeneity_solver.jl:48-144`).
 
-    Returns (xi, err, root_ok, is_increasing, first_crossing_ok).
+    Returns (xi, err, root_ok, is_increasing, first_crossing_ok). With
+    ``axis_name`` (sharded group axis), all shards run the identical
+    bisection on psum-completed AW values, so ξ is replicated by
+    construction.
     """
     dtype = lsh.cdfs.dtype
     kappa = jnp.asarray(kappa, dtype=dtype)
@@ -75,13 +86,16 @@ def compute_xi_hetero(
     def aw_of(xi):
         t_out = jnp.minimum(tau_bar_out_uncs, xi)
         t_in = jnp.minimum(tau_bar_in_uncs, xi)
-        return jnp.dot(dist, _cdf_rows_at(lsh, t_out) - _cdf_rows_at(lsh, t_in))
+        local = jnp.dot(dist, _cdf_rows_at(lsh, t_out) - _cdf_rows_at(lsh, t_in))
+        return _wreduce(local, axis_name)
 
     # Reference bracket/guess: ξ∈[0, 2·max τ̄_OUT], ξ₀ = Σ dist·(τ̄_IN+τ̄_OUT)/2
     # (`heterogeneity_solver.jl:53-60`).
     lo = jnp.zeros((), dtype=dtype)
     hi = 2.0 * jnp.max(tau_bar_out_uncs)
-    x0 = jnp.dot(dist, 0.5 * (tau_bar_in_uncs + tau_bar_out_uncs))
+    if axis_name is not None:
+        hi = lax.pmax(hi, axis_name)
+    x0 = _wreduce(jnp.dot(dist, 0.5 * (tau_bar_in_uncs + tau_bar_out_uncs)), axis_name)
 
     xi = bisect(lambda x: aw_of(x) - kappa, lo, hi, num_iters=config.bisect_iters, x0=x0)
 
@@ -94,14 +108,18 @@ def compute_xi_hetero(
     eps = lsh.dt
     t_out = jnp.minimum(tau_bar_out_uncs, xi)
     t_in = jnp.minimum(tau_bar_in_uncs, xi)
-    aw_eps = jnp.dot(dist, _cdf_rows_at(lsh, t_out + eps) - _cdf_rows_at(lsh, t_in + eps))
+    aw_eps = _wreduce(
+        jnp.dot(dist, _cdf_rows_at(lsh, t_out + eps) - _cdf_rows_at(lsh, t_in + eps)), axis_name
+    )
     is_increasing = aw_eps >= aw
 
-    first_ok = _first_crossing_ok(xi, tau_bar_in_uncs, lsh, kappa)
+    first_ok = _first_crossing_ok(xi, tau_bar_in_uncs, lsh, kappa, axis_name=axis_name)
     return xi, err, root_ok, is_increasing, first_ok
 
 
-def _first_crossing_ok(xi_star, tau_bar_in_uncs, lsh: LearningSolutionHetero, kappa):
+def _first_crossing_ok(
+    xi_star, tau_bar_in_uncs, lsh: LearningSolutionHetero, kappa, axis_name=None
+):
     """Reject roots that are not the FIRST up-crossing of κ
     (`is_valid_equilibrium_hetero`, `heterogeneity_solver.jl:175-210`).
 
@@ -115,7 +133,7 @@ def _first_crossing_ok(xi_star, tau_bar_in_uncs, lsh: LearningSolutionHetero, ka
     # AW_path(t) = Σ_k dist_k·(G_k(t) − G_k(max(0, t − τ_I_k)))
     shifted = jnp.maximum(0.0, t[None, :] - tau_i[:, None])  # (K, n)
     g_shift = _cdf_rows_at(lsh, shifted)
-    aw_path = jnp.einsum("k,kn->n", lsh.dist, lsh.cdfs - g_shift)
+    aw_path = _wreduce(jnp.einsum("k,kn->n", lsh.dist, lsh.cdfs - g_shift), axis_name)
 
     in_range = t <= xi_star
     above = jnp.logical_and(aw_path > kappa, in_range)
@@ -129,9 +147,15 @@ def solve_equilibrium_hetero(
     econ: EconomicParams,
     config: SolverConfig = SolverConfig(),
     tspan_end=None,
+    axis_name=None,
 ) -> EquilibriumResultHetero:
     """Full hetero equilibrium (`solve_equilibrium_hetero`,
-    `heterogeneity_solver.jl:241-293`), branchless with status codes."""
+    `heterogeneity_solver.jl:241-293`), branchless with status codes.
+
+    With ``axis_name`` (group axis sharded under shard_map), per-group
+    stages stay local and only the weighted reductions cross shards; the
+    returned scalars are replicated, per-group arrays sharded.
+    """
     dtype = lsh.cdfs.dtype
     if tspan_end is None:
         tspan_end = lsh.grid[-1]
@@ -144,11 +168,13 @@ def solve_equilibrium_hetero(
     tau_in_uncs = jax.vmap(lambda hr: first_upcrossing(tau_grid, hr, u, default))(hrs)
     tau_out_uncs = jax.vmap(lambda hr: last_downcrossing(tau_grid, hr, u, default))(hrs)
 
-    # No group can optimally exit (`heterogeneity_solver.jl:266-272`).
-    no_crossing = jnp.all(tau_in_uncs == tau_out_uncs)
+    # No group can optimally exit (`heterogeneity_solver.jl:266-272`); the
+    # ALL-groups condition completes across shards as a summed crossing count.
+    n_crossing = _wreduce(jnp.sum(tau_in_uncs != tau_out_uncs), axis_name)
+    no_crossing = n_crossing == 0
 
     xi_c, err, root_ok, increasing, first_ok = compute_xi_hetero(
-        tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config
+        tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config, axis_name=axis_name
     )
 
     valid = jnp.logical_and(root_ok, jnp.logical_and(increasing, first_ok))
@@ -182,7 +208,9 @@ def solve_equilibrium_hetero(
     )
 
 
-def get_aw_hetero(result: EquilibriumResultHetero, lsh: LearningSolutionHetero) -> AWHetero:
+def get_aw_hetero(
+    result: EquilibriumResultHetero, lsh: LearningSolutionHetero, axis_name=None
+) -> AWHetero:
     """Group-decomposed AW curves on the learning grid (`get_AW_hetero`,
     `heterogeneity_solver.jl:316-375`).
 
@@ -208,7 +236,7 @@ def get_aw_hetero(result: EquilibriumResultHetero, lsh: LearningSolutionHetero) 
     aw_in_groups = branch(tau_in_con)
     aw_out_groups = branch(tau_out_con)
     aw_groups = aw_out_groups - aw_in_groups
-    aw_cum = jnp.einsum("k,kn->n", lsh.dist, aw_groups)
+    aw_cum = _wreduce(jnp.einsum("k,kn->n", lsh.dist, aw_groups), axis_name)
     return AWHetero(
         t_grid=t,
         aw_cum=aw_cum,

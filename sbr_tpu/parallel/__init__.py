@@ -8,6 +8,18 @@ social-learning simulation (`jax.sharding` + shard_map; collectives ride
 ICI).
 """
 
+from sbr_tpu.parallel.distributed import (
+    initialize_distributed,
+    run_tiled_grid_multihost,
+    tile_assignment,
+)
 from sbr_tpu.parallel.mesh import balanced_2d, make_agent_mesh, make_grid_mesh
 
-__all__ = ["balanced_2d", "make_agent_mesh", "make_grid_mesh"]
+__all__ = [
+    "balanced_2d",
+    "make_agent_mesh",
+    "make_grid_mesh",
+    "initialize_distributed",
+    "run_tiled_grid_multihost",
+    "tile_assignment",
+]

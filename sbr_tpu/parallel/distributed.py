@@ -1,0 +1,169 @@
+"""Multi-host layer: `jax.distributed` bring-up and DCN sweep farming
+(SURVEY §5.8 — the subsystem the reference lacks entirely; its sweeps are
+sequential single-process loops, `scripts/1_baseline.jl:151,224`).
+
+Two multi-host regimes, matching the framework's two parallel programs:
+
+1. **One sharded program spanning hosts** (ICI/DCN collectives): call
+   `initialize_distributed()` first, then build meshes over
+   `jax.devices()` as usual — `parallel.mesh` helpers, the sharded agent
+   sim, and the K-sharded hetero pipeline all work unchanged, since they
+   address devices through named mesh axes and XLA routes collectives over
+   ICI within a pod and DCN across pods.
+
+2. **Embarrassingly-parallel sweep farming** (no collectives): β×u grid
+   cells are independent, so hosts need not share a mesh at all —
+   `run_tiled_grid_multihost` splits the tile list across processes and
+   uses the shared checkpoint directory (`utils.checkpoint`) as the
+   rendezvous: every finished tile is an atomically-renamed npz, any
+   process can adopt any tile from disk, and the assembly pass is a pure
+   cache read. A lost host costs only its unfinished tiles, which the
+   survivors (or a retry) pick up — the failure-detection analogue of
+   SURVEY §5.3 at the cross-host level.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from sbr_tpu.models.params import ModelParams, SolverConfig
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> bool:
+    """Bring up `jax.distributed` for multi-host runs; single-process no-op.
+
+    Returns True when distributed mode was initialized. With no arguments,
+    initialization happens only if the standard coordination env vars are
+    present (JAX_COORDINATOR_ADDRESS / cloud-TPU metadata), so single-host
+    callers can invoke this unconditionally.
+    """
+    explicit = coordinator_address is not None or num_processes is not None
+    env = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if not explicit and not env:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
+def tile_assignment(n_tiles: int, n_processes: int, process_id: int) -> range:
+    """Contiguous balanced split: process p owns tiles [start_p, end_p).
+
+    Every tile is owned by exactly one process; sizes differ by at most 1.
+    """
+    if not 0 <= process_id < n_processes:
+        raise ValueError(f"process_id {process_id} not in [0, {n_processes})")
+    base, rem = divmod(n_tiles, n_processes)
+    start = process_id * base + min(process_id, rem)
+    return range(start, start + base + (1 if process_id < rem else 0))
+
+
+def _tile_list(n_b: int, n_u: int, tile_shape) -> list:
+    """Tile origins in `run_tiled_grid`'s iteration order."""
+    tb, tu = tile_shape
+    return [(bi, ui) for bi in range(0, n_b, tb) for ui in range(0, n_u, tu)]
+
+
+def run_tiled_grid_multihost(
+    beta_values,
+    u_values,
+    base: ModelParams,
+    checkpoint_dir: str,
+    config: Optional[SolverConfig] = None,
+    tile_shape=(256, 256),
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    wait: bool = True,
+    poll_s: float = 5.0,
+    timeout_s: float = 24 * 3600.0,
+    dtype=None,
+    verbose: bool = False,
+):
+    """Farm a β×u grid across processes via the shared checkpoint dir.
+
+    Each process computes only its `tile_assignment` share (plus anything
+    already on disk); coordination is purely filesystem-level, so this
+    works across hosts that share nothing but storage — no collectives, no
+    jax.distributed requirement (use it when a mesh-spanning program is
+    also running; not needed here).
+
+    With ``wait`` (default), after finishing its share the process polls
+    until every tile exists, then assembles and returns the full grid.
+    With ``wait=False`` it returns None right after its own share — the
+    pattern for worker processes whose results are consumed elsewhere.
+    """
+    from sbr_tpu.utils.checkpoint import _tile_path, run_tiled_grid
+
+    if process_id is None or num_processes is None:
+        import jax
+
+        process_id = jax.process_index() if process_id is None else process_id
+        num_processes = jax.process_count() if num_processes is None else num_processes
+
+    import numpy as np
+
+    nb, nu = len(np.asarray(beta_values)), len(np.asarray(u_values))
+    tiles = _tile_list(nb, nu, tile_shape)
+    owned = {tiles[i] for i in tile_assignment(len(tiles), num_processes, process_id)}
+
+    run_tiled_grid(
+        beta_values,
+        u_values,
+        base,
+        config=config,
+        tile_shape=tile_shape,
+        checkpoint_dir=checkpoint_dir,
+        dtype=dtype,
+        verbose=verbose,
+        tile_owner=lambda bi, ui: (bi, ui) in owned,
+    )
+    if not wait:
+        return None
+
+    # Filesystem barrier: every tile must exist before assembly.
+    from pathlib import Path
+
+    ckpt = Path(checkpoint_dir)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        missing = [t for t in tiles if not _tile_path(ckpt, *t).exists()]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"{len(missing)} tiles still missing after {timeout_s:.0f}s "
+                f"(first: {missing[0]}); a peer process likely died — rerun "
+                "with its process_id (or a smaller num_processes) to adopt "
+                "its tiles."
+            )
+        if verbose:
+            print(f"  waiting on {len(missing)} peer tiles …")
+        time.sleep(poll_s)
+
+    # Assembly: all tiles cached on disk — a pure read, no recompute.
+    return run_tiled_grid(
+        beta_values,
+        u_values,
+        base,
+        config=config,
+        tile_shape=tile_shape,
+        checkpoint_dir=checkpoint_dir,
+        dtype=dtype,
+        verbose=verbose,
+    )

@@ -37,6 +37,14 @@ def _tile_path(ckpt_dir: Path, bi: int, ui: int) -> Path:
     return ckpt_dir / f"tile_b{bi:05d}_u{ui:05d}.npz"
 
 
+def tile_origins(n_b: int, n_u: int, tile_shape: Tuple[int, int]) -> list:
+    """Tile origins in `run_tiled_grid`'s iteration order — the single
+    source of truth shared with the multi-host farm's ownership split and
+    completion barrier (`parallel.distributed`)."""
+    tb, tu = tile_shape
+    return [(bi, ui) for bi in range(0, n_b, tb) for ui in range(0, n_u, tu)]
+
+
 def _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype) -> str:
     """Hash of everything that determines tile contents, so a checkpoint dir
     can never silently serve results for different parameters."""
@@ -50,7 +58,14 @@ def _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype) -
 def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
     manifest = ckpt / "manifest.json"
     if manifest.exists():
-        stored = json.loads(manifest.read_text()).get("fingerprint")
+        try:
+            stored = json.loads(manifest.read_text()).get("fingerprint")
+        except json.JSONDecodeError:
+            # A peer process is mid-write on non-atomic shared storage;
+            # with the atomic rename below this means corruption, not a
+            # race — but give one short grace read before failing.
+            time.sleep(0.2)
+            stored = json.loads(manifest.read_text()).get("fingerprint")
         if stored != fingerprint:
             raise ValueError(
                 f"Checkpoint dir {ckpt} holds tiles for a different sweep "
@@ -66,7 +81,14 @@ def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
             "checkpoint_dir or delete the unattributed tiles."
         )
     else:
-        manifest.write_text(json.dumps({"fingerprint": fingerprint}))
+        # Atomic write: multi-host farms start several processes against
+        # one dir concurrently; a peer must never observe a partial file.
+        # Losing the os.replace race to a peer writing the SAME sweep is
+        # fine (identical content).
+        fd, tmp = tempfile.mkstemp(dir=ckpt, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps({"fingerprint": fingerprint}))
+        os.replace(tmp, manifest)
 
 
 def _save_atomic(path: Path, arrays: dict) -> None:
@@ -94,12 +116,18 @@ def run_tiled_grid(
     dtype=None,
     max_retries: int = 2,
     verbose: bool = False,
+    tile_owner=None,
 ) -> GridSweepResult:
     """β×u grid in tiles with optional on-disk resume.
 
     Semantically identical to one `beta_u_grid` call over the full grid
     (cells are independent); tiling bounds device-memory footprint at
     paper resolution and gives the checkpoint/retry granularity.
+
+    ``tile_owner(bi, ui) -> bool`` restricts computation to a subset of
+    tiles (others stay at their NaN/-1 initial fill unless already on
+    disk) — the hook the multi-host sweep farm uses to split a grid
+    across processes (`parallel.distributed.run_tiled_grid_multihost`).
     """
     if config is None:  # sweep default: refinement off (see beta_u_grid)
         config = SolverConfig(refine_crossings=False)
@@ -139,8 +167,7 @@ def run_tiled_grid(
     out = {f: np.full((nb, nu), *field_init[f]) for f in _FIELDS}
 
     n_cached = 0
-    for bi in range(0, nb, tb):
-        for ui in range(0, nu, tu):
+    for bi, ui in tile_origins(nb, nu, tile_shape):
             bs = slice(bi, min(bi + tb, nb))
             us = slice(ui, min(ui + tu, nu))
             path = _tile_path(ckpt, bi, ui) if ckpt is not None else None
@@ -151,6 +178,9 @@ def run_tiled_grid(
                     out[f][bs, us] = data[f]
                 n_cached += 1
                 continue
+
+            if tile_owner is not None and not tile_owner(bi, ui):
+                continue  # another process's tile; it lands on disk, not here
 
             last_err = None
             for attempt in range(max_retries + 1):

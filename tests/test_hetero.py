@@ -184,3 +184,55 @@ class TestThousandGroups:
         base = solve_equilibrium_baseline(ls, mb.economic, cfg)
         # RK4-sampled CDF vs closed form, then identical downstream machinery
         np.testing.assert_allclose(float(res.xi), float(base.xi), atol=1e-5)
+
+
+class TestShardedGroupAxis:
+    """K-axis sharding over the 8-virtual-device mesh (SURVEY §5.8): the
+    only cross-shard couplings are ω (learning psum), the weighted AW
+    (bisection psum), the bracket pmax, and the no-crossing count."""
+
+    def test_k1000_sharded_matches_single_device(self):
+        import jax
+
+        from sbr_tpu.hetero import solve_hetero_sharded
+
+        cfg = SolverConfig(n_grid=1024, bisect_iters=60)
+        k = 1000  # 125 groups/device on the 8-device mesh
+        rng = np.random.default_rng(0)
+        betas = np.exp(rng.uniform(np.log(0.2), np.log(5.0), k))
+        dist = rng.dirichlet(np.ones(k))
+        dist = dist / dist.sum()
+        m = make_hetero_params(
+            betas=betas, dist=dist, eta_bar=15.0, u=0.1, p=0.5, kappa=0.6, lam=0.01
+        )
+
+        lsh1 = solve_learning_hetero(m.learning, cfg)
+        res1 = solve_equilibrium_hetero(lsh1, m.economic, cfg)
+        aw1 = get_aw_hetero(res1, lsh1)
+
+        mesh = jax.make_mesh((8,), ("k",))
+        lsh8, res8, aw8 = solve_hetero_sharded(m, mesh, cfg)
+
+        # per-group stages are device-local → identical; psum-reduced
+        # quantities differ only by float64 reduction order
+        np.testing.assert_allclose(np.asarray(lsh8.cdfs), np.asarray(lsh1.cdfs), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(res8.hrs), np.asarray(res1.hrs), atol=1e-9)
+        np.testing.assert_allclose(
+            np.asarray(res8.tau_bar_in_uncs), np.asarray(res1.tau_bar_in_uncs), atol=1e-9
+        )
+        assert int(res8.status) == int(res1.status)
+        np.testing.assert_allclose(float(res8.xi), float(res1.xi), atol=1e-9)
+        np.testing.assert_allclose(float(aw8.aw_max), float(aw1.aw_max), atol=1e-9)
+        np.testing.assert_allclose(np.asarray(aw8.aw_cum), np.asarray(aw1.aw_cum), atol=1e-9)
+
+    def test_indivisible_k_raises(self):
+        import jax
+
+        from sbr_tpu.hetero import solve_hetero_sharded
+
+        m = make_hetero_params(
+            betas=[0.5, 1.0, 2.0], dist=[0.3, 0.3, 0.4], eta_bar=15.0
+        )
+        mesh = jax.make_mesh((8,), ("k",))
+        with pytest.raises(ValueError, match="divide evenly"):
+            solve_hetero_sharded(m, mesh, SolverConfig(n_grid=256))

@@ -238,6 +238,23 @@ class TestAgentSimulation:
             np.asarray(r1.withdrawn_frac), np.asarray(r8.withdrawn_frac), atol=1e-6
         )
 
+    def test_comm_strategies_bit_identical(self):
+        """The bitpacked psum_scatter path and the naive all_gather+psum
+        baseline compute the same counts — results must match exactly."""
+        n = 4096
+        src, dst = scale_free_edges(n, 12.0, seed=9)
+        mesh = jax.make_mesh((8,), ("agents",))
+        cfg = AgentSimConfig(n_steps=60, dt=0.1)
+        ra = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=1, mesh=mesh)
+        rb = simulate_agents(
+            1.0, src, dst, n, x0=0.01, config=cfg, seed=1, mesh=mesh, comm="allgather_psum"
+        )
+        np.testing.assert_array_equal(np.asarray(ra.informed), np.asarray(rb.informed))
+        np.testing.assert_array_equal(np.asarray(ra.t_inf), np.asarray(rb.t_inf))
+        np.testing.assert_array_equal(
+            np.asarray(ra.informed_frac), np.asarray(rb.informed_frac)
+        )
+
     def test_sharded_bit_exact_with_padding(self):
         """Exact equivalence also holds when N is not divisible by the mesh
         (padded inert agents draw randomness but never activate)."""

@@ -142,3 +142,68 @@ class TestTiledCheckpoint:
         with pytest.raises(RuntimeError, match="failed after 3 attempts"):
             run_tiled_grid(betas, us, base, config=CFG, tile_shape=(6, 8), max_retries=2)
         assert calls["n"] == 3
+
+
+class TestMultiHostFarming:
+    """DCN sweep-farming layer (`parallel.distributed`): filesystem-
+    coordinated tile split across processes, simulated here by running
+    each process role sequentially in one process."""
+
+    def test_tile_assignment_partitions_exactly(self):
+        from sbr_tpu.parallel import tile_assignment
+
+        for n_tiles in (1, 7, 8, 23):
+            for n_proc in (1, 2, 3, 8):
+                seen = []
+                for p in range(n_proc):
+                    seen.extend(tile_assignment(n_tiles, n_proc, p))
+                assert sorted(seen) == list(range(n_tiles))
+                sizes = [len(tile_assignment(n_tiles, n_proc, p)) for p in range(n_proc)]
+                assert max(sizes) - min(sizes) <= 1
+
+    def test_two_process_farm_assembles_full_grid(self, tmp_path):
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        base = make_model_params()
+        betas = np.linspace(0.5, 3.0, 6)
+        us = np.linspace(0.02, 0.3, 8)
+
+        # worker 0: computes its share, returns immediately (wait=False)
+        out0 = run_tiled_grid_multihost(
+            betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
+            process_id=0, num_processes=2, wait=False,
+        )
+        assert out0 is None
+        n_after_0 = len(list(tmp_path.glob("tile_*.npz")))
+        assert 0 < n_after_0 < 4  # owns a strict subset of the 4 tiles
+
+        # worker 1: computes the rest, waits (all present), assembles
+        full = run_tiled_grid_multihost(
+            betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
+            process_id=1, num_processes=2, poll_s=0.1, timeout_s=10.0,
+        )
+        assert len(list(tmp_path.glob("tile_*.npz"))) == 4
+        direct = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(3, 4))
+        np.testing.assert_allclose(
+            np.asarray(full.xi), np.asarray(direct.xi), atol=1e-12, equal_nan=True
+        )
+        np.testing.assert_array_equal(np.asarray(full.status), np.asarray(direct.status))
+
+    def test_wait_times_out_on_missing_peer(self, tmp_path):
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        base = make_model_params()
+        betas = np.linspace(0.5, 3.0, 6)
+        us = np.linspace(0.02, 0.3, 8)
+        with pytest.raises(TimeoutError, match="peer process likely died"):
+            run_tiled_grid_multihost(
+                betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
+                process_id=0, num_processes=2, poll_s=0.05, timeout_s=0.3,
+            )
+
+    def test_initialize_distributed_single_process_noop(self, monkeypatch):
+        from sbr_tpu.parallel import initialize_distributed
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+        assert initialize_distributed() is False
