@@ -26,6 +26,14 @@ from pathlib import Path
 # this as the "couple hours" 5000×5000 grid, `1_baseline.jl:209-210`).
 PAPER_HEATMAP = "baseline/comp_stat_cross_heatmap_AW_large.pdf"
 
+# Framework extra (beyond the reference's 13 figures): the explicit-agent
+# population run with the withdrawal window derived from the solved social
+# fixed point, validating the equilibrium→agent loop (social/closure.py).
+CLOSURE_FIG = "social_learning/agent_vs_fixed_point.pdf"
+
+# Extra figures folded into a section's tex when present on disk.
+_EXTRAS = {1: [PAPER_HEATMAP], 4: [CLOSURE_FIG]}
+
 # The 13 reference figures (`MASTER.jl:31-88`), keyed by section.
 MANIFEST = {
     1: [
@@ -277,6 +285,21 @@ def run_social(figdir: Path, fast: bool) -> set:
     else:
         print("  ! no baseline equilibrium to plot (no bank run)")
         skipped.add("social_learning/baseline_equilibrium.pdf")
+
+    # Equilibrium→agent loop closure (VERDICT r2 task 2): feed the solved
+    # fixed point's withdrawal window into the explicit-agent simulation and
+    # plot both against the fixed point's own curves.
+    if bool(social.equilibrium.bankrun):
+        from sbr_tpu.figures.plotting import plot_agent_closure
+        from sbr_tpu.social.closure import close_loop
+
+        n, deg, dt = (20_000, 15.0, 0.1) if fast else (200_000, 60.0, 0.05)
+        comp = close_loop(model=m, n_agents=n, avg_degree=deg, dt=dt, t_max=16.0, fp=social)
+        print(
+            f"  closure: {n:,} agents, window [{comp.exit_delay:.2f}, "
+            f"{comp.reentry_delay:.2f}), AW sup-error {comp.err_aw_sup:.3f}"
+        )
+        _save(plot_agent_closure(comp), figdir / CLOSURE_FIG)
     return skipped
 
 
@@ -293,6 +316,7 @@ def write_tex(outdir: Path, sections: list, skip=()) -> Path:
     }
     captions = {
         PAPER_HEATMAP: r"Peak withdrawals over the $\beta \times u$ grid (paper resolution)",
+        CLOSURE_FIG: "Explicit-agent population under the equilibrium withdrawal window vs the social-learning fixed point",
         "baseline/learning_dynamics.pdf": r"Learning dynamics for different communication speeds $\beta$",
         "baseline/hazard_rate.pdf": "Hazard rate decomposition: total hazard, belief fragility, and conditional hazard",
         "baseline/equilibrium_dynamics_main.pdf": "Equilibrium dynamics: aggregate withdrawals (main calibration)",
@@ -325,8 +349,8 @@ def write_tex(outdir: Path, sections: list, skip=()) -> Path:
     figdir = outdir / "figures"
     for sec in sections:
         lines.append(rf"\section{{{titles[sec]}}}")
-        # --paper extras join their section when present on disk.
-        extras = [PAPER_HEATMAP] if sec == 1 and (figdir / PAPER_HEATMAP).exists() else []
+        # Extra figures join their section when present on disk.
+        extras = [f for f in _EXTRAS.get(sec, []) if (figdir / f).exists()]
         for fig in MANIFEST[sec] + extras:
             if fig in skip:
                 continue
@@ -415,7 +439,7 @@ def main(argv=None) -> int:
         s
         for s in MANIFEST
         if set(MANIFEST[s]) - not_on_disk
-        or (s == 1 and (figdir / PAPER_HEATMAP).exists())
+        or any((figdir / f).exists() for f in _EXTRAS.get(s, []))
     ]
     tex_path = write_tex(outdir, tex_sections, skip=not_on_disk)
     total = time.time() - t_start

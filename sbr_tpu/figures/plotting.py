@@ -281,3 +281,37 @@ def plot_value_function(result_interest, econ):
     ax.set_xlim(0, float(t[keep].max()))
     ax.legend(loc="upper left")
     return fig
+
+
+def plot_agent_closure(comp):
+    """Equilibrium→agent loop closure (VERDICT r2 task 2): the explicit-agent
+    population, run with the withdrawal window derived FROM the solved social
+    fixed point, against that fixed point's own AW(t) and G(t) curves
+    (`social_learning_solver.jl:63-263` + `solver.jl:495-532`). ``comp`` is a
+    `sbr_tpu.social.closure.LoopComparison`."""
+    fig, (ax_aw, ax_g) = plt.subplots(1, 2, figsize=(11.0, 4.2))
+    t = np.asarray(comp.t)
+    for ax, fp, sim, name in (
+        (ax_aw, comp.aw_fp, comp.aw_sim, "AW(t)"),
+        (ax_g, comp.g_fp, comp.g_sim, "G(t)"),
+    ):
+        ax.plot(t, fp, color="tab:blue", lw=2, label="fixed point (ODE)")
+        ax.plot(
+            t, sim, color="tab:red", lw=1.2, ls="--",
+            label=f"{comp.n_agents:,} agents" + (f" (mean of {comp.n_reps})" if comp.n_reps > 1 else ""),
+        )
+        ax.set_xlabel("Time")
+        ax.set_ylabel(name)
+        ax.grid(True, alpha=0.4)
+    xi = float(comp.fp.equilibrium.xi)
+    ax_aw.axvline(xi, color="darkgoldenrod", lw=1.5)
+    ax_aw.annotate(rf"$\xi = {xi:.1f}$", (xi + 0.2, 0.02), color="darkgoldenrod", fontsize=7)
+    ax_aw.set_title(
+        rf"Withdrawals: window [$\xi-\bar\tau_{{OUT}}^{{CON}}$, $\xi-\bar\tau_{{IN}}^{{CON}}$)"
+        rf" = [{comp.exit_delay:.2f}, {comp.reentry_delay:.2f})"
+        f"; sup-error {comp.err_aw_sup:.3f}"
+    )
+    ax_g.set_title(f"Learning; RMS error {comp.err_g_rms:.3f}")
+    ax_aw.legend(loc="upper left", fontsize=8)
+    fig.tight_layout()
+    return fig

@@ -20,6 +20,7 @@ from sbr_tpu.social.agents import (
     scale_free_edges,
     simulate_agents,
 )
+from sbr_tpu.social.closure import LoopComparison, close_loop, equilibrium_window
 
 __all__ = [
     "solve_forced_learning",
@@ -30,4 +31,7 @@ __all__ = [
     "erdos_renyi_edges",
     "scale_free_edges",
     "simulate_agents",
+    "LoopComparison",
+    "close_loop",
+    "equilibrium_window",
 ]

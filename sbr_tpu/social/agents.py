@@ -153,7 +153,7 @@ def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
     return informed & (t >= t_inf + exit_delay) & (t < t_inf + reentry_delay)
 
 
-def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype):
+def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_seeds: bool = False):
     """Host-side canonicalization: per-agent β, in-degrees, dst-sorted edges
     with their row-pointer table, initial seeds.
 
@@ -173,9 +173,20 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype):
     indeg = indeg_i.astype(dtype)
     row_ptr = row_ptr.astype(np.int32)
     rng = np.random.default_rng(seed)
-    informed0 = rng.random(n) < x0
-    if x0 > 0 and not informed0.any():  # guarantee ≥1 seed when x0>0 implies
-        informed0[rng.integers(0, n)] = True
+    if exact_seeds:
+        # Deterministic seed COUNT (exactly round(x0·n), ≥1 when x0>0): the
+        # Bernoulli draw's binomial fluctuation in the number of initially
+        # informed agents dominates the early stochastic-growth phase when
+        # x0·n is O(1) — killing it makes the ODE comparison converge in N
+        # (used by social.closure, the equilibrium→agent validation loop).
+        k = max(1, int(round(x0 * n))) if x0 > 0 else 0
+        informed0 = np.zeros(n, bool)
+        if k:
+            informed0[rng.choice(n, size=k, replace=False)] = True
+    else:
+        informed0 = rng.random(n) < x0
+        if x0 > 0 and not informed0.any():  # guarantee ≥1 seed when x0>0
+            informed0[rng.integers(0, n)] = True
     return betas, src, dst, indeg, row_ptr, informed0
 
 
@@ -210,10 +221,10 @@ def _single_device_sim(config: AgentSimConfig):
     dt = config.dt
 
     @jax.jit
-    def run(betas, src, row_ptr, indeg, informed0, key):
+    def run(betas, src, row_ptr, indeg, informed0, t_init, key):
         n = betas.shape[0]
         dtype = betas.dtype
-        t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(dtype)
+        t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
         safe_deg = jnp.maximum(indeg, 1.0)
 
         ids = jnp.arange(n, dtype=jnp.uint32)
@@ -276,7 +287,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
     dt = config.dt
     n_dev = mesh.shape[axis]
 
-    def shard_fn(betas, src, row_ptr, indeg, informed0, key):
+    def shard_fn(betas, src, row_ptr, indeg, informed0, t_init, key):
         nb = betas.shape[0]  # local agent block
         dtype = betas.dtype
         idx = lax.axis_index(axis)
@@ -286,7 +297,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
         # bit-for-bit regardless of mesh size.
         ids = (offset + jnp.arange(nb)).astype(jnp.uint32)
         row_ptr = row_ptr[0]  # (N_global + 2,): local edge ranges incl. pad segment
-        t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(dtype)
+        t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
         safe_deg = jnp.maximum(indeg, 1.0)
         inv_n = 1.0 / n_true
 
@@ -334,7 +345,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P(), P(axis), P(axis)),
         )
     )
@@ -353,6 +364,9 @@ def simulate_agents(
     mesh_axis: str = "agents",
     dtype=np.float32,
     comm: str = "scatter",
+    exact_seeds: bool = False,
+    informed0=None,
+    t_inf0=None,
 ) -> AgentSimResult:
     """Simulate N explicit agents learning from neighbor withdrawals.
 
@@ -368,14 +382,29 @@ def simulate_agents(
       comm: sharded-collective strategy — "scatter" (bitpacked all_gather +
         psum_scatter, default) or "allgather_psum" (naive baseline); both
         are bit-identical in results (`_sharded_sim` docstring).
+      exact_seeds: seed exactly round(x0·n) agents instead of Bernoulli
+        draws (see `_prep_inputs`; used by the closure validation).
+      informed0: optional (N,) bool array overriding the seeded initial
+        state entirely (x0/exact_seeds are then ignored).
+      t_inf0: optional (N,) informed times for the initially-informed
+        agents (entries where ``informed0`` is False are ignored). Values
+        may be negative — "informed before the simulation window starts" —
+        which places mid-trajectory starts correctly relative to the
+        withdrawal window (used by `closure.close_loop`). Default 0.
 
     The simulation dtype defaults to float32: aggregates are O(1) means over
     ≥10^4 agents, where Monte-Carlo error dominates rounding by orders of
     magnitude — the f32 sweet spot for TPU (SURVEY §7.3 precision ladder).
     """
     betas_h, src_h, dst_h, indeg_h, row_ptr_h, informed0_h = _prep_inputs(
-        n, betas, x0, src, dst, seed, np.dtype(dtype)
+        n, betas, x0, src, dst, seed, np.dtype(dtype), exact_seeds
     )
+    if informed0 is not None:
+        informed0_h = np.ascontiguousarray(np.asarray(informed0, dtype=bool))
+    if t_inf0 is None:
+        t_init_h = np.zeros(n, dtype=np.dtype(dtype))
+    else:
+        t_init_h = np.ascontiguousarray(np.asarray(t_inf0, dtype=np.dtype(dtype)))
     key = jax.random.PRNGKey(seed)
 
     if mesh is None:
@@ -386,6 +415,7 @@ def simulate_agents(
             jnp.asarray(row_ptr_h),
             jnp.asarray(indeg_h),
             jnp.asarray(informed0_h),
+            jnp.asarray(t_init_h),
             key,
         )
 
@@ -401,6 +431,7 @@ def simulate_agents(
         betas_h = np.concatenate([betas_h, np.zeros(n_pad, betas_h.dtype)])
         indeg_h = np.concatenate([indeg_h, np.zeros(n_pad, indeg_h.dtype)])
         informed0_h = np.concatenate([informed0_h, np.zeros(n_pad, bool)])
+        t_init_h = np.concatenate([t_init_h, np.zeros(n_pad, t_init_h.dtype)])
     # edges arrive dst-sorted from _prep_inputs (contiguous destination
     # ranges per shard); pad with sentinel dst = N_padded (an extra segment
     # dropped inside the kernel).
@@ -426,7 +457,7 @@ def simulate_agents(
     key_repl = jax.device_put(key, NamedSharding(mesh, P()))
     args = [
         jax.device_put(jnp.asarray(a), shard)
-        for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h)
+        for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h, t_init_h)
     ]
     gs, aws, informed, t_inf = fn(*args, key_repl)
     if n_pad:
