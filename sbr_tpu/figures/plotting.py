@@ -229,6 +229,9 @@ def plot_heatmap_aw(ave_meeting_time, u_values, max_aw_matrix):
         cmap="viridis",
         alpha=0.8,
         shading="auto",
+        # Embed as an image: at the paper's 5000×5000 resolution a vector
+        # mesh would be 25M path objects and a several-hundred-MB PDF.
+        rasterized=True,
     )
     fig.colorbar(mesh, ax=ax)
     return fig

@@ -74,12 +74,6 @@ def tile_assignment(n_tiles: int, n_processes: int, process_id: int) -> range:
     return range(start, start + base + (1 if process_id < rem else 0))
 
 
-def _tile_list(n_b: int, n_u: int, tile_shape) -> list:
-    """Tile origins in `run_tiled_grid`'s iteration order."""
-    tb, tu = tile_shape
-    return [(bi, ui) for bi in range(0, n_b, tb) for ui in range(0, n_u, tu)]
-
-
 def run_tiled_grid_multihost(
     beta_values,
     u_values,
@@ -108,7 +102,7 @@ def run_tiled_grid_multihost(
     With ``wait=False`` it returns None right after its own share — the
     pattern for worker processes whose results are consumed elsewhere.
     """
-    from sbr_tpu.utils.checkpoint import _tile_path, run_tiled_grid
+    from sbr_tpu.utils.checkpoint import _tile_path, run_tiled_grid, tile_origins
 
     if process_id is None or num_processes is None:
         import jax
@@ -119,7 +113,7 @@ def run_tiled_grid_multihost(
     import numpy as np
 
     nb, nu = len(np.asarray(beta_values)), len(np.asarray(u_values))
-    tiles = _tile_list(nb, nu, tile_shape)
+    tiles = tile_origins(nb, nu, tile_shape)
     owned = {tiles[i] for i in tile_assignment(len(tiles), num_processes, process_id)}
 
     run_tiled_grid(

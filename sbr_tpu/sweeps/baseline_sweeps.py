@@ -122,6 +122,9 @@ def beta_u_grid(
     dtype=None,
 ) -> GridSweepResult:
     """Figure-5 β×u grid (`1_baseline.jl:224-267`) as one jitted program.
+    NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
+    default with crossing refinement OFF; an explicit SolverConfig() keeps
+    the scalar parity path's refinement ON (slower compile, finer buffers).
 
     Reproduces the copy-constructor semantics of the reference sweep: η and
     tspan stay pinned at the base model's resolved values for every β

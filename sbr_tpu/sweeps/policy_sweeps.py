@@ -70,6 +70,9 @@ def policy_sweep_interest(
     dtype=None,
 ) -> PolicySweepResult:
     """(β, u, r) policy grid of interest-rate equilibria.
+    NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
+    default with crossing refinement OFF; an explicit SolverConfig() keeps
+    the scalar parity path's refinement ON (slower compile, finer buffers).
 
     η/tspan/δ stay pinned at the base model's resolved values for every
     cell, matching the copy-constructor semantics of the baseline sweeps

@@ -65,7 +65,15 @@ def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
             # with the atomic rename below this means corruption, not a
             # race — but give one short grace read before failing.
             time.sleep(0.2)
-            stored = json.loads(manifest.read_text()).get("fingerprint")
+            try:
+                stored = json.loads(manifest.read_text()).get("fingerprint")
+            except json.JSONDecodeError as err:
+                raise ValueError(
+                    f"Checkpoint dir {ckpt} has an unreadable manifest.json "
+                    f"({err}); it was likely written non-atomically by an "
+                    "older build or truncated on disk. Delete the corrupt "
+                    "manifest (or use a fresh checkpoint_dir) and rerun."
+                ) from err
         if stored != fingerprint:
             raise ValueError(
                 f"Checkpoint dir {ckpt} holds tiles for a different sweep "
@@ -119,6 +127,10 @@ def run_tiled_grid(
     tile_owner=None,
 ) -> GridSweepResult:
     """β×u grid in tiles with optional on-disk resume.
+    NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
+    default (crossing refinement OFF, like `beta_u_grid`), and the config is
+    part of the sweep fingerprint — switching between the two invalidates an
+    existing checkpoint dir (by design: tile numerics would differ).
 
     Semantically identical to one `beta_u_grid` call over the full grid
     (cells are independent); tiling bounds device-memory footprint at

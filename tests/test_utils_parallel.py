@@ -207,3 +207,76 @@ class TestMultiHostFarming:
         monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
         monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
         assert initialize_distributed() is False
+
+
+class TestRealTwoProcessFarm:
+    """Genuine process concurrency (VERDICT r2 task 7): process 0's share
+    runs in a spawned subprocess while process 1 runs in-test against the
+    SAME checkpoint dir, so manifest creation and tile writes
+    (`utils/checkpoint.py`) race for real instead of being sequenced."""
+
+    def test_concurrent_worker_subprocess(self, tmp_path):
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        repo = Path(__file__).resolve().parent.parent
+        worker = tmp_path / "worker0.py"
+        worker.write_text(
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            "import numpy as np\n"
+            "from sbr_tpu.models.params import SolverConfig, make_model_params\n"
+            "from sbr_tpu.parallel import run_tiled_grid_multihost\n"
+            # interpolate the module CFG so both processes share one sweep
+            # fingerprint even if CFG changes
+            f"cfg = SolverConfig(n_grid={CFG.n_grid}, bisect_iters={CFG.bisect_iters})\n"
+            "base = make_model_params()\n"
+            "betas = np.linspace(0.5, 3.0, 6)\n"
+            "us = np.linspace(0.02, 0.3, 8)\n"
+            f"run_tiled_grid_multihost(betas, us, base, {str(tmp_path / 'ckpt')!r},\n"
+            "    config=cfg, tile_shape=(3, 4), process_id=0, num_processes=2,\n"
+            "    wait=False)\n"
+            "print('WORKER0 DONE', flush=True)\n"
+        )
+        import os
+
+        env = {**os.environ, "PYTHONPATH": str(repo)}
+        proc = subprocess.Popen(
+            [sys.executable, str(worker)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=str(tmp_path),
+        )
+        try:
+            base = make_model_params()
+            betas = np.linspace(0.5, 3.0, 6)
+            us = np.linspace(0.02, 0.3, 8)
+            # process 1 starts immediately: both processes hit the shared
+            # checkpoint dir (manifest fingerprint + tile writes) while the
+            # other is live, and the wait-loop exercises the real barrier.
+            full = run_tiled_grid_multihost(
+                betas, us, base, str(tmp_path / "ckpt"), config=CFG,
+                tile_shape=(3, 4), process_id=1, num_processes=2,
+                poll_s=0.2, timeout_s=180.0,
+            )
+            out, _ = proc.communicate(timeout=180)
+            assert proc.returncode == 0, f"worker failed:\n{out}"
+            assert "WORKER0 DONE" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        assert len(list((tmp_path / "ckpt").glob("tile_*.npz"))) == 4
+        from sbr_tpu.utils import run_tiled_grid
+
+        direct = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(3, 4))
+        np.testing.assert_allclose(
+            np.asarray(full.xi), np.asarray(direct.xi), atol=1e-12, equal_nan=True
+        )
+        np.testing.assert_array_equal(np.asarray(full.status), np.asarray(direct.status))
