@@ -127,15 +127,18 @@ def _probe_loop() -> tuple:
     return platform, history
 
 
-def _run_measurement(platform: str, timeout_s: float) -> tuple:
+def _run_measurement(platform: str, timeout_s: float, script: str = None) -> tuple:
     """Run the measurement child pinned to ``platform``; returns
-    (result_dict_or_None, outcome_str, duration_s)."""
+    (result_dict_or_None, outcome_str, duration_s). ``script`` defaults to
+    this file; benchmarks/stretch.py reuses the harness by passing its own
+    path (every device touch must live in a killable child — see module
+    docstring)."""
     import subprocess
 
     t0 = time.perf_counter()
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--measure", platform],
+            [sys.executable, script or os.path.abspath(__file__), "--measure", platform],
             stdout=subprocess.PIPE,
             stderr=None,  # child diagnostics stream straight to our stderr
             text=True,

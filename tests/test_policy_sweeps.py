@@ -84,3 +84,42 @@ def test_r_above_delta_rejected():
     base = make_interest_params(delta=0.1)
     with pytest.raises(ValueError, match="must be < delta"):
         policy_sweep_interest([1.0], [0.1], [0.2], base, CFG)
+
+
+def test_policy_sweep_at_stretch_scale():
+    """10×10×10 = the BASELINE.md stretch-row grid (f32 sweep path, as run
+    by benchmarks/stretch.py). Checks structural invariants at scale and a
+    scalar spot-check; the exact-parity coverage lives in the small-grid
+    tests above."""
+    import jax.numpy as jnp
+
+    base = make_interest_params(u=0.0, delta=0.1)
+    betas = np.linspace(0.5, 3.0, 10)
+    us = np.linspace(0.0, 0.45, 10)
+    rs = np.linspace(0.0, 0.09, 10)
+    sweep = policy_sweep_interest(betas, us, rs, base, dtype=jnp.float32)
+    assert sweep.xi.shape == (10, 10, 10)
+
+    status = np.asarray(sweep.status)
+    xi = np.asarray(sweep.xi)
+    run = status == int(Status.RUN)
+    assert run.any() and (~run).any()  # both regimes present on this grid
+    # xi finite exactly on run cells; NaN elsewhere
+    assert np.isfinite(xi[run]).all()
+    assert np.isnan(xi[~run]).all()
+    # the run region shrinks as r grows (continuation value rises)
+    counts = run.sum(axis=(0, 1))
+    assert (np.diff(counts) <= 0).all()
+
+    # spot-check one run cell against the scalar solver at f32 tolerance
+    bi, ui, ri = map(int, np.argwhere(run)[0])
+    m = make_interest_params(
+        beta=float(betas[bi]), eta=base.economic.eta, tspan=base.learning.tspan,
+        u=float(us[ui]), r=float(rs[ri]), delta=0.1,
+    )
+    cfg = SolverConfig(refine_crossings=False)  # the sweep-path default
+    ls = solve_learning(m.learning, cfg, dtype=jnp.float32)
+    single = solve_equilibrium_interest(ls, m.economic, cfg)
+    np.testing.assert_allclose(
+        float(sweep.xi[bi, ui, ri]), float(single.base.xi), rtol=2e-5
+    )

@@ -321,3 +321,32 @@ class TestClosure:
         assert large.err_aw_rms < 0.03
         assert large.err_g_rms < 0.03
         assert large.err_aw_sup < 0.06
+
+
+class TestStretchConfig:
+    """Small-scale copy of the BASELINE.md stretch workload
+    (benchmarks/stretch.py): heterogeneous lognormal β on a scale-free
+    graph, with a withdrawal window active."""
+
+    def test_hetero_beta_scale_free_window(self):
+        n = 8000
+        rng = np.random.default_rng(0)
+        betas = rng.lognormal(0.0, 0.5, n).astype(np.float32)
+        src, dst = scale_free_edges(n, avg_degree=10.0, gamma=2.5, seed=11)
+        cfg = AgentSimConfig(n_steps=150, dt=0.1, exit_delay=0.0, reentry_delay=3.0)
+        res = simulate_agents(betas, src, dst, n, x0=0.005, config=cfg, seed=0)
+        g = np.asarray(res.informed_frac)
+        aw = np.asarray(res.withdrawn_frac)
+        assert np.isfinite(g).all() and np.isfinite(aw).all()
+        assert (np.diff(g) >= -1e-7).all()  # informed fraction is monotone
+        assert (aw <= g + 1e-7).all()  # withdrawn ⊆ informed
+        assert g[-1] > g[0]  # contagion actually spread
+        # faster learners (top β quartile) get informed more than slower
+        # ones (bottom quartile), conditional on having in-neighbors
+        informed = np.asarray(res.informed)
+        indeg = np.bincount(np.asarray(dst), minlength=n)
+        has_in = indeg > 0
+        q1, q3 = np.quantile(betas, [0.25, 0.75])
+        fast = informed[(betas >= q3) & has_in].mean()
+        slow = informed[(betas <= q1) & has_in].mean()
+        assert fast > slow
