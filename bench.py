@@ -159,7 +159,12 @@ def _run_measurement(platform: str, timeout_s: float, script: str = None) -> tup
         return None, "timeout", dur
 
 
-def main() -> None:
+def run_harness(script: str = None, fallback: dict = None) -> None:
+    """Parent orchestration shared by every benchmark script: probe (unless
+    SBR_BENCH_PLATFORM forces a platform), run the `--measure` child of
+    ``script``, re-run pinned to CPU on failure, and print ONE JSON line
+    with the probe/measure history in `extra.probe_history`. ``fallback``
+    is the result skeleton when every child fails."""
     forced = os.environ.get("SBR_BENCH_PLATFORM", "").strip().lower()
     if forced:
         platform, history = forced, [{"forced": forced}]
@@ -167,7 +172,7 @@ def main() -> None:
         platform, history = _probe_loop()
 
     measure_timeout = float(os.environ.get("SBR_BENCH_MEASURE_TIMEOUT_S", "2700"))
-    result, outcome, dur = _run_measurement(platform, measure_timeout)
+    result, outcome, dur = _run_measurement(platform, measure_timeout, script)
     history.append(
         {
             "phase": "measure",
@@ -178,7 +183,7 @@ def main() -> None:
     )
     if result is None and platform != "cpu":
         _log("accelerator measurement failed — re-running pinned to CPU")
-        result, outcome, dur = _run_measurement("cpu", measure_timeout)
+        result, outcome, dur = _run_measurement("cpu", measure_timeout, script)
         history.append(
             {
                 "phase": "measure",
@@ -188,15 +193,21 @@ def main() -> None:
             }
         )
     if result is None:
-        result = {
+        result = dict(fallback or {})
+        result.setdefault("extra", {})["error"] = "all measurement children failed"
+    result.setdefault("extra", {})["probe_history"] = history
+    print(json.dumps(result))
+
+
+def main() -> None:
+    run_harness(
+        fallback={
             "metric": "beta_u_grid_equilibria_per_sec",
             "value": 0.0,
             "unit": "equilibria/sec",
             "vs_baseline": 0.0,
-            "extra": {"error": "all measurement children failed"},
         }
-    result.setdefault("extra", {})["probe_history"] = history
-    print(json.dumps(result))
+    )
 
 
 # ---------------------------------------------------------------------------

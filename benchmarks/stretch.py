@@ -25,7 +25,6 @@ Usage: python benchmarks/stretch.py  (from the repo root)
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 from pathlib import Path
@@ -144,33 +143,18 @@ def measure(platform: str) -> None:
 
 
 def main() -> None:
-    """Parent side: bench.py's probe/measure harness, this file as child."""
+    """Parent side: bench.py's shared probe/measure harness, this file as
+    the `--measure` child."""
     import bench
 
-    forced = os.environ.get("SBR_BENCH_PLATFORM", "").strip().lower()
-    if forced:
-        platform, history = forced, [{"forced": forced}]
-    else:
-        platform, history = bench._probe_loop()
-    timeout = float(os.environ.get("SBR_BENCH_MEASURE_TIMEOUT_S", "2700"))
-    me = str(Path(__file__).resolve())
-    result, outcome, dur = bench._run_measurement(platform, timeout, script=me)
-    history.append({"phase": "measure", "platform": platform, "outcome": outcome,
-                    "duration_s": round(dur, 1)})
-    if result is None and platform != "cpu":
-        _log("accelerator measurement failed — re-running pinned to CPU")
-        result, outcome, dur = bench._run_measurement("cpu", timeout, script=me)
-        history.append({"phase": "measure", "platform": "cpu", "outcome": outcome,
-                        "duration_s": round(dur, 1)})
-    if result is None:
-        result = {
+    bench.run_harness(
+        script=str(Path(__file__).resolve()),
+        fallback={
             "metric": "stretch_hetero_agents_steps_per_sec",
             "value": 0.0,
             "unit": "agent-steps/sec",
-            "extra": {"error": "all measurement children failed"},
-        }
-    result.setdefault("extra", {})["probe_history"] = history
-    print(json.dumps(result))
+        },
+    )
 
 
 if __name__ == "__main__":

@@ -217,6 +217,97 @@ def _seg_counts(active_src, row_ptr):
 
 
 @functools.lru_cache(maxsize=None)
+def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int):
+    """Event-driven single-device kernel (engine="incremental").
+
+    The gather kernel pays ~E random gathers EVERY step (`wd[src]` is the
+    measured wall: ~78 ms of a ~95 ms step at 10^7 edges on v5e — the TPU
+    gather unit issues ~1.3e8 elements/s), yet each agent changes withdrawal
+    status at most twice in a whole run (enters the window, leaves it). So
+    maintain the per-destination withdrawn-neighbor counts INCREMENTALLY:
+
+        counts_i(k) = Σ_{j→i} wd_j(k)   (int32, exact by induction)
+
+    Per step: dwd = wd(k) − wd(k−1) ∈ {−1,0,+1} elementwise; compact the
+    changed agents (≤ budget_agents), expand their out-edges on a dense
+    (budget_agents × budget_deg) grid, and scatter-add ±1 into counts. When
+    the step exceeds either budget (mass simultaneous change, or a hub with
+    out-degree > budget_deg changed), fall back to the full segmented
+    recount for that step via `lax.cond` — the invariant holds either way,
+    so results are BIT-IDENTICAL to the gather engine (tested), only faster:
+    PER-STEP, compaction ~10 ms + grid scatter ~3 ms vs ~95 ms for the full
+    recount at the 10^6-agent north-star shape; end-to-end 2.6× (8.1 s vs
+    21.1 s on v5e — ablations in benchmarks/RESULTS.md).
+
+    Step 0 initializes counts from dwd vs an all-False previous mask, so the
+    x0·N founding seeds enter through the same event path.
+    """
+    dt = config.dt
+
+    @jax.jit
+    def run(betas, src, row_ptr, indeg, dst2, out_ptr, outdeg, informed0, t_init, key):
+        n = betas.shape[0]
+        e = src.shape[0]
+        dtype = betas.dtype
+        t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
+        safe_deg = jnp.maximum(indeg, 1.0)
+        ids = jnp.arange(n, dtype=jnp.uint32)
+        d_lane = jnp.arange(budget_deg, dtype=jnp.int32)[None, :]
+
+        def step(carry, k):
+            informed, t_inf, counts, wd_prev = carry
+            t = k.astype(dtype) * dt
+            wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
+            dwd = wd.astype(jnp.int32) - wd_prev.astype(jnp.int32)
+            changed = dwd != 0
+            n_changed = jnp.sum(changed)
+
+            cids = jnp.nonzero(changed, size=budget_agents, fill_value=n)[0]
+            valid = cids < n
+            cids_c = jnp.minimum(cids, n - 1).astype(jnp.int32)
+            degs = jnp.where(valid, outdeg[cids_c], 0)
+            overflow = (n_changed > budget_agents) | (jnp.max(degs) > budget_deg)
+
+            def incr(c):
+                starts = out_ptr[cids_c]
+                emask = d_lane < degs[:, None]
+                eidx = jnp.minimum(starts[:, None] + d_lane, e - 1)
+                dsts = dst2[eidx]  # (budget_agents, budget_deg)
+                sign = jnp.where(valid, dwd[cids_c], 0)
+                delta = jnp.where(emask, sign[:, None], 0)
+                return c.at[dsts.ravel()].add(delta.ravel())
+
+            def full(_):
+                return _seg_counts(wd[src], row_ptr)
+
+            counts2 = lax.cond(overflow, full, incr, counts)
+            frac = counts2.astype(dtype) / safe_deg
+            p_inf = 1.0 - jnp.exp(-betas * frac * dt)
+            draws = _agent_uniforms(key, k, ids, dtype)
+            newly = (~informed) & (draws < p_inf)
+            informed2 = informed | newly
+            t_inf2 = jnp.where(newly, t + dt, t_inf)
+            obs = (jnp.mean(informed.astype(dtype)), jnp.mean(wd.astype(dtype)))
+            return (informed2, t_inf2, counts2, wd), obs
+
+        init = (informed0, t_inf0, jnp.zeros(n, jnp.int32), jnp.zeros(n, bool))
+        (informed, t_inf, _, _), (gs, aws) = lax.scan(
+            step, init, jnp.arange(config.n_steps)
+        )
+        t_grid = jnp.arange(config.n_steps, dtype=dtype) * dt
+        return AgentSimResult(
+            t_grid=t_grid,
+            informed_frac=gs,
+            withdrawn_frac=aws,
+            informed=informed,
+            t_inf=t_inf,
+            agent_steps=n * config.n_steps,
+        )
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
 def _single_device_sim(config: AgentSimConfig):
     dt = config.dt
 
@@ -367,6 +458,9 @@ def simulate_agents(
     exact_seeds: bool = False,
     informed0=None,
     t_inf0=None,
+    engine: str = "auto",
+    incremental_budget: Optional[int] = None,
+    incremental_max_degree: int = 64,
 ) -> AgentSimResult:
     """Simulate N explicit agents learning from neighbor withdrawals.
 
@@ -391,6 +485,18 @@ def simulate_agents(
         may be negative — "informed before the simulation window starts" —
         which places mid-trajectory starts correctly relative to the
         withdrawal window (used by `closure.close_loop`). Default 0.
+      engine: "incremental" maintains withdrawn-neighbor counts by
+        event-driven ±1 updates (each agent changes status ≤ 2× per run) —
+        2.6× faster end-to-end than "gather" at the 10^6-agent north-star
+        shape (8.1 s vs 21.1 s on v5e, benchmarks/RESULTS.md) and
+        BIT-IDENTICAL in results (fallback to the full recount on budget
+        overflow keeps exactness); "gather" recounts all edges every step;
+        "auto" (default) picks incremental single-device, gather sharded.
+      incremental_budget: max changed agents handled incrementally per step
+        (default n//64, clamped to [4096, 65536]); overflow steps fall back.
+      incremental_max_degree: out-degree cap per changed agent for the
+        dense update grid; a changed agent above it triggers the fallback
+        for that step (hubs change rarely — at most twice each).
 
     The simulation dtype defaults to float32: aggregates are O(1) means over
     ≥10^4 agents, where Monte-Carlo error dominates rounding by orders of
@@ -407,7 +513,40 @@ def simulate_agents(
         t_init_h = np.ascontiguousarray(np.asarray(t_inf0, dtype=np.dtype(dtype)))
     key = jax.random.PRNGKey(seed)
 
+    if engine not in ("auto", "gather", "incremental"):
+        raise ValueError(f"Unknown engine {engine!r}")
+    if engine == "incremental" and mesh is not None:
+        raise ValueError("engine='incremental' is single-device; use engine='gather' with a mesh")
+    if engine == "auto":
+        engine = "gather" if mesh is not None else "incremental"
+    if engine == "incremental" and len(src_h) == 0:
+        # the incremental kernel's dense out-edge grid cannot gather from an
+        # empty edge array; the gather kernel handles E = 0 fine
+        engine = "gather"
+
     if mesh is None:
+        if engine == "incremental":
+            from sbr_tpu.native import sort_edges_by_dst
+
+            # out-edge structure: the same edge multiset re-sorted by SOURCE
+            # (dst2[e] = destination of the e-th src-sorted edge).
+            dst2_h, _, outdeg_h, out_ptr_h = sort_edges_by_dst(dst_h, src_h, n)
+            budget = incremental_budget
+            if budget is None:
+                budget = min(max(4096, n // 64), 65536)
+            run = _incremental_sim(config, int(budget), int(incremental_max_degree))
+            return run(
+                jnp.asarray(betas_h),
+                jnp.asarray(src_h),
+                jnp.asarray(row_ptr_h),
+                jnp.asarray(indeg_h),
+                jnp.asarray(dst2_h),
+                jnp.asarray(out_ptr_h.astype(np.int32)),
+                jnp.asarray(outdeg_h),
+                jnp.asarray(informed0_h),
+                jnp.asarray(t_init_h),
+                key,
+            )
         run = _single_device_sim(config)
         return run(
             jnp.asarray(betas_h),

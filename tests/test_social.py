@@ -350,3 +350,67 @@ class TestStretchConfig:
         fast = informed[(betas >= q3) & has_in].mean()
         slow = informed[(betas <= q1) & has_in].mean()
         assert fast > slow
+
+
+class TestIncrementalEngine:
+    """engine="incremental" (event-driven ±1 count maintenance) must be
+    BIT-IDENTICAL to the full-recount gather engine — including when its
+    per-step budgets overflow and it falls back to the full recount."""
+
+    def test_bit_identical_with_window(self):
+        n = 6000
+        src, dst = erdos_renyi_edges(n, 12.0, seed=21)
+        cfg = AgentSimConfig(n_steps=120, dt=0.1, exit_delay=0.3, reentry_delay=2.0)
+        a = simulate_agents(1.0, src, dst, n, x0=0.005, config=cfg, seed=3, engine="gather")
+        b = simulate_agents(1.0, src, dst, n, x0=0.005, config=cfg, seed=3, engine="incremental")
+        np.testing.assert_array_equal(np.asarray(a.informed), np.asarray(b.informed))
+        np.testing.assert_array_equal(np.asarray(a.t_inf), np.asarray(b.t_inf))
+        np.testing.assert_array_equal(
+            np.asarray(a.withdrawn_frac), np.asarray(b.withdrawn_frac)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.informed_frac), np.asarray(b.informed_frac)
+        )
+
+    def test_bit_identical_through_fallback(self):
+        """A hub above incremental_max_degree forces the full-recount branch
+        on every step it changes status; tiny budgets force agent-count
+        overflows too. Results must still match exactly."""
+        n = 3000
+        rng = np.random.default_rng(5)
+        src, dst = erdos_renyi_edges(n, 8.0, seed=22)
+        # add a hub: agent 0 feeds 500 random destinations (out-degree 500)
+        hub_dst = rng.choice(np.arange(1, n), size=500, replace=False).astype(np.int32)
+        src = np.concatenate([src, np.zeros(500, np.int32)])
+        dst = np.concatenate([dst, hub_dst])
+        cfg = AgentSimConfig(n_steps=100, dt=0.1, exit_delay=0.0, reentry_delay=1.5)
+        a = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=4, engine="gather")
+        b = simulate_agents(
+            1.0, src, dst, n, x0=0.01, config=cfg, seed=4,
+            engine="incremental", incremental_budget=64, incremental_max_degree=16,
+        )
+        np.testing.assert_array_equal(np.asarray(a.informed), np.asarray(b.informed))
+        np.testing.assert_array_equal(np.asarray(a.t_inf), np.asarray(b.t_inf))
+        np.testing.assert_array_equal(
+            np.asarray(a.withdrawn_frac), np.asarray(b.withdrawn_frac)
+        )
+
+    def test_engine_validation(self):
+        n = 100
+        src, dst = erdos_renyi_edges(n, 4.0, seed=0)
+        with pytest.raises(ValueError, match="Unknown engine"):
+            simulate_agents(1.0, src, dst, n, engine="warp")
+        mesh = jax.make_mesh((8,), ("agents",))
+        with pytest.raises(ValueError, match="single-device"):
+            simulate_agents(1.0, src, dst, n, mesh=mesh, engine="incremental")
+
+    def test_zero_edge_graph(self):
+        """E = 0 routes to the gather kernel (the incremental dense grid
+        cannot gather from an empty edge array): no crash, no contagion."""
+        n = 50
+        src = np.zeros(0, np.int32)
+        dst = np.zeros(0, np.int32)
+        cfg = AgentSimConfig(n_steps=20, dt=0.1)
+        res = simulate_agents(1.0, src, dst, n, x0=0.1, config=cfg, seed=0)
+        g = np.asarray(res.informed_frac)
+        assert g[-1] == g[0]  # nothing spreads without edges
