@@ -10,6 +10,15 @@ baseline ones agree with the independent scipy oracle (tests/oracle.py) to
 ~1e-6, so they double as end-to-end regression anchors for the whole
 pipeline. Tolerances: 1e-5 for deterministic f64 solves, 1e-3 for the
 social fixed point (its own convergence tolerance is 1e-4).
+
+CAVEAT (VERDICT r2 weak-7): the hetero/social pins below are OWN-OUTPUT
+pins — regression anchors, not external truth. The baseline pins are
+cross-checked against the scipy oracle, and the hetero/social CONFIGS have
+separate oracle tests at looser tolerance (tests/test_hetero.py,
+tests/test_social.py), but the pinned digits themselves (e.g.
+ξ=16.875766906) encode this implementation's numerics: a change that
+shifts both the implementation and these pins in tandem would pass here
+and must be caught by the oracle tests instead.
 """
 
 import numpy as np
