@@ -10,11 +10,16 @@ over the mesh (SURVEY §5.8).
 
 from sbr_tpu.hetero.learning import solve_learning_hetero
 from sbr_tpu.hetero.sharded import solve_hetero_sharded
-from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
+from sbr_tpu.hetero.solver import (
+    compute_xi_hetero,
+    get_aw_hetero,
+    solve_equilibrium_hetero,
+)
 
 __all__ = [
     "solve_learning_hetero",
     "solve_equilibrium_hetero",
+    "compute_xi_hetero",
     "solve_hetero_sharded",
     "get_aw_hetero",
 ]

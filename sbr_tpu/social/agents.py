@@ -216,6 +216,22 @@ def _seg_counts(active_src, row_ptr):
     return prefix[row_ptr[1:]] - prefix[row_ptr[:-1]]
 
 
+def _bitpacked_block_counts(wd, src, row_ptr, axis):
+    """Sharded full recount, bitpacked form (shared by the gather engine's
+    "scatter" comm and the incremental engine's overflow fallback — the two
+    must stay byte-for-byte equivalent for the engines' bit-identity):
+    all_gather the N/8-byte packed withdrawn mask, count this shard's
+    dst-sorted edges, and psum_scatter so each device receives only its own
+    agent block's totals. Requires the local block byte-aligned."""
+    wd_bits = jnp.packbits(wd, bitorder="little")  # (nb/8,) uint8
+    bits_global = lax.all_gather(wd_bits, axis, tiled=True)  # (N/8,)
+    active = (bits_global[src >> 3] >> (src & 7).astype(jnp.uint8)) & jnp.uint8(1)
+    counts = _seg_counts(active, row_ptr)[:-1]  # (N,) this shard's edges
+    # reduce straddling ranges AND deliver each device its own block in one
+    # reduce_scatter (1/n_dev the bytes of a psum)
+    return lax.psum_scatter(counts, axis, scatter_dimension=0, tiled=True)
+
+
 @functools.lru_cache(maxsize=None)
 def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int):
     """Event-driven single-device kernel (engine="incremental").
@@ -397,15 +413,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
             if comm == "scatter":
                 # nb is padded to a byte boundary (simulate_agents), so the
                 # packed local masks concatenate into the global bit array.
-                wd_bits = jnp.packbits(wd, bitorder="little")  # (nb/8,) uint8
-                bits_global = lax.all_gather(wd_bits, axis, tiled=True)  # (N/8,)
-                active = (
-                    bits_global[src >> 3] >> (src & 7).astype(jnp.uint8)
-                ) & jnp.uint8(1)
-                counts = _seg_counts(active, row_ptr)[:-1]  # (N,) this shard's edges
-                # reduce straddling ranges AND deliver each device its own
-                # block in one reduce_scatter (1/n_dev the bytes of a psum)
-                return lax.psum_scatter(counts, axis, scatter_dimension=0, tiled=True)
+                return _bitpacked_block_counts(wd, src, row_ptr, axis)
             wd_global = lax.all_gather(wd, axis, tiled=True)  # (N,) bool
             counts = _seg_counts(wd_global[src], row_ptr)[:-1]
             counts = lax.psum(counts, axis)  # straddling dst ranges
@@ -443,6 +451,119 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
     return fn
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_incremental_sim(
+    config: AgentSimConfig,
+    mesh: Mesh,
+    axis: str,
+    n_true: int,
+    budget_agents: int,
+    budget_deg: int,
+):
+    """Event-driven kernel over a device mesh (engine="incremental" + mesh).
+
+    Same invariant as `_incremental_sim` — counts_i(k) = Σ_{j→i} wd_j(k),
+    maintained by ±1 updates over changed agents' out-edges — distributed:
+    each device compacts the changed agents of ITS block, scatter-adds
+    their out-edge contributions into a full-length delta vector (out-edges
+    target arbitrary global destinations), and one `psum_scatter` both sums
+    the deltas across devices and hands each device its own block's slice
+    (the same collective shape as the gather path's "scatter" comm, but
+    int32 deltas instead of recounted totals). Overflow anywhere (psum'd
+    flag, so every device takes the same branch) falls back to the gather
+    path's bitpacked full recount for that step — results stay BIT-IDENTICAL
+    to every other engine/sharding combination (tested).
+
+    Out-edges are sharded BY SOURCE BLOCK (each device holds its own
+    agents' out-edges, padded to the max block edge count) — unlike the
+    gather path's count-balanced dst-sorted shards. Scale-free hubs skew
+    that padding; prefer engine="gather" for heavy-tailed out-degrees.
+    """
+    dt = config.dt
+    n_dev = mesh.shape[axis]
+
+    def shard_fn(
+        betas, src, row_ptr, indeg, dst2, out_start, outdeg, informed0, t_init, key
+    ):
+        nb = betas.shape[0]
+        el = dst2.shape[0]  # padded local out-edge chunk
+        n_gl = nb * n_dev
+        dtype = betas.dtype
+        idx = lax.axis_index(axis)
+        offset = idx * nb
+        ids = (offset + jnp.arange(nb)).astype(jnp.uint32)
+        row_ptr = row_ptr[0]
+        t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
+        safe_deg = jnp.maximum(indeg, 1.0)
+        inv_n = 1.0 / n_true
+        d_lane = jnp.arange(budget_deg, dtype=jnp.int32)[None, :]
+
+        def full_recount(wd):
+            return _bitpacked_block_counts(wd, src, row_ptr, axis)
+
+        def step(carry, k):
+            informed, t_inf, counts, wd_prev = carry
+            t = k.astype(dtype) * dt
+            wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
+            dwd = wd.astype(jnp.int32) - wd_prev.astype(jnp.int32)
+            changed = dwd != 0
+            n_changed = jnp.sum(changed)
+
+            cids = jnp.nonzero(changed, size=budget_agents, fill_value=nb)[0]
+            valid = cids < nb
+            cids_c = jnp.minimum(cids, nb - 1).astype(jnp.int32)
+            degs = jnp.where(valid, outdeg[cids_c], 0)
+            overflow = (n_changed > budget_agents) | (jnp.max(degs) > budget_deg)
+            overflow_any = lax.psum(overflow.astype(jnp.int32), axis) > 0
+
+            def incr(c):
+                starts = out_start[cids_c]
+                emask = d_lane < degs[:, None]
+                eidx = jnp.minimum(starts[:, None] + d_lane, el - 1)
+                dsts = dst2[eidx]  # global destination ids; pad edges → n_gl
+                dsts = jnp.where(emask, dsts, n_gl)
+                sign = jnp.where(valid, dwd[cids_c], 0)
+                delta = jnp.where(emask, sign[:, None], 0)
+                buf = jnp.zeros(n_gl + 1, jnp.int32).at[dsts.ravel()].add(delta.ravel())
+                return c + lax.psum_scatter(
+                    buf[:n_gl], axis, scatter_dimension=0, tiled=True
+                )
+
+            counts2 = lax.cond(overflow_any, lambda c: full_recount(wd), incr, counts)
+            frac = counts2.astype(dtype) / safe_deg
+            p_inf = 1.0 - jnp.exp(-betas * frac * dt)
+            draws = _agent_uniforms(key, k, ids, dtype)
+            newly = (~informed) & (draws < p_inf)
+            informed2 = informed | newly
+            t_inf2 = jnp.where(newly, t + dt, t_inf)
+            g = lax.psum(jnp.sum(informed.astype(dtype)), axis) * inv_n
+            aw = lax.psum(jnp.sum(wd.astype(dtype)), axis) * inv_n
+            return (informed2, t_inf2, counts2, wd), (g, aw)
+
+        # fresh zero arrays are device-invariant constants; mark them varying
+        # over the mesh axis so the scan carry types match the step outputs
+        init = (
+            informed0,
+            t_inf0,
+            lax.pcast(jnp.zeros(nb, jnp.int32), (axis,), to="varying"),
+            lax.pcast(jnp.zeros(nb, bool), (axis,), to="varying"),
+        )
+        (informed, t_inf, _, _), (gs, aws) = lax.scan(
+            step, init, jnp.arange(config.n_steps)
+        )
+        return gs, aws, informed, t_inf
+
+    fn = jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(axis),) * 9 + (P(),),
+            out_specs=(P(), P(), P(axis), P(axis)),
+        )
+    )
+    return fn
+
+
 def simulate_agents(
     betas,
     src,
@@ -473,9 +594,11 @@ def simulate_agents(
         when x0 > 0, while x0 = 0 runs a genuinely seedless control).
       mesh: optional 1-D device mesh; shards agents and edges (see module
         docstring). Without it, runs single-device.
-      comm: sharded-collective strategy — "scatter" (bitpacked all_gather +
-        psum_scatter, default) or "allgather_psum" (naive baseline); both
-        are bit-identical in results (`_sharded_sim` docstring).
+      comm: sharded-collective strategy for the GATHER engine — "scatter"
+        (bitpacked all_gather + psum_scatter, default) or "allgather_psum"
+        (naive baseline); both are bit-identical in results (`_sharded_sim`
+        docstring). Ignored by engine="incremental", whose only recount path
+        is the bitpacked scatter form.
       exact_seeds: seed exactly round(x0·n) agents instead of Bernoulli
         draws (see `_prep_inputs`; used by the closure validation).
       informed0: optional (N,) bool array overriding the seeded initial
@@ -491,9 +614,15 @@ def simulate_agents(
         shape (8.1 s vs 21.1 s on v5e, benchmarks/RESULTS.md) and
         BIT-IDENTICAL in results (fallback to the full recount on budget
         overflow keeps exactness); "gather" recounts all edges every step;
-        "auto" (default) picks incremental single-device, gather sharded.
+        "auto" (default) picks incremental single-device, gather sharded
+        (the sharded incremental variant exists — `_sharded_incremental_sim`,
+        deltas resolved by one psum_scatter — but its source-block edge
+        shards pad badly under scale-free skew, so it stays opt-in).
       incremental_budget: max changed agents handled incrementally per step
-        (default n//64, clamped to [4096, 65536]); overflow steps fall back.
+        (single-device default n//64 clamped to [4096, 65536]; with a mesh
+        the budget — including an explicit value — is PER DEVICE BLOCK,
+        default nb//64 clamped to [512, 65536] for block size nb = N/n_dev);
+        overflow steps fall back to the full recount.
       incremental_max_degree: out-degree cap per changed agent for the
         dense update grid; a changed agent above it triggers the fallback
         for that step (hubs change rarely — at most twice each).
@@ -515,9 +644,10 @@ def simulate_agents(
 
     if engine not in ("auto", "gather", "incremental"):
         raise ValueError(f"Unknown engine {engine!r}")
-    if engine == "incremental" and mesh is not None:
-        raise ValueError("engine='incremental' is single-device; use engine='gather' with a mesh")
     if engine == "auto":
+        # sharded default stays "gather": its count-balanced edge shards are
+        # robust to scale-free skew, while the incremental engine's
+        # source-block out-edge shards are not (see _sharded_incremental_sim)
         engine = "gather" if mesh is not None else "incremental"
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an
@@ -562,9 +692,10 @@ def simulate_agents(
         raise ValueError(f"Unknown comm strategy {comm!r}")
     n_dev = mesh.shape[mesh_axis]
     # agents: pad to a multiple of n_dev with inert agents (β=0, uninformed,
-    # degree 0); aggregates normalize by the true N. The "scatter" path
-    # additionally needs each local block byte-aligned for bit packing.
-    block = 8 * n_dev if comm == "scatter" else n_dev
+    # degree 0); aggregates normalize by the true N. The "scatter" path —
+    # and the incremental engine, whose overflow fallback is the bitpacked
+    # recount — additionally need each local block byte-aligned for packing.
+    block = 8 * n_dev if (comm == "scatter" or engine == "incremental") else n_dev
     n_pad = (-n) % block
     if n_pad:
         betas_h = np.concatenate([betas_h, np.zeros(n_pad, betas_h.dtype)])
@@ -575,6 +706,7 @@ def simulate_agents(
     # ranges per shard); pad with sentinel dst = N_padded (an extra segment
     # dropped inside the kernel).
     n_gl = n + n_pad
+    src_h0, dst_h0 = src_h, dst_h  # unpadded, for the out-edge structure
     e_pad = (-len(src_h)) % n_dev
     if e_pad:
         src_h = np.concatenate([src_h, np.zeros(e_pad, np.int32)])
@@ -591,14 +723,59 @@ def simulate_agents(
         ]
     ).astype(np.int32)
 
-    fn = _sharded_sim(config, mesh, mesh_axis, n, comm)
     shard = NamedSharding(mesh, P(mesh_axis))
     key_repl = jax.device_put(key, NamedSharding(mesh, P()))
-    args = [
-        jax.device_put(jnp.asarray(a), shard)
-        for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h, t_init_h)
-    ]
-    gs, aws, informed, t_inf = fn(*args, key_repl)
+    if engine == "incremental":
+        from sbr_tpu.native import sort_edges_by_dst
+
+        # Out-edges sharded BY SOURCE BLOCK: device d holds the out-edges of
+        # agents [d·nb, (d+1)·nb), padded to the max block edge count with
+        # the sentinel destination n_gl (dropped into the delta dump slot).
+        nb = n_gl // n_dev
+        dst2_all, _, outdeg_all, out_ptr_all = sort_edges_by_dst(dst_h0, src_h0, n)
+        e_all = int(out_ptr_all[-1])
+        outdeg_pad = np.zeros(n_gl, np.int32)
+        outdeg_pad[:n] = outdeg_all
+        starts_pad = np.full(n_gl, e_all, np.int64)
+        starts_pad[:n] = out_ptr_all[:-1]
+        bounds = np.array([int(starts_pad[d * nb]) for d in range(n_dev)] + [e_all])
+        el = max(1, int(np.max(bounds[1:] - bounds[:-1])))
+        dst2_sh = np.full(n_dev * el, n_gl, np.int32)
+        out_start_h = np.zeros(n_gl, np.int32)
+        for d in range(n_dev):
+            lo, hi = int(bounds[d]), int(bounds[d + 1])
+            dst2_sh[d * el : d * el + (hi - lo)] = dst2_all[lo:hi]
+            out_start_h[d * nb : (d + 1) * nb] = (
+                starts_pad[d * nb : (d + 1) * nb] - lo
+            ).astype(np.int32)
+        budget = incremental_budget
+        if budget is None:
+            budget = min(max(512, nb // 64), 65536)
+        fn = _sharded_incremental_sim(
+            config, mesh, mesh_axis, n, int(budget), int(incremental_max_degree)
+        )
+        args = [
+            jax.device_put(jnp.asarray(a), shard)
+            for a in (
+                betas_h,
+                src_h,
+                row_ptrs_h,
+                indeg_h,
+                dst2_sh,
+                out_start_h,
+                outdeg_pad,
+                informed0_h,
+                t_init_h,
+            )
+        ]
+        gs, aws, informed, t_inf = fn(*args, key_repl)
+    else:
+        fn = _sharded_sim(config, mesh, mesh_axis, n, comm)
+        args = [
+            jax.device_put(jnp.asarray(a), shard)
+            for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h, t_init_h)
+        ]
+        gs, aws, informed, t_inf = fn(*args, key_repl)
     if n_pad:
         # The padding trim [:n] is not shard-aligned; all-gather the final
         # per-agent state (output-only, O(N) bytes) so the slice is local.
