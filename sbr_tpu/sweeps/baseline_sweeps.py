@@ -133,8 +133,8 @@ def beta_u_grid(
 
     With ``mesh``, the (B, U) grid is sharded over its axes; cells are
     independent so no collectives are required and the program scales across
-    chips linearly. Axis sizes must divide the mesh axis sizes (pad the value
-    arrays if needed).
+    chips linearly. Each mesh axis size must divide the matching value-array
+    length (pad the value arrays if needed).
 
     ``config`` defaults to crossing refinement OFF (see SolverConfig): grid
     outputs (AW_max, ξ, status) are interpolation-bound, and the per-cell

@@ -41,8 +41,8 @@ class PolicySweepResult:
 
 
 @functools.lru_cache(maxsize=None)
-def _policy_fn(config: SolverConfig, dtype_name: str):
-    """Jitted (β, u, r) program, cached by (config, dtype)."""
+def _policy_fn(config: SolverConfig, dtype_name: str, mesh=None, mesh_axes=None):
+    """Jitted (β, u, r) program, cached by (config, dtype, mesh)."""
     dtype = jnp.dtype(dtype_name)
 
     def cell(beta, u, r, p, kappa, lam, eta, delta, t0, t1, x0):
@@ -58,6 +58,33 @@ def _policy_fn(config: SolverConfig, dtype_name: str):
         ),
         in_axes=(0, None, None) + bcast,
     )
+    if mesh is not None:
+        # (B, U) block-sharded via shard_map — each device runs the plain
+        # vmap³ program on its local (B/n_b, U/n_u, R) block; cells are
+        # independent, so there are no collectives and no sharded-indexing
+        # propagation inside the traced cell (gather-heavy interp under 3
+        # batched axes trips XLA's sharding-in-types inference otherwise).
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        b_ax, u_ax = mesh_axes
+
+        def body(b, u, r, *scalars):
+            # replicated inputs are device-invariant; mark every input
+            # varying over both mesh axes (each only over the axes it does
+            # not already vary on) so internal scan carries are consistent
+            b = lax.pcast(b, (u_ax,), to="varying")
+            u = lax.pcast(u, (b_ax,), to="varying")
+            vary = lambda x: lax.pcast(x, (b_ax, u_ax), to="varying")
+            return fn(b, u, vary(r), *(vary(s) for s in scalars))
+
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(b_ax), P(u_ax), P()) + (P(),) * 8,
+            out_specs=P(b_ax, u_ax, None),
+        )
+        return jax.jit(sharded)
     return jax.jit(fn)
 
 
@@ -68,11 +95,18 @@ def policy_sweep_interest(
     base: ModelParamsInterest,
     config: Optional[SolverConfig] = None,
     dtype=None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    mesh_axes: tuple = ("b", "u"),
 ) -> PolicySweepResult:
     """(β, u, r) policy grid of interest-rate equilibria.
     NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
     default with crossing refinement OFF; an explicit SolverConfig() keeps
     the scalar parity path's refinement ON (slower compile, finer buffers).
+
+    With ``mesh``, the (B, U) axes are sharded over its axes (r replicated);
+    cells are independent so the program scales across chips with no
+    collectives. Each mesh axis size must divide the matching value-array
+    length (pad the arrays if needed).
 
     η/tspan/δ stay pinned at the base model's resolved values for every
     cell, matching the copy-constructor semantics of the baseline sweeps
@@ -99,6 +133,12 @@ def policy_sweep_interest(
     r_values = jnp.asarray(r_values, dtype=dtype)
     tspan = base.learning.tspan
 
+    if mesh is not None:
+        b_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[0]))
+        u_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[1]))
+        beta_values = jax.device_put(beta_values, b_sh)
+        u_values = jax.device_put(u_values, u_sh)
+
     scalars = tuple(
         jnp.asarray(v, dtype)
         for v in (
@@ -112,7 +152,10 @@ def policy_sweep_interest(
             base.learning.x0,
         )
     )
-    xi, aw_max, status = _policy_fn(config, dtype.name)(beta_values, u_values, r_values, *scalars)
+    fn = _policy_fn(
+        config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None
+    )
+    xi, aw_max, status = fn(beta_values, u_values, r_values, *scalars)
     return PolicySweepResult(
         beta_values=beta_values,
         u_values=u_values,

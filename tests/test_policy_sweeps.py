@@ -123,3 +123,25 @@ def test_policy_sweep_at_stretch_scale():
     np.testing.assert_allclose(
         float(sweep.xi[bi, ui, ri]), float(single.base.xi), rtol=2e-5
     )
+
+
+def test_policy_sweep_sharded_matches_unsharded():
+    """(B, U) mesh-sharded policy sweep equals the single-device program
+    exactly (cells are independent; no collectives)."""
+    import jax
+    import jax.numpy as jnp
+
+    base = make_interest_params(u=0.0, delta=0.1)
+    betas = np.linspace(0.5, 3.0, 4)   # divides the 2-axis of the (2,4) mesh
+    us = np.linspace(0.0, 0.4, 8)      # divides the 4-axis
+    rs = np.linspace(0.0, 0.09, 3)
+    mesh = jax.make_mesh((2, 4), ("b", "u"))
+    sharded = policy_sweep_interest(betas, us, rs, base, CFG, mesh=mesh)
+    single = policy_sweep_interest(betas, us, rs, base, CFG)
+    np.testing.assert_array_equal(np.asarray(sharded.status), np.asarray(single.status))
+    np.testing.assert_allclose(
+        np.asarray(sharded.xi), np.asarray(single.xi), atol=1e-12, equal_nan=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.aw_max), np.asarray(single.aw_max), atol=1e-12, equal_nan=True
+    )
