@@ -13,12 +13,18 @@ from sbr_tpu.parallel.distributed import (
     run_tiled_grid_multihost,
     tile_assignment,
 )
-from sbr_tpu.parallel.mesh import balanced_2d, make_agent_mesh, make_grid_mesh
+from sbr_tpu.parallel.mesh import (
+    balanced_2d,
+    make_agent_mesh,
+    make_grid_mesh,
+    shard_axis_values,
+)
 
 __all__ = [
     "balanced_2d",
     "make_agent_mesh",
     "make_grid_mesh",
+    "shard_axis_values",
     "initialize_distributed",
     "run_tiled_grid_multihost",
     "tile_assignment",

@@ -57,3 +57,17 @@ def make_agent_mesh(devices: Optional[Sequence] = None, axis_name: str = "agents
     """1-D mesh over all (or the given) devices for agent/edge sharding."""
     devices = _devices(devices)
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def shard_axis_values(mesh: Mesh, mesh_axes: Sequence[str], *value_arrays):
+    """Place each 1-D parameter-value array on its own mesh axis
+    (`NamedSharding(mesh, P(axis))`) — the input-side idiom shared by the
+    mesh-sharded sweeps (`sweeps.beta_u_grid`, `sweeps.policy_sweep_interest`).
+    Each mesh axis size must divide the matching array length."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return tuple(
+        jax.device_put(v, NamedSharding(mesh, PartitionSpec(ax)))
+        for ax, v in zip(mesh_axes, value_arrays, strict=True)
+    )

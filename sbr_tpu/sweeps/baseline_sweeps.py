@@ -155,10 +155,9 @@ def beta_u_grid(
     u_values = jnp.asarray(u_values, dtype=dtype)
 
     if mesh is not None:
-        b_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[0]))
-        u_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[1]))
-        beta_values = jax.device_put(beta_values, b_sharding)
-        u_values = jax.device_put(u_values, u_sharding)
+        from sbr_tpu.parallel import shard_axis_values
+
+        beta_values, u_values = shard_axis_values(mesh, mesh_axes, beta_values, u_values)
 
     grid_fn = _grid_fn(config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None)
     scalars = tuple(

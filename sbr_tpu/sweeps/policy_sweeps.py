@@ -134,10 +134,9 @@ def policy_sweep_interest(
     tspan = base.learning.tspan
 
     if mesh is not None:
-        b_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[0]))
-        u_sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(mesh_axes[1]))
-        beta_values = jax.device_put(beta_values, b_sh)
-        u_values = jax.device_put(u_values, u_sh)
+        from sbr_tpu.parallel import shard_axis_values
+
+        beta_values, u_values = shard_axis_values(mesh, mesh_axes, beta_values, u_values)
 
     scalars = tuple(
         jnp.asarray(v, dtype)
