@@ -219,14 +219,22 @@ def _init_child_backend(platform: str):
     import jax
 
     if platform == "cpu":
-        # Must go through jax.config: this image's sitecustomize overrides
-        # the JAX_PLATFORMS env var (see tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
     jax.config.update("jax_compilation_cache_dir", str(Path.home() / ".cache/sbr_tpu_xla"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     devices = jax.devices()
     _log(f"backend up: {len(devices)}x {devices[0].platform}")
     return devices
+
+
+def _tiny() -> bool:
+    """SBR_BENCH_SIZES=tiny shrinks every workload to smoke-test scale so the
+    harness itself (probe → child → JSON) can be exercised in seconds — the
+    driver depends on this script emitting valid JSON at round end, so the
+    test suite runs the whole pipeline at tiny sizes."""
+    return os.environ.get("SBR_BENCH_SIZES", "").strip().lower() == "tiny"
 
 
 def bench_grid(platform: str) -> dict:
@@ -238,11 +246,15 @@ def bench_grid(platform: str) -> dict:
     from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
     from sbr_tpu.utils import timing
 
-    if platform == "cpu":  # degraded fallback: still ≥ the 10^4-point north star
+    if _tiny():
+        n_beta, n_u = 8, 8
+    elif platform == "cpu":  # degraded fallback: still ≥ the 10^4-point north star
         n_beta, n_u = 128, 128
     else:
         n_beta, n_u = 640, 640  # 409.6k cells — 40× the north-star 10^4 points
-    config = SolverConfig(n_grid=1024, bisect_iters=60, refine_crossings=False)
+    config = SolverConfig(
+        n_grid=256 if _tiny() else 1024, bisect_iters=60, refine_crossings=False
+    )
     base = make_model_params()  # Figure-5 base: β=1, η̄=15, κ=.6 (η pinned 15)
 
     # Reference grid domain (`scripts/1_baseline.jl:210-213`):
@@ -306,7 +318,9 @@ def bench_agents(platform: str) -> dict:
     """Agent-steps/sec: 10^6 agents, Erdős–Rényi deg 10, 200 steps, f32."""
     from sbr_tpu.social import AgentSimConfig, erdos_renyi_edges, simulate_agents
 
-    if platform == "cpu":  # degraded fallback size
+    if _tiny():
+        n, n_steps = 2_000, 20
+    elif platform == "cpu":  # degraded fallback size
         n, n_steps = 100_000, 100
     else:
         n, n_steps = 1_000_000, 200

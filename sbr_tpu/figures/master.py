@@ -379,6 +379,13 @@ def main(argv=None) -> int:
         help="also generate the paper-resolution heatmap via tiled checkpoint/resume "
         "(the reference's 'couple hours' 5000x5000 grid; interruptible + resumable)",
     )
+    parser.add_argument(
+        "--platform",
+        choices=("default", "cpu"),
+        default="default",
+        help="pin the JAX platform: 'cpu' avoids touching a (possibly hung) "
+        "accelerator tunnel; 'default' uses whatever backend JAX selects",
+    )
     parser.add_argument("--paper-res", type=int, default=5000, help="paper heatmap resolution")
     parser.add_argument("--paper-tile", type=int, default=500, help="paper heatmap tile size")
     parser.add_argument(
@@ -396,6 +403,10 @@ def main(argv=None) -> int:
 
     import jax
 
+    if args.platform == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
     if not args.f32:
         jax.config.update("jax_enable_x64", True)
     # Persistent compilation cache: the run is compile-dominated (execution

@@ -16,7 +16,7 @@ import math
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
 def balanced_2d(n: int) -> Tuple[int, int]:
@@ -65,7 +65,6 @@ def shard_axis_values(mesh: Mesh, mesh_axes: Sequence[str], *value_arrays):
     mesh-sharded sweeps (`sweeps.beta_u_grid`, `sweeps.policy_sweep_interest`).
     Each mesh axis size must divide the matching array length."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec
 
     return tuple(
         jax.device_put(v, NamedSharding(mesh, PartitionSpec(ax)))

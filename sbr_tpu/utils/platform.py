@@ -1,0 +1,21 @@
+"""Backend platform pinning.
+
+This image's axon sitecustomize force-registers the TPU plugin and OVERRIDES
+the `JAX_PLATFORMS` environment variable, so pinning a platform must go
+through `jax.config` after importing jax (verified: env alone is ignored).
+This is the single home for that workaround — used by the bench harness
+child, the figures CLI, and mirrored by tests/conftest.py (which must also
+set XLA_FLAGS before jax import, so it inlines the same call)."""
+
+from __future__ import annotations
+
+
+def pin_cpu_platform() -> None:
+    """Pin the CPU backend, never touching a (possibly hung) accelerator.
+
+    Must be called before any backend-initializing JAX operation; afterwards
+    it either raises (backend already initialized) or is ignored by the live
+    backend — callers that need certainty should check `jax.devices()`."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,50 @@
+"""Smoke test of the benchmark harness the driver invokes at round end.
+
+Runs the REAL pipeline — parent orchestration, `--measure` child subprocess,
+JSON contract — at SBR_BENCH_SIZES=tiny scale, pinned to CPU so no probe or
+accelerator is involved. If this breaks, `BENCH_r*.json` would be empty at
+round end, which history shows is the costliest possible failure."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(script: str) -> dict:
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "SBR_BENCH_PLATFORM": "cpu",
+        "SBR_BENCH_SIZES": "tiny",
+        "SBR_BENCH_MEASURE_TIMEOUT_S": "240",
+    }
+    out = subprocess.run(
+        [sys.executable, str(REPO / script)],
+        capture_output=True,
+        text=True,
+        timeout=280,
+        env=env,
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, f"{script} rc={out.returncode}\n{out.stderr[-800:]}"
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"{script} must print exactly ONE line, got {len(lines)}"
+    return json.loads(lines[0])
+
+
+def test_bench_emits_contract_json():
+    d = _run("bench.py")
+    assert d["metric"] == "beta_u_grid_equilibria_per_sec"
+    assert d["unit"] == "equilibria/sec"
+    assert d["value"] > 0
+    assert d["vs_baseline"] > 0
+    extra = d["extra"]
+    assert extra["platform"] == "cpu"
+    assert extra["agent_steps_per_sec"] > 0
+    # the self-documenting history: forced platform + one ok measure phase
+    phases = [h for h in extra["probe_history"] if h.get("phase") == "measure"]
+    assert phases and phases[-1]["outcome"] == "ok"
