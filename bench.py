@@ -33,7 +33,8 @@ touches an accelerator and a CHILD that does all device work:
 
 Env overrides: SBR_BENCH_PLATFORM=cpu|tpu skips the probe;
 SBR_BENCH_PROBE_ATTEMPTS / SBR_BENCH_PROBE_TIMEOUT_S /
-SBR_BENCH_MEASURE_TIMEOUT_S tune budgets.
+SBR_BENCH_MEASURE_TIMEOUT_S tune budgets; SBR_BENCH_SIZES=tiny shrinks
+every workload to smoke-test scale (used by tests/test_bench_harness.py).
 """
 
 from __future__ import annotations

@@ -32,7 +32,9 @@ def main() -> None:
     import jax
 
     if os.environ.get("SBR_COMM_BENCH_PLATFORM", "cpu") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
 
     import numpy as np
 

@@ -406,7 +406,16 @@ def main(argv=None) -> int:
     if args.platform == "cpu":
         from sbr_tpu.utils.platform import pin_cpu_platform
 
-        pin_cpu_platform()
+        try:
+            pin_cpu_platform()
+        except RuntimeError:
+            # programmatic second call after a backend already initialized:
+            # proceed only if that backend is in fact CPU
+            if jax.devices()[0].platform != "cpu":
+                print("error: --platform cpu requested but a non-CPU JAX "
+                      "backend is already initialized in this process",
+                      file=sys.stderr)
+                return 1
     if not args.f32:
         jax.config.update("jax_enable_x64", True)
     # Persistent compilation cache: the run is compile-dominated (execution

@@ -3,9 +3,12 @@
 This image's axon sitecustomize force-registers the TPU plugin and OVERRIDES
 the `JAX_PLATFORMS` environment variable, so pinning a platform must go
 through `jax.config` after importing jax (verified: env alone is ignored).
-This is the single home for that workaround — used by the bench harness
-child, the figures CLI, and mirrored by tests/conftest.py (which must also
-set XLA_FLAGS before jax import, so it inlines the same call)."""
+This is the home for that workaround — used by the bench harness child, the
+figures CLI, and benchmarks/agent_comm.py. Two call sites intentionally keep
+their own variants: tests/conftest.py (must set XLA_FLAGS before importing
+jax, so it inlines the call) and __graft_entry__._ensure_devices (wraps it
+in try/except RuntimeError because the driver may call it after a backend
+already initialized, and follows with an explicit device-count check)."""
 
 from __future__ import annotations
 

@@ -49,3 +49,26 @@ def test_paper_heatmap_generates_and_resumes(tmp_path, capsys):
     # The tex document picks the paper heatmap up once it exists on disk.
     tex = (out / "replication_figures.tex").read_text()
     assert "comp_stat_cross_heatmap_AW_large.pdf" in tex
+
+
+def test_graft_entry_compiles_and_runs():
+    """The driver compile-checks entry() single-chip at round end; guard it
+    in-suite so a refactor cannot silently break the hook."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import __graft_entry__ as ge
+
+    fn, example_args = ge.entry()
+    import jax
+    import numpy as np
+
+    out = jax.jit(fn)(*example_args)
+    xi, aw_max, status = out
+    assert xi.shape == example_args[0].shape
+    st = np.asarray(status)
+    assert ((st >= 0) & (st <= 3)).all()
+    run = st == 0
+    assert run.any()
+    assert np.isfinite(np.asarray(xi)[run]).all()
