@@ -347,6 +347,8 @@ def write_tex(outdir: Path, sections: list, skip=()) -> Path:
         "model and its three extensions.",
     ]
     figdir = outdir / "figures"
+    outdir.mkdir(parents=True, exist_ok=True)  # nothing else creates it when
+    # no section ran (e.g. --sections "" smoke/paper-only invocations)
     for sec in sections:
         lines.append(rf"\section{{{titles[sec]}}}")
         # Extra figures join their section when present on disk.
@@ -406,16 +408,15 @@ def main(argv=None) -> int:
     if args.platform == "cpu":
         from sbr_tpu.utils.platform import pin_cpu_platform
 
-        try:
-            pin_cpu_platform()
-        except RuntimeError:
-            # programmatic second call after a backend already initialized:
-            # proceed only if that backend is in fact CPU
-            if jax.devices()[0].platform != "cpu":
-                print("error: --platform cpu requested but a non-CPU JAX "
-                      "backend is already initialized in this process",
-                      file=sys.stderr)
-                return 1
+        pin_cpu_platform()
+        # the pin silently no-ops if a backend is already initialized in
+        # this process (programmatic callers), so verify unconditionally —
+        # proceeding onto a non-CPU backend would defeat the flag's purpose
+        if jax.devices()[0].platform != "cpu":
+            print("error: --platform cpu requested but a non-CPU JAX "
+                  "backend is already initialized in this process",
+                  file=sys.stderr)
+            return 1
     if not args.f32:
         jax.config.update("jax_enable_x64", True)
     # Persistent compilation cache: the run is compile-dominated (execution

@@ -63,21 +63,41 @@ def _lean_cell(ls: LearningSolution, u, p, kappa, lam, eta, tspan_end, config: S
 
 
 @functools.lru_cache(maxsize=None)
-def _u_sweep_fn(config: SolverConfig):
-    """Jitted u-sweep, cached by config so repeated sweeps (and the bench
-    harness) reuse one traced program instead of retracing per call. The
-    learning solution and economics enter as traced arguments; jit dead-code-
-    eliminates the discarded per-cell curves instead of materializing
-    (n_u, n_grid) temporaries."""
+def _u_sweep_fn(config: SolverConfig, mesh=None, mesh_axis=None):
+    """Jitted u-sweep, cached by (config, mesh) so repeated sweeps (and the
+    bench harness) reuse one traced program instead of retracing per call.
+    The learning solution and economics enter as traced arguments; jit
+    dead-code-eliminates the discarded per-cell curves instead of
+    materializing (n_u, n_grid) temporaries."""
 
-    @jax.jit
     def fn(ls, u_values, p, kappa, lam, eta, tspan_end):
         def cell(u):
             return _lean_cell(ls, u, p, kappa, lam, eta, tspan_end, config)
 
         return jax.vmap(cell)(u_values)
 
-    return fn
+    if mesh is not None:
+        # u-axis block-sharded via shard_map — each device runs the plain
+        # vmapped program on its local block (independent cells; sharded
+        # gather indexing against the replicated learning solution trips
+        # XLA's sharding-in-types inference otherwise, as in policy_sweeps).
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(ls, u_values, *scalars):
+            vary = lambda x: lax.pcast(x, (mesh_axis,), to="varying")
+            ls = jax.tree_util.tree_map(vary, ls)
+            return fn(ls, u_values, *(vary(s) for s in scalars))
+
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(mesh_axis)) + (P(),) * 5,
+            out_specs=P(mesh_axis),
+        )
+        return jax.jit(sharded)
+
+    return jax.jit(fn)
 
 
 def u_sweep(
@@ -86,15 +106,27 @@ def u_sweep(
     econ,
     config: SolverConfig = SolverConfig(),
     tspan_end=None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    mesh_axis: str = "u",
 ) -> USweepResult:
     """Figure-4 u-sweep: one Stage-1 solution shared across all u
-    (`1_baseline.jl:44,169`), Stages 2-3 vmapped."""
+    (`1_baseline.jl:44,169`), Stages 2-3 vmapped.
+
+    With ``mesh``, the u axis is sharded over ``mesh_axis`` (cells are
+    independent; the shared learning solution replicates). The mesh axis
+    size must divide len(u_values)."""
     if tspan_end is None:
         tspan_end = ls.grid[-1]
     dtype = ls.cdf.dtype
     u_values = jnp.asarray(u_values, dtype=dtype)
+    if mesh is not None:
+        from sbr_tpu.parallel import shard_axis_values
 
-    xi, tau_in, aw_max, status = _u_sweep_fn(config)(
+        (u_values,) = shard_axis_values(mesh, (mesh_axis,), u_values)
+
+    xi, tau_in, aw_max, status = _u_sweep_fn(
+        config, mesh, mesh_axis if mesh is not None else None
+    )(
         ls,
         u_values,
         jnp.asarray(econ.p, dtype),

@@ -127,3 +127,30 @@ def test_beta_u_grid_on_mesh_matches_single_device():
         np.asarray(plain.xi), np.asarray(sharded.xi), atol=1e-12, equal_nan=True
     )
     np.testing.assert_array_equal(np.asarray(plain.status), np.asarray(sharded.status))
+
+
+def test_u_sweep_sharded_matches_unsharded():
+    """u-axis mesh-sharded Figure-4 sweep equals the single-device program
+    exactly (one replicated Stage-1 solution, independent cells)."""
+    import jax
+
+    from sbr_tpu import make_model_params, solve_learning
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.sweeps import u_sweep
+
+    cfg = SolverConfig(n_grid=512, bisect_iters=60)
+    m = make_model_params()
+    ls = solve_learning(m.learning, cfg)
+    us = np.linspace(0.001, 0.9, 64)
+    mesh = jax.make_mesh((8,), ("u",))
+    sharded = u_sweep(ls, us, m.economic, cfg, mesh=mesh)
+    single = u_sweep(ls, us, m.economic, cfg)
+    np.testing.assert_array_equal(np.asarray(sharded.status), np.asarray(single.status))
+    np.testing.assert_allclose(
+        np.asarray(sharded.collapse_times), np.asarray(single.collapse_times),
+        atol=1e-12, equal_nan=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.max_withdrawals), np.asarray(single.max_withdrawals),
+        atol=1e-12, equal_nan=True,
+    )
