@@ -203,6 +203,21 @@ def _agent_uniforms(key, step_k, ids, dtype):
     return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
 
 
+def _auto_engine(outdeg_src, max_degree: int, n_steps: int) -> str:
+    """Single-device engine choice for engine="auto".
+
+    The incremental engine falls back to the full recount on any step in
+    which an agent with out-degree > max_degree changes withdrawal status.
+    Each such "hub" changes status at most twice per run, so with H hubs the
+    expected fallback steps are ≈ min(n_steps, 2H): a handful of hubs (ER
+    tail) costs a few fallback steps, but a scale-free tail (H ~ %N) makes
+    EVERY step fall back — paying the event machinery on top of the recount.
+    Pick incremental only when hub-triggered fallbacks stay a bounded
+    fraction of the run."""
+    hubs = int((np.asarray(outdeg_src) > max_degree).sum())
+    return "incremental" if hubs <= max(8, n_steps // 4) else "gather"
+
+
 def _seg_counts(active_src, row_ptr):
     """Per-destination neighbor counts from a dst-sorted edge activity mask.
 
@@ -614,10 +629,12 @@ def simulate_agents(
         shape (8.1 s vs 21.1 s on v5e, benchmarks/RESULTS.md) and
         BIT-IDENTICAL in results (fallback to the full recount on budget
         overflow keeps exactness); "gather" recounts all edges every step;
-        "auto" (default) picks incremental single-device, gather sharded
-        (the sharded incremental variant exists — `_sharded_incremental_sim`,
-        deltas resolved by one psum_scatter — but its source-block edge
-        shards pad badly under scale-free skew, so it stays opt-in).
+        "auto" (default) picks gather when sharded (the sharded incremental
+        variant exists — `_sharded_incremental_sim` — but its source-block
+        edge shards pad badly under scale-free skew, so it stays opt-in)
+        and otherwise chooses by out-degree tail (`_auto_engine`): a
+        scale-free tail of hubs above ``incremental_max_degree`` would force
+        the fallback on nearly every step, so such graphs keep "gather".
       incremental_budget: max changed agents handled incrementally per step
         (single-device default n//64 clamped to [4096, 65536]; with a mesh
         the budget — including an explicit value — is PER DEVICE BLOCK,
@@ -645,10 +662,14 @@ def simulate_agents(
     if engine not in ("auto", "gather", "incremental"):
         raise ValueError(f"Unknown engine {engine!r}")
     if engine == "auto":
-        # sharded default stays "gather": its count-balanced edge shards are
-        # robust to scale-free skew, while the incremental engine's
-        # source-block out-edge shards are not (see _sharded_incremental_sim)
-        engine = "gather" if mesh is not None else "incremental"
+        if mesh is not None:
+            # sharded default stays "gather": its count-balanced edge shards
+            # are robust to scale-free skew, while the incremental engine's
+            # source-block out-edge shards are not (_sharded_incremental_sim)
+            engine = "gather"
+        else:
+            outdeg_src = np.bincount(src_h, minlength=n) if len(src_h) else np.zeros(n, int)
+            engine = _auto_engine(outdeg_src, incremental_max_degree, config.n_steps)
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an
         # empty edge array; the gather kernel handles E = 0 fine

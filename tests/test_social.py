@@ -462,3 +462,36 @@ class TestClosureSharded:
         c8 = close_loop(n_agents=8000, avg_degree=15.0, dt=0.1, t_max=12.0, mesh=mesh)
         assert c8.err_aw_rms == pytest.approx(c1.err_aw_rms, abs=1e-6)
         assert c8.err_g_rms == pytest.approx(c1.err_g_rms, abs=1e-6)
+
+
+class TestAutoEngine:
+    def test_heuristic_prefers_incremental_for_light_tails(self):
+        from sbr_tpu.social.agents import _auto_engine
+
+        outdeg = np.full(10000, 10)
+        assert _auto_engine(outdeg, 64, 200) == "incremental"
+        # a couple of ER-tail hubs are fine (each costs ≤ 2 fallback steps)
+        outdeg[:5] = 200
+        assert _auto_engine(outdeg, 64, 200) == "incremental"
+
+    def test_heuristic_prefers_gather_for_scale_free_tails(self):
+        from sbr_tpu.social.agents import _auto_engine
+
+        rng = np.random.default_rng(0)
+        n = 100_000
+        w = (np.arange(1, n + 1)) ** (-1.0 / 1.5)
+        src = rng.choice(n, size=10 * n, p=w / w.sum())
+        outdeg = np.bincount(src, minlength=n)
+        assert (outdeg > 64).sum() > 200  # heavy tail really present
+        assert _auto_engine(outdeg, 64, 200) == "gather"
+
+    def test_auto_matches_explicit_engines(self):
+        """Whatever auto picks, results equal both explicit engines."""
+        n = 3000
+        src, dst = erdos_renyi_edges(n, 10.0, seed=41)
+        cfg = AgentSimConfig(n_steps=60, dt=0.1, exit_delay=0.0, reentry_delay=2.0)
+        auto = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=1)
+        for eng in ("gather", "incremental"):
+            r = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=1, engine=eng)
+            np.testing.assert_array_equal(np.asarray(auto.informed), np.asarray(r.informed))
+            np.testing.assert_array_equal(np.asarray(auto.t_inf), np.asarray(r.t_inf))
