@@ -212,10 +212,10 @@ def _auto_engine(outdeg_src, max_degree: int, n_steps: int) -> str:
     expected fallback steps are ≈ min(n_steps, 2H): a handful of hubs (ER
     tail) costs a few fallback steps, but a scale-free tail (H ~ %N) makes
     EVERY step fall back — paying the event machinery on top of the recount.
-    Pick incremental only when hub-triggered fallbacks stay a bounded
-    fraction of the run."""
+    Pick incremental only when hub-triggered fallbacks (≈ 2H steps) stay
+    under a quarter of the run."""
     hubs = int((np.asarray(outdeg_src) > max_degree).sum())
-    return "incremental" if hubs <= max(8, n_steps // 4) else "gather"
+    return "incremental" if 2 * hubs <= max(2, n_steps // 4) else "gather"
 
 
 def _seg_counts(active_src, row_ptr):
@@ -661,15 +661,20 @@ def simulate_agents(
 
     if engine not in ("auto", "gather", "incremental"):
         raise ValueError(f"Unknown engine {engine!r}")
+    out_struct = None  # (dst2, src_sorted, outdeg, out_ptr), computed once
     if engine == "auto":
-        if mesh is not None:
+        if mesh is not None or len(src_h) == 0:
             # sharded default stays "gather": its count-balanced edge shards
             # are robust to scale-free skew, while the incremental engine's
             # source-block out-edge shards are not (_sharded_incremental_sim)
             engine = "gather"
         else:
-            outdeg_src = np.bincount(src_h, minlength=n) if len(src_h) else np.zeros(n, int)
-            engine = _auto_engine(outdeg_src, incremental_max_degree, config.n_steps)
+            from sbr_tpu.native import sort_edges_by_dst
+
+            # the out-edge structure doubles as the degree census for the
+            # engine choice and as the incremental kernel's input
+            out_struct = sort_edges_by_dst(dst_h, src_h, n)
+            engine = _auto_engine(out_struct[2], incremental_max_degree, config.n_steps)
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an
         # empty edge array; the gather kernel handles E = 0 fine
@@ -681,7 +686,9 @@ def simulate_agents(
 
             # out-edge structure: the same edge multiset re-sorted by SOURCE
             # (dst2[e] = destination of the e-th src-sorted edge).
-            dst2_h, _, outdeg_h, out_ptr_h = sort_edges_by_dst(dst_h, src_h, n)
+            if out_struct is None:
+                out_struct = sort_edges_by_dst(dst_h, src_h, n)
+            dst2_h, _, outdeg_h, out_ptr_h = out_struct
             budget = incremental_budget
             if budget is None:
                 budget = min(max(4096, n // 64), 65536)

@@ -280,3 +280,14 @@ class TestRealTwoProcessFarm:
             np.asarray(full.xi), np.asarray(direct.xi), atol=1e-12, equal_nan=True
         )
         np.testing.assert_array_equal(np.asarray(full.status), np.asarray(direct.status))
+
+
+def test_profiler_trace_writes_capture(tmp_path):
+    """`utils.timing.trace` (the bench harness's profiler hook) captures an
+    XLA trace into the given directory."""
+    from sbr_tpu.utils.timing import trace
+
+    with trace(str(tmp_path)):
+        fence(jnp.arange(128.0) * 2.0)
+    files = [p for p in tmp_path.rglob("*") if p.is_file()]
+    assert files, "profiler trace produced no files"
