@@ -36,8 +36,6 @@ def main() -> None:
 
         pin_cpu_platform()
 
-    import numpy as np
-
     from sbr_tpu.social import AgentSimConfig, erdos_renyi_edges, simulate_agents
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000

@@ -13,7 +13,6 @@ Replaces the reference's sequential scans and tolerance-triggered loops:
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -8,7 +8,6 @@ Reference workload parameters come from `scripts/1_baseline.jl:34-44,106,118`.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline, with_overrides
 from sbr_tpu.baseline.solver import solve_equilibrium_core

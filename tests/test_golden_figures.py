@@ -21,7 +21,6 @@ shifts both the implementation and these pins in tandem would pass here
 and must be caught by the oracle tests instead.
 """
 
-import numpy as np
 import pytest
 
 from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline, with_overrides

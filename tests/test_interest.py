@@ -11,8 +11,8 @@ import numpy as np
 import pytest
 
 from sbr_tpu.baseline.learning import solve_learning
-from sbr_tpu.baseline.solver import _hazard_parts, solve_equilibrium_baseline
-from sbr_tpu.interest import solve_equilibrium_interest, solve_value_function
+from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+from sbr_tpu.interest import solve_equilibrium_interest
 from sbr_tpu.models.params import SolverConfig, make_interest_params
 
 from oracle import solve_interest_oracle
