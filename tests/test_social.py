@@ -495,3 +495,23 @@ class TestAutoEngine:
             r = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=1, engine=eng)
             np.testing.assert_array_equal(np.asarray(auto.informed), np.asarray(r.informed))
             np.testing.assert_array_equal(np.asarray(auto.t_inf), np.asarray(r.t_inf))
+
+
+def test_plot_agent_closure_builds_figure():
+    """The closure figure builder renders from a LoopComparison (unit-level;
+    the CLI path is exercised by master --fast section 4)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from sbr_tpu.figures.plotting import plot_agent_closure
+    from sbr_tpu.social import close_loop
+
+    comp = close_loop(
+        n_agents=2000, avg_degree=10.0, dt=0.2, t_max=12.0,
+        config=SolverConfig(n_grid=1024), max_iter=300,
+    )
+    fig = plot_agent_closure(comp)
+    assert len(fig.axes) >= 2
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
