@@ -55,13 +55,42 @@ MANIFEST = {
 }
 
 
+# Live PdfPages collector for the combined figure document (the reference
+# ships a LaTeX-compiled output/replication_figures.pdf; this image has no
+# TeX toolchain, so the document is composed directly from the live figures
+# at generation time). Set per-run by main(); None disables collection.
+# _PDF_PENDING_HEADER holds a section header emitted lazily before the
+# section's FIRST figure, so all-skipped sections leave no empty header.
+_PDF_DOC = None
+_PDF_PENDING_HEADER = None
+
+
 def _save(fig, path: Path) -> None:
+    global _PDF_PENDING_HEADER
     path.parent.mkdir(parents=True, exist_ok=True)
     fig.savefig(path, bbox_inches="tight")
+    if _PDF_DOC is not None:
+        if _PDF_PENDING_HEADER is not None:
+            _pdf_text_page(_PDF_DOC, [_PDF_PENDING_HEADER], size=18)
+            _PDF_PENDING_HEADER = None
+        _PDF_DOC.savefig(fig, bbox_inches="tight")
     import matplotlib.pyplot as plt
 
     plt.close(fig)
     print(f"  ✓ saved {path}")
+
+
+def _pdf_text_page(doc, lines, size=20) -> None:
+    """A text-only page (title / section header) in the combined document."""
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=(8.27, 11.69))  # A4 portrait
+    fig.text(
+        0.5, 0.6, "\n\n".join(lines), ha="center", va="center",
+        fontsize=size, wrap=True,
+    )
+    doc.savefig(fig)
+    plt.close(fig)
 
 
 def run_baseline(figdir: Path, fast: bool) -> None:
@@ -433,22 +462,61 @@ def main(argv=None) -> int:
 
     t_start = time.time()
     skipped = set()
-    for sec in sections:
-        print("=" * 70)
-        print(f"SECTION {sec}/4: {names[sec]}")
-        print("=" * 70)
-        t0 = time.time()
-        skipped |= runners[sec](figdir, args.fast) or set()
-        print(f"  section time: {time.time() - t0:.1f}s")
+    # Combined figure document, composed from the live figures as they are
+    # generated (the reference's output/replication_figures.pdf is the same
+    # document compiled via LaTeX, unavailable in this image). Partial
+    # --sections runs produce a document covering only what they ran; the
+    # .tex document remains the everything-on-disk view.
+    global _PDF_DOC, _PDF_PENDING_HEADER
+    doc_path = outdir / "replication_figures.pdf"
+    doc_tmp = outdir / "replication_figures.pdf.tmp"
+    doc = None
+    if sections or args.paper:
+        from matplotlib.backends.backend_pdf import PdfPages
 
-    if args.paper:
-        print("=" * 70)
-        print("PAPER-RESOLUTION HEATMAP (tiled, resumable)")
-        print("=" * 70)
-        t0 = time.time()
-        ckpt = Path(args.checkpoint_dir) if args.checkpoint_dir else outdir / "checkpoints/heatmap_large"
-        run_paper_heatmap(figdir, ckpt, args.paper_res, args.paper_tile)
-        print(f"  paper heatmap time: {time.time() - t0:.1f}s")
+        outdir.mkdir(parents=True, exist_ok=True)
+        # write to a temp path and rename on clean completion, so a crash
+        # or partial run never destroys a previously complete document
+        doc = PdfPages(doc_tmp)
+        _pdf_text_page(
+            doc,
+            ["Replication Figures", "The Social Determinants of Bank Runs",
+             "(sbr_tpu TPU-native framework)"],
+            size=22,
+        )
+        _PDF_DOC = doc
+    ok_run = False
+    try:
+        for sec in sections:
+            print("=" * 70)
+            print(f"SECTION {sec}/4: {names[sec]}")
+            print("=" * 70)
+            _PDF_PENDING_HEADER = names[sec]
+            t0 = time.time()
+            skipped |= runners[sec](figdir, args.fast) or set()
+            print(f"  section time: {time.time() - t0:.1f}s")
+
+        if args.paper:
+            print("=" * 70)
+            print("PAPER-RESOLUTION HEATMAP (tiled, resumable)")
+            print("=" * 70)
+            _PDF_PENDING_HEADER = "Paper-resolution heatmap"
+            t0 = time.time()
+            ckpt = Path(args.checkpoint_dir) if args.checkpoint_dir else outdir / "checkpoints/heatmap_large"
+            run_paper_heatmap(figdir, ckpt, args.paper_res, args.paper_tile)
+            print(f"  paper heatmap time: {time.time() - t0:.1f}s")
+        ok_run = True
+    finally:
+        if doc is not None:
+            _PDF_DOC = None
+            _PDF_PENDING_HEADER = None
+            doc.close()
+            if ok_run:
+                import os as _os
+
+                _os.replace(doc_tmp, doc_path)
+            else:
+                doc_tmp.unlink(missing_ok=True)
 
     # The tex document reflects everything present on disk (not just the
     # sections run now), so partial --sections runs extend rather than
@@ -481,6 +549,8 @@ def main(argv=None) -> int:
         print(f"  {'✓' if ok else '✗'} {figdir / fig}")
         if not ok:
             missing.append(fig)
+    if doc is not None and doc_path.exists():
+        print(f"  ✓ {doc_path} (combined figure document)")
     print(f"  ✓ {tex_path}")
     return 1 if missing else 0
 
