@@ -471,12 +471,19 @@ def main(argv=None) -> int:
     doc_path = outdir / "replication_figures.pdf"
     doc_tmp = outdir / "replication_figures.pdf.tmp"
     doc = None
-    if sections or args.paper:
+    # The combined document is only assembled on FULL-replication runs:
+    # PdfPages cannot extend an existing file, so a partial --sections run
+    # would otherwise replace a complete document with just its own slice
+    # (the .tex document, which CAN reflect everything on disk, remains the
+    # partial-run view). Figures render twice on full runs (disk + doc page)
+    # — acceptable since all figures are small (the 5000x5000 heatmap is
+    # rasterized, ~32 KB).
+    if set(sections) == set(MANIFEST):
         from matplotlib.backends.backend_pdf import PdfPages
 
         outdir.mkdir(parents=True, exist_ok=True)
         # write to a temp path and rename on clean completion, so a crash
-        # or partial run never destroys a previously complete document
+        # never destroys a previously complete document
         doc = PdfPages(doc_tmp)
         _pdf_text_page(
             doc,

@@ -1,0 +1,108 @@
+"""Unit-render tests for every figure builder (figures/plotting.py).
+
+The master CLI path is covered by tests/test_master_cli.py; these lock each
+builder individually — a signature or field rename fails here in seconds
+instead of mid-replication. Each test only asserts the figure builds and has
+axes; visual parity with the reference is the replication document's job.
+"""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from sbr_tpu import make_model_params, solve_learning, solve_equilibrium_baseline
+from sbr_tpu.models.params import SolverConfig, make_hetero_params, make_interest_params
+
+CFG = SolverConfig(n_grid=512, bisect_iters=60)
+
+
+@pytest.fixture(scope="module")
+def baseline_solved():
+    m = make_model_params()
+    ls = solve_learning(m.learning, CFG)
+    res = solve_equilibrium_baseline(ls, m.economic, CFG)
+    return m, ls, res
+
+
+def _check(fig):
+    assert fig.axes, "figure has no axes"
+    plt.close(fig)
+
+
+def test_plot_learning_distribution(baseline_solved):
+    from sbr_tpu.figures.plotting import plot_learning_distribution
+
+    m, ls, _ = baseline_solved
+    _check(plot_learning_distribution([ls], m.learning.tspan, [m.learning.beta]))
+
+
+def test_plot_hazard_rate_decomposition(baseline_solved):
+    from sbr_tpu.figures.plotting import plot_hazard_rate_decomposition
+
+    m, ls, res = baseline_solved
+    _check(plot_hazard_rate_decomposition(res, ls, m.economic))
+
+
+def test_plot_equilibrium(baseline_solved):
+    from sbr_tpu.figures.plotting import plot_equilibrium
+
+    m, ls, res = baseline_solved
+    assert bool(res.bankrun)
+    _check(plot_equilibrium(res, ls, m.economic))
+
+
+def test_plot_comp_stat_panels(baseline_solved):
+    from sbr_tpu.figures.plotting import plot_comp_stat_withdrawals_and_collapse
+    from sbr_tpu.sweeps import u_sweep
+
+    m, ls, _ = baseline_solved
+    sw = u_sweep(ls, np.linspace(0.01, 1.5, 64), m.economic, CFG)
+    fig_a, fig_b = plot_comp_stat_withdrawals_and_collapse(
+        np.asarray(sw.u_values),
+        np.asarray(sw.max_withdrawals),
+        np.asarray(sw.collapse_times),
+        m.economic.kappa,
+        return_times=np.asarray(sw.return_times),
+    )
+    _check(fig_a)
+    _check(fig_b)
+
+
+def test_plot_heatmap_aw(baseline_solved):
+    from sbr_tpu.figures.plotting import plot_heatmap_aw
+    from sbr_tpu.sweeps import beta_u_grid
+
+    m, _, _ = baseline_solved
+    amt = np.linspace(0.05, 1.0, 8)
+    us = np.linspace(0.01, 1.0, 8)
+    grid = beta_u_grid(1.0 / amt, us, m, config=CFG)
+    _check(plot_heatmap_aw(amt, us, np.asarray(grid.max_aw).T))
+
+
+def test_plot_aw_hetero():
+    from sbr_tpu.figures.plotting import plot_aw_hetero
+    from sbr_tpu.hetero import get_aw_hetero, solve_equilibrium_hetero, solve_learning_hetero
+
+    m = make_hetero_params(
+        betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=0.1, p=0.9, kappa=0.3, lam=0.1
+    )
+    lsh = solve_learning_hetero(m.learning, CFG)
+    res = solve_equilibrium_hetero(lsh, m.economic, CFG)
+    assert bool(res.bankrun)
+    aw = get_aw_hetero(res, lsh)
+    _check(plot_aw_hetero(res, aw, m.economic, m.learning.betas))
+
+
+def test_plot_value_function():
+    from sbr_tpu.baseline.learning import solve_learning as solve_l
+    from sbr_tpu.figures.plotting import plot_value_function
+    from sbr_tpu.interest import solve_equilibrium_interest
+
+    m = make_interest_params(u=0.0, r=0.06, delta=0.1)
+    ls = solve_l(m.learning, CFG)
+    res = solve_equilibrium_interest(ls, m.economic, CFG)
+    _check(plot_value_function(res, m.economic))
