@@ -1,0 +1,118 @@
+"""Per-op ablation of the agent-sim step (the evidence behind RESULTS.md's
+"Agent-sim engines" section and the event-driven engine's design).
+
+Three step variants isolate where the time goes at the north-star shape
+(10^6 agents, 10^7 ER edges):
+
+- full:     the real gather-engine step (neighbor gather + counts + RNG)
+- norng:    gather + counts, RNG replaced by a frac-dependent constant
+- nogather: RNG + elementwise physics, neighbor counts replaced by a
+            wd-dependent constant
+
+plus microbenchmarks of the primitive ops (random gather, cumsum,
+row-pointer gathers, scatter-add, compaction). Measured 2026-07-30 on
+1x v5e: full 94.6 ms/step ≈ norng (RNG is free), nogather 1.5 ms/step —
+the wd[src] random gather is the wall (~78 ms, ~1.3e8 elements/s).
+
+Usage: python benchmarks/ablate_agent_step.py  (SBR_BENCH_PLATFORM=cpu to pin)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    if os.environ.get("SBR_BENCH_PLATFORM", "").strip().lower() == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from sbr_tpu.social import erdos_renyi_edges
+    from sbr_tpu.social.agents import _agent_uniforms, _prep_inputs, _seg_counts
+
+    n, nsteps = 1_000_000, 50
+    src, dst = erdos_renyi_edges(n, 10.0, seed=0)
+    betas, src_s, _, indeg, row_ptr, informed0 = _prep_inputs(
+        n, 1.0, 1e-4, src, dst, 0, np.float32
+    )
+    key = jax.random.PRNGKey(0)
+    dt = 0.05
+    print(f"platform: {jax.devices()[0].platform}; {len(src_s)} edges", file=sys.stderr)
+
+    def make(variant):
+        @jax.jit
+        def run(betas, src, row_ptr, indeg, informed0, key):
+            t_inf0 = jnp.where(informed0, 0.0, jnp.inf).astype(jnp.float32)
+            safe = jnp.maximum(indeg, 1.0)
+            ids = jnp.arange(n, dtype=jnp.uint32)
+
+            def step(carry, k):
+                informed, t_inf = carry
+                t = k.astype(jnp.float32) * dt
+                wd = informed & (t >= t_inf)
+                if variant in ("full", "norng"):
+                    frac = _seg_counts(wd[src], row_ptr).astype(jnp.float32) / safe
+                else:
+                    frac = jnp.full((n,), 0.3, jnp.float32) * wd.mean()
+                p_inf = 1.0 - jnp.exp(-betas * frac * dt)
+                if variant in ("full", "nogather"):
+                    draws = _agent_uniforms(key, k, ids, jnp.float32)
+                else:  # keep a data dependency without the RNG
+                    draws = jnp.full((n,), 0.5, jnp.float32) * frac
+                newly = (~informed) & (draws < p_inf)
+                return (informed | newly, jnp.where(newly, t + dt, t_inf)), wd.mean()
+
+            (_, _), aw = lax.scan(step, (informed0, t_inf0), jnp.arange(nsteps))
+            return aw
+
+        return run
+
+    args = (
+        jnp.asarray(betas), jnp.asarray(src_s), jnp.asarray(row_ptr),
+        jnp.asarray(indeg), jnp.asarray(informed0), key,
+    )
+    for variant in ("full", "norng", "nogather"):
+        f = make(variant)
+        float(f(*args)[-1])  # compile
+        t0 = time.perf_counter()
+        float(f(*args)[-1])
+        el = time.perf_counter() - t0
+        print(f"{variant:9s}: {el:.3f}s / {nsteps} steps = {el / nsteps * 1e3:6.1f} ms/step")
+
+    # primitive microbenchmarks
+    e = len(src_s)
+    wd = jnp.asarray(np.random.default_rng(0).random(n) < 0.3)
+    src_d = jnp.asarray(src_s)
+    rp = jnp.asarray(row_ptr)
+    reps = 30
+
+    def bench(name, f, *a):
+        g = jax.jit(f)
+        float(jnp.sum(g(*a)))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = g(*a)
+        float(jnp.sum(r))
+        print(f"{name:30s}: {(time.perf_counter() - t0) / reps * 1e3:6.2f} ms")
+
+    bench("gather wd[src] (1e7)", lambda w, s: w[s].astype(jnp.int32), wd, src_d)
+    bench("cumsum 1e7 int32", jnp.cumsum, jnp.ones(e, jnp.int32))
+    bench("prefix gathers at row_ptr", lambda p, r: p[r[1:]] - p[r[:-1]], jnp.ones(e + 1, jnp.int32), rp)
+    idx = jnp.asarray(np.random.default_rng(1).integers(0, n, 100_000, np.int32))
+    bench("scatter-add 1e5 into 1e6", lambda c, i: c.at[i].add(1), jnp.zeros(n, jnp.int32), idx)
+    mask = jnp.asarray(np.random.default_rng(2).random(n) < 0.01)
+    bench("nonzero(size=16384) over 1e6", lambda m: jnp.nonzero(m, size=16384, fill_value=n)[0], mask)
+
+
+if __name__ == "__main__":
+    main()

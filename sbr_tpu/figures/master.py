@@ -464,9 +464,7 @@ def main(argv=None) -> int:
     skipped = set()
     # Combined figure document, composed from the live figures as they are
     # generated (the reference's output/replication_figures.pdf is the same
-    # document compiled via LaTeX, unavailable in this image). Partial
-    # --sections runs produce a document covering only what they ran; the
-    # .tex document remains the everything-on-disk view.
+    # document compiled via LaTeX, unavailable in this image).
     global _PDF_DOC, _PDF_PENDING_HEADER
     doc_path = outdir / "replication_figures.pdf"
     doc_tmp = outdir / "replication_figures.pdf.tmp"

@@ -98,11 +98,10 @@ def test_plot_aw_hetero():
 
 
 def test_plot_value_function():
-    from sbr_tpu.baseline.learning import solve_learning as solve_l
     from sbr_tpu.figures.plotting import plot_value_function
     from sbr_tpu.interest import solve_equilibrium_interest
 
     m = make_interest_params(u=0.0, r=0.06, delta=0.1)
-    ls = solve_l(m.learning, CFG)
+    ls = solve_learning(m.learning, CFG)
     res = solve_equilibrium_interest(ls, m.economic, CFG)
     _check(plot_value_function(res, m.economic))
