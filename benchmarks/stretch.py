@@ -43,6 +43,11 @@ def stretch_agents(n: int = 1_000_000, n_steps: int = 200) -> dict:
 
     from sbr_tpu.social import AgentSimConfig, scale_free_edges, simulate_agents
 
+    import bench
+
+    if bench._tiny():  # SBR_BENCH_SIZES=tiny: harness smoke-test scale
+        n, n_steps = 2_000, 20
+
     rng = np.random.default_rng(0)
     # lognormal β_i: median 1, σ=0.5 → heavy right tail of fast learners,
     # the continuous analogue of the reference's two-group βs=[0.125, 12.5]
@@ -87,6 +92,11 @@ def stretch_policy(n_beta: int = 10, n_u: int = 10, n_r: int = 10) -> dict:
 
     from sbr_tpu.models.params import make_interest_params
     from sbr_tpu.sweeps import policy_sweep_interest
+
+    import bench
+
+    if bench._tiny():
+        n_beta, n_u, n_r = 4, 4, 3
 
     base = make_interest_params(u=0.0, delta=0.1)
     betas = np.linspace(0.5, 3.0, n_beta)

@@ -48,3 +48,15 @@ def test_bench_emits_contract_json():
     # the self-documenting history: forced platform + one ok measure phase
     phases = [h for h in extra["probe_history"] if h.get("phase") == "measure"]
     assert phases and phases[-1]["outcome"] == "ok"
+
+
+def test_stretch_emits_contract_json():
+    d = _run("benchmarks/stretch.py")
+    assert d["metric"] == "stretch_hetero_agents_steps_per_sec"
+    assert d["unit"] == "agent-steps/sec"
+    assert d["value"] > 0
+    extra = d["extra"]
+    assert extra["platform"] == "cpu"
+    assert extra["policy"]["policy_eq_per_sec"] > 0
+    phases = [h for h in extra["probe_history"] if h.get("phase") == "measure"]
+    assert phases and phases[-1]["outcome"] == "ok"
