@@ -31,10 +31,24 @@ touches an accelerator and a CHILD that does all device work:
 - the full probe/measure history (attempts, durations, outcomes) lands in
   the JSON `extra.probe_history`, so a CPU fallback is self-documenting.
 
+Round-4 additions (VERDICT r3 task 1 + ADVICE r3):
+
+- every accelerator-platform measurement is opportunistically PERSISTED as a
+  timestamped driver-format JSON under `benchmarks/` (atomic tmp+rename), and
+  every harness run appends one line to `benchmarks/CAPTURE_LOG.jsonl` — the
+  evidence chain no longer depends on a human committing artifacts by hand;
+- `python bench.py --watch N [interval_s]` probes every ~interval (default
+  600 s) up to N times and runs+persists the full measurement on the first
+  TPU success — an opportunistic capture daemon for the flaky tunnel;
+- the whole probe→measure→CPU-retry envelope is capped by
+  SBR_BENCH_BUDGET_S (default 3300 s): each phase's timeout shrinks to the
+  remaining budget, so the worst case is ~55 min, not the former ~107 min.
+
 Env overrides: SBR_BENCH_PLATFORM=cpu|tpu skips the probe;
 SBR_BENCH_PROBE_ATTEMPTS / SBR_BENCH_PROBE_TIMEOUT_S /
-SBR_BENCH_MEASURE_TIMEOUT_S tune budgets; SBR_BENCH_SIZES=tiny shrinks
-every workload to smoke-test scale (used by tests/test_bench_harness.py).
+SBR_BENCH_MEASURE_TIMEOUT_S / SBR_BENCH_BUDGET_S tune budgets;
+SBR_BENCH_SIZES=tiny shrinks every workload to smoke-test scale (used by
+tests/test_bench_harness.py).
 """
 
 from __future__ import annotations
@@ -98,24 +112,48 @@ def _probe_accelerator(timeout_s: float) -> tuple:
         return "", "timeout", dur
 
 
-def _probe_loop() -> tuple:
+class _Budget:
+    """Wall-clock envelope for one harness run (ADVICE r3 #3: the former
+    worst case of 3x300s probes + backoffs + 2x2700s measures was ~107 min,
+    longer than a plausible driver round-end budget — so the bench could
+    burn the whole window and still emit nothing). Every phase timeout is
+    clamped to what remains of SBR_BENCH_BUDGET_S."""
+
+    def __init__(self):
+        self.total_s = float(os.environ.get("SBR_BENCH_BUDGET_S", "3300"))
+        self.t0 = time.perf_counter()
+
+    def remaining(self) -> float:
+        return self.total_s - (time.perf_counter() - self.t0)
+
+    def clamp(self, want_s: float, floor_s: float = 30.0) -> float:
+        """Phase timeout: at most ``want_s``, at most the remaining budget,
+        never below ``floor_s`` (a 5 s timeout would kill healthy children)."""
+        return max(floor_s, min(want_s, self.remaining()))
+
+
+def _probe_loop(budget: "_Budget" = None) -> tuple:
     """Probe with retry/backoff; returns (platform, history list)."""
     attempts = int(os.environ.get("SBR_BENCH_PROBE_ATTEMPTS", "3"))
     timeout_s = float(os.environ.get("SBR_BENCH_PROBE_TIMEOUT_S", "300"))
     history = []
     platform = ""
     for attempt in range(1, attempts + 1):
-        platform, outcome, dur = _probe_accelerator(timeout_s)
+        eff_timeout = budget.clamp(timeout_s) if budget else timeout_s
+        platform, outcome, dur = _probe_accelerator(eff_timeout)
         history.append(
             {
                 "attempt": attempt,
-                "timeout_s": timeout_s,
+                "timeout_s": eff_timeout,
                 "duration_s": round(dur, 1),
                 "outcome": outcome,
                 "platform": platform or None,
             }
         )
         if platform:
+            break
+        if budget is not None and budget.remaining() < 60.0:
+            _log("probe budget exhausted — skipping remaining attempts")
             break
         if attempt < attempts:
             backoff = 10.0 * (2 ** (attempt - 1))
@@ -160,20 +198,65 @@ def _run_measurement(platform: str, timeout_s: float, script: str = None) -> tup
         return None, "timeout", dur
 
 
+def _benchmarks_dir() -> Path:
+    return Path(__file__).resolve().parent / "benchmarks"
+
+
+def _persist_capture(result: dict, script: str = None) -> None:
+    """Opportunistically persist any ACCELERATOR-platform measurement as a
+    timestamped driver-format JSON under benchmarks/ (VERDICT r3 weak #1:
+    driver-captured beats builder-committed, but a builder-committed artifact
+    written atomically the moment the chip answered beats losing the number
+    to a later tunnel hang). No-op for CPU results."""
+    platform = (result.get("extra") or {}).get("platform", "")
+    if platform in ("", "cpu") or _tiny():
+        return
+    try:
+        stamp = time.strftime("%Y-%m-%dT%H%M%S")
+        name = Path(script).stem if script else "BENCH"
+        name = "BENCH" if name == "bench" else name.upper()
+        dest = _benchmarks_dir() / f"{name}_{platform}_auto_{stamp}.json"
+        tmp = dest.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(result, indent=1) + "\n")
+        os.replace(tmp, dest)
+        _log(f"persisted {platform} capture -> {dest}")
+    except OSError as err:
+        _log(f"capture persist failed (non-fatal): {err!r}")
+
+
+def _log_capture_attempt(entry: dict) -> None:
+    """Append one line to benchmarks/CAPTURE_LOG.jsonl — the round's evidence
+    that automatic capture was attempted even when the tunnel never answered.
+    Tiny-size smoke runs (the test suite) are not capture attempts."""
+    if _tiny():
+        return
+    try:
+        entry = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **entry}
+        with open(_benchmarks_dir() / "CAPTURE_LOG.jsonl", "a") as fh:
+            fh.write(json.dumps(entry) + "\n")
+    except OSError as err:
+        _log(f"capture log append failed (non-fatal): {err!r}")
+
+
 def run_harness(script: str = None, fallback: dict = None) -> None:
     """Parent orchestration shared by every benchmark script: probe (unless
     SBR_BENCH_PLATFORM forces a platform), run the `--measure` child of
     ``script``, re-run pinned to CPU on failure, and print ONE JSON line
     with the probe/measure history in `extra.probe_history`. ``fallback``
-    is the result skeleton when every child fails."""
+    is the result skeleton when every child fails. The whole run is capped
+    by SBR_BENCH_BUDGET_S; accelerator results are persisted to
+    benchmarks/ and every run is logged to CAPTURE_LOG.jsonl."""
+    budget = _Budget()
     forced = os.environ.get("SBR_BENCH_PLATFORM", "").strip().lower()
     if forced:
         platform, history = forced, [{"forced": forced}]
     else:
-        platform, history = _probe_loop()
+        platform, history = _probe_loop(budget)
 
     measure_timeout = float(os.environ.get("SBR_BENCH_MEASURE_TIMEOUT_S", "2700"))
-    result, outcome, dur = _run_measurement(platform, measure_timeout, script)
+    result, outcome, dur = _run_measurement(
+        platform, budget.clamp(measure_timeout, floor_s=60.0), script
+    )
     history.append(
         {
             "phase": "measure",
@@ -184,7 +267,9 @@ def run_harness(script: str = None, fallback: dict = None) -> None:
     )
     if result is None and platform != "cpu":
         _log("accelerator measurement failed — re-running pinned to CPU")
-        result, outcome, dur = _run_measurement("cpu", measure_timeout, script)
+        result, outcome, dur = _run_measurement(
+            "cpu", budget.clamp(measure_timeout, floor_s=60.0), script
+        )
         history.append(
             {
                 "phase": "measure",
@@ -197,7 +282,67 @@ def run_harness(script: str = None, fallback: dict = None) -> None:
         result = dict(fallback or {})
         result.setdefault("extra", {})["error"] = "all measurement children failed"
     result.setdefault("extra", {})["probe_history"] = history
+    _persist_capture(result, script)
+    _log_capture_attempt(
+        {
+            "script": Path(script).name if script else "bench.py",
+            "platform": (result.get("extra") or {}).get("platform"),
+            "outcome": outcome,
+            "value": result.get("value"),
+            "history": history,
+        }
+    )
     print(json.dumps(result))
+
+
+def watch(max_attempts: int, interval_s: float) -> int:
+    """Opportunistic capture daemon (VERDICT r3 task 1): probe with a short
+    timeout every ``interval_s``; on the first accelerator hit, run the full
+    measurement child and persist it. Exits 0 on a persisted accelerator
+    capture, 1 if every probe failed. No CPU fallback — this mode exists
+    only to catch the flaky tunnel in an up-phase; the round-end driver run
+    still goes through run_harness."""
+    probe_timeout = float(os.environ.get("SBR_BENCH_WATCH_PROBE_TIMEOUT_S", "120"))
+    measure_timeout = float(os.environ.get("SBR_BENCH_MEASURE_TIMEOUT_S", "2700"))
+    for attempt in range(1, max_attempts + 1):
+        platform, outcome, dur = _probe_accelerator(probe_timeout)
+        _log(f"watch probe {attempt}/{max_attempts}: {outcome} ({dur:.1f}s)")
+        if platform and platform != "cpu":
+            result, m_outcome, m_dur = _run_measurement(platform, measure_timeout)
+            # The child re-derives its platform after backend init; a tunnel
+            # that dropped between probe and attach silently falls back to
+            # CPU in-child — that is NOT an accelerator capture, keep
+            # watching (the probe-to-attach TOCTOU from the module docstring).
+            measured = ((result or {}).get("extra") or {}).get("platform", "")
+            entry = {
+                "script": "bench.py --watch",
+                "platform": measured or platform,
+                "outcome": m_outcome,
+                "probe_attempt": attempt,
+            }
+            if result is not None and measured not in ("", "cpu"):
+                result.setdefault("extra", {})["probe_history"] = [
+                    {"watch_attempt": attempt, "outcome": outcome, "duration_s": round(dur, 1)},
+                    {"phase": "measure", "platform": measured, "outcome": m_outcome,
+                     "duration_s": round(m_dur, 1)},
+                ]
+                entry["value"] = result.get("value")
+                _persist_capture(result)
+                _log_capture_attempt(entry)
+                print(json.dumps(result))
+                return 0
+            if result is not None and measured == "cpu":
+                entry["outcome"] = "cpu-fallback-in-child"
+                _log("measure child fell back to CPU — not a capture; continuing watch")
+            _log_capture_attempt(entry)
+        else:
+            _log_capture_attempt(
+                {"script": "bench.py --watch", "platform": platform or None,
+                 "outcome": outcome, "probe_attempt": attempt}
+            )
+        if attempt < max_attempts:
+            time.sleep(interval_s)
+    return 1
 
 
 def main() -> None:
@@ -397,5 +542,9 @@ def measure(platform: str) -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         measure(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--watch":
+        n = int(sys.argv[2]) if len(sys.argv) >= 3 else 6
+        interval = float(sys.argv[3]) if len(sys.argv) >= 4 else 600.0
+        sys.exit(watch(n, interval))
     else:
         main()
