@@ -1,0 +1,170 @@
+"""Pallas experiment: can a VMEM-resident bitmask beat the wd[src] gather wall?
+
+The agent-sim ablation (`ablate_agent_step.py`) measured the per-edge
+``wd[src]`` random gather as the full-recount wall: ~78 ms of a ~95 ms step
+at 10^7 edges on 1x v5e, i.e. ~1.3e8 elements/s through the XLA gather
+unit, with the withdrawn mask living in HBM. The untried lever (VERDICT r3
+task 2): the BITPACKED mask is only N/8 bytes — 125 KB at the 10^6-agent
+north star, a fraction of the ~16 MB/core VMEM — so a Pallas kernel can
+pin it on-chip and stream dst-sorted edge src-id blocks through the VPU,
+extracting one bit per edge with no HBM round-trip per element.
+
+This script isolates exactly that unit (bit extraction per edge; the
+surrounding prefix-sum + row-pointer machinery of `_seg_counts` is ~4 ms
+and not in question) and measures four variants at the production shape:
+
+  xla_bool_gather    wd[src] on an unpacked bool mask (the production wall)
+  xla_bit_gather     packed[src>>3] gather + shift/mask (8x smaller table)
+  pallas_bit_gather  the VMEM-resident Pallas kernel, one grid step per
+                     edge block, mask block-spec'd to stay resident
+  pallas_bool_gather same kernel on the unpacked (1 byte/agent) mask —
+                     1 MB at 10^6 agents, still VMEM-resident; separates
+                     "VMEM residency" from "bit-unpacking arithmetic"
+
+Outputs are asserted IDENTICAL to the XLA reference before any timing
+(the recount semantics of `social/agents.py::_seg_counts` — an edge is
+active iff bit src_e of the mask is set).
+
+The experiment has an acceptable negative result: if Mosaic's per-element
+dynamic gather binds at the same rate as the XLA gather unit, the numbers
+land in the JSON artifact, RESULTS.md records why the gather engine is
+already at the hardware wall, and the question closes.
+
+Run: python benchmarks/ablate_pallas_recount.py [n_agents] [n_edges]
+  SBR_ABL_PLATFORM=cpu pins CPU (interpret-mode kernels, correctness only);
+  on TPU the kernels compile for real and the timings are the result.
+  SBR_ABL_JSON=path writes the artifact.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EDGE_BLOCK = 1 << 17  # 131072 edges per grid step
+
+
+def _build_pallas_gather(n_mask: int, e_pad: int, interpret: bool, packed: bool):
+    """pallas_call computing active[e] = bit src_e of the mask.
+
+    The mask (packed uint8 bits, or unpacked uint8 bools) is block-spec'd
+    with a constant index map, so it is DMA'd to VMEM once and stays
+    resident across all E/EDGE_BLOCK grid steps; each step streams one
+    src-id block in and one activity block out.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(mask_ref, src_ref, out_ref):
+        src = src_ref[:]
+        if packed:
+            byte = jnp.take(mask_ref[:], src >> 3, axis=0)
+            out_ref[:] = (
+                (byte >> (src & 7).astype(jnp.uint8)) & jnp.uint8(1)
+            ).astype(jnp.int32)
+        else:
+            out_ref[:] = jnp.take(mask_ref[:], src, axis=0).astype(jnp.int32)
+
+    grid = e_pad // EDGE_BLOCK
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((n_mask,), lambda i: (0,)),  # resident mask
+            pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,)),
+        out_shape=__import__("jax").ShapeDtypeStruct((e_pad,), jnp.int32),
+        interpret=interpret,
+    )
+
+
+def main() -> None:
+    if os.environ.get("SBR_ABL_PLATFORM", "") == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    e = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000_000
+    platform = jax.devices()[0].platform
+    interpret = platform == "cpu"
+    print(f"platform={platform} n_agents={n} n_edges={e} interpret={interpret}")
+
+    rng = np.random.default_rng(0)
+    n8 = -(-n // 8) * 8  # byte-aligned agent count
+    e_pad = -(-e // EDGE_BLOCK) * EDGE_BLOCK
+    wd = rng.random(n8) < 0.3
+    wd[n:] = False
+    src = rng.integers(0, n, size=e_pad, dtype=np.int32)
+    wd_d = jnp.asarray(wd)
+    wd_u8 = jnp.asarray(wd.astype(np.uint8))
+    packed_d = jnp.asarray(np.packbits(wd, bitorder="little"))
+    src_d = jnp.asarray(src)
+
+    @jax.jit
+    def xla_bool_gather(w, s):
+        return w[s].astype(jnp.int32)
+
+    @jax.jit
+    def xla_bit_gather(p, s):
+        return ((p[s >> 3] >> (s & 7).astype(jnp.uint8)) & jnp.uint8(1)).astype(
+            jnp.int32
+        )
+
+    pallas_bit = jax.jit(_build_pallas_gather(n8 // 8, e_pad, interpret, packed=True))
+    pallas_bool = jax.jit(_build_pallas_gather(n8, e_pad, interpret, packed=False))
+
+    ref = np.asarray(xla_bool_gather(wd_d, src_d))
+    variants = {
+        "xla_bool_gather": lambda: xla_bool_gather(wd_d, src_d),
+        "xla_bit_gather": lambda: xla_bit_gather(packed_d, src_d),
+        "pallas_bit_gather": lambda: pallas_bit(packed_d, src_d),
+        "pallas_bool_gather": lambda: pallas_bool(wd_u8, src_d),
+    }
+    results = {}
+    for name, fn in variants.items():
+        try:
+            out = np.asarray(jax.block_until_ready(fn()))  # compile + check
+        except Exception as err:  # Mosaic lowering gaps are a valid outcome
+            print(f"{name:>20}: FAILED to compile/run: {err!r}"[:300])
+            results[name] = {"error": str(err)[:200]}
+            continue
+        np.testing.assert_array_equal(out, ref, err_msg=name)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        results[name] = {"best_s": round(best, 5), "elem_per_sec": round(e_pad / best, 1)}
+        print(f"{name:>20}: {best * 1e3:8.2f} ms  ({e_pad / best / 1e6:8.1f}M elem/s)")
+
+    ok = [k for k, v in results.items() if "best_s" in v]
+    if "pallas_bit_gather" in ok and "xla_bool_gather" in ok:
+        sp = results["xla_bool_gather"]["best_s"] / results["pallas_bit_gather"]["best_s"]
+        print(f"pallas_bit speedup vs production gather: {sp:.2f}x")
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        payload = {
+            "platform": platform,
+            "interpret": interpret,
+            "n_agents": n,
+            "n_edges": e_pad,
+            "results": results,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

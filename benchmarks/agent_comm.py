@@ -51,25 +51,54 @@ def main() -> None:
     print(f"graph: {len(src)} edges in {time.perf_counter() - t0:.1f}s")
     cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
 
+    # variants: the two gather-engine collective strategies, plus the
+    # event-driven incremental engine (edge-count-sharded out-edge chunks)
+    variants = {
+        "scatter": dict(comm="scatter", engine="gather"),
+        "allgather_psum": dict(comm="allgather_psum", engine="gather"),
+        "incremental": dict(engine="incremental"),
+    }
     results = {}
-    for comm in ("scatter", "allgather_psum"):
+    for name, kw in variants.items():
         # warm (compile)
-        r = simulate_agents(1.0, src, dst, n, x0=1e-3, config=cfg, seed=0, mesh=mesh, comm=comm)
+        r = simulate_agents(1.0, src, dst, n, x0=1e-3, config=cfg, seed=0, mesh=mesh, **kw)
         float(r.informed_frac[-1])
         times = []
         for rep in range(3):
             t0 = time.perf_counter()
             r = simulate_agents(
-                1.0, src, dst, n, x0=1e-3, config=cfg, seed=rep + 1, mesh=mesh, comm=comm
+                1.0, src, dst, n, x0=1e-3, config=cfg, seed=rep + 1, mesh=mesh, **kw
             )
             float(r.informed_frac[-1])  # device→host fence
             times.append(time.perf_counter() - t0)
         best = min(times)
-        results[comm] = best
-        print(f"{comm:>16}: {best:.3f}s ({n * n_steps / best / 1e6:.1f}M agent-steps/s)")
+        results[name] = best
+        print(f"{name:>16}: {best:.3f}s ({n * n_steps / best / 1e6:.1f}M agent-steps/s)")
 
     speedup = results["allgather_psum"] / results["scatter"]
     print(f"scatter speedup vs allgather_psum: {speedup:.2f}x")
+    print(
+        f"incremental speedup vs gather/scatter: "
+        f"{results['scatter'] / results['incremental']:.2f}x"
+    )
+    out = os.environ.get("SBR_COMM_BENCH_JSON", "")
+    if out:
+        import json
+
+        payload = {
+            "platform": devs[0].platform,
+            "n_devices": len(devs),
+            "n_agents": n,
+            "avg_degree": deg,
+            "n_steps": n_steps,
+            "best_wall_s": {k: round(v, 4) for k, v in results.items()},
+            "agent_steps_per_sec": {
+                k: round(n * n_steps / v, 1) for k, v in results.items()
+            },
+        }
+        with open(out, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out}")
 
 
 if __name__ == "__main__":

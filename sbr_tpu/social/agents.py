@@ -23,9 +23,13 @@ counts, bit-identical in results (tested):
 - "incremental": event-driven — an agent's withdrawal status changes at
   most twice per run, so counts are maintained by ±1 updates over changed
   agents' out-edges, with the full recount as the overflow fallback
-  (2.6× end-to-end at the 10^6-agent shape; `_incremental_sim`).
+  (2.6× end-to-end at the 10^6-agent shape; `_incremental_sim`). Under a
+  mesh, out-edges are sharded by EDGE COUNT (src-sorted chunks of exactly
+  E/n_dev), so it is the sharded default too (`_sharded_incremental_sim`).
 
-The default ("auto") picks by sharding and out-degree tail (`_auto_engine`).
+The default ("auto") picks by expected fallback steps: the hub tail (per-
+chunk slice tail under a mesh) plus a logistic mass-change overflow
+estimate (`_auto_engine`).
 
 The withdrawal window mirrors the equilibrium strategy: from `get_AW`
 (`src/baseline/solver.jl:495-532`), an agent informed at time s is withdrawn
@@ -214,19 +218,58 @@ def _agent_uniforms(key, step_k, ids, dtype):
     return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
 
 
-def _auto_engine(outdeg_src, max_degree: int, n_steps: int) -> str:
-    """Single-device engine choice for engine="auto".
+def _auto_engine(
+    edge_slices,
+    max_degree: int,
+    n_steps: int,
+    n: int,
+    beta_mean: float,
+    dt: float,
+    budget: int,
+) -> str:
+    """Engine choice for engine="auto" (single-device and sharded).
 
     The incremental engine falls back to the full recount on any step in
-    which an agent with out-degree > max_degree changes withdrawal status.
-    Each such "hub" changes status at most twice per run, so with H hubs the
-    expected fallback steps are ≈ min(n_steps, 2H): a handful of hubs (ER
-    tail) costs a few fallback steps, but a scale-free tail (H ~ %N) makes
-    EVERY step fall back — paying the event machinery on top of the recount.
-    Pick incremental only when hub-triggered fallbacks (≈ 2H steps) stay
-    under a quarter of the run."""
-    hubs = int((np.asarray(outdeg_src) > max_degree).sum())
-    return "incremental" if 2 * hubs <= max(2, n_steps // 4) else "gather"
+    which (a) a changed agent's edge slice exceeds ``max_degree``, or (b)
+    the number of changed agents exceeds ``budget``. Pick incremental only
+    when the expected fallback steps stay under a quarter of the run:
+
+    - Hub fallbacks: each agent whose (per-device) edge slice exceeds
+      max_degree changes status at most twice per run → ≈ 2H steps.
+      ``edge_slices`` is the whole out-degree vector single-device, or the
+      per-agent MAX CHUNK SLICE under a mesh (edge-count sharding splits a
+      hub's edges across chunks, so the sharded census is milder).
+    - Mass-change overflow (ADVICE r3): the logistic bulk changes
+      ≈ n·β·G(1-G)·dt agents per step (withdrawal-window entries/exits
+      mirror informed transitions, doubling the rate), which can exceed
+      ``budget`` on exactly the steep steps the hub count ignores. Steps
+      above budget satisfy G(1-G) > c with c = budget/(2·n·β·dt); the
+      logistic spends (1/β)·ln(((1/2+r)/(1/2−r))²) time in that band,
+      r = √(1/4−c) — count those steps too.
+    """
+    hubs = int((np.asarray(edge_slices) > max_degree).sum())
+    fallback_steps = 2.0 * hubs
+    if beta_mean > 0 and budget > 0:
+        c = budget / (2.0 * n * beta_mean * dt)
+        if c < 0.25:
+            r = float(np.sqrt(0.25 - c))
+            band = (2.0 / beta_mean) * float(np.log((0.5 + r) / (0.5 - r)))
+            fallback_steps += band / dt
+    return "incremental" if fallback_steps <= max(2, n_steps // 4) else "gather"
+
+
+def _max_chunk_slice(out_ptr: np.ndarray, ec: int, n: int) -> np.ndarray:
+    """Per-agent largest out-edge slice under edge-count sharding with chunk
+    size ``ec``: an agent's contiguous src-sorted edge range [start, end)
+    lands in 1-2 chunks when deg ≤ ec (middle chunks of longer spans are
+    full and clip to ec). This is the hub census the sharded auto choice
+    uses — splitting tames hubs up to ~2× max_degree per chunk boundary."""
+    starts = out_ptr[:-1].astype(np.int64)
+    ends = out_ptr[1:].astype(np.int64)
+    f = starts // ec
+    piece1 = np.minimum(ends, (f + 1) * ec) - starts
+    piece2 = np.clip(ends - (f + 1) * ec, 0, ec)
+    return np.maximum(piece1, piece2)[:n]
 
 
 def _seg_counts(active_src, row_ptr):
@@ -242,20 +285,25 @@ def _seg_counts(active_src, row_ptr):
     return prefix[row_ptr[1:]] - prefix[row_ptr[:-1]]
 
 
-def _bitpacked_block_counts(wd, src, row_ptr, axis):
-    """Sharded full recount, bitpacked form (shared by the gather engine's
-    "scatter" comm and the incremental engine's overflow fallback — the two
-    must stay byte-for-byte equivalent for the engines' bit-identity):
-    all_gather the N/8-byte packed withdrawn mask, count this shard's
-    dst-sorted edges, and psum_scatter so each device receives only its own
-    agent block's totals. Requires the local block byte-aligned."""
-    wd_bits = jnp.packbits(wd, bitorder="little")  # (nb/8,) uint8
-    bits_global = lax.all_gather(wd_bits, axis, tiled=True)  # (N/8,)
+def _bit_recount(bits_global, src, row_ptr, axis):
+    """Count this shard's dst-sorted edges against a gathered global bit
+    mask and psum_scatter each device its own agent block's totals (one
+    reduce_scatter resolves straddling ranges at 1/n_dev of a psum's
+    bytes). The single body shared by every bitpacked recount path — the
+    gather engine's "scatter" comm and the incremental engine's overflow
+    fallback — so their byte-for-byte equivalence (the engines' bit-
+    identity contract) lives in exactly one place."""
     active = (bits_global[src >> 3] >> (src & 7).astype(jnp.uint8)) & jnp.uint8(1)
     counts = _seg_counts(active, row_ptr)[:-1]  # (N,) this shard's edges
-    # reduce straddling ranges AND deliver each device its own block in one
-    # reduce_scatter (1/n_dev the bytes of a psum)
     return lax.psum_scatter(counts, axis, scatter_dimension=0, tiled=True)
+
+
+def _bitpacked_block_counts(wd, src, row_ptr, axis):
+    """Sharded full recount from a local withdrawn mask: pack to N/8 bytes,
+    all_gather, then `_bit_recount`. Requires the local block byte-aligned."""
+    wd_bits = jnp.packbits(wd, bitorder="little")  # (nb/8,) uint8
+    bits_global = lax.all_gather(wd_bits, axis, tiled=True)  # (N/8,)
+    return _bit_recount(bits_global, src, row_ptr, axis)
 
 
 @functools.lru_cache(maxsize=None)
@@ -489,73 +537,93 @@ def _sharded_incremental_sim(
     """Event-driven kernel over a device mesh (engine="incremental" + mesh).
 
     Same invariant as `_incremental_sim` — counts_i(k) = Σ_{j→i} wd_j(k),
-    maintained by ±1 updates over changed agents' out-edges — distributed:
-    each device compacts the changed agents of ITS block, scatter-adds
-    their out-edge contributions into a full-length delta vector (out-edges
-    target arbitrary global destinations), and one `psum_scatter` both sums
-    the deltas across devices and hands each device its own block's slice
-    (the same collective shape as the gather path's "scatter" comm, but
-    int32 deltas instead of recounted totals). Overflow anywhere (psum'd
-    flag, so every device takes the same branch) falls back to the gather
-    path's bitpacked full recount for that step — results stay BIT-IDENTICAL
-    to every other engine/sharding combination (tested).
+    maintained by ±1 updates over changed agents' out-edges — distributed
+    with out-edges sharded BY EDGE COUNT: the src-sorted edge array is cut
+    into exactly-balanced E/n_dev chunks (the same trick the gather path
+    uses for its dst-sorted shards), so scale-free hubs no longer skew any
+    per-device padding — a hub's out-edges simply SPLIT across consecutive
+    chunks, each device updating its piece. Each device carries host-built
+    (local_start, local_deg) tables mapping every global agent to the slice
+    of its out-edges inside this chunk.
 
-    Out-edges are sharded BY SOURCE BLOCK (each device holds its own
-    agents' out-edges, padded to the max block edge count) — unlike the
-    gather path's count-balanced dst-sorted shards. Scale-free hubs skew
-    that padding; prefer engine="gather" for heavy-tailed out-degrees.
+    Change detection is global: each step all_gathers the BITPACKED
+    withdrawn mask (N/8 bytes — the same collective the fallback recount
+    needs anyway) and XORs it against the carried previous mask, so every
+    device sees every changed agent and serves the changes that own edges
+    in its chunk. One `psum_scatter` then both sums the ±1 deltas across
+    devices and hands each device its own agent block's slice. Overflow
+    anywhere (visible-changed count or a local edge slice above budget;
+    psum'd flag, so every device takes the same branch) falls back to the
+    full bitpacked recount for that step, reusing the already-gathered
+    mask — results stay BIT-IDENTICAL to every other engine/sharding
+    combination (tested on skewed scale-free graphs).
     """
     dt = config.dt
     n_dev = mesh.shape[axis]
 
     def shard_fn(
-        betas, src, row_ptr, indeg, dst2, out_start, outdeg, informed0, t_init, key
+        betas, src, row_ptr, indeg, dst2, lstart, ldeg, informed0, t_init, key
     ):
         nb = betas.shape[0]
-        el = dst2.shape[0]  # padded local out-edge chunk
+        ec = dst2.shape[0]  # this device's edge-count-balanced chunk
         n_gl = nb * n_dev
         dtype = betas.dtype
         idx = lax.axis_index(axis)
         offset = idx * nb
         ids = (offset + jnp.arange(nb)).astype(jnp.uint32)
-        row_ptr = row_ptr[0]
+        row_ptr = row_ptr[0]  # dst-sorted table for the fallback recount
+        lstart = lstart[0]  # (n_gl,) this chunk's slice start per agent
+        ldeg = ldeg[0]  # (n_gl,) this chunk's slice length per agent
         t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
         safe_deg = jnp.maximum(indeg, 1.0)
         inv_n = 1.0 / n_true
         d_lane = jnp.arange(budget_deg, dtype=jnp.int32)[None, :]
+        has_edges = ldeg > 0
 
-        def full_recount(wd):
-            return _bitpacked_block_counts(wd, src, row_ptr, axis)
+        def bit_at(bits, pos):
+            return ((bits[pos >> 3] >> (pos & 7).astype(jnp.uint8)) & 1).astype(
+                jnp.int32
+            )
 
         def step(carry, k):
-            informed, t_inf, counts, wd_prev = carry
+            informed, t_inf, counts, prev_bits = carry
             t = k.astype(dtype) * dt
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
-            dwd = wd.astype(jnp.int32) - wd_prev.astype(jnp.int32)
-            changed = dwd != 0
-            n_changed = jnp.sum(changed)
+            wd_bits = jnp.packbits(wd, bitorder="little")
+            bits_global = lax.all_gather(wd_bits, axis, tiled=True)  # (n_gl/8,)
+            changed = jnp.unpackbits(
+                bits_global ^ prev_bits, bitorder="little"
+            ).astype(bool)
 
-            cids = jnp.nonzero(changed, size=budget_agents, fill_value=nb)[0]
-            valid = cids < nb
-            cids_c = jnp.minimum(cids, nb - 1).astype(jnp.int32)
-            degs = jnp.where(valid, outdeg[cids_c], 0)
-            overflow = (n_changed > budget_agents) | (jnp.max(degs) > budget_deg)
+            visible = changed & has_edges
+            n_vis = jnp.sum(visible)
+            cids = jnp.nonzero(visible, size=budget_agents, fill_value=n_gl)[0]
+            valid = cids < n_gl
+            cids_c = jnp.minimum(cids, n_gl - 1).astype(jnp.int32)
+            degs = jnp.where(valid, ldeg[cids_c], 0)
+            overflow = (n_vis > budget_agents) | (jnp.max(degs) > budget_deg)
             overflow_any = lax.psum(overflow.astype(jnp.int32), axis) > 0
 
             def incr(c):
-                starts = out_start[cids_c]
+                starts = lstart[cids_c]
                 emask = d_lane < degs[:, None]
-                eidx = jnp.minimum(starts[:, None] + d_lane, el - 1)
-                dsts = dst2[eidx]  # global destination ids; pad edges → n_gl
-                dsts = jnp.where(emask, dsts, n_gl)
-                sign = jnp.where(valid, dwd[cids_c], 0)
+                eidx = jnp.minimum(starts[:, None] + d_lane, ec - 1)
+                dsts = dst2[eidx]  # global destination ids
+                dsts = jnp.where(emask, dsts, n_gl)  # pad lanes → dump slot
+                sign = jnp.where(
+                    valid, bit_at(bits_global, cids_c) - bit_at(prev_bits, cids_c), 0
+                )
                 delta = jnp.where(emask, sign[:, None], 0)
                 buf = jnp.zeros(n_gl + 1, jnp.int32).at[dsts.ravel()].add(delta.ravel())
                 return c + lax.psum_scatter(
                     buf[:n_gl], axis, scatter_dimension=0, tiled=True
                 )
 
-            counts2 = lax.cond(overflow_any, lambda c: full_recount(wd), incr, counts)
+            def full(c):
+                # the gather path's recount, reusing the already-gathered mask
+                return _bit_recount(bits_global, src, row_ptr, axis)
+
+            counts2 = lax.cond(overflow_any, full, incr, counts)
             frac = counts2.astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
             draws = _agent_uniforms(key, k, ids, dtype)
@@ -564,7 +632,7 @@ def _sharded_incremental_sim(
             t_inf2 = jnp.where(newly, t + dt, t_inf)
             g = lax.psum(jnp.sum(informed.astype(dtype)), axis) * inv_n
             aw = lax.psum(jnp.sum(wd.astype(dtype)), axis) * inv_n
-            return (informed2, t_inf2, counts2, wd), (g, aw)
+            return (informed2, t_inf2, counts2, bits_global), (g, aw)
 
         # fresh zero arrays are device-invariant constants; mark them varying
         # over the mesh axis so the scan carry types match the step outputs
@@ -572,7 +640,7 @@ def _sharded_incremental_sim(
             informed0,
             t_inf0,
             lax.pcast(jnp.zeros(nb, jnp.int32), (axis,), to="varying"),
-            lax.pcast(jnp.zeros(nb, bool), (axis,), to="varying"),
+            lax.pcast(jnp.zeros(n_gl // 8, jnp.uint8), (axis,), to="varying"),
         )
         (informed, t_inf, _, _), (gs, aws) = lax.scan(
             step, init, jnp.arange(config.n_steps)
@@ -640,12 +708,12 @@ def simulate_agents(
         shape (8.1 s vs 21.1 s on v5e, benchmarks/RESULTS.md) and
         BIT-IDENTICAL in results (fallback to the full recount on budget
         overflow keeps exactness); "gather" recounts all edges every step;
-        "auto" (default) picks gather when sharded (the sharded incremental
-        variant exists — `_sharded_incremental_sim` — but its source-block
-        edge shards pad badly under scale-free skew, so it stays opt-in)
-        and otherwise chooses by out-degree tail (`_auto_engine`): a
-        scale-free tail of hubs above ``incremental_max_degree`` would force
-        the fallback on nearly every step, so such graphs keep "gather".
+        "auto" (default) chooses by the expected fallback-step count
+        (`_auto_engine`): hub fallbacks from the out-degree tail above
+        ``incremental_max_degree`` (under a mesh, the per-CHUNK slice tail —
+        edge-count sharding splits hub edges across devices) plus the
+        logistic mass-change overflow estimate; a scale-free hub tail or a
+        fast contagion (n·β·dt ≫ budget through the bulk) keeps "gather".
       incremental_budget: max changed agents handled incrementally per step
         (single-device default n//64 clamped to [4096, 65536]; with a mesh
         the budget — including an explicit value — is PER DEVICE BLOCK,
@@ -674,18 +742,37 @@ def simulate_agents(
         raise ValueError(f"Unknown engine {engine!r}")
     out_struct = None  # (dst2, src_sorted, outdeg, out_ptr), computed once
     if engine == "auto":
-        if mesh is not None or len(src_h) == 0:
-            # sharded default stays "gather": its count-balanced edge shards
-            # are robust to scale-free skew, while the incremental engine's
-            # source-block out-edge shards are not (_sharded_incremental_sim)
+        if len(src_h) == 0:
             engine = "gather"
         else:
-            from sbr_tpu.native import sort_edges_by_dst
-
-            # the out-edge structure doubles as the degree census for the
-            # engine choice and as the incremental kernel's input
-            out_struct = sort_edges_by_dst(dst_h, src_h, n)
-            engine = _auto_engine(out_struct[2], incremental_max_degree, config.n_steps)
+            # the census needs only out-degrees (and their cumsum under a
+            # mesh) — an O(E) bincount, NOT the full edge re-sort, which is
+            # deferred to the branch that actually runs incremental
+            outdeg_c = np.bincount(src_h, minlength=n).astype(np.int64)
+            if mesh is None:
+                census = outdeg_c
+                budget_est = incremental_budget or min(max(4096, n // 64), 65536)
+            else:
+                # edge-count sharding splits hub edges across chunks, and the
+                # per-device change budget multiplies across devices — census
+                # and budget are both the per-device effective values
+                n_dev_a = mesh.shape[mesh_axis]
+                ec_a = max(1, -(-len(src_h) // n_dev_a))
+                out_ptr_c = np.concatenate([[0], np.cumsum(outdeg_c)])
+                census = _max_chunk_slice(out_ptr_c, ec_a, n)
+                nb_a = -(-n // n_dev_a)
+                budget_est = (
+                    incremental_budget or min(max(512, nb_a // 64), 65536)
+                ) * n_dev_a
+            engine = _auto_engine(
+                census,
+                incremental_max_degree,
+                config.n_steps,
+                n,
+                float(np.mean(betas_h)),
+                config.dt,
+                int(budget_est),
+            )
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an
         # empty edge array; the gather kernel handles E = 0 fine
@@ -767,26 +854,29 @@ def simulate_agents(
     if engine == "incremental":
         from sbr_tpu.native import sort_edges_by_dst
 
-        # Out-edges sharded BY SOURCE BLOCK: device d holds the out-edges of
-        # agents [d·nb, (d+1)·nb), padded to the max block edge count with
-        # the sentinel destination n_gl (dropped into the delta dump slot).
+        # Out-edges sharded BY EDGE COUNT: the src-sorted edge array is cut
+        # into exact E/n_dev chunks (sentinel destination n_gl pads the tail
+        # into the delta dump slot); per-device (local_start, local_deg)
+        # tables map every global agent to its slice inside each chunk, so
+        # hub edges split across chunks instead of skewing any padding.
         nb = n_gl // n_dev
-        dst2_all, _, outdeg_all, out_ptr_all = sort_edges_by_dst(dst_h0, src_h0, n)
+        if out_struct is None:
+            out_struct = sort_edges_by_dst(dst_h0, src_h0, n)
+        dst2_all, _, _, out_ptr_all = out_struct
         e_all = int(out_ptr_all[-1])
-        outdeg_pad = np.zeros(n_gl, np.int32)
-        outdeg_pad[:n] = outdeg_all
-        starts_pad = np.full(n_gl, e_all, np.int64)
-        starts_pad[:n] = out_ptr_all[:-1]
-        bounds = np.array([int(starts_pad[d * nb]) for d in range(n_dev)] + [e_all])
-        el = max(1, int(np.max(bounds[1:] - bounds[:-1])))
-        dst2_sh = np.full(n_dev * el, n_gl, np.int32)
-        out_start_h = np.zeros(n_gl, np.int32)
+        ec = max(1, -(-e_all // n_dev))
+        dst2_sh = np.full(n_dev * ec, n_gl, np.int32)
+        dst2_sh[:e_all] = dst2_all
+        starts = out_ptr_all[:-1].astype(np.int64)
+        ends = out_ptr_all[1:].astype(np.int64)
+        lstart_h = np.zeros((n_dev, n_gl), np.int32)
+        ldeg_h = np.zeros((n_dev, n_gl), np.int32)
         for d in range(n_dev):
-            lo, hi = int(bounds[d]), int(bounds[d + 1])
-            dst2_sh[d * el : d * el + (hi - lo)] = dst2_all[lo:hi]
-            out_start_h[d * nb : (d + 1) * nb] = (
-                starts_pad[d * nb : (d + 1) * nb] - lo
-            ).astype(np.int32)
+            lo, hi = d * ec, (d + 1) * ec
+            s = np.clip(starts, lo, hi)
+            e_ = np.clip(ends, lo, hi)
+            lstart_h[d, :n] = (s - lo).astype(np.int32)
+            ldeg_h[d, :n] = (e_ - s).astype(np.int32)
         budget = incremental_budget
         if budget is None:
             budget = min(max(512, nb // 64), 65536)
@@ -801,8 +891,8 @@ def simulate_agents(
                 row_ptrs_h,
                 indeg_h,
                 dst2_sh,
-                out_start_h,
-                outdeg_pad,
+                lstart_h,
+                ldeg_h,
                 informed0_h,
                 t_init_h,
             )

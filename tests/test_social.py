@@ -430,6 +430,26 @@ class TestIncrementalEngine:
             np.asarray(r1.withdrawn_frac), np.asarray(r8.withdrawn_frac), atol=1e-6
         )
 
+    def test_sharded_incremental_bit_exact_on_skewed_graph(self):
+        """Scale-free out-degree skew, default budgets: the edge-count
+        sharded incremental engine (hub edges split across chunks) equals
+        the single-device gather run exactly — the round-3 padding-skew
+        objection to making incremental the sharded default."""
+        n = 4001
+        src, dst = scale_free_edges(n, 10.0, gamma=2.2, seed=41)
+        mesh = jax.make_mesh((8,), ("agents",))
+        cfg = AgentSimConfig(n_steps=70, dt=0.1, exit_delay=0.1, reentry_delay=2.0)
+        r1 = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=11, engine="gather")
+        r8 = simulate_agents(
+            1.0, src, dst, n, x0=0.01, config=cfg, seed=11, mesh=mesh,
+            engine="incremental",
+        )
+        np.testing.assert_array_equal(np.asarray(r1.informed), np.asarray(r8.informed))
+        np.testing.assert_array_equal(np.asarray(r1.t_inf), np.asarray(r8.t_inf))
+        np.testing.assert_array_equal(
+            np.asarray(r1.informed_frac), np.asarray(r8.informed_frac)
+        )
+
     def test_sharded_incremental_fallback_matches_gather(self):
         """Tiny budgets force the psum'd overflow path (bitpacked full
         recount) on most steps; must still equal the sharded gather engine
@@ -469,10 +489,10 @@ class TestAutoEngine:
         from sbr_tpu.social.agents import _auto_engine
 
         outdeg = np.full(10000, 10)
-        assert _auto_engine(outdeg, 64, 200) == "incremental"
+        assert _auto_engine(outdeg, 64, 200, 10000, 1.0, 0.05, 4096) == "incremental"
         # a couple of ER-tail hubs are fine (each costs ≤ 2 fallback steps)
         outdeg[:5] = 200
-        assert _auto_engine(outdeg, 64, 200) == "incremental"
+        assert _auto_engine(outdeg, 64, 200, 10000, 1.0, 0.05, 4096) == "incremental"
 
     def test_heuristic_prefers_gather_for_scale_free_tails(self):
         from sbr_tpu.social.agents import _auto_engine
@@ -483,7 +503,33 @@ class TestAutoEngine:
         src = rng.choice(n, size=10 * n, p=w / w.sum())
         outdeg = np.bincount(src, minlength=n)
         assert (outdeg > 64).sum() > 200  # heavy tail really present
-        assert _auto_engine(outdeg, 64, 200) == "gather"
+        assert _auto_engine(outdeg, 64, 200, n, 1.0, 0.05, 4096) == "gather"
+
+    def test_heuristic_counts_mass_change_overflow(self):
+        """ADVICE r3: a fast contagion overflows the change budget through
+        the logistic bulk even with zero hubs — the heuristic must count
+        those steps, not just hub fallbacks."""
+        from sbr_tpu.social.agents import _auto_engine
+
+        outdeg = np.full(1000, 10)  # no hubs at all
+        # peak change rate 2·n·β·dt/4 = 5e5 ≫ budget 4096 → the bulk
+        # overflows for ~(2/β)·ln((.5+r)/(.5-r))/dt ≈ 25 steps > n_steps/4
+        assert _auto_engine(outdeg, 64, 80, 2_000_000, 5.0, 0.1, 4096) == "gather"
+        # budget 3e5 leaves c=0.15 → only ~6 overflow steps ≤ n_steps/4
+        assert _auto_engine(outdeg, 64, 80, 2_000_000, 5.0, 0.1, 300_000) == "incremental"
+
+    def test_max_chunk_slice_splits_hubs(self):
+        """Edge-count sharding: a hub whose out-edges span chunk boundaries
+        is censused by its largest per-chunk slice, not its full degree."""
+        from sbr_tpu.social.agents import _max_chunk_slice
+
+        # agent 0: 100 edges, agents 1..10: 10 each → out_ptr
+        degs = np.array([100] + [10] * 10)
+        out_ptr = np.concatenate([[0], np.cumsum(degs)])
+        # chunk size 40: hub splits into slices 40/40/20 → max 40
+        slices = _max_chunk_slice(out_ptr, 40, 11)
+        assert slices[0] == 40
+        assert (slices[1:] <= 10).all()
 
     def test_auto_matches_explicit_engines(self):
         """Whatever auto picks, results equal both explicit engines."""
