@@ -1,0 +1,183 @@
+"""Compare the Figure-5 no-run frontier against the reference's COMMITTED figure.
+
+The reference's deliverable is figure parity (`MASTER.jl:31-88`), and its
+paper-resolution heatmap PDF (`/root/reference/output/figures/baseline/
+comp_stat_cross_heatmap_AW_large.pdf`) embeds the full 5000×5000 raster:
+a DeviceRGB image (viridis-mapped AW_max) plus a DeviceGray soft mask in
+which NaN (no-run) cells are fully transparent (value 0) and run cells
+carry the plot's alpha=0.8 (value 204) — `scripts/1_baseline.jl:278-284`.
+That mask is an EXTERNAL, bit-exact record of the reference's own no-run
+region, cell for cell, produced by the reference's own adaptive-grid
+numerics on the author's machine.
+
+This script extracts the mask + RGB (pure stdlib zlib; the PDF streams are
+FlateDecode), assembles this repo's 5000×5000 status grid from the
+checkpointed tiles (`output/checkpoints/heatmap_large/`, written by
+`python -m sbr_tpu.figures.master --paper`), aligns orientations
+(raster row 0 = u = 1.0; column i = ave_meeting_time index i), and reports:
+
+- run/no-run disagreement count and its spatial distribution (distance to
+  the frontier in grid cells);
+- the split between genuine frontier disagreement and the reference's
+  early-termination fill (after 5 consecutive no-run u's per column the
+  reference fills the REST of the column with NaN without solving —
+  `1_baseline.jl:236-244` — so cells above that cut were never computed
+  there; a run cell of ours in that region is not a numerics difference);
+- an approximate AW-value comparison by inverting the viridis colormap of
+  the RGB raster against our max_aw (8-bit quantized, so ~1/255 of the
+  color range is the floor).
+
+Writes a JSON artifact; the narrative lands in PARITY.md.
+
+Run: python benchmarks/reference_frontier.py  (host-side numpy only)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REF_PDF = Path("/root/reference/output/figures/baseline/comp_stat_cross_heatmap_AW_large.pdf")
+TILE_DIR = Path(__file__).resolve().parent.parent / "output/checkpoints/heatmap_large"
+N = 5000
+TILE = 500
+
+
+def extract_raster(pdf_path: Path):
+    """Pull the (mask, rgb) 5000×5000 arrays out of the PDF's image streams."""
+    data = pdf_path.read_bytes()
+    streams = []
+    for m in re.finditer(
+        rb"<<[^<>]*?/Subtype\s*/Image(?:[^<>]|<<[^<>]*>>)*?>>\s*stream\r?\n", data, re.S
+    ):
+        d = m.group(0)
+        w = int(re.search(rb"/Width (\d+)", d).group(1))
+        h = int(re.search(rb"/Height (\d+)", d).group(1))
+        gray = b"/DeviceGray" in d
+        start = m.end()
+        end = data.index(b"endstream", start)
+        raw = zlib.decompress(data[start:end].rstrip(b"\r\n"))
+        arr = np.frombuffer(raw, np.uint8)
+        streams.append((gray, arr.reshape(h, w) if gray else arr.reshape(h, w, 3)))
+    mask = next(a for g, a in streams if g)
+    rgb = next(a for g, a in streams if not g)
+    return mask, rgb
+
+
+def load_tiles():
+    """Assemble (status, max_aw) [amt_index, u_index] from the tile store."""
+    status = np.full((N, N), -1, np.int32)
+    max_aw = np.full((N, N), np.nan, np.float32)
+    for bi in range(0, N, TILE):
+        for ui in range(0, N, TILE):
+            t = np.load(TILE_DIR / f"tile_b{bi:05d}_u{ui:05d}.npz")
+            status[bi : bi + TILE, ui : ui + TILE] = t["status"]
+            max_aw[bi : bi + TILE, ui : ui + TILE] = t["max_aw"]
+    assert (status >= 0).all(), "tile store incomplete"
+    return status, max_aw
+
+
+def main() -> None:
+    mask, rgb = extract_raster(REF_PDF)
+    status, max_aw = load_tiles()
+
+    # orientation: raster[r, c] ↔ (u index N-1-r, amt index c); ours is
+    # [amt, u] → transpose to [u, amt] and flip u to match the raster
+    ours_norun = (status.T != 0)[::-1, :]
+    ref_norun = mask == 0
+
+    agree = ours_norun == ref_norun
+    n_dis = int((~agree).sum())
+    print(f"no-run masks: {N*N} cells, disagreements: {n_dis} ({n_dis/(N*N):.3e})")
+    print(f"  ref no-run frac:  {ref_norun.mean():.6f}")
+    print(f"  ours no-run frac: {ours_norun.mean():.6f}")
+
+    # Split disagreements against the reference's early-termination fill:
+    # per column the reference solves UP from u=0.001 and, after 5
+    # consecutive no-run cells, fills the REST with NaN WITHOUT solving
+    # (`1_baseline.jl:236-244`). A disagreement above that cut is "the
+    # reference never computed this cell", not a numerics difference.
+    ref_bot = ref_norun[::-1, :]  # row 0 = u smallest, solve order
+    win5 = np.lib.stride_tricks.sliding_window_view(ref_bot, 5, axis=0).all(axis=-1)
+    has_cut = win5.any(axis=0)
+    cut_start = np.where(has_cut, np.argmax(win5, axis=0), N)  # first row of the 5-block
+    fill_from = cut_start + 5  # rows >= this were never solved by the reference
+    bot_rows = N - 1 - np.nonzero(~agree)[0]  # disagreements in solve order
+    dis_cols = np.nonzero(~agree)[1]
+    in_fill = bot_rows >= fill_from[dis_cols]
+    ours_run_there = ~ours_norun[::-1, :][bot_rows, dis_cols]
+    n_fill = int((in_fill & ours_run_there).sum())
+    genuine = ~(in_fill & ours_run_there)
+    n_genuine = int(genuine.sum())
+    print(
+        f"  split: {n_genuine} genuine (reference solved the cell), "
+        f"{n_fill} in the reference's early-termination fill (never solved there)"
+    )
+
+    # frontier distance for GENUINE disagreements only, and only in columns
+    # where the reference actually has a boundary
+    first_norun = np.where(ref_bot.any(axis=0), np.argmax(ref_bot, axis=0), -1)
+    g_rows = bot_rows[genuine]
+    g_cols = dis_cols[genuine]
+    bounded = first_norun[g_cols] >= 0
+    dist = np.abs(g_rows[bounded] - first_norun[g_cols[bounded]])
+    n_unbounded = int((~bounded).sum())
+    if len(dist):
+        print(
+            "  genuine-disagreement distance to ref frontier (cells): "
+            f"max={int(dist.max())}, p99={int(np.percentile(dist, 99))}, "
+            f"median={int(np.median(dist))}"
+            + (f"; {n_unbounded} in columns where ref never stops running" if n_unbounded else "")
+        )
+
+    # approximate AW value check via viridis inversion (8-bit floor ~1/255)
+    from matplotlib import cm
+
+    lut = (np.asarray(cm.get_cmap("viridis")(np.linspace(0, 1, 256)))[:, :3] * 255).astype(
+        np.uint8
+    )
+    ours_aw = max_aw.T[::-1, :]
+    finite = ~ours_norun & ~ref_norun
+    lo, hi = np.nanmin(ours_aw[finite]), np.nanmax(ours_aw[finite])
+    sample = np.random.default_rng(0).choice(np.flatnonzero(finite), 200_000, replace=False)
+    px = rgb.reshape(-1, 3)[sample].astype(np.int32)
+    idx = np.argmin(
+        ((px[:, None, :] - lut[None, :, :].astype(np.int32)) ** 2).sum(-1), axis=1
+    )
+    ref_val = lo + idx / 255.0 * (hi - lo)
+    our_val = ours_aw.reshape(-1)[sample]
+    dv = ref_val - our_val
+    print(
+        f"  AW via viridis inversion (n=200k sample, clim=[{lo:.4f},{hi:.4f}]): "
+        f"mean|Δ|={np.abs(dv).mean():.5f}, p99|Δ|={np.percentile(np.abs(dv),99):.5f} "
+        f"(8-bit floor ≈ {(hi-lo)/255/2:.5f})"
+    )
+
+    payload = {
+        "cells": N * N,
+        "disagreements": n_dis,
+        "genuine_disagreements": n_genuine,
+        "early_termination_fill_disagreements": n_fill,
+        "ref_norun_frac": float(ref_norun.mean()),
+        "ours_norun_frac": float(ours_norun.mean()),
+        "dist_to_frontier_max": int(dist.max()) if len(dist) else 0,
+        "dist_to_frontier_median": float(np.median(dist)) if len(dist) else 0,
+        "aw_viridis_mean_abs_delta": float(np.abs(dv).mean()),
+        "aw_viridis_p99_abs_delta": float(np.percentile(np.abs(dv), 99)),
+        "aw_8bit_floor": float((hi - lo) / 255 / 2),
+    }
+    out = Path(__file__).resolve().parent / "FRONTIER_vs_reference.json"
+    out.write_text(json.dumps(payload, indent=1) + "\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

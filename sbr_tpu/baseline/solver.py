@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from sbr_tpu.baseline.learning import logistic_pdf
+from sbr_tpu.baseline.learning import logistic_cdf, logistic_pdf
 from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre
 from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
 from sbr_tpu.models.params import EconomicParams, SolverConfig
@@ -44,16 +44,58 @@ def _root_tol(dtype) -> float:
     return 1e-7 if jnp.dtype(dtype) == jnp.float64 else 1e-4
 
 
+def _warped_grid(eta, beta, x0, n, warp, dtype):
+    """Transition-resolving hazard grid for the closed-form logistic.
+
+    A uniform [0, η] grid cannot see equilibria at large β: the whole
+    logistic transition (width ~1/β around t* = logit((1-x0)/x0)/β) falls
+    inside one cell once β ≳ n/η, and the solver mislabels genuinely
+    running cells as false equilibria — measured against the reference's
+    committed 5000×5000 heatmap raster, ALL 17,666 false-eq cells of the
+    round-3 paper sweep sat in the 4 highest-β columns where the
+    reference's adaptive grid (`learning.jl:51`) resolves the spike. The
+    fix mirrors the reference's grid-inheritance idea in closed form: the
+    grid is the SORTED UNION of ⌈(1-warp)·n⌉ uniform points with ⌊warp·n⌋
+    points of the logistic inverse-CDF map
+
+        t(q) = [logit(x0 + q·(G(η)-x0)) - logit(x0)] / β,
+
+    which places them uniformly in G-space — i.e. clustered through the
+    transition with local spacing ~1/(β·warp·n) at ANY β, while the uniform
+    half keeps the flat tail covered. (A convex BLEND of the two maps does
+    not work: the uniform component floors the local spacing at
+    (1-warp)·η/n, which still swallows the transition once β ≳ n/η —
+    measured before switching to the union form.) Duplicate knots from the
+    union are harmless: zero-width intervals contribute nothing to the
+    quadrature and cannot host a crossing (`core.rootfind._interp_cross`
+    guards flat segments).
+    """
+    n_q = max(1, int(warp * n))
+    n_u = n - n_q
+    t_uniform = jnp.linspace(jnp.zeros((), dtype), eta, n_u)
+    q = jnp.linspace(jnp.zeros((), dtype), jnp.ones((), dtype), n_q)
+    g_eta = logistic_cdf(eta, beta, x0)
+    levels = x0 + q * (g_eta - x0)
+    logit = lambda v: jnp.log(v) - jnp.log1p(-v)
+    t_quant = (logit(levels) - logit(jnp.asarray(x0, dtype))) / beta
+    grid = jnp.sort(jnp.concatenate([t_uniform, t_quant]))
+    # pin the endpoints exactly (t_quant hits 0/η only up to rounding)
+    return jnp.clip(grid, 0.0, eta).at[0].set(0.0).at[-1].set(eta)
+
+
 def _hazard_parts(p, lam, ls: LearningSolution, eta, config: SolverConfig):
     """Hazard grid, values, and the cumulative normalization integral."""
     dtype = ls.cdf.dtype
     eta = jnp.asarray(eta, dtype=dtype)
     p = jnp.asarray(p, dtype=dtype)
     lam = jnp.asarray(lam, dtype=dtype)
-    tau_grid = jnp.linspace(jnp.zeros((), dtype), eta, config.n_grid)
 
     if ls.closed_form:
         beta, x0 = ls.beta, ls.x0
+        if config.grid_warp > 0.0:
+            tau_grid = _warped_grid(eta, beta, x0, config.n_grid, config.grid_warp, dtype)
+        else:
+            tau_grid = jnp.linspace(jnp.zeros((), dtype), eta, config.n_grid)
 
         def integrand(ts):
             return jnp.exp(lam * ts) * logistic_pdf(ts, beta, x0)
@@ -61,6 +103,9 @@ def _hazard_parts(p, lam, ls: LearningSolution, eta, config: SolverConfig):
         integ = cumulative_gauss_legendre(integrand, tau_grid, order=config.quad_order)
         g_tau = logistic_pdf(tau_grid, beta, x0)
     else:
+        # grid-backed Stage 1 (hetero groups, social fixed point): the
+        # learning grid is uniform, so the hazard grid stays uniform too
+        tau_grid = jnp.linspace(jnp.zeros((), dtype), eta, config.n_grid)
         g_tau = ls.pdf_at(tau_grid)
         eg = jnp.exp(lam * tau_grid) * g_tau
         integ = cumtrapz(eg, x=tau_grid)
@@ -98,14 +143,14 @@ def _make_hazard_at(p, lam, ls: LearningSolution, tau_grid, integ, int_eta, conf
     nodes, weights = np.polynomial.legendre.leggauss(config.quad_order)
     nodes = jnp.asarray(nodes, dtype=dtype)
     weights = jnp.asarray(weights, dtype=dtype)
-    dtau = tau_grid[1] - tau_grid[0]
     n = tau_grid.shape[0]
     beta, x0 = ls.beta, ls.x0
     p = jnp.asarray(p, dtype=dtype)
     lam = jnp.asarray(lam, dtype=dtype)
 
     def hazard_at(tau):
-        i = jnp.clip(jnp.floor(tau / dtau).astype(jnp.int32), 0, n - 2)
+        # binary-search lookup: the grid may be warped (non-uniform)
+        i = jnp.clip(jnp.searchsorted(tau_grid, tau, side="right") - 1, 0, n - 2)
         a = tau_grid[i]
         half = 0.5 * (tau - a)
         mid = 0.5 * (tau + a)
@@ -132,11 +177,16 @@ def optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=None, refine_iters: int
     if hazard_at is None:
         return t_in, t_out
 
-    dtau = tau_grid[1] - tau_grid[0]
     eta = tau_grid[-1]
+    n = tau_grid.shape[0]
 
     def bracket(t):
-        return jnp.clip(t - dtau, 0.0, eta), jnp.clip(t + dtau, 0.0, eta)
+        # ±one LOCAL grid interval around the coarse crossing (the grid may
+        # be warped, so the neighborhood width varies along the axis)
+        i = jnp.clip(jnp.searchsorted(tau_grid, t, side="right") - 1, 0, n - 1)
+        lo = tau_grid[jnp.maximum(i - 1, 0)]
+        hi = tau_grid[jnp.minimum(i + 2, n - 1)]
+        return lo, hi
 
     lo_i, hi_i = bracket(t_in)
     t_in_ref = bisect(lambda t: hazard_at(t) - u, lo_i, hi_i, num_iters=refine_iters)
@@ -183,11 +233,24 @@ def compute_xi(
     err = jnp.abs(aw - kappa)
     root_ok = err <= _root_tol(dtype)
 
-    eps = ls.dt
     t_out = jnp.minimum(tau_bar_out_unc, xi)
     t_in = jnp.minimum(tau_bar_in_unc, xi)
-    aw_eps = ls.cdf_at(t_out + eps) - ls.cdf_at(t_in + eps)
-    is_increasing = aw_eps >= aw
+    if ls.closed_form:
+        # The reference's ε is its LOCAL adaptive-grid spacing at ξ
+        # (`solver.jl:336-339`) — tiny where G moves fast. A fixed ε = ls.dt
+        # breaks at large β: with the transition width ~1/β ≪ dt, both
+        # shifted evaluations land past the transition and every genuine
+        # equilibrium reads as "decreasing" (measured: all 17,666 false-eq
+        # cells of the round-3 paper heatmap were this artifact). In closed
+        # form the ε→0 limit is exact: d/dε [G(t_out+ε) - G(t_in+ε)] at 0
+        # is g(t_out) - g(t_in).
+        is_increasing = logistic_pdf(t_out, ls.beta, ls.x0) >= logistic_pdf(
+            t_in, ls.beta, ls.x0
+        )
+    else:
+        eps = ls.dt
+        aw_eps = ls.cdf_at(t_out + eps) - ls.cdf_at(t_in + eps)
+        is_increasing = aw_eps >= aw
     return xi, err, root_ok, is_increasing
 
 

@@ -466,6 +466,10 @@ def main(argv=None) -> int:
     # generated (the reference's output/replication_figures.pdf is the same
     # document compiled via LaTeX, unavailable in this image).
     global _PDF_DOC, _PDF_PENDING_HEADER
+    # reset collector state at entry: a prior partial --sections run in the
+    # same process leaves a stale pending header otherwise (ADVICE r3)
+    _PDF_DOC = None
+    _PDF_PENDING_HEADER = None
     doc_path = outdir / "replication_figures.pdf"
     doc_tmp = outdir / "replication_figures.pdf.tmp"
     doc = None

@@ -12,6 +12,8 @@ config).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax.numpy as jnp
 from flax import struct
 
@@ -49,6 +51,11 @@ def solve_equilibrium_interest_core(
     r = jnp.asarray(r, dtype=dtype)
     nan = jnp.asarray(jnp.nan, dtype=dtype)
 
+    # The HJB scan and V's interp_uniform both assume uniform spacing, so
+    # the interest path pins the hazard grid uniform (grid_warp is a
+    # high-β sweep concern; policy sweeps stay at moderate β).
+    if config.grid_warp > 0.0:
+        config = dataclasses.replace(config, grid_warp=0.0)
     tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
     v = solve_value_function(tau_grid, hr, delta, r, u, config)
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`

@@ -272,9 +272,16 @@ class SolverConfig:
     ode_substeps: int = 2
     quad_order: int = 8
     refine_crossings: bool = True
+    # Fraction of hazard-grid points allocated through the logistic
+    # inverse-CDF map (closed-form Stage 1 only): resolves the 1/β-wide
+    # transition that a uniform grid loses at β ≳ n_grid/η — without it the
+    # highest-β columns of the Figure-5 heatmap mislabel running cells as
+    # false equilibria (see baseline/solver.py::_warped_grid). 0 disables.
+    grid_warp: float = 0.5
 
     def __post_init__(self):
         _check(self.n_grid >= 16, "n_grid too small")
         _check(self.bisect_iters >= 1, "bisect_iters must be >= 1")
         _check(self.ode_substeps >= 1, "ode_substeps must be >= 1")
         _check(self.quad_order >= 1, "quad_order must be >= 1")
+        _check(0.0 <= self.grid_warp <= 1.0, "grid_warp must be in [0, 1]")

@@ -715,10 +715,14 @@ def simulate_agents(
         logistic mass-change overflow estimate; a scale-free hub tail or a
         fast contagion (n·β·dt ≫ budget through the bulk) keeps "gather".
       incremental_budget: max changed agents handled incrementally per step
-        (single-device default n//64 clamped to [4096, 65536]; with a mesh
-        the budget — including an explicit value — is PER DEVICE BLOCK,
-        default nb//64 clamped to [512, 65536] for block size nb = N/n_dev);
-        overflow steps fall back to the full recount.
+        (single-device default n//64 clamped to [4096, 65536]). With a mesh
+        the budget — including an explicit value — is PER DEVICE, but the
+        population it caps is the agents changed ANYWHERE globally that own
+        out-edges in the device's E/n_dev chunk (change detection is global
+        via the all_gathered bit mask); default nb//64 clamped to
+        [512, 65536] for nb = N/n_dev, which is ~1/n_dev of the global
+        change rate when ids are uncorrelated with the dynamics. Overflow
+        steps fall back to the full recount.
       incremental_max_degree: out-degree cap per changed agent for the
         dense update grid; a changed agent above it triggers the fallback
         for that step (hubs change rarely — at most twice each).

