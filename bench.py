@@ -77,39 +77,70 @@ print("PLATFORM=" + jax.devices()[0].platform, flush=True)
 """
 
 
+def _run_killable(argv, timeout_s: float) -> tuple:
+    """Run ``argv`` with stdout/stderr captured via TEMP FILES and the child
+    in its OWN PROCESS GROUP, returning (rc_or_None, stdout, stderr, dur).
+
+    Why not subprocess.run(capture_output=..., timeout=...): on timeout it
+    kills the immediate child and then blocks in communicate() until the
+    PIPE closes — and the TPU plugin spawns helper grandchildren that
+    inherit the pipe and survive the kill, so the "timeout" never returns
+    (observed: the watch daemon froze for 100 min inside probe #2 this
+    way). Files cannot block, and killpg takes the helpers down too.
+    """
+    import signal
+    import subprocess
+    import tempfile
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryFile("w+") as fout, tempfile.TemporaryFile("w+") as ferr:
+        proc = subprocess.Popen(
+            argv,
+            stdout=fout,
+            stderr=ferr,
+            stdin=subprocess.DEVNULL,
+            text=True,
+            start_new_session=True,  # own process group → killpg reaches helpers
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            rc = None
+        dur = time.perf_counter() - t0
+        fout.seek(0)
+        ferr.seek(0)
+        return rc, fout.read(), ferr.read(), dur
+
+
 def _probe_accelerator(timeout_s: float) -> tuple:
     """Ask a SUBPROCESS to run a real tiny jit computation on the default
     (accelerator) backend and report its platform.
 
     The computation (compile + execute + device->host fetch + value check)
     is the point: round 2 showed `jax.devices()` alone can succeed while
-    the first real dispatch hangs. A hang anywhere in the child is killed
-    by the timeout. Returns (platform_or_empty, outcome_str, duration_s).
+    the first real dispatch hangs. A hang anywhere in the child (or its
+    TPU-plugin helpers) is killed by the timeout via `_run_killable`.
+    Returns (platform_or_empty, outcome_str, duration_s).
     """
-    import subprocess
-
-    t0 = time.perf_counter()
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        dur = time.perf_counter() - t0
-        platform = ""
-        for line in out.stdout.strip().splitlines():
-            if line.startswith("PLATFORM="):
-                platform = line.split("=", 1)[1].strip()
-        if out.returncode == 0 and platform:
-            return platform, "ok", dur
-        tail = out.stderr.strip()[-200:]
-        _log(f"probe rc={out.returncode}, stderr tail: {tail!r}")
-        return "", f"rc={out.returncode}", dur
-    except subprocess.TimeoutExpired:
-        dur = time.perf_counter() - t0
+    rc, stdout, stderr, dur = _run_killable(
+        [sys.executable, "-c", _PROBE_CODE], timeout_s
+    )
+    if rc is None:
         _log(f"probe timed out after {timeout_s:.0f}s (accelerator backend hung)")
         return "", "timeout", dur
+    platform = ""
+    for line in stdout.strip().splitlines():
+        if line.startswith("PLATFORM="):
+            platform = line.split("=", 1)[1].strip()
+    if rc == 0 and platform:
+        return platform, "ok", dur
+    _log(f"probe rc={rc}, stderr tail: {stderr.strip()[-200:]!r}")
+    return "", f"rc={rc}", dur
 
 
 class _Budget:
@@ -171,31 +202,25 @@ def _run_measurement(platform: str, timeout_s: float, script: str = None) -> tup
     (result_dict_or_None, outcome_str, duration_s). ``script`` defaults to
     this file; benchmarks/stretch.py reuses the harness by passing its own
     path (every device touch must live in a killable child — see module
-    docstring)."""
-    import subprocess
-
-    t0 = time.perf_counter()
-    try:
-        out = subprocess.run(
-            [sys.executable, script or os.path.abspath(__file__), "--measure", platform],
-            stdout=subprocess.PIPE,
-            stderr=None,  # child diagnostics stream straight to our stderr
-            text=True,
-            timeout=timeout_s,
-        )
-        dur = time.perf_counter() - t0
-        if out.returncode == 0 and out.stdout.strip():
-            try:
-                return json.loads(out.stdout.strip().splitlines()[-1]), "ok", dur
-            except json.JSONDecodeError:
-                _log(f"measure child printed non-JSON: {out.stdout[-200:]!r}")
-                return None, "bad-json", dur
-        _log(f"measure child rc={out.returncode}")
-        return None, f"rc={out.returncode}", dur
-    except subprocess.TimeoutExpired:
-        dur = time.perf_counter() - t0
+    docstring). Uses `_run_killable` (file-backed IO + process-group kill)
+    so a hung tunnel cannot freeze the parent past the timeout."""
+    rc, stdout, stderr, dur = _run_killable(
+        [sys.executable, script or os.path.abspath(__file__), "--measure", platform],
+        timeout_s,
+    )
+    if stderr:
+        sys.stderr.write(stderr)  # child diagnostics, forwarded
+    if rc is None:
         _log(f"measure child timed out after {timeout_s:.0f}s on {platform}")
         return None, "timeout", dur
+    if rc == 0 and stdout.strip():
+        try:
+            return json.loads(stdout.strip().splitlines()[-1]), "ok", dur
+        except json.JSONDecodeError:
+            _log(f"measure child printed non-JSON: {stdout[-200:]!r}")
+            return None, "bad-json", dur
+    _log(f"measure child rc={rc}")
+    return None, f"rc={rc}", dur
 
 
 def _benchmarks_dir() -> Path:

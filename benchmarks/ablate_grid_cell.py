@@ -1,0 +1,138 @@
+"""Roofline the β×u grid cell (VERDICT r3 task 5).
+
+The agent sim got a per-stage ablation (`ablate_agent_step.py`) that found
+its gather wall and motivated the event-driven engine; the grid sweep —
+the repo's headline metric — never did. This script times the vmap² grid
+program (`sweeps/baseline_sweeps.py::_grid_fn`) across config axes that
+isolate its stages:
+
+  bisect_iters 30/60/90   Stage-3 cost: each iteration is two closed-form
+                          G evaluations (exp + divide) per cell
+  quad_order 2/4/8        Stage-2 hazard quadrature: order×(n_grid-1)
+                          exp+logistic evaluations per cell
+  n_grid 512/1024/2048    everything grid-shaped: quadrature points,
+                          crossing scan, AW_max reduction
+  grid_warp 0/0.5         the round-4 transition-resolving grid: its
+                          jnp.sort(n_grid) per cell is the suspected cost
+                          of the high-β parity fix (tests/ref_emulator.py)
+
+plus a HOISTED-HAZARD probe: the hazard (grid construction + quadrature +
+HR values) depends only on β, not u, so the vmap² program recomputes it
+n_u× redundantly; `hazard_hoist_estimate` measures a β-row's hazard alone
+to bound what restructuring the sweep as per-row hazard + per-cell
+crossings/bisection would save.
+
+Writes one JSON artifact; conclusions land in benchmarks/RESULTS.md.
+
+Run: python benchmarks/ablate_grid_cell.py [n_beta] [n_u]
+  SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    if os.environ.get("SBR_ABL_PLATFORM", "") == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    n_beta = int(sys.argv[1]) if len(sys.argv) > 1 else 640
+    n_u = int(sys.argv[2]) if len(sys.argv) > 2 else 640
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} grid={n_beta}x{n_u} f32 (bench configuration)")
+
+    base = make_model_params()
+    amt = np.linspace(1e-4, 1.0, n_beta)
+    betas = 1.0 / amt
+    us = np.linspace(0.001, 1.0, n_u)
+
+    def timed(config: SolverConfig) -> float:
+        def run(rep):
+            grid = beta_u_grid(
+                betas, us + rep * 1e-6, base, config=config, dtype=jnp.float32
+            )
+            return float(
+                jnp.sum(grid.status) + jnp.nansum(grid.max_aw) + jnp.nansum(grid.xi)
+            )
+
+        run(0)  # compile
+        ts = []
+        for rep in range(1, 4):
+            t0 = time.perf_counter()
+            run(rep)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    baseline_cfg = dict(n_grid=1024, bisect_iters=60, refine_crossings=False)
+    variants = {
+        "baseline(1024,60,q8,warp.5)": SolverConfig(**baseline_cfg),
+        "bisect30": SolverConfig(**{**baseline_cfg, "bisect_iters": 30}),
+        "bisect90": SolverConfig(**{**baseline_cfg, "bisect_iters": 90}),
+        "quad2": SolverConfig(**{**baseline_cfg, "quad_order": 2}),
+        "quad4": SolverConfig(**{**baseline_cfg, "quad_order": 4}),
+        "warp0(uniform grid)": SolverConfig(**{**baseline_cfg, "grid_warp": 0.0}),
+        "ngrid512": SolverConfig(**{**baseline_cfg, "n_grid": 512}),
+        "ngrid2048": SolverConfig(**{**baseline_cfg, "n_grid": 2048}),
+    }
+    results = {}
+    for name, cfg in variants.items():
+        best = timed(cfg)
+        results[name] = round(best, 4)
+        print(f"{name:>28}: {best:.4f}s  ({n_beta * n_u / best / 1e6:.2f}M eq/s)")
+
+    # hoisted-hazard bound: hazard work alone for all β rows (one cell per
+    # β in u), vs the full grid — the gap × (1 - 1/n_u) is the redundancy
+    t_row = None
+    try:
+        cfg = SolverConfig(**baseline_cfg)
+
+        def hazard_only(rep):
+            grid = beta_u_grid(
+                betas, np.array([0.5 + rep * 1e-6]), base, config=cfg, dtype=jnp.float32
+            )
+            return float(jnp.nansum(grid.xi) + jnp.sum(grid.status))
+
+        hazard_only(0)
+        ts = []
+        for rep in range(1, 4):
+            t0 = time.perf_counter()
+            hazard_only(rep)
+            ts.append(time.perf_counter() - t0)
+        t_row = min(ts)
+        print(
+            f"{'hazard+1cell per beta-row':>28}: {t_row:.4f}s "
+            f"(if hoisted, bounds per-row overhead at {t_row / results['baseline(1024,60,q8,warp.5)'] * 100:.0f}% "
+            "of full-grid time)"
+        )
+    except Exception as err:
+        print(f"hazard-row probe failed: {err!r}")
+
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        payload = {
+            "platform": platform,
+            "grid": [n_beta, n_u],
+            "best_wall_s": results,
+            "hazard_row_s": round(t_row, 4) if t_row else None,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

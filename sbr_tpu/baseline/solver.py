@@ -21,6 +21,9 @@ Design notes vs the reference:
 
 from __future__ import annotations
 
+import time
+
+import jax
 import jax.numpy as jnp
 
 from sbr_tpu.baseline.learning import logistic_cdf, logistic_pdf
@@ -28,6 +31,16 @@ from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre
 from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
 from sbr_tpu.models.params import EconomicParams, SolverConfig
 from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
+
+
+def _stamp_solve_time(res, t0: float):
+    """Attach wall-clock solve_time (device-fenced) to a result, skipping
+    traced contexts — the convenience entries may run under jit/shard_map,
+    where a host clock is meaningless and blocking is illegal."""
+    if isinstance(res.xi, jax.core.Tracer):
+        return res
+    jax.block_until_ready(res.xi)
+    return res.replace(solve_time=time.perf_counter() - t0)
 
 
 def _root_tol(dtype) -> float:
@@ -274,6 +287,45 @@ def get_aw(xi, tau_bar_in_unc, tau_bar_out_unc, tau_grid, ls: LearningSolution):
     return aw_cum, aw_out, aw_in
 
 
+def _aw_max_exact(xi, tau_bar_in_unc, tau_bar_out_unc, eta, ls: LearningSolution):
+    """Exact max of the AW curve for closed-form Stage 1, in O(1).
+
+    AW(t) = [G(t−ξ+τ̄_OUT^CON)]₊ − [G(t−ξ+τ̄_IN^CON)]₊ + G(0) is piecewise
+    smooth on [0, η] with kinks where each branch's mask activates. On the
+    both-branches-active piece, AW′ = g(out-arg) − g(in-arg) vanishes where
+    the two pdf arguments straddle the logistic peak s* = ln((1−x0)/x0)/β
+    symmetrically (the logistic pdf is symmetric about s*:
+    logit G(s*±d) = ±βd), i.e. at t* = ξ + s* − (τ̄_IN^CON+τ̄_OUT^CON)/2.
+    Where only the out-branch is active AW is increasing, so that piece's
+    max sits at its right end — a kink. The global max therefore lies in
+    {0, η, t*, ξ−τ̄_IN^CON, ξ−τ̄_OUT^CON}: five exact evaluations replace
+    the reference's O(n) grid max (`solver.jl:566`), and the result is the
+    TRUE maximum rather than a grid sample of it.
+    """
+    dtype = ls.cdf.dtype
+    zero = jnp.zeros((), dtype=dtype)
+    tau_in_con = jnp.minimum(tau_bar_in_unc, xi)
+    tau_out_con = jnp.minimum(tau_bar_out_unc, xi)
+
+    s_star = (jnp.log1p(-ls.x0) - jnp.log(ls.x0)) / ls.beta
+    t_peak = xi + s_star - 0.5 * (tau_in_con + tau_out_con)
+    candidates = jnp.stack(
+        [
+            zero,
+            jnp.asarray(eta, dtype),
+            jnp.clip(t_peak, 0.0, eta),
+            jnp.clip(xi - tau_in_con, 0.0, eta),
+            jnp.clip(xi - tau_out_con, 0.0, eta),
+        ]
+    )
+
+    shift_in = candidates - xi + tau_in_con
+    aw_in = jnp.where(shift_in >= 0, ls.cdf_at(jnp.maximum(shift_in, zero)), zero)
+    shift_out = candidates - xi + tau_out_con
+    aw_out = jnp.where(shift_out >= 0, ls.cdf_at(jnp.maximum(shift_out, zero)), zero)
+    return jnp.max(aw_out - aw_in) + ls.cdf_at(zero)
+
+
 def solve_equilibrium_core(
     ls: LearningSolution,
     u,
@@ -326,7 +378,12 @@ def solve_equilibrium_core(
     aw_cum = jnp.where(run, aw_cum, nan)
     aw_out = jnp.where(run, aw_out, nan)
     aw_in = jnp.where(run, aw_in, nan)
-    aw_max = jnp.where(run, jnp.max(aw_cum), nan)
+    if ls.closed_form:
+        # exact O(1) maximum — and it unhooks aw_max from the (n,) curves,
+        # so the sweeps' lean cells dead-code-eliminate get_aw entirely
+        aw_max = jnp.where(run, _aw_max_exact(xi, tau_in_unc, tau_out_unc, eta, ls), nan)
+    else:
+        aw_max = jnp.where(run, jnp.max(aw_cum), nan)
 
     return EquilibriumResult(
         xi=xi,
@@ -355,9 +412,13 @@ def solve_equilibrium_baseline(
 ) -> EquilibriumResult:
     """Convenience entry mirroring `solve_equilibrium_baseline(lr, econ)`
     (`solver.jl:413`). ``tspan_end`` defaults to the learning grid's end, the
-    reference's `lr.params.tspan[2]` (`solver.jl:421`)."""
+    reference's `lr.params.tspan[2]` (`solver.jl:421`). The result carries
+    wall-clock ``solve_time`` with a device fence, like every reference
+    result struct (`solver.jl:414,458`)."""
     if tspan_end is None:
         tspan_end = ls.grid[-1]
-    return solve_equilibrium_core(
+    t0 = time.perf_counter()
+    res = solve_equilibrium_core(
         ls, econ.u, econ.p, econ.kappa, econ.lam, econ.eta, tspan_end, config
     )
+    return _stamp_solve_time(res, t0)

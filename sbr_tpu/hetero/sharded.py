@@ -81,6 +81,7 @@ def solve_hetero_sharded(
         status=P(),
         converged=P(),
         tolerance=P(),
+        solve_time=P(),  # replicated scalar leaf (0.0 inside the traced body)
     )
     spec_aw = (
         AWHetero(

@@ -156,6 +156,9 @@ def solve_equilibrium_hetero(
     stages stay local and only the weighted reductions cross shards; the
     returned scalars are replicated, per-group arrays sharded.
     """
+    import time
+
+    t_start = time.perf_counter()
     dtype = lsh.cdfs.dtype
     if tspan_end is None:
         tspan_end = lsh.grid[-1]
@@ -195,16 +198,21 @@ def solve_equilibrium_hetero(
         no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
     )
 
-    return EquilibriumResultHetero(
-        xi=xi,
-        tau_bar_in_uncs=tau_in_uncs,
-        tau_bar_out_uncs=tau_out_uncs,
-        hrs=hrs,
-        tau_grid=tau_grid,
-        bankrun=run,
-        status=status,
-        converged=converged,
-        tolerance=tolerance,
+    from sbr_tpu.baseline.solver import _stamp_solve_time
+
+    return _stamp_solve_time(
+        EquilibriumResultHetero(
+            xi=xi,
+            tau_bar_in_uncs=tau_in_uncs,
+            tau_bar_out_uncs=tau_out_uncs,
+            hrs=hrs,
+            tau_grid=tau_grid,
+            bankrun=run,
+            status=status,
+            converged=converged,
+            tolerance=tolerance,
+        ),
+        t_start,
     )
 
 

@@ -32,6 +32,15 @@ class EquilibriumResultInterest:
     v: jnp.ndarray  # (n,) value function V(τ̄) on tau_grid
     hr_effective: jnp.ndarray  # (n,) h − rV used for the buffer crossings
 
+    def __repr__(self) -> str:
+        from sbr_tpu.models.results import _fmt
+
+        return (
+            f"EquilibriumResultInterest(ξ={_fmt(self.base.xi)}, "
+            f"bankrun={_fmt(self.base.bankrun)}, status={_fmt(self.base.status)}, "
+            f"V(0)={_fmt(self.v[..., 0])}, solve_time={_fmt(self.base.solve_time, 3)}s)"
+        )
+
 
 def solve_equilibrium_interest_core(
     ls: LearningSolution,
@@ -135,10 +144,16 @@ def solve_equilibrium_interest(
     tspan_end=None,
 ) -> EquilibriumResultInterest:
     """Convenience entry mirroring `solve_equilibrium_interest(lr, econ, model)`
-    (`interest_rate_solver.jl:51`)."""
+    (`interest_rate_solver.jl:51`). The embedded baseline result carries
+    device-fenced ``solve_time`` like the reference's structs."""
+    import time
+
+    from sbr_tpu.baseline.solver import _stamp_solve_time
+
+    t0 = time.perf_counter()
     if tspan_end is None:
         tspan_end = ls.grid[-1]
-    return solve_equilibrium_interest_core(
+    res = solve_equilibrium_interest_core(
         ls,
         econ.u,
         econ.p,
@@ -150,3 +165,4 @@ def solve_equilibrium_interest(
         tspan_end,
         config,
     )
+    return res.replace(base=_stamp_solve_time(res.base, t0))

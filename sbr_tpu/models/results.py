@@ -18,6 +18,31 @@ import jax.numpy as jnp
 from flax import struct
 
 
+def _fmt(x, digits: int = 6) -> str:
+    """Human-readable scalar for result ``__repr__``s (reference `Base.show`,
+    `model.jl:218-245`, `solver.jl:116-129`): 0-d arrays print as numbers,
+    batched leaves summarize as shape — reprs must stay cheap and safe for
+    vmapped results."""
+    import jax as _jax
+
+    try:
+        arr = jnp.asarray(x)
+    except Exception:
+        return repr(x)
+    # NB: under an active trace even jnp.asarray(0.0) yields a Tracer, so
+    # the guard must run on the CONVERTED value
+    if isinstance(arr, _jax.core.Tracer):
+        return f"<traced {getattr(arr, 'aval', '?')}>"
+    if arr.ndim > 0:
+        return f"<{arr.shape} {arr.dtype}>"
+    v = arr.item()
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
 class Status(enum.IntEnum):
     """Per-cell outcome codes (SURVEY §5.5: structured status instead of prints).
 
@@ -127,6 +152,22 @@ class EquilibriumResult:
     aw_out: jnp.ndarray  # (n,) exits
     aw_in: jnp.ndarray  # (n,) re-entries
     aw_max: jnp.ndarray  # max of aw_cum (reference `AW_max`)
+    # Host-side wall-clock of the convenience entry that produced this
+    # result (reference `SolvedModel.solve_time`, `solver.jl:414,458`);
+    # 0.0 for results created inside jitted sweeps, where a per-cell
+    # host clock has no meaning. A pytree LEAF, not a static field: a
+    # per-call wall-clock in the treedef would make every stamped result
+    # a distinct pytree type (breaking tree_map across results and
+    # retracing every jit that takes one).
+    solve_time: float = 0.0
+
+    def __repr__(self) -> str:  # reference `Base.show`, `solver.jl:116-129`
+        return (
+            f"EquilibriumResult(ξ={_fmt(self.xi)}, bankrun={_fmt(self.bankrun)}, "
+            f"status={_fmt(self.status)}, τ̄_IN={_fmt(self.tau_bar_in_unc)}, "
+            f"τ̄_OUT={_fmt(self.tau_bar_out_unc)}, AW_max={_fmt(self.aw_max)}, "
+            f"solve_time={_fmt(self.solve_time, 3)}s)"
+        )
 
 
 @struct.dataclass
@@ -148,6 +189,15 @@ class EquilibriumResultHetero:
     status: jnp.ndarray  # int32 Status code
     converged: jnp.ndarray  # bool
     tolerance: jnp.ndarray  # achieved |AW(ξ)-κ|
+    solve_time: float = 0.0  # pytree leaf; see EquilibriumResult.solve_time
+
+    def __repr__(self) -> str:
+        k = self.hrs.shape[0] if self.hrs.ndim >= 1 else "?"
+        return (
+            f"EquilibriumResultHetero(K={k}, ξ={_fmt(self.xi)}, "
+            f"bankrun={_fmt(self.bankrun)}, status={_fmt(self.status)}, "
+            f"solve_time={_fmt(self.solve_time, 3)}s)"
+        )
 
 
 @struct.dataclass

@@ -163,6 +163,16 @@ class AgentSimResult:
     # the advertised 10^6-agent scale under default x32).
     agent_steps: int = struct.field(pytree_node=False, default=0)
 
+    def __repr__(self) -> str:
+        from sbr_tpu.models.results import _fmt
+
+        return (
+            f"AgentSimResult(N={self.informed.shape[-1]}, "
+            f"steps={self.t_grid.shape[-1]}, "
+            f"final_G={_fmt(self.informed_frac[..., -1], 4)}, "
+            f"final_AW={_fmt(self.withdrawn_frac[..., -1], 4)})"
+        )
+
 
 def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
     return informed & (t >= t_inf + exit_delay) & (t < t_inf + reentry_delay)

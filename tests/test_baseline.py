@@ -153,3 +153,27 @@ def test_aw_at_xi_equals_kappa():
     ls = solve_learning(m.learning)
     aw_at_xi = float(ls.cdf_at(res.xi) - ls.cdf_at(jnp.minimum(res.tau_bar_in_unc, res.xi)))
     assert abs(aw_at_xi - m.economic.kappa) < 1e-9
+
+
+def test_repr_and_solve_time():
+    """Results print one readable line and carry wall-clock solve_time
+    (reference `Base.show` + `SolvedModel.solve_time`, `solver.jl:116-129,414`)."""
+    m = make_model_params()
+    res = _solve_jax(m)
+    r = repr(res)
+    assert "\n" not in r and "EquilibriumResult(" in r and "bankrun=True" in r
+    assert res.solve_time > 0
+    # vmapped (batched) results must not blow up the repr either
+    ls = solve_learning(m.learning)
+    import jax
+
+    from sbr_tpu.baseline.solver import solve_equilibrium_core
+
+    e = m.economic
+    batched = jax.vmap(
+        lambda u: solve_equilibrium_core(
+            ls, u, e.p, e.kappa, e.lam, e.eta, ls.grid[-1], SolverConfig()
+        )
+    )(jnp.linspace(0.05, 0.15, 3))
+    rb = repr(batched)
+    assert "\n" not in rb and "(3,)" in rb

@@ -73,6 +73,32 @@ class TestSocialFixedPoint:
         assert not bool(res.aborted)
         assert 2 <= int(res.iterations) <= 500
 
+    def test_history_telemetry(self, solved):
+        """The error/ξ iteration ring (VERDICT r3 #7): filled for exactly the
+        iterations that ran, errors broadly contracting (damped fixed point:
+        monotone-ish, allow transient bumps), final entries consistent."""
+        _, res = solved
+        err, xi = res.history()
+        n = min(int(res.iterations), res.history_err.shape[-1])
+        assert len(err) == n == len(xi)
+        assert np.isfinite(err[1:]).all() and np.isfinite(xi).all()
+        # contraction: the last error is far below the first finite one, and
+        # at least ~2/3 of consecutive steps decrease the error
+        first = err[1] if not np.isfinite(err[0]) else err[0]
+        assert err[-1] < first * 0.1
+        dec_frac = np.mean(np.diff(err[1:]) < 0)
+        assert dec_frac > 0.6, dec_frac
+        assert err[-1] == pytest.approx(float(res.error))
+        assert xi[-1] == pytest.approx(float(res.xi))
+        # solve_time stamped by the host entry
+        assert res.solve_time > 0
+
+    def test_repr_one_line(self, solved):
+        _, res = solved
+        r = repr(res)
+        assert "\n" not in r and "SocialFixedPointResult(" in r
+        assert "iterations=" in r and "converged=True" in r
+
     def test_vs_oracle(self, solved):
         m, res = solved
         ora = solve_social_oracle(
