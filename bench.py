@@ -108,8 +108,11 @@ def _run_killable(argv, timeout_s: float) -> tuple:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
-                pass
-            proc.wait()
+                proc.kill()  # killpg can fail (pgid race); kill the child itself
+            try:
+                proc.wait(timeout=30.0)  # never wait unbounded — that IS the bug
+            except subprocess.TimeoutExpired:
+                _log("child unreapable after SIGKILL; abandoning (zombie)")
             rc = None
         dur = time.perf_counter() - t0
         fout.seek(0)

@@ -39,7 +39,7 @@ def _stamp_solve_time(res, t0: float):
     where a host clock is meaningless and blocking is illegal."""
     if isinstance(res.xi, jax.core.Tracer):
         return res
-    jax.block_until_ready(res.xi)
+    jax.block_until_ready(res)  # the WHOLE pytree — curves dispatch after xi
     return res.replace(solve_time=time.perf_counter() - t0)
 
 

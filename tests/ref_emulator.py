@@ -64,6 +64,54 @@ def _linterp(grid, values):
     return lambda t: np.interp(t, grid, values)
 
 
+def _hazard_reference(grid, pdf, p, lam, eta):
+    """`hazard_rate` (`solver.jl:153-185`): the pdf's grid cut at η (η
+    appended), sequential trapezoid of e^{λt}g(t) (np.cumsum accumulates the
+    same increments in the same order as the reference's loop, so the
+    floating-point result is identical), vectorized HR on the grid.
+    Returns (tau_bar, hr_values)."""
+    tau_bar = grid[grid <= eta]
+    if len(tau_bar) == 0 or tau_bar[-1] != eta:
+        tau_bar = np.append(tau_bar, eta)
+    eg_vals = np.exp(lam * tau_bar) * pdf(tau_bar)
+    increments = 0.5 * (eg_vals[:-1] + eg_vals[1:]) * np.diff(tau_bar)
+    int_cum = np.concatenate([[0.0], np.cumsum(increments)])
+    int_eta = int_cum[-1]
+    hr_values = (p * np.exp(lam * tau_bar) * pdf(tau_bar)) / (
+        p * int_cum + (1.0 - p) * int_eta
+    )
+    return tau_bar, hr_values
+
+
+def _optimal_buffer_reference(u, tau_bar, hr_values, tspan_end):
+    """`optimal_buffer` (`solver.jl:211-264`): boolean scan, first-↑/last-↓
+    crossing by linear interpolation, with the exact boundary-case ladder."""
+    above = hr_values > u
+    if not above.any():
+        return tspan_end, tspan_end
+    if above.all():
+        return tau_bar[0], tau_bar[-1]
+    tau_in_unc = tspan_end
+    for i in range(len(tau_bar) - 1):
+        if not above[i] and above[i + 1]:
+            t1, t2 = tau_bar[i], tau_bar[i + 1]
+            h1, h2 = hr_values[i], hr_values[i + 1]
+            tau_in_unc = t1 + (u - h1) * (t2 - t1) / (h2 - h1)
+            break
+    tau_out_unc = tspan_end
+    for i in range(len(tau_bar) - 2, -1, -1):
+        if above[i] and not above[i + 1]:
+            t1, t2 = tau_bar[i], tau_bar[i + 1]
+            h1, h2 = hr_values[i], hr_values[i + 1]
+            tau_out_unc = t1 + (u - h1) * (t2 - t1) / (h2 - h1)
+            break
+    if tau_in_unc == tspan_end and above.any():
+        tau_in_unc = tau_bar[np.argmax(above)]
+    if tau_out_unc == tspan_end and above.any():
+        tau_out_unc = tau_bar[len(above) - 1 - np.argmax(above[::-1])]
+    return tau_in_unc, tau_out_unc
+
+
 @functools.lru_cache(maxsize=256)
 def solve_reference_baseline(
     beta: float = 1.0,
@@ -108,49 +156,12 @@ def solve_reference_baseline(
     pdf = _linterp(grid, pdf_vals)
 
     # --- Stage 2: hazard on the inherited grid (solver.jl:153-185) -------
-    tau_bar = grid[grid <= eta]
-    if len(tau_bar) == 0 or tau_bar[-1] != eta:
-        tau_bar = np.append(tau_bar, eta)
-
-    def eg(t):
-        return np.exp(lam * t) * pdf(t)
-
-    # the reference's sequential trapezoid loop (solver.jl:172-175):
-    # np.cumsum accumulates the same increments in the same order, so the
-    # floating-point result is identical to the loop
-    eg_vals = eg(tau_bar)
-    increments = 0.5 * (eg_vals[:-1] + eg_vals[1:]) * np.diff(tau_bar)
-    int_cum = np.concatenate([[0.0], np.cumsum(increments)])
-    int_eta = int_cum[-1]
-    hr_values = (p * np.exp(lam * tau_bar) * pdf(tau_bar)) / (
-        p * int_cum + (1.0 - p) * int_eta
-    )
+    tau_bar, hr_values = _hazard_reference(grid, pdf, p, lam, eta)
 
     # --- Stage 2: optimal buffer (solver.jl:211-264) ---------------------
-    above = hr_values > u
-    if not above.any():
-        tau_in_unc = tau_out_unc = tspan_end
-    elif above.all():
-        tau_in_unc, tau_out_unc = tau_bar[0], tau_bar[-1]
-    else:
-        tau_in_unc = tspan_end
-        for i in range(len(tau_bar) - 1):
-            if not above[i] and above[i + 1]:
-                t1, t2 = tau_bar[i], tau_bar[i + 1]
-                h1, h2 = hr_values[i], hr_values[i + 1]
-                tau_in_unc = t1 + (u - h1) * (t2 - t1) / (h2 - h1)
-                break
-        tau_out_unc = tspan_end
-        for i in range(len(tau_bar) - 2, -1, -1):
-            if above[i] and not above[i + 1]:
-                t1, t2 = tau_bar[i], tau_bar[i + 1]
-                h1, h2 = hr_values[i], hr_values[i + 1]
-                tau_out_unc = t1 + (u - h1) * (t2 - t1) / (h2 - h1)
-                break
-        if tau_in_unc == tspan_end and above.any():
-            tau_in_unc = tau_bar[np.argmax(above)]
-        if tau_out_unc == tspan_end and above.any():
-            tau_out_unc = tau_bar[len(above) - 1 - np.argmax(above[::-1])]
+    tau_in_unc, tau_out_unc = _optimal_buffer_reference(
+        u, tau_bar, hr_values, tspan_end
+    )
 
     # --- Stage 3: bisection (solver.jl:308-376) --------------------------
     if tau_in_unc == tau_out_unc:  # u above max(HR): trivial no-run
@@ -213,3 +224,204 @@ def _compute_xi_reference(tau_in_unc, tau_out_unc, grid, cdf, kappa, max_iters=1
             xi_min = xi_old
             xi_new = 0.5 * (xi_old + xi_max)
     return np.nan, False
+
+
+@dataclasses.dataclass
+class RefHeteroSolution:
+    """Scalars the reference's `SolvedModelHetero` would carry."""
+
+    xi: float
+    tau_in_uncs: np.ndarray  # (K,)
+    tau_out_uncs: np.ndarray  # (K,)
+    bankrun: bool
+    grid: np.ndarray
+
+
+@functools.lru_cache(maxsize=64)
+def solve_reference_hetero(
+    betas: tuple,
+    dist: tuple,
+    x0: float = 1e-4,
+    u: float = 0.1,
+    p: float = 0.9,
+    kappa: float = 0.3,
+    lam: float = 0.1,
+    eta_bar: float = 30.0,
+    rtol: float = 3e-14,
+) -> RefHeteroSolution:
+    """The reference's heterogeneity pipeline, step for step:
+
+    - coupled K-ODE dG_k = (1-G_k)·β_k·ω, ω = Σ dist_j·G_j, adaptive grid
+      (`heterogeneity_learning.jl:49-94`); pdfs symbolic from the rhs;
+    - per-group hazard on the SHARED grid (`heterogeneity_solver.jl:255`,
+      grid=lr.grid) and per-group buffers via the baseline scan;
+    - `compute_ξ_hetero` (`heterogeneity_solver.jl:48-144`): weighted-AW
+      bisection from the dist-weighted midpoint guess over [0, 2·max τ̄_OUT],
+      ABSOLUTE tolerance 1e-12, max 500 iterations, shared-grid slope
+      epsilon, plus `is_valid_equilibrium_hetero`'s backward first-crossing
+      scan (`:175-210`) on convergence.
+    """
+    betas = np.asarray(betas, float)
+    dist = np.asarray(dist, float)
+    k = len(betas)
+    beta_avg = float(np.sum(dist * betas))
+    eta = eta_bar / beta_avg
+    tspan_end = 2.0 * eta
+
+    def rhs(t, g):
+        omega = np.sum(dist * g)
+        return (1.0 - g) * betas * omega
+
+    max_step = max(2e-3 / beta_avg, tspan_end / 20000.0)
+    sol = solve_ivp(
+        rhs, (0.0, tspan_end), [x0] * k, method="RK45",
+        rtol=rtol, atol=1e-16, max_step=max_step,
+    )
+    grid = sol.t
+    cdf_vals = sol.y  # (K, n)
+    omega_vals = dist @ cdf_vals
+    pdf_vals = (1.0 - cdf_vals) * betas[:, None] * omega_vals[None, :]
+    cdfs = [_linterp(grid, cdf_vals[j]) for j in range(k)]
+    pdfs = [_linterp(grid, pdf_vals[j]) for j in range(k)]
+
+    tau_in_uncs = np.zeros(k)
+    tau_out_uncs = np.zeros(k)
+    for j in range(k):
+        tau_bar, hr_values = _hazard_reference(grid, pdfs[j], p, lam, eta)
+        tau_in_uncs[j], tau_out_uncs[j] = _optimal_buffer_reference(
+            u, tau_bar, hr_values, tspan_end
+        )
+
+    if np.all(tau_in_uncs == tau_out_uncs):
+        return RefHeteroSolution(np.nan, tau_in_uncs, tau_out_uncs, False, grid)
+
+    xi, ok = _compute_xi_hetero_reference(
+        tau_in_uncs, tau_out_uncs, dist, cdfs, grid, kappa
+    )
+    return RefHeteroSolution(float(xi), tau_in_uncs, tau_out_uncs, bool(ok), grid)
+
+
+def _compute_xi_hetero_reference(
+    tau_in_uncs, tau_out_uncs, dist, cdfs, grid, kappa, max_iters=500, tol=1e-12
+):
+    """`compute_ξ_hetero` (`heterogeneity_solver.jl:48-144`) line by line."""
+    k = len(dist)
+    xi_new = float(np.sum(dist * (tau_in_uncs + tau_out_uncs) / 2.0))
+    xi_min, xi_max = 0.0, float(np.max(tau_out_uncs)) * 2.0
+    for it in range(1, max_iters + 1):
+        if abs(xi_min - xi_max) < 2.0 * np.spacing(abs(xi_min - xi_max)):
+            return np.nan, False
+        if it == max_iters - 1:
+            return np.nan, False
+        xi_old = xi_new
+        idx = np.searchsorted(grid, xi_old, side="right") - 1
+        eps = grid[min(idx + 1, len(grid) - 1)] - grid[idx]
+        aw = aw_eps = 0.0
+        for j in range(k):
+            tin = min(tau_in_uncs[j], xi_old)
+            tout = min(tau_out_uncs[j], xi_old)
+            aw += dist[j] * (cdfs[j](tout) - cdfs[j](tin))
+            aw_eps += dist[j] * (cdfs[j](tout + eps) - cdfs[j](tin + eps))
+        err = aw - kappa
+        if abs(err) <= tol:
+            if aw_eps >= aw:
+                if not _is_valid_equilibrium_hetero_reference(
+                    xi_old, tau_in_uncs, cdfs, grid, kappa, dist
+                ):
+                    return np.nan, False
+                return xi_old, True
+            return np.nan, False
+        if err > 0:
+            xi_max = xi_old
+            xi_new = 0.5 * (xi_old + xi_min)
+        else:
+            xi_min = xi_old
+            xi_new = 0.5 * (xi_old + xi_max)
+    return np.nan, False
+
+
+def _is_valid_equilibrium_hetero_reference(xi_star, tau_in_uncs, cdfs, grid, kappa, dist):
+    """`is_valid_equilibrium_hetero` (`heterogeneity_solver.jl:175-210`):
+    backward scan of AW(t; ξ*) for a ↓crossing of κ before ξ*."""
+    g = grid[grid <= xi_star]
+    if len(g) == 0:
+        return True
+    aw_path = np.zeros(len(g))
+    for j in range(len(dist)):
+        tau_i = max(0.0, xi_star - tau_in_uncs[j])
+        aw_path += dist[j] * (cdfs[j](g) - cdfs[j](np.maximum(0.0, g - tau_i)))
+    above = aw_path > kappa
+    for i in range(len(g) - 2, -1, -1):
+        if above[i] and not above[i + 1]:
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class RefInterestSolution:
+    """Scalars the reference's `SolvedModelInterest` would carry."""
+
+    xi: float
+    tau_in_unc: float
+    tau_out_unc: float
+    bankrun: bool
+    v0: float  # V at τ̄=0 (the boundary value)
+
+
+@functools.lru_cache(maxsize=64)
+def solve_reference_interest(
+    beta: float = 1.0,
+    x0: float = 1e-4,
+    u: float = 0.0,
+    p: float = 0.5,
+    kappa: float = 0.6,
+    lam: float = 0.01,
+    eta: float = 15.0,
+    r: float = 0.06,
+    delta: float = 0.1,
+    tspan_end: float | None = None,
+    rtol: float = 3e-14,
+) -> RefInterestSolution:
+    """The reference's interest-rate pipeline (`interest_rate_solver.jl:51-150`):
+    baseline hazard, the HJB V′(τ̄)=(h+δ)(1−V)+max(u+rV−h,0) with boundary
+    V(0)=(u+δ)/(r+δ) solved adaptively against the LINEAR-INTERPOLATED
+    hazard and saved on HR's grid (`value_function_solver.jl:66-112`),
+    effective hazard h−rV, then the baseline buffers/ξ machinery unchanged.
+    """
+    tspan_end = 2.0 * eta if tspan_end is None else tspan_end
+    base = solve_reference_baseline(
+        beta=beta, x0=x0, u=u, p=p, kappa=kappa, lam=lam, eta=eta,
+        tspan_end=tspan_end, rtol=rtol,
+    )
+    tau_bar, hr_values = base.hr_grid, base.hr_values
+    hr_interp = _linterp(tau_bar, hr_values)
+    v0 = (u + delta) / (r + delta)
+
+    def hjb(t, v):
+        h = hr_interp(t)
+        return (h + delta) * (1.0 - v) + np.maximum(u + r * v - h, 0.0)
+
+    sol = solve_ivp(
+        hjb, (0.0, tau_bar[-1]), [v0], method="RK45",
+        rtol=rtol, atol=1e-16, t_eval=tau_bar,
+        max_step=max(2e-3 / beta, tau_bar[-1] / 20000.0),
+    )
+    v_values = sol.y[0]
+    h_eff = hr_values - r * v_values
+
+    tau_in_unc, tau_out_unc = _optimal_buffer_reference(
+        u, tau_bar, h_eff, tspan_end
+    )
+    if tau_in_unc == tau_out_unc:
+        return RefInterestSolution(np.nan, tau_in_unc, tau_out_unc, False, v0)
+    # baseline ξ machinery on the word-of-mouth CDF (`interest_rate_solver.jl:122`)
+    sol1 = solve_ivp(
+        lambda t, y: beta * y * (1.0 - y), (0.0, tspan_end), [x0],
+        method="RK45", rtol=rtol, atol=1e-16,
+        max_step=max(2e-3 / beta, tspan_end / 20000.0),
+    )
+    cdf = _linterp(sol1.t, sol1.y[0])
+    xi, bankrun = _compute_xi_reference(tau_in_unc, tau_out_unc, sol1.t, cdf, kappa)
+    return RefInterestSolution(
+        float(xi), float(tau_in_unc), float(tau_out_unc), bool(bankrun), v0
+    )

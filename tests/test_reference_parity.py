@@ -155,3 +155,54 @@ class TestNoRunFrontier:
                 disagreements.append(float(u))
         # any residual disagreement must hug the frontier (≈ 0.1091953)
         assert all(abs(u - 0.1091953) < 5e-6 for u in disagreements), disagreements
+
+
+class TestExtensionParity:
+    """The hetero and interest extensions against emulations of the
+    reference's own extension algorithms (`ref_emulator.solve_reference_hetero`
+    / `solve_reference_interest`) at the script calibrations
+    (`scripts/2_heterogeneity.jl:38-49`, `scripts/3_interest_rates.jl:37-46`).
+    Tolerances are looser than baseline because the hetero path is
+    grid-backed (no closed form) on BOTH sides."""
+
+    def test_hetero_script_calibration(self):
+        from ref_emulator import solve_reference_hetero
+
+        from sbr_tpu.hetero import solve_equilibrium_hetero, solve_learning_hetero
+        from sbr_tpu.models.params import make_hetero_params
+
+        ref = solve_reference_hetero((0.125, 12.5), (0.9, 0.1))
+        m = make_hetero_params(
+            betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0,
+            u=0.1, p=0.9, kappa=0.3, lam=0.1,
+        )
+        config = SolverConfig()
+        res = solve_equilibrium_hetero(
+            solve_learning_hetero(m.learning, config), m.economic, config
+        )
+        assert bool(res.bankrun) == ref.bankrun
+        assert float(res.xi) == pytest.approx(ref.xi, abs=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(res.tau_bar_in_uncs), ref.tau_in_uncs, atol=5e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.tau_bar_out_uncs), ref.tau_out_uncs, atol=5e-5
+        )
+
+    def test_interest_script_calibration(self):
+        from ref_emulator import solve_reference_interest
+
+        from sbr_tpu.interest import solve_equilibrium_interest
+        from sbr_tpu.models.params import make_interest_params
+
+        ref = solve_reference_interest()
+        m = make_interest_params(u=0.0, r=0.06, delta=0.1)
+        config = SolverConfig()
+        res = solve_equilibrium_interest(
+            solve_learning(m.learning, config), m.economic, config
+        )
+        assert bool(res.base.bankrun) == ref.bankrun
+        assert float(res.base.xi) == pytest.approx(ref.xi, abs=1e-6)
+        assert float(res.base.tau_bar_in_unc) == pytest.approx(ref.tau_in_unc, abs=1e-6)
+        assert float(res.base.tau_bar_out_unc) == pytest.approx(ref.tau_out_unc, abs=1e-6)
+        assert float(res.v[0]) == pytest.approx(ref.v0, abs=1e-9)
