@@ -1,0 +1,90 @@
+"""Quantify the closure's O(dt) bias by dt-halving (VERDICT r3 weak #6).
+
+`social/closure.py` documents two O(dt) biases (informed times rounded up
+to step ends; forcing frozen per step) and the tests assert convergence in
+N — but convergence in dt was never measured. This script runs the
+equilibrium→agent closure at a fixed population and halving step sizes,
+averaging several seeds per dt so Monte-Carlo noise (~1/√(N·reps)) sits
+well under the dt trend, and fits err(dt) ≈ a + b·dt.
+
+If the closure errors are dominated by the documented O(dt) rounding, the
+fitted slope b is positive and the dt→0 intercept a lands near the O(x0)
+offset floor (~1e-4, also documented). A flat curve would instead mean the
+tolerances are eating something else — worth knowing either way.
+
+Run: python benchmarks/dt_convergence.py [n_agents] [n_reps]
+  SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    if os.environ.get("SBR_ABL_PLATFORM", "") == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import numpy as np
+
+    from sbr_tpu.social.closure import close_loop
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_reps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    platform = jax.devices()[0].platform
+    dts = [0.2, 0.1, 0.05, 0.025]
+    print(f"platform={platform} N={n} reps/dt={n_reps} dts={dts}")
+
+    rows = []
+    fp = None
+    for dt in dts:
+        errs_rms, errs_sup = [], []
+        for rep in range(n_reps):
+            c = close_loop(
+                n_agents=n, dt=dt, n_reps=1, seed=100 + rep, fp=fp
+            )
+            fp = c.fp  # solve the fixed point once; reuse across dt/seed
+            # use the closure's OWN error metrics so this calibration can
+            # never drift from what the test suite asserts
+            errs_rms.append(float(c.err_aw_rms))
+            errs_sup.append(float(c.err_aw_sup))
+        row = {
+            "dt": dt,
+            "rms_mean": float(np.mean(errs_rms)),
+            "rms_std": float(np.std(errs_rms)),
+            "sup_mean": float(np.mean(errs_sup)),
+        }
+        rows.append(row)
+        print(
+            f"  dt={dt:6.3f}: AW rms = {row['rms_mean']:.5f} ± {row['rms_std']:.5f}, "
+            f"sup = {row['sup_mean']:.5f}"
+        )
+
+    x = np.array([r["dt"] for r in rows])
+    y = np.array([r["rms_mean"] for r in rows])
+    b, a = np.polyfit(x, y, 1)
+    print(f"fit: err(dt) ≈ {a:.5f} + {b:.5f}·dt  (intercept = dt→0 floor)")
+
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        payload = {
+            "platform": platform,
+            "n_agents": n,
+            "n_reps": n_reps,
+            "rows": rows,
+            "fit_intercept": float(a),
+            "fit_slope": float(b),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,7 @@ class RefSolution:
     bankrun: bool
     aw_max: float
     grid: np.ndarray  # the adaptive Stage-1 grid (the root of inheritance)
+    g_values: np.ndarray  # G on that grid (so callers reuse the same CDF)
     hr_grid: np.ndarray
     hr_values: np.ndarray
 
@@ -188,6 +189,7 @@ def solve_reference_baseline(
         bankrun=bool(bankrun),
         aw_max=aw_max,
         grid=grid,
+        g_values=g_vals,
         hr_grid=tau_bar,
         hr_values=hr_values,
     )
@@ -414,14 +416,11 @@ def solve_reference_interest(
     )
     if tau_in_unc == tau_out_unc:
         return RefInterestSolution(np.nan, tau_in_unc, tau_out_unc, False, v0)
-    # baseline ξ machinery on the word-of-mouth CDF (`interest_rate_solver.jl:122`)
-    sol1 = solve_ivp(
-        lambda t, y: beta * y * (1.0 - y), (0.0, tspan_end), [x0],
-        method="RK45", rtol=rtol, atol=1e-16,
-        max_step=max(2e-3 / beta, tspan_end / 20000.0),
-    )
-    cdf = _linterp(sol1.t, sol1.y[0])
-    xi, bankrun = _compute_xi_reference(tau_in_unc, tau_out_unc, sol1.t, cdf, kappa)
+    # baseline ξ machinery on the word-of-mouth CDF
+    # (`interest_rate_solver.jl:122`), reusing the base solve's exact grid
+    # and G values — the same inheritance the reference gets for free
+    cdf = _linterp(base.grid, base.g_values)
+    xi, bankrun = _compute_xi_reference(tau_in_unc, tau_out_unc, base.grid, cdf, kappa)
     return RefInterestSolution(
         float(xi), float(tau_in_unc), float(tau_out_unc), bool(bankrun), v0
     )
