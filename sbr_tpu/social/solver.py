@@ -105,8 +105,14 @@ class _LoopState:
 
 
 @functools.lru_cache(maxsize=None)
-def _build_fixed_point(config: SolverConfig, tol: float, max_iter: int, damping: float):
-    """Jitted fixed-point program, cached per static numerics config."""
+def _build_fixed_point(
+    config: SolverConfig, tol: float, max_iter: int, damping: float, verbose: bool = False
+):
+    """Jitted fixed-point program, cached per static numerics config.
+
+    ``verbose`` streams one line per iteration from INSIDE the on-device
+    while_loop via `jax.debug.print` — the reference's verbose threading
+    (`social_learning_solver.jl:124-241`) without leaving the device."""
 
     @jax.jit
     def run(beta, x0, u, p, kappa, lam, eta, grid):
@@ -132,6 +138,11 @@ def _build_fixed_point(config: SolverConfig, tol: float, max_iter: int, damping:
             conv = jnp.logical_and(err < tol_, ~exceeded)
             aw_next = jnp.where(conv, aw_new, (1.0 - alpha) * s.aw + alpha * aw_new)
             aw_next = jnp.where(exceeded, s.aw, aw_next)
+            if verbose:
+                jax.debug.print(
+                    "[social fp] iter {i}: err={e:.3e} xi={x:.6f} bankrun={b}",
+                    i=s.it + 1, e=err, x=xi_new, b=res.bankrun,
+                )
             slot = jnp.mod(s.it, HISTORY_LEN)
             return _LoopState(
                 aw=aw_next,
@@ -186,6 +197,7 @@ def solve_equilibrium_social(
     max_iter: int = 250,
     damping: float = 0.5,
     dtype=None,
+    verbose: bool = False,
 ) -> SocialFixedPointResult:
     """Solve the social-learning equilibrium
     (`solve_equilibrium_social_learning`, `social_learning_solver.jl:63`).
@@ -204,7 +216,9 @@ def solve_equilibrium_social(
     econ = model.economic
     eta = econ.eta
     grid = jnp.linspace(jnp.zeros((), dtype), jnp.asarray(eta, dtype), config.n_grid)
-    run = _build_fixed_point(config, float(tol), int(max_iter), float(damping))
+    run = _build_fixed_point(
+        config, float(tol), int(max_iter), float(damping), bool(verbose)
+    )
     t0 = time.perf_counter()
     res = run(
         jnp.asarray(model.learning.beta, dtype),

@@ -60,3 +60,44 @@ def test_stretch_emits_contract_json():
     assert extra["policy"]["policy_eq_per_sec"] > 0
     phases = [h for h in extra["probe_history"] if h.get("phase") == "measure"]
     assert phases and phases[-1]["outcome"] == "ok"
+
+
+def test_run_killable_survives_pipe_holding_grandchild():
+    """The observed tunnel failure mode: the probe child spawns a helper
+    that inherits stdout and outlives a SIGKILL to the child alone —
+    subprocess.run(capture_output=True) then blocks in communicate()
+    forever (the watch daemon froze 100 min this way). `_run_killable`
+    must return at ~timeout regardless, because (a) output goes to temp
+    files, not pipes, and (b) the kill hits the whole process group."""
+    import sys
+    import time
+
+    import bench
+
+    child = (
+        "import subprocess, sys, time\n"
+        # grandchild inherits stdout and sleeps far past every timeout
+        "subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(600)'])\n"
+        "print('CHILD UP', flush=True)\n"
+        "time.sleep(600)\n"  # the child itself also hangs
+    )
+    t0 = time.perf_counter()
+    # 10 s start budget: interpreter + nested Popen must land 'CHILD UP'
+    # before the kill even on a loaded CI host (2 s flaked under load)
+    rc, out, err, dur = bench._run_killable([sys.executable, "-c", child], 10.0)
+    wall = time.perf_counter() - t0
+    assert rc is None  # timed out
+    assert wall < 40.0, f"parent blocked {wall:.0f}s — the pipe hang is back"
+    assert "CHILD UP" in out  # pre-kill output still captured via the file
+
+
+def test_run_killable_captures_fast_child():
+    import sys
+
+    import bench
+
+    rc, out, err, dur = bench._run_killable(
+        [sys.executable, "-c", "print('OK'); import sys; print('E', file=sys.stderr)"],
+        30.0,
+    )
+    assert rc == 0 and out.strip() == "OK" and err.strip() == "E"

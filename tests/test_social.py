@@ -587,3 +587,16 @@ def test_plot_agent_closure_builds_figure():
     import matplotlib.pyplot as plt
 
     plt.close(fig)
+
+
+class TestVerboseFixedPoint:
+    def test_verbose_streams_iterations(self, capfd):
+        """The reference threads `verbose` through its solver and prints
+        per-iteration error/ξ (`social_learning_solver.jl:124-241`); here
+        the same telemetry streams from inside the device while_loop."""
+        m = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+        res = solve_equilibrium_social(m, SolverConfig(n_grid=512), verbose=True)
+        jax.effects_barrier()
+        out = capfd.readouterr().out
+        assert "[social fp] iter 1:" in out
+        assert f"iter {int(res.iterations)}" in out
