@@ -424,3 +424,110 @@ def solve_reference_interest(
     return RefInterestSolution(
         float(xi), float(tau_in_unc), float(tau_out_unc), bool(bankrun), v0
     )
+
+
+@dataclasses.dataclass
+class RefSocialSolution:
+    """What the reference's social fixed point returns (the last inner
+    `SolvedModel`) plus the loop metadata it prints but drops."""
+
+    xi: float
+    bankrun: bool
+    converged: bool
+    iterations: int
+    error: float
+
+
+@functools.lru_cache(maxsize=16)
+def solve_reference_social(
+    beta: float = 0.9,
+    x0: float = 1e-4,
+    u: float = 0.5,
+    p: float = 0.99,
+    kappa: float = 0.25,
+    lam: float = 0.25,
+    eta_bar: float = 30.0,
+    tol: float = 1e-4,
+    max_iter: int = 500,
+    rtol: float = 3e-14,
+) -> RefSocialSolution:
+    """The reference's social-learning fixed point
+    (`social_learning_solver.jl:63-263`), iteration for iteration:
+
+    - tspan overridden to (0, η); AW⁽⁰⁾ = the baseline word-of-mouth CDF;
+    - per iteration: the forced ODE dG = (1−G)·β·AW⁽ⁿ⁻¹⁾(t) on an adaptive
+      grid (`social_learning_dynamics.jl:58-78`), pdf symbolic from the
+      rhs, then the FULL baseline Stage-2/3 on that grid;
+    - inner no-run: ξ⁽ⁿ⁾ = ξ⁽ⁿ⁻¹⁾ + η/500, aborting past η;
+    - convergence: sup-norm of the UNDAMPED candidate vs the previous AW on
+      a fixed 1000-point comparison grid; else damp α = 0.5 ON THE CDF GRID.
+    """
+    eta = eta_bar / beta
+    max_step = max(2e-3 / beta, eta / 20000.0)
+    grid_comp = np.linspace(0.0, eta, 1000)
+
+    # init: word-of-mouth baseline learning (`:90-94`)
+    sol0 = solve_ivp(
+        lambda t, y: beta * y * (1.0 - y), (0.0, eta), [x0],
+        method="RK45", rtol=rtol, atol=1e-16, max_step=max_step,
+    )
+    aw_old = _linterp(sol0.t, sol0.y[0])
+
+    xi_new = 0.0
+    converged = False
+    last = (np.nan, False)
+    it = 0
+    err = np.inf
+    for it in range(1, max_iter + 1):
+        xi_old = xi_new
+        # (a) forced learning from withdrawals
+        sol = solve_ivp(
+            lambda t, y: (1.0 - y) * beta * aw_old(t), (0.0, eta), [x0],
+            method="RK45", rtol=rtol, atol=1e-16, max_step=max_step,
+        )
+        cdf_grid = sol.t
+        g_vals = sol.y[0]
+        cdf = _linterp(cdf_grid, g_vals)
+        pdf = _linterp(cdf_grid, (1.0 - g_vals) * beta * aw_old(cdf_grid))
+
+        # (b) full baseline Stage 2/3 on the inherited grid
+        tau_bar, hr_values = _hazard_reference(cdf_grid, pdf, p, lam, eta)
+        tin, tout = _optimal_buffer_reference(u, tau_bar, hr_values, eta)
+        if tin == tout:
+            xi, bankrun = np.nan, False
+        else:
+            xi, bankrun = _compute_xi_reference(tin, tout, cdf_grid, cdf, kappa)
+        last = (xi, bankrun)
+
+        # (c) candidate AW via get_AW on HR's grid (`:164,198`)
+        if not bankrun:
+            xi_new = xi_old + eta / 500.0
+            if xi_new > eta:
+                break  # aborted (`:155-160`)
+        else:
+            xi_new = xi
+        tin_con = min(tin, xi_new)
+        tout_con = min(tout, xi_new)
+        sh_in = tau_bar - xi_new + tin_con
+        sh_out = tau_bar - xi_new + tout_con
+        aw_in = np.where(sh_in >= 0, cdf(np.maximum(sh_in, 0.0)), 0.0)
+        aw_out = np.where(sh_out >= 0, cdf(np.maximum(sh_out, 0.0)), 0.0)
+        aw_new = _linterp(tau_bar, aw_out - aw_in + cdf(0.0))
+
+        # (d) convergence on the UNDAMPED candidate (`:168-171,202-203`)
+        err = float(np.max(np.abs(aw_new(grid_comp) - aw_old(grid_comp))))
+        if err < tol:
+            converged = True
+            break
+        # (e) damp on the CDF grid (`:183-187,222-227`)
+        damped = 0.5 * aw_old(cdf_grid) + 0.5 * aw_new(cdf_grid)
+        aw_old = _linterp(cdf_grid, damped)
+
+    xi_final, bankrun_final = last
+    return RefSocialSolution(
+        xi=float(xi_final),
+        bankrun=bool(bankrun_final),
+        converged=bool(converged),
+        iterations=it,
+        error=err,
+    )

@@ -206,3 +206,22 @@ class TestExtensionParity:
         assert float(res.base.tau_bar_in_unc) == pytest.approx(ref.tau_in_unc, abs=1e-6)
         assert float(res.base.tau_bar_out_unc) == pytest.approx(ref.tau_out_unc, abs=1e-6)
         assert float(res.v[0]) == pytest.approx(ref.v0, abs=1e-9)
+
+
+class TestSocialParity:
+    def test_social_script_calibration(self):
+        """The social fixed point against the reference's own damped
+        iteration (`ref_emulator.solve_reference_social`) at the Figure-12
+        calibration. Both sides stop at the same sup-norm tolerance
+        (1e-4 on AW), so ξ agreement is bounded by the fixed point's own
+        stopping width (|Δξ| ≲ tol/g(ξ) ≈ 1e-3), not by grid numerics."""
+        from ref_emulator import solve_reference_social
+
+        from sbr_tpu.social.solver import solve_equilibrium_social
+
+        ref = solve_reference_social()
+        m = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+        res = solve_equilibrium_social(m, SolverConfig(n_grid=4096), tol=1e-4, max_iter=500)
+        assert ref.converged and bool(res.converged)
+        assert bool(res.equilibrium.bankrun) == ref.bankrun
+        assert float(res.xi) == pytest.approx(ref.xi, abs=2e-3)
