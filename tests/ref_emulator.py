@@ -463,7 +463,10 @@ def solve_reference_social(
       a fixed 1000-point comparison grid; else damp α = 0.5 ON THE CDF GRID.
     """
     eta = eta_bar / beta
-    max_step = max(2e-3 / beta, eta / 20000.0)
+    # coarser grid floor than the scalar-parity emulators: the fixed point
+    # is compared at its own 1e-4 stopping tolerance (ξ to ~1e-3), far
+    # above grid error, and this loop pays ~50 adaptive solves
+    max_step = max(2e-3 / beta, eta / 8000.0)
     grid_comp = np.linspace(0.0, eta, 1000)
 
     # init: word-of-mouth baseline learning (`:90-94`)
