@@ -11,15 +11,18 @@ extracting one bit per edge with no HBM round-trip per element.
 
 This script isolates exactly that unit (bit extraction per edge; the
 surrounding prefix-sum + row-pointer machinery of `_seg_counts` is ~4 ms
-and not in question) and measures four variants at the production shape:
+and not in question) and measures five variants at the production shape:
 
-  xla_bool_gather    wd[src] on an unpacked bool mask (the production wall)
-  xla_bit_gather     packed[src>>3] gather + shift/mask (8x smaller table)
-  pallas_bit_gather  the VMEM-resident Pallas kernel, one grid step per
-                     edge block, mask block-spec'd to stay resident
-  pallas_bool_gather same kernel on the unpacked (1 byte/agent) mask —
-                     1 MB at 10^6 agents, still VMEM-resident; separates
-                     "VMEM residency" from "bit-unpacking arithmetic"
+  xla_bool_gather      wd[src] on an unpacked bool mask (the production wall)
+  xla_bit_gather       packed[src>>3] gather + shift/mask (8x smaller table)
+  pallas_bit_gather    the VMEM-resident Pallas kernel, one grid step per
+                       edge block, mask block-spec'd to stay resident
+  pallas_bit_gather_2d the same kernel with edge blocks shaped
+                       (EDGE_BLOCK/128, 128) — Mosaic's native lane layout,
+                       the fallback if the 1-D form fails to lower
+  pallas_bool_gather   the kernel on the unpacked (1 byte/agent) mask —
+                       1 MB at 10^6 agents, still VMEM-resident; separates
+                       "VMEM residency" from "bit-unpacking arithmetic"
 
 Outputs are asserted IDENTICAL to the XLA reference before any timing
 (the recount semantics of `social/agents.py::_seg_counts` — an edge is
@@ -49,13 +52,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 EDGE_BLOCK = 1 << 17  # 131072 edges per grid step
 
 
-def _build_pallas_gather(n_mask: int, e_pad: int, interpret: bool, packed: bool):
+def _build_pallas_gather(
+    n_mask: int, e_pad: int, interpret: bool, packed: bool, two_d: bool = False
+):
     """pallas_call computing active[e] = bit src_e of the mask.
 
     The mask (packed uint8 bits, or unpacked uint8 bools) is block-spec'd
     with a constant index map, so it is DMA'd to VMEM once and stays
     resident across all E/EDGE_BLOCK grid steps; each step streams one
     src-id block in and one activity block out.
+
+    ``two_d`` reshapes the edge blocks to (EDGE_BLOCK/128, 128) — Mosaic's
+    native lane layout — as a fallback in case the 1-D form fails to lower
+    (the mask stays 1-D either way; `jnp.take` with 2-D indices from a 1-D
+    array yields the 2-D result directly). Callers reshape in/out.
     """
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -71,15 +81,22 @@ def _build_pallas_gather(n_mask: int, e_pad: int, interpret: bool, packed: bool)
             out_ref[:] = jnp.take(mask_ref[:], src, axis=0).astype(jnp.int32)
 
     grid = e_pad // EDGE_BLOCK
+    if two_d:
+        rows = EDGE_BLOCK // 128
+        edge_spec = pl.BlockSpec((rows, 128), lambda i: (i, 0))
+        out_shape = __import__("jax").ShapeDtypeStruct((e_pad // 128, 128), jnp.int32)
+    else:
+        edge_spec = pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,))
+        out_shape = __import__("jax").ShapeDtypeStruct((e_pad,), jnp.int32)
     return pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((n_mask,), lambda i: (0,)),  # resident mask
-            pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,)),
+            edge_spec,
         ],
-        out_specs=pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,)),
-        out_shape=__import__("jax").ShapeDtypeStruct((e_pad,), jnp.int32),
+        out_specs=edge_spec,
+        out_shape=out_shape,
         interpret=interpret,
     )
 
@@ -122,12 +139,20 @@ def main() -> None:
 
     pallas_bit = jax.jit(_build_pallas_gather(n8 // 8, e_pad, interpret, packed=True))
     pallas_bool = jax.jit(_build_pallas_gather(n8, e_pad, interpret, packed=False))
+    pallas_bit_2d = jax.jit(
+        _build_pallas_gather(n8 // 8, e_pad, interpret, packed=True, two_d=True)
+    )
+    src_2d = src_d.reshape(-1, 128)
 
     ref = np.asarray(xla_bool_gather(wd_d, src_d))
     variants = {
         "xla_bool_gather": lambda: xla_bool_gather(wd_d, src_d),
         "xla_bit_gather": lambda: xla_bit_gather(packed_d, src_d),
+        # NB: the 2d variant is timed WITHOUT the host-facing reshape (a
+        # relayout copy on TPU that no other variant pays); the
+        # correctness check reshapes once below
         "pallas_bit_gather": lambda: pallas_bit(packed_d, src_d),
+        "pallas_bit_gather_2d": lambda: pallas_bit_2d(packed_d, src_2d),
         "pallas_bool_gather": lambda: pallas_bool(wd_u8, src_d),
     }
     results = {}
@@ -138,7 +163,7 @@ def main() -> None:
             print(f"{name:>20}: FAILED to compile/run: {err!r}"[:300])
             results[name] = {"error": str(err)[:200]}
             continue
-        np.testing.assert_array_equal(out, ref, err_msg=name)
+        np.testing.assert_array_equal(out.reshape(-1), ref, err_msg=name)
         times = []
         for _ in range(5):
             t0 = time.perf_counter()
