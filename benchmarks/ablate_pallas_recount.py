@@ -67,6 +67,7 @@ def _build_pallas_gather(
     (the mask stays 1-D either way; `jnp.take` with 2-D indices from a 1-D
     array yields the 2-D result directly). Callers reshape in/out.
     """
+    import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -84,10 +85,10 @@ def _build_pallas_gather(
     if two_d:
         rows = EDGE_BLOCK // 128
         edge_spec = pl.BlockSpec((rows, 128), lambda i: (i, 0))
-        out_shape = __import__("jax").ShapeDtypeStruct((e_pad // 128, 128), jnp.int32)
+        out_shape = jax.ShapeDtypeStruct((e_pad // 128, 128), jnp.int32)
     else:
         edge_spec = pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,))
-        out_shape = __import__("jax").ShapeDtypeStruct((e_pad,), jnp.int32)
+        out_shape = jax.ShapeDtypeStruct((e_pad,), jnp.int32)
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -174,9 +175,12 @@ def main() -> None:
         print(f"{name:>20}: {best * 1e3:8.2f} ms  ({e_pad / best / 1e6:8.1f}M elem/s)")
 
     ok = [k for k, v in results.items() if "best_s" in v]
-    if "pallas_bit_gather" in ok and "xla_bool_gather" in ok:
-        sp = results["xla_bool_gather"]["best_s"] / results["pallas_bit_gather"]["best_s"]
-        print(f"pallas_bit speedup vs production gather: {sp:.2f}x")
+    # headline: the best pallas variant that actually lowered vs the wall
+    pallas_ok = [k for k in ok if k.startswith("pallas_bit")]
+    if pallas_ok and "xla_bool_gather" in ok:
+        best = min(pallas_ok, key=lambda k: results[k]["best_s"])
+        sp = results["xla_bool_gather"]["best_s"] / results[best]["best_s"]
+        print(f"{best} speedup vs production gather: {sp:.2f}x")
     out_path = os.environ.get("SBR_ABL_JSON", "")
     if out_path:
         payload = {

@@ -449,7 +449,10 @@ def solve_reference_social(
     eta_bar: float = 30.0,
     tol: float = 1e-4,
     max_iter: int = 500,
-    rtol: float = 3e-14,
+    # ~50 adaptive solves at rtol 3e-14 cost 140+ s for a fixed point whose
+    # own stopping tolerance is 1e-4; 1e-10 keeps Stage-1 fidelity 4+
+    # orders below the comparison tolerance at ~5x fewer RK steps
+    rtol: float = 1e-10,
 ) -> RefSocialSolution:
     """The reference's social-learning fixed point
     (`social_learning_solver.jl:63-263`), iteration for iteration:
@@ -463,10 +466,12 @@ def solve_reference_social(
       a fixed 1000-point comparison grid; else damp α = 0.5 ON THE CDF GRID.
     """
     eta = eta_bar / beta
-    # coarser grid floor than the scalar-parity emulators: the fixed point
-    # is compared at its own 1e-4 stopping tolerance (ξ to ~1e-3), far
-    # above grid error, and this loop pays ~50 adaptive solves
-    max_step = max(2e-3 / beta, eta / 8000.0)
+    # much coarser grid floor than the scalar-parity emulators: the fixed
+    # point is compared at its own 1e-4 stopping tolerance (ξ to ~1e-3);
+    # grid interp error at h = η/2000 is ~1e-5, far below that, and this
+    # loop pays ~50 adaptive solves (measured: the η/20000 floor cost 138 s
+    # of test time for a ξ identical to 6 decimals)
+    max_step = max(2e-3 / beta, eta / 2000.0)
     grid_comp = np.linspace(0.0, eta, 1000)
 
     # init: word-of-mouth baseline learning (`:90-94`)
