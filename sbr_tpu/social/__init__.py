@@ -16,7 +16,9 @@ from sbr_tpu.social.solver import SocialFixedPointResult, solve_equilibrium_soci
 from sbr_tpu.social.agents import (
     AgentSimConfig,
     AgentSimResult,
+    PreparedAgentGraph,
     erdos_renyi_edges,
+    prepare_agent_graph,
     scale_free_edges,
     simulate_agents,
 )
@@ -28,7 +30,9 @@ __all__ = [
     "solve_equilibrium_social",
     "AgentSimConfig",
     "AgentSimResult",
+    "PreparedAgentGraph",
     "erdos_renyi_edges",
+    "prepare_agent_graph",
     "scale_free_edges",
     "simulate_agents",
     "LoopComparison",

@@ -178,6 +178,27 @@ def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
     return informed & (t >= t_inf + exit_delay) & (t < t_inf + reentry_delay)
 
 
+def _draw_seeds(rng, n: int, x0: float, exact_seeds: bool) -> np.ndarray:
+    """Initial informed mask — the ONE definition of the seed draw order
+    (shared by `_prep_inputs` and `simulate_agents`, whose bit-identical
+    prepared-vs-direct guarantee depends on it)."""
+    if exact_seeds:
+        # Deterministic seed COUNT (exactly round(x0·n), ≥1 when x0>0): the
+        # Bernoulli draw's binomial fluctuation in the number of initially
+        # informed agents dominates the early stochastic-growth phase when
+        # x0·n is O(1) — killing it makes the ODE comparison converge in N
+        # (used by social.closure, the equilibrium→agent validation loop).
+        k = max(1, int(round(x0 * n))) if x0 > 0 else 0
+        informed0 = np.zeros(n, bool)
+        if k:
+            informed0[rng.choice(n, size=k, replace=False)] = True
+        return informed0
+    informed0 = rng.random(n) < x0
+    if x0 > 0 and not informed0.any():  # guarantee ≥1 seed when x0>0
+        informed0[rng.integers(0, n)] = True
+    return informed0
+
+
 def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_seeds: bool = False):
     """Host-side canonicalization: per-agent β, in-degrees, dst-sorted edges
     with their row-pointer table, initial seeds.
@@ -197,21 +218,7 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_see
     src, dst, indeg_i, row_ptr = sort_edges_by_dst(src, dst, n)
     indeg = indeg_i.astype(dtype)
     row_ptr = row_ptr.astype(np.int32)
-    rng = np.random.default_rng(seed)
-    if exact_seeds:
-        # Deterministic seed COUNT (exactly round(x0·n), ≥1 when x0>0): the
-        # Bernoulli draw's binomial fluctuation in the number of initially
-        # informed agents dominates the early stochastic-growth phase when
-        # x0·n is O(1) — killing it makes the ODE comparison converge in N
-        # (used by social.closure, the equilibrium→agent validation loop).
-        k = max(1, int(round(x0 * n))) if x0 > 0 else 0
-        informed0 = np.zeros(n, bool)
-        if k:
-            informed0[rng.choice(n, size=k, replace=False)] = True
-    else:
-        informed0 = rng.random(n) < x0
-        if x0 > 0 and not informed0.any():  # guarantee ≥1 seed when x0>0
-            informed0[rng.integers(0, n)] = True
+    informed0 = _draw_seeds(np.random.default_rng(seed), n, x0, exact_seeds)
     return betas, src, dst, indeg, row_ptr, informed0
 
 
@@ -668,11 +675,207 @@ def _sharded_incremental_sim(
     return fn
 
 
-def simulate_agents(
+@dataclasses.dataclass(frozen=True)
+class PreparedAgentGraph:
+    """Device-resident graph structures, reusable across simulations.
+
+    Everything about a simulate_agents call that does NOT depend on the
+    seed / initial state: the dst-sorted edge arrays and row-pointer
+    tables, per-agent β and in-degrees, the engine choice and its
+    out-edge structures, mesh padding and shard tables — already uploaded
+    (and sharded, when a mesh is given). Building this costs two O(E)
+    host sorts plus ~100 MB of H2D at the 10⁷-edge north-star shape —
+    several seconds that a per-call API pays on EVERY run; repeated
+    simulations on one graph (benchmark reps, closure seed-averaging,
+    policy studies) should pay it once via ``prepare_agent_graph`` and
+    pass ``prepared=`` to ``simulate_agents``.
+    """
+
+    n: int
+    n_gl: int  # padded agent count (== n without a mesh)
+    n_pad: int
+    n_edges: int  # TRUE edge count (the device src array may carry pad edges)
+    dtype: object  # np.dtype of the simulation floats
+    mesh: Optional[Mesh]
+    mesh_axis: str
+    comm: str
+    engine: str  # resolved: "gather" or "incremental"
+    budget: int
+    max_degree: int
+    # device arrays (sharded over mesh_axis when mesh is not None)
+    betas: object
+    src: object  # dst-sorted edge sources (padded/sharded under a mesh)
+    row_ptr: object
+    indeg: object
+    inc: Optional[tuple]  # engine-specific extra arrays, engine="incremental"
+
+
+def prepare_agent_graph(
     betas,
     src,
     dst,
     n: int,
+    config: AgentSimConfig = AgentSimConfig(),
+    mesh: Optional[Mesh] = None,
+    mesh_axis: str = "agents",
+    dtype=np.float32,
+    comm: str = "scatter",
+    engine: str = "auto",
+    incremental_budget: Optional[int] = None,
+    incremental_max_degree: int = 64,
+) -> PreparedAgentGraph:
+    """Host-side canonicalization + upload, factored out of simulate_agents.
+
+    ``config`` enters only through the engine="auto" census (n_steps and dt
+    set the expected fallback rate); the prepared graph is reusable with
+    any config whose engine choice you are happy to keep.
+    """
+    dtype = np.dtype(dtype)
+    if engine not in ("auto", "gather", "incremental"):
+        raise ValueError(f"Unknown engine {engine!r}")
+    if comm not in ("scatter", "allgather_psum"):
+        raise ValueError(f"Unknown comm strategy {comm!r}")
+    from sbr_tpu.native import sort_edges_by_dst
+
+    betas_h = np.broadcast_to(np.asarray(betas, dtype=dtype), (n,)).copy()
+    src_h, dst_h, indeg_i, row_ptr_h = sort_edges_by_dst(src, dst, n)
+    indeg_h = indeg_i.astype(dtype)
+    row_ptr_h = row_ptr_h.astype(np.int32)
+
+    if engine == "auto":
+        if len(src_h) == 0:
+            engine = "gather"
+        else:
+            # the census needs only out-degrees (and their cumsum under a
+            # mesh) — an O(E) bincount, NOT the full edge re-sort, which is
+            # deferred to the branch that actually runs incremental
+            outdeg_c = np.bincount(src_h, minlength=n).astype(np.int64)
+            if mesh is None:
+                census = outdeg_c
+                budget_est = incremental_budget or min(max(4096, n // 64), 65536)
+            else:
+                # edge-count sharding splits hub edges across chunks, and the
+                # per-device change budget multiplies across devices — census
+                # and budget are both the per-device effective values
+                n_dev_a = mesh.shape[mesh_axis]
+                ec_a = max(1, -(-len(src_h) // n_dev_a))
+                out_ptr_c = np.concatenate([[0], np.cumsum(outdeg_c)])
+                census = _max_chunk_slice(out_ptr_c, ec_a, n)
+                nb_a = -(-n // n_dev_a)
+                budget_est = (
+                    incremental_budget or min(max(512, nb_a // 64), 65536)
+                ) * n_dev_a
+            engine = _auto_engine(
+                census,
+                incremental_max_degree,
+                config.n_steps,
+                n,
+                float(np.mean(betas_h)),
+                config.dt,
+                int(budget_est),
+            )
+    if engine == "incremental" and len(src_h) == 0:
+        # the incremental kernel's dense out-edge grid cannot gather from an
+        # empty edge array; the gather kernel handles E = 0 fine
+        engine = "gather"
+
+    if mesh is None:
+        if engine == "incremental":
+            # out-edge structure: the same edge multiset re-sorted by SOURCE
+            # (dst2[e] = destination of the e-th src-sorted edge).
+            dst2_h, _, outdeg_h, out_ptr_h = sort_edges_by_dst(dst_h, src_h, n)
+            budget = incremental_budget
+            if budget is None:
+                budget = min(max(4096, n // 64), 65536)
+            inc = (
+                jnp.asarray(dst2_h),
+                jnp.asarray(out_ptr_h.astype(np.int32)),
+                jnp.asarray(outdeg_h),
+            )
+        else:
+            budget, inc = 0, None
+        return PreparedAgentGraph(
+            n=n, n_gl=n, n_pad=0, n_edges=len(src_h), dtype=dtype, mesh=None,
+            mesh_axis=mesh_axis, comm=comm, engine=engine, budget=int(budget),
+            max_degree=int(incremental_max_degree),
+            betas=jnp.asarray(betas_h), src=jnp.asarray(src_h),
+            row_ptr=jnp.asarray(row_ptr_h), indeg=jnp.asarray(indeg_h), inc=inc,
+        )
+
+    n_dev = mesh.shape[mesh_axis]
+    # agents: pad to a multiple of n_dev with inert agents (β=0, uninformed,
+    # degree 0); aggregates normalize by the true N. The "scatter" path —
+    # and the incremental engine, whose overflow fallback is the bitpacked
+    # recount — additionally need each local block byte-aligned for packing.
+    block = 8 * n_dev if (comm == "scatter" or engine == "incremental") else n_dev
+    n_pad = (-n) % block
+    if n_pad:
+        betas_h = np.concatenate([betas_h, np.zeros(n_pad, betas_h.dtype)])
+        indeg_h = np.concatenate([indeg_h, np.zeros(n_pad, indeg_h.dtype)])
+    # edges arrive dst-sorted (contiguous destination ranges per shard); pad
+    # with sentinel dst = N_padded (an extra segment dropped in the kernel).
+    n_gl = n + n_pad
+    src_h0, dst_h0 = src_h, dst_h  # unpadded, for the out-edge structure
+    e_pad = (-len(src_h)) % n_dev
+    if e_pad:
+        src_h = np.concatenate([src_h, np.zeros(e_pad, np.int32)])
+        dst_h = np.concatenate([dst_h, np.full(e_pad, n_gl, np.int32)])
+    # Per-shard row-pointer tables over the global segment ids (plus the pad
+    # segment): each shard's edge chunk is dst-sorted, so its pointers are a
+    # searchsorted over that chunk.
+    e_local = len(dst_h) // n_dev
+    seg_ids = np.arange(n_gl + 2)
+    row_ptrs_h = np.stack(
+        [
+            np.searchsorted(dst_h[d * e_local : (d + 1) * e_local], seg_ids, side="left")
+            for d in range(n_dev)
+        ]
+    ).astype(np.int32)
+
+    shard = NamedSharding(mesh, P(mesh_axis))
+    put = lambda a: jax.device_put(jnp.asarray(a), shard)
+    if engine == "incremental":
+        # Out-edges sharded BY EDGE COUNT: the src-sorted edge array is cut
+        # into exact E/n_dev chunks (sentinel destination n_gl pads the tail
+        # into the delta dump slot); per-device (local_start, local_deg)
+        # tables map every global agent to its slice inside each chunk, so
+        # hub edges split across chunks instead of skewing any padding.
+        nb = n_gl // n_dev
+        dst2_all, _, _, out_ptr_all = sort_edges_by_dst(dst_h0, src_h0, n)
+        e_all = int(out_ptr_all[-1])
+        ec = max(1, -(-e_all // n_dev))
+        dst2_sh = np.full(n_dev * ec, n_gl, np.int32)
+        dst2_sh[:e_all] = dst2_all
+        starts = out_ptr_all[:-1].astype(np.int64)
+        ends = out_ptr_all[1:].astype(np.int64)
+        lstart_h = np.zeros((n_dev, n_gl), np.int32)
+        ldeg_h = np.zeros((n_dev, n_gl), np.int32)
+        for d in range(n_dev):
+            lo, hi = d * ec, (d + 1) * ec
+            s = np.clip(starts, lo, hi)
+            e_ = np.clip(ends, lo, hi)
+            lstart_h[d, :n] = (s - lo).astype(np.int32)
+            ldeg_h[d, :n] = (e_ - s).astype(np.int32)
+        budget = incremental_budget
+        if budget is None:
+            budget = min(max(512, nb // 64), 65536)
+        inc = (put(dst2_sh), put(lstart_h), put(ldeg_h))
+    else:
+        budget, inc = 0, None
+    return PreparedAgentGraph(
+        n=n, n_gl=n_gl, n_pad=n_pad, n_edges=len(src_h0), dtype=dtype, mesh=mesh,
+        mesh_axis=mesh_axis, comm=comm, engine=engine, budget=int(budget),
+        max_degree=int(incremental_max_degree),
+        betas=put(betas_h), src=put(src_h), row_ptr=put(row_ptrs_h),
+        indeg=put(indeg_h), inc=inc,
+    )
+
+
+def simulate_agents(
+    betas=None,
+    src=None,
+    dst=None,
+    n: Optional[int] = None,
     x0: float = 1e-4,
     config: AgentSimConfig = AgentSimConfig(),
     seed: int = 0,
@@ -686,6 +889,7 @@ def simulate_agents(
     engine: str = "auto",
     incremental_budget: Optional[int] = None,
     incremental_max_degree: int = 64,
+    prepared: Optional[PreparedAgentGraph] = None,
 ) -> AgentSimResult:
     """Simulate N explicit agents learning from neighbor withdrawals.
 
@@ -740,185 +944,79 @@ def simulate_agents(
     The simulation dtype defaults to float32: aggregates are O(1) means over
     ≥10^4 agents, where Monte-Carlo error dominates rounding by orders of
     magnitude — the f32 sweet spot for TPU (SURVEY §7.3 precision ladder).
+
+    ``prepared``: a `PreparedAgentGraph` from `prepare_agent_graph` — the
+    graph-side work (two O(E) host sorts, shard tables, ~100 MB H2D at the
+    north-star shape) is then skipped entirely and the graph-related
+    arguments (betas/src/dst/n/mesh/comm/engine/budgets/dtype) are ignored.
+    Results are BIT-IDENTICAL with or without ``prepared`` (the seed stream
+    is independent of graph preparation; tested).
     """
-    betas_h, src_h, dst_h, indeg_h, row_ptr_h, informed0_h = _prep_inputs(
-        n, betas, x0, src, dst, seed, np.dtype(dtype), exact_seeds
-    )
+    if prepared is None:
+        if betas is None or src is None or dst is None or n is None:
+            raise ValueError("simulate_agents needs (betas, src, dst, n) or prepared=")
+        prepared = prepare_agent_graph(
+            betas, src, dst, n, config=config, mesh=mesh, mesh_axis=mesh_axis,
+            dtype=dtype, comm=comm, engine=engine,
+            incremental_budget=incremental_budget,
+            incremental_max_degree=incremental_max_degree,
+        )
+    n = prepared.n
+    dtype_np = prepared.dtype
+
+    # per-call state: seeds and informed times (the ONLY seed-dependent host
+    # work — O(N), milliseconds; `_draw_seeds` is the single definition of
+    # the draw order, so prepared and direct calls match bit for bit)
     if informed0 is not None:
         informed0_h = np.ascontiguousarray(np.asarray(informed0, dtype=bool))
-    if t_inf0 is None:
-        t_init_h = np.zeros(n, dtype=np.dtype(dtype))
     else:
-        t_init_h = np.ascontiguousarray(np.asarray(t_inf0, dtype=np.dtype(dtype)))
+        informed0_h = _draw_seeds(np.random.default_rng(seed), n, x0, exact_seeds)
+    if t_inf0 is None:
+        t_init_h = np.zeros(n, dtype=dtype_np)
+    else:
+        t_init_h = np.ascontiguousarray(np.asarray(t_inf0, dtype=dtype_np))
     key = jax.random.PRNGKey(seed)
 
-    if engine not in ("auto", "gather", "incremental"):
-        raise ValueError(f"Unknown engine {engine!r}")
-    out_struct = None  # (dst2, src_sorted, outdeg, out_ptr), computed once
-    if engine == "auto":
-        if len(src_h) == 0:
-            engine = "gather"
-        else:
-            # the census needs only out-degrees (and their cumsum under a
-            # mesh) — an O(E) bincount, NOT the full edge re-sort, which is
-            # deferred to the branch that actually runs incremental
-            outdeg_c = np.bincount(src_h, minlength=n).astype(np.int64)
-            if mesh is None:
-                census = outdeg_c
-                budget_est = incremental_budget or min(max(4096, n // 64), 65536)
-            else:
-                # edge-count sharding splits hub edges across chunks, and the
-                # per-device change budget multiplies across devices — census
-                # and budget are both the per-device effective values
-                n_dev_a = mesh.shape[mesh_axis]
-                ec_a = max(1, -(-len(src_h) // n_dev_a))
-                out_ptr_c = np.concatenate([[0], np.cumsum(outdeg_c)])
-                census = _max_chunk_slice(out_ptr_c, ec_a, n)
-                nb_a = -(-n // n_dev_a)
-                budget_est = (
-                    incremental_budget or min(max(512, nb_a // 64), 65536)
-                ) * n_dev_a
-            engine = _auto_engine(
-                census,
-                incremental_max_degree,
-                config.n_steps,
-                n,
-                float(np.mean(betas_h)),
-                config.dt,
-                int(budget_est),
-            )
-    if engine == "incremental" and len(src_h) == 0:
-        # the incremental kernel's dense out-edge grid cannot gather from an
-        # empty edge array; the gather kernel handles E = 0 fine
-        engine = "gather"
-
-    if mesh is None:
-        if engine == "incremental":
-            from sbr_tpu.native import sort_edges_by_dst
-
-            # out-edge structure: the same edge multiset re-sorted by SOURCE
-            # (dst2[e] = destination of the e-th src-sorted edge).
-            if out_struct is None:
-                out_struct = sort_edges_by_dst(dst_h, src_h, n)
-            dst2_h, _, outdeg_h, out_ptr_h = out_struct
-            budget = incremental_budget
-            if budget is None:
-                budget = min(max(4096, n // 64), 65536)
-            run = _incremental_sim(config, int(budget), int(incremental_max_degree))
+    if prepared.mesh is None:
+        if prepared.engine == "incremental":
+            dst2_d, out_ptr_d, outdeg_d = prepared.inc
+            run = _incremental_sim(config, prepared.budget, prepared.max_degree)
             return run(
-                jnp.asarray(betas_h),
-                jnp.asarray(src_h),
-                jnp.asarray(row_ptr_h),
-                jnp.asarray(indeg_h),
-                jnp.asarray(dst2_h),
-                jnp.asarray(out_ptr_h.astype(np.int32)),
-                jnp.asarray(outdeg_h),
-                jnp.asarray(informed0_h),
-                jnp.asarray(t_init_h),
-                key,
+                prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
+                dst2_d, out_ptr_d, outdeg_d,
+                jnp.asarray(informed0_h), jnp.asarray(t_init_h), key,
             )
         run = _single_device_sim(config)
         return run(
-            jnp.asarray(betas_h),
-            jnp.asarray(src_h),
-            jnp.asarray(row_ptr_h),
-            jnp.asarray(indeg_h),
-            jnp.asarray(informed0_h),
-            jnp.asarray(t_init_h),
-            key,
+            prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
+            jnp.asarray(informed0_h), jnp.asarray(t_init_h), key,
         )
 
-    if comm not in ("scatter", "allgather_psum"):
-        raise ValueError(f"Unknown comm strategy {comm!r}")
-    n_dev = mesh.shape[mesh_axis]
-    # agents: pad to a multiple of n_dev with inert agents (β=0, uninformed,
-    # degree 0); aggregates normalize by the true N. The "scatter" path —
-    # and the incremental engine, whose overflow fallback is the bitpacked
-    # recount — additionally need each local block byte-aligned for packing.
-    block = 8 * n_dev if (comm == "scatter" or engine == "incremental") else n_dev
-    n_pad = (-n) % block
+    mesh = prepared.mesh
+    mesh_axis = prepared.mesh_axis
+    n_pad = prepared.n_pad
     if n_pad:
-        betas_h = np.concatenate([betas_h, np.zeros(n_pad, betas_h.dtype)])
-        indeg_h = np.concatenate([indeg_h, np.zeros(n_pad, indeg_h.dtype)])
         informed0_h = np.concatenate([informed0_h, np.zeros(n_pad, bool)])
         t_init_h = np.concatenate([t_init_h, np.zeros(n_pad, t_init_h.dtype)])
-    # edges arrive dst-sorted from _prep_inputs (contiguous destination
-    # ranges per shard); pad with sentinel dst = N_padded (an extra segment
-    # dropped inside the kernel).
-    n_gl = n + n_pad
-    src_h0, dst_h0 = src_h, dst_h  # unpadded, for the out-edge structure
-    e_pad = (-len(src_h)) % n_dev
-    if e_pad:
-        src_h = np.concatenate([src_h, np.zeros(e_pad, np.int32)])
-        dst_h = np.concatenate([dst_h, np.full(e_pad, n_gl, np.int32)])
-    # Per-shard row-pointer tables over the global segment ids (plus the pad
-    # segment): each shard's edge chunk is dst-sorted, so its pointers are a
-    # searchsorted over that chunk.
-    e_local = len(dst_h) // n_dev
-    seg_ids = np.arange(n_gl + 2)
-    row_ptrs_h = np.stack(
-        [
-            np.searchsorted(dst_h[d * e_local : (d + 1) * e_local], seg_ids, side="left")
-            for d in range(n_dev)
-        ]
-    ).astype(np.int32)
-
     shard = NamedSharding(mesh, P(mesh_axis))
     key_repl = jax.device_put(key, NamedSharding(mesh, P()))
-    if engine == "incremental":
-        from sbr_tpu.native import sort_edges_by_dst
-
-        # Out-edges sharded BY EDGE COUNT: the src-sorted edge array is cut
-        # into exact E/n_dev chunks (sentinel destination n_gl pads the tail
-        # into the delta dump slot); per-device (local_start, local_deg)
-        # tables map every global agent to its slice inside each chunk, so
-        # hub edges split across chunks instead of skewing any padding.
-        nb = n_gl // n_dev
-        if out_struct is None:
-            out_struct = sort_edges_by_dst(dst_h0, src_h0, n)
-        dst2_all, _, _, out_ptr_all = out_struct
-        e_all = int(out_ptr_all[-1])
-        ec = max(1, -(-e_all // n_dev))
-        dst2_sh = np.full(n_dev * ec, n_gl, np.int32)
-        dst2_sh[:e_all] = dst2_all
-        starts = out_ptr_all[:-1].astype(np.int64)
-        ends = out_ptr_all[1:].astype(np.int64)
-        lstart_h = np.zeros((n_dev, n_gl), np.int32)
-        ldeg_h = np.zeros((n_dev, n_gl), np.int32)
-        for d in range(n_dev):
-            lo, hi = d * ec, (d + 1) * ec
-            s = np.clip(starts, lo, hi)
-            e_ = np.clip(ends, lo, hi)
-            lstart_h[d, :n] = (s - lo).astype(np.int32)
-            ldeg_h[d, :n] = (e_ - s).astype(np.int32)
-        budget = incremental_budget
-        if budget is None:
-            budget = min(max(512, nb // 64), 65536)
+    informed0_d = jax.device_put(jnp.asarray(informed0_h), shard)
+    t_init_d = jax.device_put(jnp.asarray(t_init_h), shard)
+    if prepared.engine == "incremental":
+        dst2_sh, lstart_d, ldeg_d = prepared.inc
         fn = _sharded_incremental_sim(
-            config, mesh, mesh_axis, n, int(budget), int(incremental_max_degree)
+            config, mesh, mesh_axis, n, prepared.budget, prepared.max_degree
         )
-        args = [
-            jax.device_put(jnp.asarray(a), shard)
-            for a in (
-                betas_h,
-                src_h,
-                row_ptrs_h,
-                indeg_h,
-                dst2_sh,
-                lstart_h,
-                ldeg_h,
-                informed0_h,
-                t_init_h,
-            )
-        ]
-        gs, aws, informed, t_inf = fn(*args, key_repl)
+        gs, aws, informed, t_inf = fn(
+            prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
+            dst2_sh, lstart_d, ldeg_d, informed0_d, t_init_d, key_repl,
+        )
     else:
-        fn = _sharded_sim(config, mesh, mesh_axis, n, comm)
-        args = [
-            jax.device_put(jnp.asarray(a), shard)
-            for a in (betas_h, src_h, row_ptrs_h, indeg_h, informed0_h, t_init_h)
-        ]
-        gs, aws, informed, t_inf = fn(*args, key_repl)
+        fn = _sharded_sim(config, mesh, mesh_axis, n, prepared.comm)
+        gs, aws, informed, t_inf = fn(
+            prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
+            informed0_d, t_init_d, key_repl,
+        )
     if n_pad:
         # The padding trim [:n] is not shard-aligned; all-gather the final
         # per-agent state (output-only, O(N) bytes) so the slice is local.
