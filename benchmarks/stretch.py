@@ -41,7 +41,12 @@ def _log(msg: str) -> None:
 def stretch_agents(n: int = 1_000_000, n_steps: int = 200) -> dict:
     import numpy as np
 
-    from sbr_tpu.social import AgentSimConfig, scale_free_edges, simulate_agents
+    from sbr_tpu.social import (
+        AgentSimConfig,
+        prepare_agent_graph,
+        scale_free_edges,
+        simulate_agents,
+    )
 
     import bench
 
@@ -56,9 +61,14 @@ def stretch_agents(n: int = 1_000_000, n_steps: int = 200) -> dict:
     src, dst = scale_free_edges(n, avg_degree=10.0, gamma=2.5, seed=0)
     _log(f"scale-free graph: {len(src)} edges in {time.perf_counter() - t0:.1f}s")
     cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+    t0 = time.perf_counter()
+    pg = prepare_agent_graph(betas, src, dst, n, config=cfg)
+    _log(
+        f"graph prepared (engine={pg.engine}) in {time.perf_counter() - t0:.1f}s"
+    )
 
     def run(seed: int) -> float:
-        res = simulate_agents(betas, src, dst, n, x0=1e-4, config=cfg, seed=seed)
+        res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=seed)
         return float(res.informed_frac[-1])  # device→host fence
 
     t0 = time.perf_counter()

@@ -199,9 +199,11 @@ def _draw_seeds(rng, n: int, x0: float, exact_seeds: bool) -> np.ndarray:
     return informed0
 
 
-def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_seeds: bool = False):
+def _canonicalize_graph(betas, src, dst, n: int, dtype):
     """Host-side canonicalization: per-agent β, in-degrees, dst-sorted edges
-    with their row-pointer table, initial seeds.
+    with their row-pointer table — the ONE definition shared by
+    `prepare_agent_graph` and `_prep_inputs` (whose consumers include the
+    ablation benchmark asserting the production layout).
 
     Edges are sorted by destination so the per-step neighbor aggregation is
     a segmented reduction over contiguous edge ranges. On TPU that is
@@ -209,15 +211,19 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_see
     NOT `segment_sum`, whose scatter-add lowering serializes on TPU
     (measured ~200 ms/step at 10^7 edges vs ~ms for the cumsum form).
     ``row_ptr[i]`` is the first edge index with dst ≥ i, so edges of agent i
-    occupy [row_ptr[i], row_ptr[i+1])."""
+    occupy [row_ptr[i], row_ptr[i+1]). The sort is the native O(E+N)
+    counting sort when the compiled library is available, numpy argsort
+    otherwise (same stable order either way)."""
     from sbr_tpu.native import sort_edges_by_dst
 
     betas = np.broadcast_to(np.asarray(betas, dtype=dtype), (n,)).copy()
-    # Native O(E+N) counting sort when the compiled library is available,
-    # numpy argsort otherwise (same stable order either way).
     src, dst, indeg_i, row_ptr = sort_edges_by_dst(src, dst, n)
-    indeg = indeg_i.astype(dtype)
-    row_ptr = row_ptr.astype(np.int32)
+    return betas, src, dst, indeg_i.astype(dtype), row_ptr.astype(np.int32)
+
+
+def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_seeds: bool = False):
+    """Canonicalized graph + initial seeds (see `_canonicalize_graph`)."""
+    betas, src, dst, indeg, row_ptr = _canonicalize_graph(betas, src, dst, n, dtype)
     informed0 = _draw_seeds(np.random.default_rng(seed), n, x0, exact_seeds)
     return betas, src, dst, indeg, row_ptr, informed0
 
@@ -675,7 +681,7 @@ def _sharded_incremental_sim(
     return fn
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)  # eq=False: fields are device arrays
 class PreparedAgentGraph:
     """Device-resident graph structures, reusable across simulations.
 
@@ -686,9 +692,11 @@ class PreparedAgentGraph:
     (and sharded, when a mesh is given). Building this costs two O(E)
     host sorts plus ~100 MB of H2D at the 10⁷-edge north-star shape —
     several seconds that a per-call API pays on EVERY run; repeated
-    simulations on one graph (benchmark reps, closure seed-averaging,
-    policy studies) should pay it once via ``prepare_agent_graph`` and
-    pass ``prepared=`` to ``simulate_agents``.
+    simulations on ONE graph (benchmark reps, seed studies on a fixed
+    network) should pay it once via ``prepare_agent_graph`` and pass
+    ``prepared=`` to ``simulate_agents``. (Workloads that redraw the
+    graph per repetition — e.g. `closure.close_loop`'s rep averaging,
+    where graph randomness is part of the Monte-Carlo — gain nothing.)
     """
 
     n: int
@@ -737,10 +745,9 @@ def prepare_agent_graph(
         raise ValueError(f"Unknown comm strategy {comm!r}")
     from sbr_tpu.native import sort_edges_by_dst
 
-    betas_h = np.broadcast_to(np.asarray(betas, dtype=dtype), (n,)).copy()
-    src_h, dst_h, indeg_i, row_ptr_h = sort_edges_by_dst(src, dst, n)
-    indeg_h = indeg_i.astype(dtype)
-    row_ptr_h = row_ptr_h.astype(np.int32)
+    betas_h, src_h, dst_h, indeg_h, row_ptr_h = _canonicalize_graph(
+        betas, src, dst, n, dtype
+    )
 
     if engine == "auto":
         if len(src_h) == 0:
