@@ -101,3 +101,44 @@ def test_run_killable_captures_fast_child():
         30.0,
     )
     assert rc == 0 and out.strip() == "OK" and err.strip() == "E"
+
+
+def test_persist_capture_writes_accelerator_artifact(tmp_path, monkeypatch):
+    """The watch-daemon/harness persist path: accelerator results land as
+    timestamped driver-format JSON; CPU results and tiny smoke runs do not
+    (this machinery is the round's TPU evidence chain — a silent bug here
+    loses the capture)."""
+    import bench
+
+    monkeypatch.delenv("SBR_BENCH_SIZES", raising=False)
+    monkeypatch.setattr(bench, "_benchmarks_dir", lambda: tmp_path)
+    res = {"metric": "m", "value": 1.5, "unit": "x", "extra": {"platform": "tpu"}}
+    bench._persist_capture(res)
+    files = list(tmp_path.glob("BENCH_tpu_auto_*.json"))
+    assert len(files) == 1
+    import json
+
+    assert json.loads(files[0].read_text())["value"] == 1.5
+    bench._persist_capture({"extra": {"platform": "cpu"}})  # not a capture
+    monkeypatch.setenv("SBR_BENCH_SIZES", "tiny")
+    bench._persist_capture(res)  # tiny smoke runs are not captures either
+    assert len(list(tmp_path.glob("*.json"))) == 1
+    # and the attempt log appends one line per (non-tiny) logged attempt
+    monkeypatch.delenv("SBR_BENCH_SIZES")
+    bench._log_capture_attempt({"script": "t", "outcome": "ok"})
+    log = tmp_path / "CAPTURE_LOG.jsonl"
+    assert log.exists() and len(log.read_text().splitlines()) == 1
+
+
+def test_budget_clamps_phase_timeouts():
+    """ADVICE r3 #3: every phase timeout shrinks to the remaining budget so
+    a hung tunnel cannot burn a ~107-minute worst case."""
+    import bench
+
+    b = bench._Budget()
+    b.total_s = 100.0
+    assert b.clamp(50.0) == 50.0
+    assert b.clamp(1000.0) <= 100.0
+    b.t0 -= 200.0  # simulate 200 s elapsed: budget exhausted
+    assert b.clamp(1000.0) == 30.0  # the floor keeps healthy children alive
+    assert b.clamp(1000.0, floor_s=60.0) == 60.0
