@@ -12,8 +12,6 @@ config).
 
 from __future__ import annotations
 
-import dataclasses
-
 import jax.numpy as jnp
 from flax import struct
 
@@ -60,13 +58,15 @@ def solve_equilibrium_interest_core(
     r = jnp.asarray(r, dtype=dtype)
     nan = jnp.asarray(jnp.nan, dtype=dtype)
 
-    # The HJB scan and V's interp_uniform both assume uniform spacing, so
-    # the interest path pins the hazard grid uniform (grid_warp is a
-    # high-β sweep concern; policy sweeps stay at moderate β).
-    if config.grid_warp > 0.0:
-        config = dataclasses.replace(config, grid_warp=0.0)
+    # The hazard grid may be warped (transition-resolving, round-4 high-β
+    # fix); both the HJB scan (non-uniform RK4 intervals + searchsorted
+    # hazard interp) and V's evaluator below follow the grid, so a high-β
+    # (β,u,r) policy sweep resolves the logistic transition exactly like
+    # the baseline sweep does. ``warped`` is static (config is concrete at
+    # trace time), so the uniform fast path costs nothing when warp is off.
+    warped = config.grid_warp > 0.0 and ls.closed_form
     tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
-    v = solve_value_function(tau_grid, hr, delta, r, u, config)
+    v = solve_value_function(tau_grid, hr, delta, r, u, config, uniform=not warped)
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
 
     # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`).
@@ -82,9 +82,13 @@ def solve_equilibrium_interest_core(
         hazard_at = _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config)
         t0 = tau_grid[0]
         dt = tau_grid[1] - tau_grid[0]
+        if warped:
+            v_at = lambda tau: jnp.interp(tau, tau_grid, v)
+        else:
+            v_at = lambda tau: interp_uniform(tau, t0, dt, v)
 
         def hazard_eff_at(tau):
-            return hazard_at(tau) - r * interp_uniform(tau, t0, dt, v)
+            return hazard_at(tau) - r * v_at(tau)
 
     tau_in_unc, tau_out_unc = optimal_buffer(
         u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at

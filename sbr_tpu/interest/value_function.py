@@ -23,12 +23,18 @@ from sbr_tpu.core.ode import rk4
 from sbr_tpu.models.params import SolverConfig
 
 
-def solve_value_function(tau_grid, hr, delta, r, u, config: SolverConfig = SolverConfig()):
+def solve_value_function(
+    tau_grid, hr, delta, r, u, config: SolverConfig = SolverConfig(), uniform: bool = True
+):
     """Integrate the HJB forward in τ̄ over ``tau_grid``; returns V samples.
 
-    ``hr`` are hazard samples on the same (uniform) grid; inside RK4 substeps
-    the hazard is evaluated by linear interpolation — the same resolution the
-    reference's interpolant provides (`value_function_solver.jl:89`).
+    ``hr`` are hazard samples on the same grid; inside RK4 substeps the hazard
+    is evaluated by linear interpolation — the same resolution the reference's
+    interpolant provides (`value_function_solver.jl:89`). ``uniform=False``
+    switches to searchsorted interpolation for warped (transition-resolving)
+    grids; `core.ode.rk4` already takes non-uniform save intervals, so the
+    scan itself needs no change. The flag must be a static Python bool — the
+    caller knows it from ``config.grid_warp`` before tracing.
     """
     dtype = hr.dtype
     delta = jnp.asarray(delta, dtype=dtype)
@@ -37,10 +43,15 @@ def solve_value_function(tau_grid, hr, delta, r, u, config: SolverConfig = Solve
     t0 = tau_grid[0]
     dt = tau_grid[1] - tau_grid[0]
 
+    if uniform:
+        hr_at = lambda t: interp_uniform(t, t0, dt, hr)
+    else:
+        hr_at = lambda t: jnp.interp(t, tau_grid, hr)
+
     v0 = (u + delta) / (r + delta)  # boundary at crash (`value_function_solver.jl:77,101`)
 
     def rhs(t, v, _):
-        h = interp_uniform(t, t0, dt, hr)
+        h = hr_at(t)
         reentry = jnp.maximum(u + r * v - h, 0.0)
         return (h + delta) * (1.0 - v) + reentry
 

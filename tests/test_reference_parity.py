@@ -207,6 +207,36 @@ class TestExtensionParity:
         assert float(res.base.tau_bar_out_unc) == pytest.approx(ref.tau_out_unc, abs=1e-6)
         assert float(res.v[0]) == pytest.approx(ref.v0, abs=1e-9)
 
+    def test_interest_extreme_beta(self):
+        """VERDICT r4 task 3: the interest path at β ≫ n_grid/η — the regime
+        where a uniform grid swallows the 1/β-wide logistic transition. The
+        solver no longer pins grid_warp=0 (round-4's silent config rewrite):
+        the HJB integrates over the warped grid (non-uniform RK4 intervals +
+        searchsorted hazard interp) and V's crossing interp follows the grid.
+        Oracle: the reference-numerics emulator (adaptive grid, like
+        `learning.jl:51` resolves any β). η is pinned at 15 (the heatmap's
+        copy-ctor convention) so the transition width 1/β ≈ 7.5e-4 is ~5x
+        under the uniform spacing η/n_grid."""
+        from ref_emulator import solve_reference_interest
+
+        from sbr_tpu.interest import solve_equilibrium_interest
+        from sbr_tpu.models.params import make_interest_params
+
+        beta = 2000.0
+        m = make_interest_params(
+            beta=beta, eta=15.0, u=0.1, r=0.06, delta=0.1, tspan=(0.0, 30.0)
+        )
+        config = SolverConfig()  # grid_warp 0.5 default, now honored
+        assert config.grid_warp > 0.0
+        ls = solve_learning(m.learning, config)
+        res = solve_equilibrium_interest(ls, m.economic, config)
+        ref = solve_reference_interest(
+            beta=beta, eta=15.0, u=0.1, r=0.06, delta=0.1, tspan_end=30.0
+        )
+        assert bool(res.base.bankrun) == ref.bankrun
+        assert float(res.base.xi) == pytest.approx(ref.xi, abs=1e-6)
+        assert float(res.base.tau_bar_out_unc) == pytest.approx(ref.tau_out_unc, abs=1e-6)
+
 
 class TestSocialParity:
     def test_social_script_calibration(self):
