@@ -96,6 +96,14 @@ def _warped_grid(eta, beta, x0, n, warp, dtype):
     return jnp.clip(grid, 0.0, eta).at[0].set(0.0).at[-1].set(eta)
 
 
+def hazard_grid_is_uniform(ls: LearningSolution, config: SolverConfig) -> bool:
+    """Whether `_hazard_parts` will build a uniform grid — the single source
+    of truth for callers that choose between uniform-stride and searchsorted
+    interpolation over that grid (the interest path's HJB and V evaluators).
+    Static: depends only on concrete config/solution metadata."""
+    return not (ls.closed_form and config.grid_warp > 0.0)
+
+
 def _hazard_parts(p, lam, ls: LearningSolution, eta, config: SolverConfig):
     """Hazard grid, values, and the cumulative normalization integral."""
     dtype = ls.cdf.dtype
@@ -105,7 +113,7 @@ def _hazard_parts(p, lam, ls: LearningSolution, eta, config: SolverConfig):
 
     if ls.closed_form:
         beta, x0 = ls.beta, ls.x0
-        if config.grid_warp > 0.0:
+        if not hazard_grid_is_uniform(ls, config):
             tau_grid = _warped_grid(eta, beta, x0, config.n_grid, config.grid_warp, dtype)
         else:
             tau_grid = jnp.linspace(jnp.zeros((), dtype), eta, config.n_grid)

@@ -24,6 +24,28 @@ def interp(x, xp, fp):
     return jnp.interp(x, xp, fp)
 
 
+def interp_shared(x, xp, fp):
+    """Linear interpolation of batched rows ``fp`` (..., n) sampled at SHARED
+    sorted knots ``xp`` (n,), evaluated at ``x`` (scalar or any shape
+    broadcastable against the batch dims); clamps outside [xp[0], xp[-1]].
+
+    One searchsorted serves every row — the K-group learning family shares
+    one (possibly non-uniform, transition-warped) grid, like the reference's
+    groups share the adaptive grid (`heterogeneity_learning.jl:73-89`).
+    Zero-width (duplicate) knots are guarded: the left value wins.
+    """
+    x = jnp.asarray(x)
+    n = xp.shape[0]
+    i0 = jnp.clip(jnp.searchsorted(xp, x, side="right") - 1, 0, n - 2)
+    x0 = xp[i0]
+    x1 = xp[i0 + 1]
+    denom = jnp.where(x1 > x0, x1 - x0, 1.0)
+    w = jnp.clip(((x - x0) / denom).astype(fp.dtype), 0.0, 1.0)
+    f0 = jnp.take(fp, i0, axis=-1)
+    f1 = jnp.take(fp, i0 + 1, axis=-1)
+    return f0 * (1.0 - w) + f1 * w
+
+
 def interp_uniform(x, t0, dt, fp):
     """Linear interpolation of ``fp`` sampled on the uniform grid t0 + i*dt.
 

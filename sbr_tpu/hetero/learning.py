@@ -18,8 +18,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from sbr_tpu.core.integrate import cumulative_gauss_legendre
 from sbr_tpu.core.ode import rk4
 from sbr_tpu.models.params import LearningParamsHetero, SolverConfig
 from sbr_tpu.models.results import LearningSolutionHetero
@@ -90,17 +92,181 @@ def solve_learning_hetero_arrays(
     )
 
 
+# ---------------------------------------------------------------------------
+# Exact path: the coupled K-ODE reduced to one scalar quadrature.
+#
+# Substituting Ω(t) = ∫₀ᵗ ω(s) ds into dG_k/dt = (1-G_k)·β_k·ω(t) gives
+# dG_k/dΩ = β_k·(1-G_k), i.e. the CLOSED FORM
+#
+#     G_k(Ω) = 1 - (1-x0)·e^{-β_k·Ω},
+#
+# and Ω itself satisfies the scalar autonomous ODE
+#
+#     dΩ/dt = ω(Ω) = Σ_j dist_j·G_j(Ω) = 1 - (1-x0)·Σ_j dist_j·e^{-β_j·Ω},
+#
+# which, being monotone, is solved by quadrature: t(Ω) = ∫₀^Ω dv/ω(v) with an
+# ANALYTIC integrand. This replaces the RK4 scan entirely and — decisive for
+# the β_k ≳ n_grid/η regime (VERDICT r4 task 4) — every group's transition is
+# exact in Ω-space; the grid only has to resolve the scalar map t(Ω), whose
+# knots are chosen below as the union of per-group G-quantile points (local
+# spacing ~1/(β_k·n) through EVERY group's transition, the same inverse-CDF
+# idea as `baseline/solver.py::_warped_grid`), log-spaced points through the
+# early exponential ramp (ω varies on the scale x0/⟨β⟩ near Ω=0), and
+# uniform-in-t points for the tail. The reference resolves the same structure
+# with its adaptive solver (`heterogeneity_learning.jl:73-74` at eps tol).
+# ---------------------------------------------------------------------------
+
+
+def _omega_of(betas, dist, x0):
+    """ω(Ω) evaluator, broadcasting over any Ω shape (K-sum on axis 0)."""
+
+    def omega(v):
+        v = jnp.asarray(v)
+        e = jnp.exp(-betas.reshape(betas.shape + (1,) * v.ndim) * v[None])
+        return 1.0 - (1.0 - x0) * jnp.sum(dist.reshape(dist.shape + (1,) * v.ndim) * e, axis=0)
+
+    return omega
+
+
+def _omega_knots(betas, dist, x0, omega_hi, n_q, n_log, dtype):
+    """Quantile + log knots on [0, omega_hi] (pins added by the caller).
+
+    Per-group quantile points place levels uniformly in each group's
+    informed share: G_k levels L → Ω = -ln((1-L)/(1-x0))/β_k, clustering
+    through every group's exponential knee at any β_k. When K exceeds the
+    ``n_q`` budget, a β-sorted stride subsample of the groups gets one
+    point each — nearby βs share transition regions, so log-spread
+    representatives keep every decade of β covered. Log points cover the
+    early ramp where ω grows from x0 on the Ω-scale x0/⟨β⟩. The output
+    size is static: ≤ n_q + n_log always.
+    """
+    # Fill the n_q budget with (group, level) pairs: groups round-robin over
+    # ≤ n_q β-sorted representatives, levels from a golden-ratio
+    # low-discrepancy sequence. This covers (0, 1] finely in AGGREGATE
+    # whatever the group structure — duplicate or near-equal βs pool their
+    # slots into one densely-covered transition (a per-group uniform split
+    # would collapse K identical groups onto per ≈ n_q/K distinct levels),
+    # while widely separated βs each keep ~n_q/K spread levels of their own.
+    k = betas.shape[0]
+    n_sel = min(k, n_q)
+    gidx = np.linspace(0, k - 1, n_sel).astype(np.int32)
+    slots = np.arange(n_q) % n_sel
+    sel = jnp.sort(betas)[gidx][slots]  # (n_q,)
+    phi = 0.6180339887498949
+    q = jnp.asarray((np.arange(1, n_q + 1) * phi) % 1.0, dtype=dtype)
+    q = jnp.clip(q, 1.0 / (2 * n_q), 1.0)
+    g_hi = 1.0 - (1.0 - x0) * jnp.exp(-sel * omega_hi)
+    levels = jnp.clip(x0 + q * (g_hi - x0), x0, 1.0 - 1e-15)
+    quant = -jnp.log((1.0 - levels) / (1.0 - x0)) / sel
+
+    beta_ave = jnp.dot(dist, betas)
+    lo = jnp.maximum(x0 / beta_ave * 1e-2, omega_hi * 1e-14)
+    logs = jnp.exp(
+        jnp.linspace(jnp.log(lo), jnp.log(omega_hi), n_log, dtype=dtype)
+    )
+    return jnp.clip(jnp.concatenate([quant.reshape(-1), logs]), 0.0, omega_hi)
+
+
+def solve_learning_hetero_exact(
+    params: LearningParamsHetero,
+    config: SolverConfig = SolverConfig(),
+    dtype=jnp.float64,
+):
+    """Exact hetero Stage 1 via the Ω reduction (module header above).
+
+    Returns (t_grid, omega_grid, omega_vals) — the warped time grid, Ω at its
+    knots, and ω(Ω) there — for `hetero_solution_from_omega` to expand into
+    per-group arrays (kept separate so the sharded path can expand LOCAL
+    group rows from the same replicated table).
+    """
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+    t0, t1 = params.tspan
+    if t0 != 0.0:
+        raise ValueError(f"hetero exact path assumes tspan starting at 0, got {params.tspan}")
+    betas = jnp.asarray(params.betas, dtype=dtype)
+    dist = jnp.asarray(params.dist, dtype=dtype)
+    x0 = jnp.asarray(params.x0, dtype=dtype)
+    omega = _omega_of(betas, dist, x0)
+    n = config.n_grid
+    order = config.quad_order
+
+    # Pass 1 — coarse map out to Ω = t1 (an upper bound: ω ≤ 1 ⇒ Ω(t) ≤ t),
+    # to locate Ω₁ = Ω(t1).
+    coarse = jnp.sort(
+        jnp.concatenate(
+            [
+                jnp.zeros((1,), dtype),
+                _omega_knots(betas, dist, x0, jnp.asarray(t1, dtype), n // 2, n // 8, dtype),
+                jnp.linspace(jnp.zeros((), dtype), t1, n // 4),
+            ]
+        )
+    )
+    t_coarse = cumulative_gauss_legendre(lambda v: 1.0 / omega(v), coarse, order=order)
+    omega1 = jnp.interp(jnp.asarray(t1, dtype), t_coarse, coarse)
+
+    # Pass 2 — final grid on [0, Ω₁]: quantiles + logs + uniform-in-t knots
+    # (inverted through the coarse map), endpoints pinned. The uniform
+    # budget absorbs whatever the (static-sized) knot set didn't use, so
+    # the total is exactly n_grid at any K.
+    knots = _omega_knots(betas, dist, x0, omega1, n // 2, n // 8, dtype)
+    n_unif = n - int(knots.shape[0]) - 2
+    t_targets = jnp.linspace(jnp.zeros((), dtype), t1, n_unif)
+    omega_unif = jnp.interp(t_targets, t_coarse, coarse)
+    omega_grid = jnp.sort(
+        jnp.concatenate([jnp.zeros((1,), dtype), knots, omega_unif, omega1[None]])
+    )
+    omega_grid = jnp.clip(omega_grid, 0.0, omega1).at[0].set(0.0).at[-1].set(omega1)
+
+    t_grid = cumulative_gauss_legendre(lambda v: 1.0 / omega(v), omega_grid, order=order)
+    # Pin the endpoint exactly: t(Ω₁) = t1 up to the coarse-map inversion
+    # error; downstream takes grid[-1] as tspan_end.
+    t_grid = t_grid.at[-1].set(t1)
+    return t_grid, omega_grid, omega(omega_grid)
+
+
+def hetero_solution_from_omega(
+    betas_local, dist_local, x0, t_grid, omega_grid, omega_vals
+) -> LearningSolutionHetero:
+    """Expand the (replicated) Ω table into per-group rows — closed form, so
+    the local group axis can be a shard: no collective is needed, the global
+    coupling lives entirely inside the table."""
+    cdfs = 1.0 - (1.0 - x0) * jnp.exp(-betas_local[:, None] * omega_grid[None, :])
+    pdfs = (1.0 - cdfs) * betas_local[:, None] * omega_vals[None, :]
+    return LearningSolutionHetero(
+        grid=t_grid,
+        cdfs=cdfs,
+        pdfs=pdfs,
+        t0=t_grid[0],
+        dt=t_grid[1] - t_grid[0],
+        betas=betas_local,
+        dist=dist_local,
+    )
+
+
 def solve_learning_hetero(
     params: LearningParamsHetero,
     config: SolverConfig = SolverConfig(),
     dtype=jnp.float64,
 ) -> LearningSolutionHetero:
-    """Solve the coupled K-group system on a static uniform grid."""
+    """Solve the K-group system.
+
+    With ``config.grid_warp > 0`` (default): the exact Ω-reduction on a
+    transition-warped grid — correct at any β_k, including the
+    β_k ≳ n_grid/η regime where a uniform grid swallows a fast group's
+    transition (VERDICT r4 task 4). With ``grid_warp == 0``: the legacy
+    RK4 scan on a uniform grid (kept as a differential oracle for the
+    exact path and for bit-exact sharding-equivalence tests).
+    """
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
-    t0, t1 = params.tspan
-    grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
     betas = jnp.asarray(params.betas, dtype=dtype)
     dist = jnp.asarray(params.dist, dtype=dtype)
+    if config.grid_warp > 0.0:
+        t_grid, omega_grid, omega_vals = solve_learning_hetero_exact(params, config, dtype)
+        return hetero_solution_from_omega(
+            betas, dist, jnp.asarray(params.x0, dtype=dtype), t_grid, omega_grid, omega_vals
+        )
+    t0, t1 = params.tspan
+    grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
     return solve_learning_hetero_arrays(
         betas, dist, params.x0, grid, hetero_substeps(params, config)
     )

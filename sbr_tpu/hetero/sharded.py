@@ -24,7 +24,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sbr_tpu.hetero.learning import hetero_substeps, solve_learning_hetero_arrays
+from sbr_tpu.hetero.learning import (
+    hetero_solution_from_omega,
+    hetero_substeps,
+    solve_learning_hetero_arrays,
+    solve_learning_hetero_exact,
+)
 from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
 from sbr_tpu.models.params import ModelParamsHetero, SolverConfig
 from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero
@@ -59,11 +64,26 @@ def solve_hetero_sharded(
     substeps = hetero_substeps(params.learning, config)
     econ = params.economic
 
-    def fn(betas_l, dist_l):
-        grid = jnp.linspace(
-            jnp.asarray(t0, dtype=dtype), jnp.asarray(t1, dtype=dtype), config.n_grid
-        )
-        lsh = solve_learning_hetero_arrays(betas_l, dist_l, x0, grid, substeps, axis_name=axis)
+    exact = config.grid_warp > 0.0
+    if exact:
+        # Exact Ω path (round 5): the scalar t(Ω) table carries ALL the
+        # cross-group coupling and is computed once outside shard_map with
+        # the full betas (a replicated input); each shard then expands its
+        # LOCAL group rows in closed form — the learning stage needs no
+        # collective at all. The ω psum of the RK4 path disappears.
+        tables = solve_learning_hetero_exact(params.learning, config, dtype)
+        x0_c = jnp.asarray(x0, dtype=dtype)
+
+    def fn(betas_l, dist_l, *tabs):
+        if exact:
+            lsh = hetero_solution_from_omega(betas_l, dist_l, x0_c, *tabs)
+        else:
+            grid = jnp.linspace(
+                jnp.asarray(t0, dtype=dtype), jnp.asarray(t1, dtype=dtype), config.n_grid
+            )
+            lsh = solve_learning_hetero_arrays(
+                betas_l, dist_l, x0, grid, substeps, axis_name=axis
+            )
         res = solve_equilibrium_hetero(lsh, econ, config, axis_name=axis)
         aw = get_aw_hetero(res, lsh, axis_name=axis) if with_aw else None
         return lsh, res, aw
@@ -100,12 +120,13 @@ def solve_hetero_sharded(
     betas = jax.device_put(jnp.asarray(params.learning.betas, dtype=dtype), shard)
     dist = jax.device_put(jnp.asarray(params.learning.dist, dtype=dtype), shard)
 
+    table_args = tables if exact else ()
     fn_sharded = jax.jit(
         jax.shard_map(
             fn,
             mesh=mesh,
-            in_specs=(P(axis), P(axis)),
+            in_specs=(P(axis), P(axis)) + (P(),) * len(table_args),
             out_specs=(spec_lsh, spec_res, spec_aw),
         )
     )
-    return fn_sharded(betas, dist)
+    return fn_sharded(betas, dist, *table_args)

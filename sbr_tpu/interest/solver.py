@@ -15,7 +15,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import struct
 
-from sbr_tpu.baseline.solver import _hazard_parts, compute_xi, get_aw, optimal_buffer
+from sbr_tpu.baseline.solver import (
+    _hazard_parts,
+    compute_xi,
+    get_aw,
+    hazard_grid_is_uniform,
+    optimal_buffer,
+)
 from sbr_tpu.interest.value_function import solve_value_function
 from sbr_tpu.models.params import EconomicParamsInterest, SolverConfig
 from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
@@ -64,7 +70,7 @@ def solve_equilibrium_interest_core(
     # (β,u,r) policy sweep resolves the logistic transition exactly like
     # the baseline sweep does. ``warped`` is static (config is concrete at
     # trace time), so the uniform fast path costs nothing when warp is off.
-    warped = config.grid_warp > 0.0 and ls.closed_form
+    warped = not hazard_grid_is_uniform(ls, config)
     tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
     v = solve_value_function(tau_grid, hr, delta, r, u, config, uniform=not warped)
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`

@@ -108,24 +108,26 @@ class LearningSolutionHetero:
     one static grid (the reference's groups also share the adaptive grid).
     """
 
-    grid: jnp.ndarray  # (n,) shared uniform time grid over tspan
+    grid: jnp.ndarray  # (n,) shared time grid over tspan (may be warped)
     cdfs: jnp.ndarray  # (K, n) per-group G_k(t)
     pdfs: jnp.ndarray  # (K, n) per-group g_k(t)
     t0: jnp.ndarray  # scalar, grid start
-    dt: jnp.ndarray  # scalar, grid spacing
+    dt: jnp.ndarray  # scalar, FIRST grid spacing (uniform-grid legacy field;
+    # use local spacings of ``grid`` for anything resolution-sensitive)
     betas: jnp.ndarray  # (K,) group learning rates
     dist: jnp.ndarray  # (K,) group weights (simplex)
 
     def cdf_at(self, t):
-        """G_k at time(s) t: output shape (K, *t.shape)."""
-        from sbr_tpu.core.interp import interp_uniform
+        """G_k at time(s) t: output shape (K, *t.shape). Searchsorted interp —
+        the grid is transition-warped under the exact Ω path (round 5)."""
+        from sbr_tpu.core.interp import interp_shared
 
-        return interp_uniform(t, self.t0, self.dt, self.cdfs)
+        return interp_shared(t, self.grid, self.cdfs)
 
     def pdf_at(self, t):
-        from sbr_tpu.core.interp import interp_uniform
+        from sbr_tpu.core.interp import interp_shared
 
-        return interp_uniform(t, self.t0, self.dt, self.pdfs)
+        return interp_shared(t, self.grid, self.pdfs)
 
 
 @struct.dataclass

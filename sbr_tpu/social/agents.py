@@ -269,6 +269,13 @@ def _auto_engine(
       above budget satisfy G(1-G) > c with c = budget/(2·n·β·dt); the
       logistic spends (1/β)·ln(((1/2+r)/(1/2−r))²) time in that band,
       r = √(1/4−c) — count those steps too.
+
+    Approximation (ADVICE r4): the factor 2 treats informed transitions and
+    withdrawal-window entries/exits as one synchronous band. With
+    reentry_delay − exit_delay larger than the band width, the exit wave is
+    a second time-shifted band and fallback steps can be undercounted —
+    harmless for correctness (fallback is bit-identical), only for the
+    throughput of a misclassified "incremental" choice.
     """
     hubs = int((np.asarray(edge_slices) > max_degree).sum())
     fallback_steps = 2.0 * hubs
@@ -768,7 +775,12 @@ def prepare_agent_graph(
                 ec_a = max(1, -(-len(src_h) // n_dev_a))
                 out_ptr_c = np.concatenate([[0], np.cumsum(outdeg_c)])
                 census = _max_chunk_slice(out_ptr_c, ec_a, n)
-                nb_a = -(-n // n_dev_a)
+                # the same padded per-device block the runtime will use
+                # (byte-aligned for the incremental candidate, ADVICE r4:
+                # a ceil(n/n_dev) estimate drifted from the runtime budget
+                # near block boundaries)
+                n_gl_a = n + (-n) % (8 * n_dev_a)
+                nb_a = n_gl_a // n_dev_a
                 budget_est = (
                     incremental_budget or min(max(512, nb_a // 64), 65536)
                 ) * n_dev_a
@@ -968,8 +980,38 @@ def simulate_agents(
             incremental_budget=incremental_budget,
             incremental_max_degree=incremental_max_degree,
         )
+    else:
+        # ADVICE r4: graph-shaping arguments alongside prepared= were
+        # silently ignored — a caller passing a different n or mesh got the
+        # prepared graph's values with no signal. Reject conflicts loudly.
+        conflicts = [
+            name
+            for name, passed in (
+                # identity checks only — betas/src/dst may be numpy arrays,
+                # where != would produce an ambiguous elementwise result
+                ("betas", betas is not None), ("src", src is not None),
+                ("dst", dst is not None), ("n", n is not None),
+                ("mesh", mesh is not None),
+                ("comm", comm != "scatter"), ("engine", engine != "auto"),
+                ("incremental_budget", incremental_budget is not None),
+                ("incremental_max_degree", incremental_max_degree != 64),
+            )
+            if passed
+        ]
+        if conflicts:
+            raise ValueError(
+                f"simulate_agents: {conflicts} conflict with prepared= — the "
+                "prepared graph already fixes the graph/mesh/engine; rebuild "
+                "it with prepare_agent_graph(...) to change them"
+            )
     n = prepared.n
     dtype_np = prepared.dtype
+    for name, arr in (("informed0", informed0), ("t_inf0", t_inf0)):
+        if arr is not None and np.asarray(arr).shape[0] != n:
+            raise ValueError(
+                f"simulate_agents: {name} has length {np.asarray(arr).shape[0]} "
+                f"but the graph has n = {n} agents"
+            )
 
     # per-call state: seeds and informed times (the ONLY seed-dependent host
     # work — O(N), milliseconds; `_draw_seeds` is the single definition of
