@@ -162,7 +162,12 @@ class _Budget:
 
     def clamp(self, want_s: float, floor_s: float = 30.0) -> float:
         """Phase timeout: at most ``want_s``, at most the remaining budget,
-        never below ``floor_s`` (a 5 s timeout would kill healthy children)."""
+        never below ``floor_s`` (a 5 s timeout would kill healthy children) —
+        EXCEPT when the budget is already spent, where the phase gets 0 and
+        the caller skips it (ADVICE r4: the floor used to let late phases
+        overrun SBR_BENCH_BUDGET_S by minutes)."""
+        if self.remaining() <= 0.0:
+            return 0.0
         return max(floor_s, min(want_s, self.remaining()))
 
 
@@ -174,6 +179,9 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
     platform = ""
     for attempt in range(1, attempts + 1):
         eff_timeout = budget.clamp(timeout_s) if budget else timeout_s
+        if eff_timeout <= 0.0:  # clamp's 0-means-skip contract (ADVICE r4)
+            _log("probe budget exhausted before attempt — skipping")
+            break
         platform, outcome, dur = _probe_accelerator(eff_timeout)
         history.append(
             {
@@ -186,11 +194,13 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
         )
         if platform:
             break
-        if budget is not None and budget.remaining() < 60.0:
+        backoff = 10.0 * (2 ** (attempt - 1))
+        # ADVICE r4: count the upcoming backoff sleep against the budget
+        # check, so backoffs cannot push the run past SBR_BENCH_BUDGET_S
+        if budget is not None and budget.remaining() < 60.0 + backoff:
             _log("probe budget exhausted — skipping remaining attempts")
             break
         if attempt < attempts:
-            backoff = 10.0 * (2 ** (attempt - 1))
             _log(f"probe attempt {attempt}/{attempts} failed; backing off {backoff:.0f}s")
             time.sleep(backoff)
             history[-1]["backoff_s"] = backoff
@@ -206,7 +216,11 @@ def _run_measurement(platform: str, timeout_s: float, script: str = None) -> tup
     this file; benchmarks/stretch.py reuses the harness by passing its own
     path (every device touch must live in a killable child — see module
     docstring). Uses `_run_killable` (file-backed IO + process-group kill)
-    so a hung tunnel cannot freeze the parent past the timeout."""
+    so a hung tunnel cannot freeze the parent past the timeout. A zero/
+    negative ``timeout_s`` (exhausted budget) skips the phase outright."""
+    if timeout_s <= 0.0:
+        _log("measurement skipped — budget exhausted")
+        return None, "skipped-budget", 0.0
     rc, stdout, stderr, dur = _run_killable(
         [sys.executable, script or os.path.abspath(__file__), "--measure", platform],
         timeout_s,

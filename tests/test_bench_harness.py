@@ -139,6 +139,77 @@ def test_budget_clamps_phase_timeouts():
     b.total_s = 100.0
     assert b.clamp(50.0) == 50.0
     assert b.clamp(1000.0) <= 100.0
+    assert b.clamp(10.0) == 30.0  # the floor keeps healthy children alive
     b.t0 -= 200.0  # simulate 200 s elapsed: budget exhausted
-    assert b.clamp(1000.0) == 30.0  # the floor keeps healthy children alive
-    assert b.clamp(1000.0, floor_s=60.0) == 60.0
+    # ADVICE r4: a spent budget returns 0 → the caller SKIPS the phase
+    # (the old floor here let late phases overrun SBR_BENCH_BUDGET_S)
+    assert b.clamp(1000.0) == 0.0
+    assert bench._run_measurement("cpu", b.clamp(1000.0)) == (
+        None,
+        "skipped-budget",
+        0.0,
+    )
+
+
+def test_watch_persists_fake_accelerator_capture(tmp_path, monkeypatch, capsys):
+    """VERDICT r4 task 8: the watch daemon's persist+log path, exercised
+    with a faked accelerator probe/measurement so the round's one real
+    tunnel window cannot be wasted on a plumbing bug. Asserts the
+    timestamped artifact and the CAPTURE_LOG line are both written, with
+    the probe history embedded."""
+    import bench
+
+    monkeypatch.delenv("SBR_BENCH_SIZES", raising=False)  # tiny gates persist
+    monkeypatch.setattr(bench, "_benchmarks_dir", lambda: tmp_path)
+    monkeypatch.setattr(bench, "_probe_accelerator", lambda t: ("tpu", "ok", 0.1))
+    fake = {
+        "metric": "beta_u_grid_equilibria_per_sec",
+        "value": 123.0,
+        "unit": "equilibria/sec",
+        "vs_baseline": 61.5,
+        "extra": {"platform": "tpu"},
+    }
+    monkeypatch.setattr(
+        bench, "_run_measurement", lambda p, t: ({**fake, "extra": dict(fake["extra"])}, "ok", 1.0)
+    )
+    assert bench.watch(1, 0.0) == 0
+    # exactly-one-JSON-line stdout contract holds in watch mode too
+    lines = [ln for ln in capsys.readouterr().out.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1 and json.loads(lines[0])["value"] == 123.0
+
+    arts = list(tmp_path.glob("BENCH_tpu_auto_*.json"))
+    assert len(arts) == 1, list(tmp_path.iterdir())
+    data = json.loads(arts[0].read_text())
+    assert data["value"] == 123.0
+    hist = data["extra"]["probe_history"]
+    assert hist[0]["watch_attempt"] == 1 and hist[1]["phase"] == "measure"
+
+    entries = [
+        json.loads(ln)
+        for ln in (tmp_path / "CAPTURE_LOG.jsonl").read_text().strip().splitlines()
+    ]
+    assert entries[-1]["script"] == "bench.py --watch"
+    assert entries[-1]["platform"] == "tpu" and entries[-1]["value"] == 123.0
+
+
+def test_watch_rejects_cpu_fallback_capture(tmp_path, monkeypatch, capsys):
+    """A measure child that silently fell back to CPU (tunnel dropped in the
+    probe→attach window) must NOT count as an accelerator capture: nothing
+    persisted, logged as cpu-fallback-in-child, watch keeps probing."""
+    import bench
+
+    monkeypatch.delenv("SBR_BENCH_SIZES", raising=False)
+    monkeypatch.setattr(bench, "_benchmarks_dir", lambda: tmp_path)
+    monkeypatch.setattr(bench, "_probe_accelerator", lambda t: ("tpu", "ok", 0.1))
+    fake = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+            "extra": {"platform": "cpu"}}
+    monkeypatch.setattr(
+        bench, "_run_measurement", lambda p, t: ({**fake, "extra": dict(fake["extra"])}, "ok", 1.0)
+    )
+    assert bench.watch(1, 0.0) == 1
+    assert not list(tmp_path.glob("*.json"))
+    entries = [
+        json.loads(ln)
+        for ln in (tmp_path / "CAPTURE_LOG.jsonl").read_text().strip().splitlines()
+    ]
+    assert entries[-1]["outcome"] == "cpu-fallback-in-child"
