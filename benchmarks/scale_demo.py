@@ -1,0 +1,135 @@
+"""Order-of-magnitude scale demonstration: 10^7 agents / 10^8 edges on one
+chip (VERDICT r4 task 7).
+
+The pieces have all been measured separately — the native O(E+N) counting
+sort did 10^8 edges in 15.3 s, `_seg_counts` is exact to 2^31 edges, and
+`prepare_agent_graph` amortizes the ~GB-scale upload — but never as ONE
+workload. Two phases:
+
+A. **Headline**: 10^7 heterogeneous-β agents on a Chung–Lu scale-free
+   graph with 10^8 edges (avg degree 10, γ=2.5), 200 steps — the stretch
+   config an order of magnitude up. Reports agent-steps/sec with the
+   prep/steady split (the prep side IS part of the demonstration: one
+   graph build + upload serves every subsequent simulation).
+B. **Physics check at scale**: the same 10^7/10^8 shape as an Erdős–Rényi
+   graph with uniform β and immediate exit, vs the logistic mean-field
+   limit (SURVEY §4(e)). At avg degree 10 the per-agent neighbor fraction
+   is quantized to tenths, so the mid-transition band deviates from the
+   representative-agent ODE by design; the SATURATION level and the
+   self-averaged S-shape are the scale-invariant checks (bands measured at
+   n = 2x10^5, same degree, where they are n-independent: the curve is an
+   average over 10^7 agents — sampling noise is ~10^-4).
+
+Prints ONE JSON line; reuses bench.py's killable parent/child harness
+(the tunnel can hang at any point). `SBR_BENCH_SIZES=tiny` shrinks to
+smoke scale for the harness contract test.
+
+Usage: python benchmarks/scale_demo.py  (from the repo root)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _log(msg: str) -> None:
+    print(f"[scale] {msg}", file=sys.stderr, flush=True)
+
+
+def headline(n: int = 10_000_000, n_steps: int = 200) -> dict:
+    """The stretch-config workload an order of magnitude up — same timing
+    protocol and result contract, so reuse it rather than fork it."""
+    import stretch  # sibling module; benchmarks/ is on sys.path as script dir
+
+    return stretch.stretch_agents(n=n, n_steps=n_steps, avg_degree=10.0)
+
+
+def physics_check(n: int = 10_000_000, avg_degree: float = 10.0) -> dict:
+    """Logistic-limit check at the demo shape (immediate exit ⇒ AW = G ⇒
+    dG/dt = β·G(1-G)). Tolerances measured at n = 2x10^5, same degree,
+    where the degree-10 quantization bias is already converged in n."""
+    import numpy as np
+
+    import bench
+    from sbr_tpu.baseline.learning import logistic_cdf
+    from sbr_tpu.social import AgentSimConfig, erdos_renyi_edges, simulate_agents
+
+    if bench._tiny():
+        n = 20_000
+
+    beta, x0 = 1.0, 1e-3
+    src, dst = erdos_renyi_edges(n, avg_degree, seed=3)
+    cfg = AgentSimConfig(n_steps=300, dt=0.05)
+    t0 = time.perf_counter()
+    res = simulate_agents(beta, src, dst, n, x0=x0, config=cfg, seed=0)
+    got = np.asarray(res.informed_frac, dtype=np.float64)
+    run_s = time.perf_counter() - t0
+    t = np.asarray(res.t_grid)
+    x0_eff = float(got[0])  # realized Bernoulli seed fraction
+    want = np.asarray(logistic_cdf(t, beta, x0_eff))
+    active = want > 0.01
+    rel_band = float(np.max(np.abs(got[active] - want[active]) / want[active]))
+    sat_err = float(abs(got[-1] - want[-1]))
+    monotone = bool((np.diff(got) >= -1e-9).all())
+    _log(
+        f"physics: ER degree {avg_degree} at n={n:,}: saturation |Δ|={sat_err:.4f}, "
+        f"active-band rel max={rel_band:.3f}, monotone={monotone} ({run_s:.1f}s)"
+    )
+    return {
+        "n_agents": n,
+        "n_edges": len(src),
+        "saturation_abs_err": round(sat_err, 5),
+        "active_band_rel_max": round(rel_band, 4),
+        "monotone": monotone,
+        # bands: saturation matches the ODE tightly (every agent with an
+        # informed neighbor eventually crosses); the transition band lags
+        # the ODE by O(1/degree) quantization, measured 0.43-0.60 falling
+        # in n (0.43 at 2e5; the tiny smoke shape sits at 0.60) — 0.7 is
+        # the loose-side bound for any n at degree 10
+        "pass": bool(sat_err < 0.02 and rel_band < 0.7 and monotone),
+        "run_s": round(run_s, 1),
+    }
+
+
+def measure(platform: str) -> None:
+    import bench
+
+    devices = bench._init_child_backend(platform)
+    platform = devices[0].platform
+    head = headline()
+    phys = physics_check()
+    print(
+        json.dumps(
+            {
+                "metric": "scale_demo_agent_steps_per_sec",
+                "value": round(head["agent_steps_per_sec"], 1),
+                "unit": "agent-steps/sec",
+                "extra": {"platform": platform, "headline": head, "physics": phys},
+            }
+        )
+    )
+
+
+def main() -> None:
+    import bench
+
+    bench.run_harness(
+        script=str(Path(__file__).resolve()),
+        fallback={
+            "metric": "scale_demo_agent_steps_per_sec",
+            "value": 0.0,
+            "unit": "agent-steps/sec",
+        },
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
+        measure(sys.argv[2])
+    else:
+        main()

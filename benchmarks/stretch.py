@@ -38,7 +38,7 @@ def _log(msg: str) -> None:
     print(f"[stretch] {msg}", file=sys.stderr, flush=True)
 
 
-def stretch_agents(n: int = 1_000_000, n_steps: int = 200) -> dict:
+def stretch_agents(n: int = 1_000_000, n_steps: int = 200, avg_degree: float = 10.0) -> dict:
     import numpy as np
 
     from sbr_tpu.social import (
@@ -58,8 +58,9 @@ def stretch_agents(n: int = 1_000_000, n_steps: int = 200) -> dict:
     # the continuous analogue of the reference's two-group βs=[0.125, 12.5]
     betas = rng.lognormal(mean=0.0, sigma=0.5, size=n).astype(np.float32)
     t0 = time.perf_counter()
-    src, dst = scale_free_edges(n, avg_degree=10.0, gamma=2.5, seed=0)
-    _log(f"scale-free graph: {len(src)} edges in {time.perf_counter() - t0:.1f}s")
+    src, dst = scale_free_edges(n, avg_degree=avg_degree, gamma=2.5, seed=0)
+    gen_s = time.perf_counter() - t0
+    _log(f"scale-free graph: {len(src)} edges in {gen_s:.1f}s")
     cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
     t0 = time.perf_counter()
     pg = prepare_agent_graph(betas, src, dst, n, config=cfg)
@@ -86,9 +87,12 @@ def stretch_agents(n: int = 1_000_000, n_steps: int = 200) -> dict:
     return {
         "agent_steps_per_sec": n * n_steps / steady,
         "n_agents": n,
+        "n_edges": len(src),
         "n_steps": n_steps,
-        "graph": "scale_free(avg_degree=10, gamma=2.5)",
+        "graph": f"scale_free(avg_degree={avg_degree}, gamma=2.5)",
         "betas": "lognormal(0, 0.5)",
+        "engine": pg.engine,
+        "graph_gen_s": round(gen_s, 1),
         "first_call_s": round(first_s, 2),
         "steady_s": round(steady, 3),
         # NB: since the prepare_agent_graph migration, graph prep is OUT of

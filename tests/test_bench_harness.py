@@ -213,3 +213,14 @@ def test_watch_rejects_cpu_fallback_capture(tmp_path, monkeypatch, capsys):
         for ln in (tmp_path / "CAPTURE_LOG.jsonl").read_text().strip().splitlines()
     ]
     assert entries[-1]["outcome"] == "cpu-fallback-in-child"
+
+
+def test_scale_demo_emits_contract_json():
+    d = _run("benchmarks/scale_demo.py")
+    assert d["metric"] == "scale_demo_agent_steps_per_sec"
+    assert d["value"] > 0
+    extra = d["extra"]
+    assert extra["platform"] == "cpu"
+    assert extra["headline"]["prep_s"] >= 0
+    # the logistic-limit physics check must pass even at smoke scale
+    assert extra["physics"]["pass"] is True

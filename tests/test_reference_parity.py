@@ -279,9 +279,18 @@ class TestSocialParity:
     def test_social_script_calibration(self):
         """The social fixed point against the reference's own damped
         iteration (`ref_emulator.solve_reference_social`) at the Figure-12
-        calibration. Both sides stop at the same sup-norm tolerance
-        (1e-4 on AW), so ξ agreement is bounded by the fixed point's own
-        stopping width (|Δξ| ≲ tol/g(ξ) ≈ 1e-3), not by grid numerics."""
+        calibration, both sides at the script's sup-norm tolerance (1e-4).
+
+        Bound justified by measurement (VERDICT r4 task 5, run 2026-07-30):
+        the theoretical stopping-width bound is |Δξ| ≲ tol/g(ξ) ≈ 1e-3,
+        but the two loops track each other ITERATION FOR ITERATION (50=50
+        at tol=1e-4, 56=56 at 1e-5) so the stopping error largely cancels:
+        measured |Δξ| = 4.4e-5 at tol=1e-4, 2.6e-5 at 1e-5. The residual
+        floor is each side's own discretization (~5e-5: the emulator moves
+        5.0e-5 between rtol 1e-10 and 3e-14; sbr moves 5.8e-5 between
+        n_grid 4096 and 8192). 2e-4 is ~4x the measured gap and ~4x that
+        floor — tight enough to catch a real regression, loose enough for
+        the documented numerics."""
         from ref_emulator import solve_reference_social
 
         from sbr_tpu.social.solver import solve_equilibrium_social
@@ -291,4 +300,7 @@ class TestSocialParity:
         res = solve_equilibrium_social(m, SolverConfig(n_grid=4096), tol=1e-4, max_iter=500)
         assert ref.converged and bool(res.converged)
         assert bool(res.equilibrium.bankrun) == ref.bankrun
-        assert float(res.xi) == pytest.approx(ref.xi, abs=2e-3)
+        # near-lockstep iteration counts (measured exactly equal; ±1 allows
+        # a benign scipy/JAX step-selection change without a false alarm)
+        assert abs(int(res.iterations) - ref.iterations) <= 1
+        assert float(res.xi) == pytest.approx(ref.xi, abs=2e-4)
