@@ -1,0 +1,105 @@
+"""End-to-end agent-sim engine comparison at the headline bench shape.
+
+Re-anchors the r3 hand-assembled `INCREMENTAL_tpu_v5e_2026-07-30.json`
+(gather 21.1 s vs incremental 8.1 s over 200 steps at 10^6 agents / 10^7
+ER edges on 1x v5e) on the CURRENT tree, and records what `engine="auto"`
+would pick at this shape — the input the `_auto_engine` census tuning
+needs: at the bench config (budget 15625, beta=1, dt=0.05) the logistic
+mass-change band predicts ~57 fallback steps of 200, just over the
+n_steps/4 threshold, so auto picks "gather"; the r3 measurement says the
+incremental engine wins 2.6x at this exact shape INCLUDING those
+fallbacks. If that ratio reproduces, the census threshold models the
+wrong quantity (fallback fraction, not expected cost) and gets retuned.
+
+Run: python benchmarks/engine_compare.py [n_agents] [avg_degree] [n_steps]
+  SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    if os.environ.get("SBR_ABL_PLATFORM", "") == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import numpy as np
+
+    from sbr_tpu.social import (
+        AgentSimConfig,
+        erdos_renyi_edges,
+        prepare_agent_graph,
+        simulate_agents,
+    )
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    deg = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 200
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} n={n} deg={deg} steps={n_steps}")
+
+    src, dst = erdos_renyi_edges(n, deg, seed=0)
+    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+    auto_pick = prepare_agent_graph(1.0, src, dst, n, config=cfg).engine
+    print(f"engine='auto' picks: {auto_pick}")
+
+    results = {}
+    final = {}
+    for engine in ("gather", "incremental"):
+        pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine=engine)
+        t0 = time.perf_counter()
+        res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+        jax.block_until_ready(res.withdrawn_frac)
+        first = time.perf_counter() - t0
+        times = []
+        for rep in range(2):
+            t0 = time.perf_counter()
+            res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+            # device->host fetch as the honest fence (axon tunnel)
+            final[engine] = (
+                np.asarray(res.informed).sum(),
+                float(res.withdrawn_frac[-1]),
+            )
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        results[engine] = {
+            "steady_s": round(best, 3),
+            "first_call_s": round(first, 3),
+            "agent_steps_per_sec": round(n * n_steps / best, 1),
+        }
+        print(
+            f"{engine:>12}: {best:.3f}s steady ({n * n_steps / best / 1e6:.1f}M "
+            f"agent-steps/s; first call {first:.1f}s)"
+        )
+
+    assert final["gather"] == final["incremental"], "engines disagree"
+    ratio = results["gather"]["steady_s"] / results["incremental"]["steady_s"]
+    print(f"incremental speedup vs gather: {ratio:.2f}x (outputs identical)")
+
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        payload = {
+            "platform": platform,
+            "n_agents": n,
+            "avg_degree": deg,
+            "n_steps": n_steps,
+            "dt": 0.05,
+            "auto_pick": auto_pick,
+            "results": results,
+            "outputs_identical": True,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

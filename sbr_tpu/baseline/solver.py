@@ -96,6 +96,34 @@ def _warped_grid(eta, beta, x0, n, warp, dtype):
     return jnp.clip(grid, 0.0, eta).at[0].set(0.0).at[-1].set(eta)
 
 
+def warped_grid_index(t, eta, beta, x0, n, warp):
+    """Bracketing-index guess into `_warped_grid`'s knots, in closed form.
+
+    The warped grid is the sorted union of two ANALYTIC monotone sequences,
+    so the rank of any query is arithmetic — no searchsorted: the count of
+    uniform knots ≤ t is a floor division, and the count of quantile knots
+    ≤ t inverts the same logistic map that placed them (t_j ≤ t  ⟺
+    q_j ≤ (G(t)−x0)/(G(η)−x0)). The returned index is exact up to floating
+    rounding at knot boundaries (≪ one knot — transition-region spacing is
+    ~η/(warp·n) while the rank error is ~n·eps); pair with
+    `core.interp.interp_guided`, which absorbs ±1.
+    """
+    dtype = jnp.result_type(t, jnp.float32)
+    t = jnp.asarray(t, dtype)
+    n_q = max(1, int(warp * n))
+    n_u = n - n_q
+    if n_u >= 2:
+        cnt_u = jnp.clip(
+            jnp.floor(t * ((n_u - 1) / eta)).astype(jnp.int32) + 1, 0, n_u
+        )
+    else:
+        cnt_u = jnp.full(t.shape, n_u, jnp.int32)  # 0 or 1 knot at t=0
+    g_eta = logistic_cdf(eta, beta, x0)
+    ratio = (logistic_cdf(t, beta, x0) - x0) / (g_eta - x0)
+    cnt_q = jnp.clip(jnp.floor(ratio * (n_q - 1)).astype(jnp.int32) + 1, 0, n_q)
+    return cnt_u + cnt_q - 1
+
+
 def hazard_grid_is_uniform(ls: LearningSolution, config: SolverConfig) -> bool:
     """Whether `_hazard_parts` will build a uniform grid — the single source
     of truth for callers that choose between uniform-stride and searchsorted

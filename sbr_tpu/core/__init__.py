@@ -5,7 +5,7 @@ Replaces the reference's Interpolations.jl objects + adaptive-grid idioms
 static-shape, jit/vmap-safe primitives.
 """
 
-from sbr_tpu.core.interp import interp, interp_uniform
+from sbr_tpu.core.interp import interp, interp_guided, interp_uniform
 from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre, trapz
 from sbr_tpu.core.rootfind import (
     bisect,

@@ -37,13 +37,46 @@ def interp_shared(x, xp, fp):
     x = jnp.asarray(x)
     n = xp.shape[0]
     i0 = jnp.clip(jnp.searchsorted(xp, x, side="right") - 1, 0, n - 2)
-    x0 = xp[i0]
-    x1 = xp[i0 + 1]
+    return _segment_blend(x, xp, fp, i0)
+
+
+def _segment_blend(x, xp, fp, i0):
+    """Clamped linear blend on bracket ``[xp[i0], xp[i0+1]]`` (shared tail of
+    the non-uniform evaluators; zero-width segments: the left value wins)."""
+    x0 = jnp.take(xp, i0)
+    x1 = jnp.take(xp, i0 + 1)
     denom = jnp.where(x1 > x0, x1 - x0, 1.0)
     w = jnp.clip(((x - x0) / denom).astype(fp.dtype), 0.0, 1.0)
     f0 = jnp.take(fp, i0, axis=-1)
     f1 = jnp.take(fp, i0 + 1, axis=-1)
     return f0 * (1.0 - w) + f1 * w
+
+
+def interp_guided(x, xp, fp, i_guess):
+    """Linear interpolation at sorted knots ``xp`` with a caller-supplied
+    bracketing-index guess accurate to ±1 knot.
+
+    Replaces searchsorted's ~log₂(n) dependent gather-compare steps with a
+    constant THREE gathers when the caller can compute the bracket
+    analytically — the warped hazard grid is a union of two closed-form
+    sequences, so its rank function is arithmetic, not a search
+    (`baseline/solver.py::warped_grid_index`). Inside a sequential scan
+    (the HJB RK4 substeps) the latency difference is the measured 3.7×
+    policy-sweep regression of the warp-honoring interest path.
+
+    The guess is corrected locally: start one knot below and step up at
+    most twice, covering guesses in error by one either way. Knots may be
+    duplicated (zero-width segments) provided ``fp`` is a pointwise
+    function of ``xp`` — tied knots then carry equal values and every
+    bracket choice interpolates identically. Clamps outside
+    [xp[0], xp[-1]] like `interp`.
+    """
+    x = jnp.asarray(x)
+    n = xp.shape[0]
+    i0 = jnp.clip(jnp.asarray(i_guess, jnp.int32) - 1, 0, n - 2)
+    i0 = jnp.where((x >= jnp.take(xp, i0 + 1)) & (i0 < n - 2), i0 + 1, i0)
+    i0 = jnp.where((x >= jnp.take(xp, i0 + 1)) & (i0 < n - 2), i0 + 1, i0)
+    return _segment_blend(x, xp, fp, i0)
 
 
 def interp_uniform(x, t0, dt, fp):

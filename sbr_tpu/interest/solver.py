@@ -21,7 +21,9 @@ from sbr_tpu.baseline.solver import (
     get_aw,
     hazard_grid_is_uniform,
     optimal_buffer,
+    warped_grid_index,
 )
+from sbr_tpu.core.interp import interp_guided, interp_uniform
 from sbr_tpu.interest.value_function import solve_value_function
 from sbr_tpu.models.params import EconomicParamsInterest, SolverConfig
 from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
@@ -72,7 +74,15 @@ def solve_equilibrium_interest_core(
     # trace time), so the uniform fast path costs nothing when warp is off.
     warped = not hazard_grid_is_uniform(ls, config)
     tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
-    v = solve_value_function(tau_grid, hr, delta, r, u, config, uniform=not warped)
+    index_fn = None
+    if warped:
+        eta_c = jnp.asarray(eta, dtype=dtype)
+        index_fn = lambda t: warped_grid_index(
+            t, eta_c, ls.beta, ls.x0, config.n_grid, config.grid_warp
+        )
+    v = solve_value_function(
+        tau_grid, hr, delta, r, u, config, uniform=not warped, index_fn=index_fn
+    )
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
 
     # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`).
@@ -83,13 +93,12 @@ def solve_equilibrium_interest_core(
     hazard_eff_at = None
     if ls.closed_form and config.refine_crossings:
         from sbr_tpu.baseline.solver import _make_hazard_at
-        from sbr_tpu.core.interp import interp_uniform
 
         hazard_at = _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config)
         t0 = tau_grid[0]
         dt = tau_grid[1] - tau_grid[0]
         if warped:
-            v_at = lambda tau: jnp.interp(tau, tau_grid, v)
+            v_at = lambda tau: interp_guided(tau, tau_grid, v, index_fn(tau))
         else:
             v_at = lambda tau: interp_uniform(tau, t0, dt, v)
 

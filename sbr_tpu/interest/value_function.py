@@ -17,24 +17,35 @@ kink crossing, an O(h³) global contribution, far below pipeline tolerances.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
-from sbr_tpu.core.interp import interp_uniform
-from sbr_tpu.core.ode import rk4
+from sbr_tpu.core.interp import interp_guided, interp_uniform
 from sbr_tpu.models.params import SolverConfig
 
 
 def solve_value_function(
-    tau_grid, hr, delta, r, u, config: SolverConfig = SolverConfig(), uniform: bool = True
+    tau_grid,
+    hr,
+    delta,
+    r,
+    u,
+    config: SolverConfig = SolverConfig(),
+    uniform: bool = True,
+    index_fn=None,
 ):
     """Integrate the HJB forward in τ̄ over ``tau_grid``; returns V samples.
 
     ``hr`` are hazard samples on the same grid; inside RK4 substeps the hazard
     is evaluated by linear interpolation — the same resolution the reference's
     interpolant provides (`value_function_solver.jl:89`). ``uniform=False``
-    switches to searchsorted interpolation for warped (transition-resolving)
-    grids; `core.ode.rk4` already takes non-uniform save intervals, so the
-    scan itself needs no change. The flag must be a static Python bool — the
-    caller knows it from ``config.grid_warp`` before tracing.
+    switches to non-uniform (warped, transition-resolving) grids;
+    `core.ode.rk4` already takes non-uniform save intervals, so the scan
+    itself needs no change. ``index_fn`` (t → bracketing-index guess, e.g.
+    `baseline.solver.warped_grid_index`) replaces searchsorted with O(1)
+    arithmetic + `interp_guided` — the hazard lookup sits inside the RK4
+    scan's sequential substeps, where searchsorted's ~10 dependent gathers
+    per evaluation were the measured 3.7× cost of honoring the warp in the
+    (β,u,r) policy sweep. Both flags must be static at trace time.
     """
     dtype = hr.dtype
     delta = jnp.asarray(delta, dtype=dtype)
@@ -45,17 +56,46 @@ def solve_value_function(
 
     if uniform:
         hr_at = lambda t: interp_uniform(t, t0, dt, hr)
+    elif index_fn is not None:
+        hr_at = lambda t: interp_guided(t, tau_grid, hr, index_fn(t))
     else:
         hr_at = lambda t: jnp.interp(t, tau_grid, hr)
 
     v0 = (u + delta) / (r + delta)  # boundary at crash (`value_function_solver.jl:77,101`)
 
-    def rhs(t, v, _):
-        h = hr_at(t)
-        reentry = jnp.maximum(u + r * v - h, 0.0)
-        return (h + delta) * (1.0 - v) + reentry
-
     # The kink in max() halves the local order where it crosses; extra
     # substeps keep the global error budget comfortable.
     substeps = max(config.ode_substeps, 4)
-    return rk4(rhs, v0, tau_grid, substeps=substeps)
+
+    # Every RK4 stage time is a STATIC function of the save grid, so the
+    # hazard lookups — the only data-dependent reads in the rhs — are
+    # hoisted out of the sequential scan and evaluated VECTORIZED here
+    # (throughput-bound), leaving the scan body pure arithmetic. Interp
+    # inside the scan was a ~3-10-deep dependent-gather chain per stage
+    # (uniform index math or warped searchsorted/guided), serialized 16×
+    # per grid interval; hoisting it is most of the warp-honoring policy
+    # sweep's recovery toward the uniform-grid throughput. Node times are
+    # written with the exact FP associations the in-scan path used
+    # (t = t0 + j·h; t + 0.5·h; t + h), so results are bit-identical.
+    t0s = tau_grid[:-1]
+    h = (tau_grid[1:] - t0s) / substeps
+    tj = t0s[:, None] + jnp.arange(substeps, dtype=dtype) * h[:, None]  # (n-1, s)
+    nodes = jnp.stack([tj, tj + 0.5 * h[:, None], tj + h[:, None]], axis=-1)
+    hr_nodes = hr_at(nodes)  # (n-1, s, 3), one vectorized interp
+
+    def rhs_at(hv, v):
+        return (hv + delta) * (1.0 - v) + jnp.maximum(u + r * v - hv, 0.0)
+
+    def interval(v, xs):
+        hstep, hrow = xs
+        for j in range(substeps):  # static unroll: all node reads static
+            h1, hm, h2 = hrow[j, 0], hrow[j, 1], hrow[j, 2]
+            k1 = rhs_at(h1, v)
+            k2 = rhs_at(hm, v + 0.5 * hstep * k1)
+            k3 = rhs_at(hm, v + 0.5 * hstep * k2)
+            k4 = rhs_at(h2, v + hstep * k3)
+            v = v + (hstep / 6.0) * (k1 + 2.0 * k2 + 2.0 * k3 + k4)
+        return v, v
+
+    _, vs = lax.scan(interval, v0, (h, hr_nodes))
+    return jnp.concatenate([v0[None], vs], axis=0)

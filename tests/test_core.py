@@ -99,3 +99,31 @@ def test_rk4_logistic_vs_closed_form():
     ys = rk4(lambda t, y, a: a * y * (1 - y), jnp.asarray(x0), ts, args=beta, substeps=2)
     want = x0 / (x0 + (1 - x0) * np.exp(-beta * np.asarray(ts)))
     np.testing.assert_allclose(np.asarray(ys), want, atol=1e-10)
+
+
+def test_interp_guided_warped_grid_matches_searchsorted():
+    """`warped_grid_index` + `interp_guided` must reproduce jnp.interp on the
+    transition-warped hazard grid exactly — the analytic rank map replaces
+    searchsorted inside the HJB scan (the warp-honoring interest path's
+    measured 3.7x policy-sweep cost), so it must bracket identically at any
+    β, at knots, between knots, and out of range."""
+    from sbr_tpu.baseline.solver import _warped_grid, warped_grid_index
+    from sbr_tpu.core import interp_guided
+
+    rng = np.random.default_rng(3)
+    x0 = 1e-4
+    for beta in (1.0, 37.0, 1e3, 1e4):
+        eta = 15.0 / beta
+        n, warp = 257, 0.5
+        grid = np.asarray(_warped_grid(eta, beta, x0, n, warp, jnp.float64))
+        assert (np.diff(grid) >= 0).all()
+        fp = np.sin(grid * beta) + grid * beta  # pointwise function of knots
+        x = np.concatenate(
+            [rng.uniform(-0.1 * eta, 1.1 * eta, 501), grid, 0.5 * (grid[:-1] + grid[1:])]
+        )
+        guess = warped_grid_index(x, eta, beta, x0, n, warp)
+        got = np.asarray(
+            interp_guided(x, jnp.asarray(grid), jnp.asarray(fp), guess)
+        )
+        want = np.interp(x, grid, fp)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12, err_msg=f"beta={beta}")
