@@ -48,13 +48,18 @@ def main() -> None:
 
     src, dst = erdos_renyi_edges(n, deg, seed=0)
     cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
-    auto_pick = prepare_agent_graph(1.0, src, dst, n, config=cfg).engine
+    pg_auto = prepare_agent_graph(1.0, src, dst, n, config=cfg)
+    auto_pick = pg_auto.engine
     print(f"engine='auto' picks: {auto_pick}")
 
     results = {}
     final = {}
     for engine in ("gather", "incremental"):
-        pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine=engine)
+        # the auto probe already built one of the two graphs — reuse it
+        if engine == auto_pick:
+            pg = pg_auto
+        else:
+            pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine=engine)
         t0 = time.perf_counter()
         res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
         jax.block_until_ready(res.withdrawn_frac)

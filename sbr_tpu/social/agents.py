@@ -254,8 +254,8 @@ def _auto_engine(
 
     The incremental engine falls back to the full recount on any step in
     which (a) a changed agent's edge slice exceeds ``max_degree``, or (b)
-    the number of changed agents exceeds ``budget``. Pick incremental only
-    when the expected fallback steps stay under a quarter of the run:
+    the number of changed agents exceeds ``budget``. Pick incremental when
+    its expected cost in recount units beats the gather engine's:
 
     - Hub fallbacks: each agent whose (per-device) edge slice exceeds
       max_degree changes status at most twice per run → ≈ 2H steps.
@@ -276,6 +276,20 @@ def _auto_engine(
     a second time-shifted band and fallback steps can be undercounted —
     harmless for correctness (fallback is bit-identical), only for the
     throughput of a misclassified "incremental" choice.
+
+    The decision compares EXPECTED COST, not fallback fraction: a fallback
+    step costs one recount plus detection overhead (1+ε ≈ 1.15 recounts)
+    while a non-fallback incremental step is far cheaper than a recount —
+    ρ = 0.35 recounts here, conservative against the measured TPU ratio
+    (`ENGINE_COMPARE_tpu_2026-07-31.json`: 26 vs 94 ms/step at the 10⁶-
+    agent/10⁷-ER-edge bench shape, incremental 3.6× end-to-end — a shape
+    the old "fallbacks ≤ n_steps/4" threshold misrouted to gather because
+    its predicted ~57-step overflow band exceeded 50, fallback cost
+    notwithstanding). ρ is the TPU cost structure by design — on CPU the
+    recount runs at memory bandwidth and incremental is ~0.9×
+    (`SHARDED_ENGINES_cpu8_*.json`); the census stays platform-independent
+    so prepared graphs are portable, tuned for the hardware the framework
+    targets.
     """
     hubs = int((np.asarray(edge_slices) > max_degree).sum())
     fallback_steps = 2.0 * hubs
@@ -285,7 +299,11 @@ def _auto_engine(
             r = float(np.sqrt(0.25 - c))
             band = (2.0 / beta_mean) * float(np.log((0.5 + r) / (0.5 - r)))
             fallback_steps += band / dt
-    return "incremental" if fallback_steps <= max(2, n_steps // 4) else "gather"
+    rho, eps = 0.35, 0.15
+    cost_incremental = fallback_steps * (1.0 + eps) + max(
+        n_steps - fallback_steps, 0.0
+    ) * rho
+    return "incremental" if cost_incremental <= n_steps else "gather"
 
 
 def _max_chunk_slice(out_ptr: np.ndarray, ec: int, n: int) -> np.ndarray:
