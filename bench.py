@@ -425,6 +425,38 @@ def _tiny() -> bool:
     return os.environ.get("SBR_BENCH_SIZES", "").strip().lower() == "tiny"
 
 
+def pipelined_time(dispatch, start_rep: int, n_pipe: int | None = None):
+    """Sustained per-dispatch seconds: K dispatches in flight, ONE fence.
+
+    A single fenced dispatch on this rig pays the tunnel's RPC round-trip
+    (~0.1 s floor measured on the β×u grid: one 640-cell row costs 93% of
+    the full 409.6k-cell grid, and n_grid 512→2048 moves nothing —
+    ABLATE_GRID_tpu_2026-07-31), so per-rep fencing measures the tunnel,
+    not the sweep. The framework's own workload shape is back-to-back
+    dispatches (the 5000×5000 paper heatmap = 100 sequential tiles), hence
+    the sustained protocol: launch K reps without an intervening fetch,
+    then sum every rep's device-side reduction scalar ON DEVICE and read
+    the one result back — a single D2H read that data-depends on every rep
+    (stronger than stream ordering). `dispatch(rep)` must return
+    `(_, device_scalar)` where the scalar reduces that rep's outputs.
+    Returns (seconds_per_dispatch, n_pipe).
+    """
+    import numpy as np
+
+    if n_pipe is None:
+        n_pipe = 2 if _tiny() else 8
+    fences = []
+    t0 = time.perf_counter()
+    for rep in range(start_rep, start_rep + n_pipe):
+        _, fence = dispatch(rep)
+        fences.append(fence)
+    fence_total = float(sum(fences[1:], fences[0]))  # the one blocking read
+    pipelined_s = (time.perf_counter() - t0) / n_pipe
+    if not np.isfinite(fence_total):
+        raise RuntimeError(f"pipelined fence reduced to {fence_total}")
+    return pipelined_s, n_pipe
+
+
 def bench_grid(platform: str) -> dict:
     """Equilibria/sec on the β×u grid (f32 sweep path, refinement off)."""
     import jax.numpy as jnp
@@ -476,26 +508,7 @@ def bench_grid(platform: str) -> dict:
         times.append(time.perf_counter() - t0)
     dispatch_s = min(times)
 
-    # Sustained throughput: K dispatches in flight, ONE fence at the end.
-    # The per-dispatch fenced time above is dominated by the tunnel's RPC
-    # round-trip on this rig (measured ~0.1 s floor: one 640-cell row costs
-    # 93% of the full 409.6k-cell grid, and n_grid 512→2048 moves nothing —
-    # ABLATE_GRID_tpu_2026-07-31). The framework's own workload shape is
-    # back-to-back tiles (the 5000×5000 paper heatmap = 100 sequential
-    # dispatches), so the headline eq/s is measured pipelined: the TPU
-    # stream executes programs in launch order, hence fetching every rep's
-    # scalar after the LAST launch fences all of them while letting the
-    # device run without host round-trips in between.
-    n_pipe = 2 if _tiny() else 8
-    fences = []
-    t0 = time.perf_counter()
-    for rep in range(4, 4 + n_pipe):
-        grid, fence = dispatch(rep)
-        fences.append(fence)
-    # one device-side sum → ONE D2H read that data-depends on every rep
-    fence_total = float(sum(fences[1:], fences[0]))
-    pipelined_s = (time.perf_counter() - t0) / n_pipe
-    assert np.isfinite(fence_total)
+    pipelined_s, n_pipe = pipelined_time(dispatch, start_rep=4)
     elapsed = min(dispatch_s, pipelined_s)
 
     # Profiler capture around ONE steady-state rep (SURVEY §5.1; VERDICT r1
