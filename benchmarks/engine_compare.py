@@ -13,6 +13,10 @@ wrong quantity (fallback fraction, not expected cost) and gets retuned.
 
 Run: python benchmarks/engine_compare.py [n_agents] [avg_degree] [n_steps]
   SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.
+  SBR_ABL_GRAPH=scale_free switches to the STRETCH shape (Chung-Lu
+  γ=2.5 + lognormal(0, 0.5) per-agent β — `stretch.stretch_agents`),
+  answering whether the hub-census auto pick of "gather" there is right
+  by measurement rather than by the census model.
 """
 
 from __future__ import annotations
@@ -43,12 +47,21 @@ def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     deg = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
     n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 200
+    graph = os.environ.get("SBR_ABL_GRAPH", "er")
     platform = jax.devices()[0].platform
-    print(f"platform={platform} n={n} deg={deg} steps={n_steps}")
+    print(f"platform={platform} n={n} deg={deg} steps={n_steps} graph={graph}")
 
-    src, dst = erdos_renyi_edges(n, deg, seed=0)
+    if graph == "scale_free":
+        from sbr_tpu.social import scale_free_edges
+
+        src, dst = scale_free_edges(n, avg_degree=deg, gamma=2.5, seed=0)
+        rng = np.random.default_rng(1)  # same β law as stretch.stretch_agents
+        betas = rng.lognormal(mean=0.0, sigma=0.5, size=n).astype(np.float32)
+    else:
+        src, dst = erdos_renyi_edges(n, deg, seed=0)
+        betas = 1.0
     cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
-    pg_auto = prepare_agent_graph(1.0, src, dst, n, config=cfg)
+    pg_auto = prepare_agent_graph(betas, src, dst, n, config=cfg)
     auto_pick = pg_auto.engine
     print(f"engine='auto' picks: {auto_pick}")
 
@@ -59,7 +72,7 @@ def main() -> None:
         if engine == auto_pick:
             pg = pg_auto
         else:
-            pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine=engine)
+            pg = prepare_agent_graph(betas, src, dst, n, config=cfg, engine=engine)
         t0 = time.perf_counter()
         res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
         jax.block_until_ready(res.withdrawn_frac)
@@ -93,6 +106,7 @@ def main() -> None:
     if out_path:
         payload = {
             "platform": platform,
+            "graph": graph,
             "n_agents": n,
             "avg_degree": deg,
             "n_steps": n_steps,

@@ -38,7 +38,12 @@ def _log(msg: str) -> None:
     print(f"[stretch] {msg}", file=sys.stderr, flush=True)
 
 
-def stretch_agents(n: int = 1_000_000, n_steps: int = 200, avg_degree: float = 10.0) -> dict:
+def stretch_agents(
+    n: int = 1_000_000,
+    n_steps: int = 200,
+    avg_degree: float = 10.0,
+    max_steps_per_launch: int | None = None,
+) -> dict:
     import numpy as np
 
     from sbr_tpu.social import (
@@ -61,7 +66,9 @@ def stretch_agents(n: int = 1_000_000, n_steps: int = 200, avg_degree: float = 1
     src, dst = scale_free_edges(n, avg_degree=avg_degree, gamma=2.5, seed=0)
     gen_s = time.perf_counter() - t0
     _log(f"scale-free graph: {len(src)} edges in {gen_s:.1f}s")
-    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+    cfg = AgentSimConfig(
+        n_steps=n_steps, dt=0.05, max_steps_per_launch=max_steps_per_launch
+    )
     t0 = time.perf_counter()
     pg = prepare_agent_graph(betas, src, dst, n, config=cfg)
     prep_s = time.perf_counter() - t0

@@ -132,18 +132,33 @@ class AgentSimConfig:
       constant forcing, so dt controls only the forcing resolution.
     - exit_delay / reentry_delay: the equilibrium withdrawal window relative
       to each agent's informed time (see module docstring).
+    - max_steps_per_launch: bound the number of steps in any single device
+      execution; a run longer than this is split into host-level chunks
+      that carry (informed, t_inf) between launches. Results are
+      BIT-IDENTICAL to the unchunked run (tested): the step index is
+      global across chunks, so times and the per-(agent, step) RNG stream
+      are unchanged, and the withdrawn-neighbor counts are integers that
+      rebuild exactly from the carried state at each chunk start. Use when
+      a single launch would exceed an external execution deadline — the
+      axon TPU tunnel on this rig kills the worker ("TPU worker process
+      crashed") when one program runs longer than ~1-2 min, which the
+      10^7-agent x 200-step recount (~1.3 s/step) hits — or to create
+      natural checkpoint boundaries in very long simulations.
     """
 
     n_steps: int = 200
     dt: float = 0.1
     exit_delay: float = 0.0
     reentry_delay: float = float("inf")
+    max_steps_per_launch: Optional[int] = None
 
     def __post_init__(self):
         if self.n_steps < 1:
             raise ValueError("n_steps must be >= 1")
         if self.dt <= 0:
             raise ValueError("dt must be positive")
+        if self.max_steps_per_launch is not None and self.max_steps_per_launch < 1:
+            raise ValueError("max_steps_per_launch must be >= 1 (or None)")
 
 
 @struct.dataclass
@@ -383,7 +398,7 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
     dt = config.dt
 
     @jax.jit
-    def run(betas, src, row_ptr, indeg, dst2, out_ptr, outdeg, informed0, t_init, key):
+    def run(betas, src, row_ptr, indeg, dst2, out_ptr, outdeg, informed0, t_init, key, k0):
         n = betas.shape[0]
         e = src.shape[0]
         dtype = betas.dtype
@@ -430,9 +445,9 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
 
         init = (informed0, t_inf0, jnp.zeros(n, jnp.int32), jnp.zeros(n, bool))
         (informed, t_inf, _, _), (gs, aws) = lax.scan(
-            step, init, jnp.arange(config.n_steps)
+            step, init, jnp.arange(config.n_steps) + k0
         )
-        t_grid = jnp.arange(config.n_steps, dtype=dtype) * dt
+        t_grid = (jnp.arange(config.n_steps) + k0).astype(dtype) * dt
         return AgentSimResult(
             t_grid=t_grid,
             informed_frac=gs,
@@ -450,7 +465,7 @@ def _single_device_sim(config: AgentSimConfig):
     dt = config.dt
 
     @jax.jit
-    def run(betas, src, row_ptr, indeg, informed0, t_init, key):
+    def run(betas, src, row_ptr, indeg, informed0, t_init, key, k0):
         n = betas.shape[0]
         dtype = betas.dtype
         t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
@@ -473,9 +488,9 @@ def _single_device_sim(config: AgentSimConfig):
             return (informed2, t_inf2), obs
 
         (informed, t_inf), (gs, aws) = lax.scan(
-            step, (informed0, t_inf0), jnp.arange(config.n_steps)
+            step, (informed0, t_inf0), jnp.arange(config.n_steps) + k0
         )
-        t_grid = jnp.arange(config.n_steps, dtype=dtype) * dt
+        t_grid = (jnp.arange(config.n_steps) + k0).astype(dtype) * dt
         return AgentSimResult(
             t_grid=t_grid,
             informed_frac=gs,
@@ -516,7 +531,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
     dt = config.dt
     n_dev = mesh.shape[axis]
 
-    def shard_fn(betas, src, row_ptr, indeg, informed0, t_init, key):
+    def shard_fn(betas, src, row_ptr, indeg, informed0, t_init, key, k0):
         nb = betas.shape[0]  # local agent block
         dtype = betas.dtype
         idx = lax.axis_index(axis)
@@ -558,7 +573,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
             return (informed2, t_inf2), (g, aw)
 
         (informed, t_inf), (gs, aws) = lax.scan(
-            step, (informed0, t_inf0), jnp.arange(config.n_steps)
+            step, (informed0, t_inf0), jnp.arange(config.n_steps) + k0
         )
         return gs, aws, informed, t_inf
 
@@ -566,7 +581,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(), P()),
             out_specs=(P(), P(), P(axis), P(axis)),
         )
     )
@@ -610,7 +625,7 @@ def _sharded_incremental_sim(
     n_dev = mesh.shape[axis]
 
     def shard_fn(
-        betas, src, row_ptr, indeg, dst2, lstart, ldeg, informed0, t_init, key
+        betas, src, row_ptr, indeg, dst2, lstart, ldeg, informed0, t_init, key, k0
     ):
         nb = betas.shape[0]
         ec = dst2.shape[0]  # this device's edge-count-balanced chunk
@@ -691,7 +706,7 @@ def _sharded_incremental_sim(
             lax.pcast(jnp.zeros(n_gl // 8, jnp.uint8), (axis,), to="varying"),
         )
         (informed, t_inf, _, _), (gs, aws) = lax.scan(
-            step, init, jnp.arange(config.n_steps)
+            step, init, jnp.arange(config.n_steps) + k0
         )
         return gs, aws, informed, t_inf
 
@@ -699,7 +714,7 @@ def _sharded_incremental_sim(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(axis),) * 9 + (P(),),
+            in_specs=(P(axis),) * 9 + (P(), P()),
             out_specs=(P(), P(), P(axis), P(axis)),
         )
     )
@@ -927,6 +942,7 @@ def simulate_agents(
     incremental_budget: Optional[int] = None,
     incremental_max_degree: int = 64,
     prepared: Optional[PreparedAgentGraph] = None,
+    step_offset: int = 0,
 ) -> AgentSimResult:
     """Simulate N explicit agents learning from neighbor withdrawals.
 
@@ -953,6 +969,12 @@ def simulate_agents(
         may be negative — "informed before the simulation window starts" —
         which places mid-trajectory starts correctly relative to the
         withdrawal window (used by `closure.close_loop`). Default 0.
+      step_offset: global index of this call's first step; time starts at
+        step_offset·dt and the per-(agent, step) RNG stream continues from
+        there. With (informed0, t_inf0) taken from a previous result this
+        resumes that simulation exactly — the launch-chunking loop
+        (``config.max_steps_per_launch``) is built on it. Traced, so
+        resuming does not recompile.
       engine: "incremental" maintains withdrawn-neighbor counts by
         event-driven ±1 updates (each agent changes status ≤ 2× per run) —
         2.6× faster end-to-end than "gather" at the 10^6-agent north-star
@@ -1025,24 +1047,87 @@ def simulate_agents(
     n = prepared.n
     dtype_np = prepared.dtype
     for name, arr in (("informed0", informed0), ("t_inf0", t_inf0)):
-        if arr is not None and np.asarray(arr).shape[0] != n:
+        # np.shape, not np.asarray: the latter would materialize a device
+        # array to host, defeating the chunk-carry fast path below
+        if arr is not None and np.shape(arr)[0] != n:
             raise ValueError(
-                f"simulate_agents: {name} has length {np.asarray(arr).shape[0]} "
+                f"simulate_agents: {name} has length {np.shape(arr)[0]} "
                 f"but the graph has n = {n} agents"
             )
 
     # per-call state: seeds and informed times (the ONLY seed-dependent host
     # work — O(N), milliseconds; `_draw_seeds` is the single definition of
-    # the draw order, so prepared and direct calls match bit for bit)
-    if informed0 is not None:
-        informed0_h = np.ascontiguousarray(np.asarray(informed0, dtype=bool))
-    else:
+    # the draw order, so prepared and direct calls match bit for bit).
+    # Single-device fast path: state that is ALREADY a correctly-typed
+    # device array (a previous result's informed/t_inf — the launch-chunking
+    # loop's carry) is used as-is, skipping the ~2·O(N)-byte host round-trip
+    # per chunk boundary (~6 s at 10^7 agents over the axon tunnel) and
+    # letting consecutive launches pipeline on the device stream.
+    def _device_ok(arr, want_dtype):
+        return (
+            prepared.mesh is None
+            and isinstance(arr, jax.Array)
+            and arr.dtype == want_dtype
+            and arr.shape == (n,)
+        )
+
+    if informed0 is None:
         informed0_h = _draw_seeds(np.random.default_rng(seed), n, x0, exact_seeds)
+    elif _device_ok(informed0, jnp.bool_):
+        informed0_h = informed0
+    else:
+        informed0_h = np.ascontiguousarray(np.asarray(informed0, dtype=bool))
     if t_inf0 is None:
         t_init_h = np.zeros(n, dtype=dtype_np)
+    elif _device_ok(t_inf0, jnp.dtype(dtype_np)):
+        t_init_h = t_inf0
     else:
         t_init_h = np.ascontiguousarray(np.asarray(t_inf0, dtype=dtype_np))
     key = jax.random.PRNGKey(seed)
+
+    # Launch chunking: split one long device execution into equal host-level
+    # launches carrying (informed, t_inf); bit-identical to the unchunked
+    # run (see AgentSimConfig.max_steps_per_launch). Chunk lengths are
+    # equalized so at most two distinct programs compile.
+    launch_cap = config.max_steps_per_launch
+    if launch_cap is not None and launch_cap < config.n_steps:
+        n_chunks = -(-config.n_steps // launch_cap)
+        chunk_len = -(-config.n_steps // n_chunks)
+        parts = []
+        inf_c, tinf_c = informed0_h, t_init_h
+        done = 0
+        while done < config.n_steps:
+            this_len = min(chunk_len, config.n_steps - done)
+            cfg_c = dataclasses.replace(
+                config, n_steps=this_len, max_steps_per_launch=None
+            )
+            part = simulate_agents(
+                config=cfg_c, seed=seed, informed0=inf_c, t_inf0=tinf_c,
+                prepared=prepared, step_offset=step_offset + done,
+            )
+            parts.append(part)
+            inf_c, tinf_c = part.informed, part.t_inf
+            done += this_len
+            # cheap scalar fence per boundary: the axon tunnel mishandles a
+            # deep async execute queue (measured 2.7x slowdown when three
+            # 26 s launches were enqueued without an intervening fetch),
+            # while a fenced boundary costs one RPC round-trip. The big
+            # state arrays stay on device either way.
+            float(part.informed_frac[-1])
+        return AgentSimResult(
+            t_grid=jnp.concatenate([p.t_grid for p in parts]),
+            informed_frac=jnp.concatenate([p.informed_frac for p in parts]),
+            withdrawn_frac=jnp.concatenate([p.withdrawn_frac for p in parts]),
+            informed=parts[-1].informed,
+            t_inf=parts[-1].t_inf,
+            agent_steps=sum(p.agent_steps for p in parts),
+        )
+    if config.max_steps_per_launch is not None:
+        # non-binding cap (cap >= n_steps): normalize it out of the config so
+        # the lru-cached kernel constructors don't compile a duplicate
+        # program distinct from the cap=None entry
+        config = dataclasses.replace(config, max_steps_per_launch=None)
+    k0 = jnp.int32(step_offset)
 
     if prepared.mesh is None:
         if prepared.engine == "incremental":
@@ -1051,12 +1136,12 @@ def simulate_agents(
             return run(
                 prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
                 dst2_d, out_ptr_d, outdeg_d,
-                jnp.asarray(informed0_h), jnp.asarray(t_init_h), key,
+                jnp.asarray(informed0_h), jnp.asarray(t_init_h), key, k0,
             )
         run = _single_device_sim(config)
         return run(
             prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
-            jnp.asarray(informed0_h), jnp.asarray(t_init_h), key,
+            jnp.asarray(informed0_h), jnp.asarray(t_init_h), key, k0,
         )
 
     mesh = prepared.mesh
@@ -1076,13 +1161,13 @@ def simulate_agents(
         )
         gs, aws, informed, t_inf = fn(
             prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
-            dst2_sh, lstart_d, ldeg_d, informed0_d, t_init_d, key_repl,
+            dst2_sh, lstart_d, ldeg_d, informed0_d, t_init_d, key_repl, k0,
         )
     else:
         fn = _sharded_sim(config, mesh, mesh_axis, n, prepared.comm)
         gs, aws, informed, t_inf = fn(
             prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
-            informed0_d, t_init_d, key_repl,
+            informed0_d, t_init_d, key_repl, k0,
         )
     if n_pad:
         # The padding trim [:n] is not shard-aligned; all-gather the final
@@ -1090,7 +1175,7 @@ def simulate_agents(
         replicated = NamedSharding(mesh, P())
         informed = jax.device_put(informed, replicated)
         t_inf = jax.device_put(t_inf, replicated)
-    t_grid = jnp.arange(config.n_steps, dtype=gs.dtype) * config.dt
+    t_grid = (jnp.arange(config.n_steps) + k0).astype(gs.dtype) * config.dt
     return AgentSimResult(
         t_grid=t_grid,
         informed_frac=gs,
