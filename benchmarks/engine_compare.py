@@ -60,7 +60,11 @@ def main() -> None:
     else:
         src, dst = erdos_renyi_edges(n, deg, seed=0)
         betas = 1.0
-    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+    # SBR_ABL_CHUNK bounds single-launch duration (mandatory at the
+    # 10^7/10^8 shape — the axon tunnel kills executions over ~1-2 min;
+    # chunked results are bit-identical, tests/test_social.py)
+    chunk = int(os.environ.get("SBR_ABL_CHUNK", "0")) or None
+    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05, max_steps_per_launch=chunk)
     pg_auto = prepare_agent_graph(betas, src, dst, n, config=cfg)
     auto_pick = pg_auto.engine
     print(f"engine='auto' picks: {auto_pick}")

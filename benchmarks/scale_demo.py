@@ -54,8 +54,16 @@ def headline(n: int = 10_000_000, n_steps: int = 200) -> dict:
     number honestly includes."""
     import stretch  # sibling module; benchmarks/ is on sys.path as script dir
 
+    # engine pinned by measurement, not census: at exactly this shape the
+    # incremental engine runs 1.14x faster than gather (202.0 vs 230.5 s,
+    # ENGINE_COMPARE_sf1e7_tpu_2026-07-31.json, outputs identical); the
+    # auto census stays conservative here (its expected-change model puts
+    # hub fallbacks at ~99% of steps where the measured rate is ~66% —
+    # Chung-Lu hubs front-load their single change), so the demo pins what
+    # the measurement established.
     return stretch.stretch_agents(
-        n=n, n_steps=n_steps, avg_degree=10.0, max_steps_per_launch=20
+        n=n, n_steps=n_steps, avg_degree=10.0, max_steps_per_launch=20,
+        engine="incremental",
     )
 
 

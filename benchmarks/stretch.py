@@ -43,6 +43,7 @@ def stretch_agents(
     n_steps: int = 200,
     avg_degree: float = 10.0,
     max_steps_per_launch: int | None = None,
+    engine: str = "auto",
 ) -> dict:
     import numpy as np
 
@@ -70,7 +71,7 @@ def stretch_agents(
         n_steps=n_steps, dt=0.05, max_steps_per_launch=max_steps_per_launch
     )
     t0 = time.perf_counter()
-    pg = prepare_agent_graph(betas, src, dst, n, config=cfg)
+    pg = prepare_agent_graph(betas, src, dst, n, config=cfg, engine=engine)
     prep_s = time.perf_counter() - t0
     _log(f"graph prepared (engine={pg.engine}) in {prep_s:.1f}s")
 
@@ -177,7 +178,14 @@ def measure(platform: str) -> None:
 
     devices = bench._init_child_backend(platform)
     platform = devices[0].platform
-    agents = stretch_agents()
+    # engine pinned by measurement at exactly this shape: incremental 1.42x
+    # over gather (13.26 vs 18.87 s, ENGINE_COMPARE_sf_tpu_2026-07-31.json,
+    # outputs identical). The auto census stays conservative on heavy
+    # hub tails (its expected-change model saturates where the measured
+    # fallback rate is ~half — see RESULTS.md "Auto-engine census vs
+    # measurement"), so the stretch benchmark pins what its own shape's
+    # measurement established.
+    agents = stretch_agents(engine="incremental")
     policy = stretch_policy()
     print(
         json.dumps(

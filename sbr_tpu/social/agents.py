@@ -272,25 +272,21 @@ def _auto_engine(
     the number of changed agents exceeds ``budget``. Pick incremental when
     its expected cost in recount units beats the gather engine's:
 
-    - Hub fallbacks: each agent whose (per-device) edge slice exceeds
-      max_degree changes status at most twice per run → ≈ 2H steps.
-      ``edge_slices`` is the whole out-degree vector single-device, or the
-      per-agent MAX CHUNK SLICE under a mesh (edge-count sharding splits a
-      hub's edges across chunks, so the sharded census is milder).
-    - Mass-change overflow (ADVICE r3): the logistic bulk changes
-      ≈ n·β·G(1-G)·dt agents per step (withdrawal-window entries/exits
-      mirror informed transitions, doubling the rate), which can exceed
-      ``budget`` on exactly the steep steps the hub count ignores. Steps
-      above budget satisfy G(1-G) > c with c = budget/(2·n·β·dt); the
-      logistic spends (1/β)·ln(((1/2+r)/(1/2−r))²) time in that band,
-      r = √(1/4−c) — count those steps too.
+    Both mechanisms are counted per step against the logistic census
+    trajectory (see the inline comments): budget overflow is deterministic
+    in the step's expected change mass, and hub fallbacks saturate at one
+    per step via 1−exp(−H·ΔG̃) — hub changes cluster into the transition
+    steps, so the count is bounded by the steps in the active window, NOT
+    by 2H. ``edge_slices`` is the whole out-degree vector single-device,
+    or the per-agent MAX CHUNK SLICE under a mesh (edge-count sharding
+    splits a hub's edges across chunks, so the sharded census is milder).
 
-    Approximation (ADVICE r4): the factor 2 treats informed transitions and
-    withdrawal-window entries/exits as one synchronous band. With
-    reentry_delay − exit_delay larger than the band width, the exit wave is
-    a second time-shifted band and fallback steps can be undercounted —
-    harmless for correctness (fallback is bit-identical), only for the
-    throughput of a misclassified "incremental" choice.
+    Approximation (ADVICE r4): the factor 2 in ΔG̃ treats informed
+    transitions and withdrawal-window entries/exits as one synchronous
+    band. With reentry_delay − exit_delay larger than the band width the
+    exit wave is a second time-shifted band and fallback steps can be
+    undercounted — harmless for correctness (fallback is bit-identical),
+    only for the throughput of a misclassified "incremental" choice.
 
     The decision compares EXPECTED COST, not fallback fraction: a fallback
     step costs one recount plus detection overhead (1+ε ≈ 1.15 recounts)
@@ -307,13 +303,31 @@ def _auto_engine(
     targets.
     """
     hubs = int((np.asarray(edge_slices) > max_degree).sum())
-    fallback_steps = 2.0 * hubs
-    if beta_mean > 0 and budget > 0:
-        c = budget / (2.0 * n * beta_mean * dt)
-        if c < 0.25:
-            r = float(np.sqrt(0.25 - c))
-            band = (2.0 / beta_mean) * float(np.log((0.5 + r) / (0.5 - r)))
-            fallback_steps += band / dt
+    fallback_steps = 0.0
+    if beta_mean > 0:
+        # Per-step change mass from the logistic census trajectory
+        # G(t) = x0/(x0+(1-x0)e^{-βt}) started at the framework's default
+        # seed fraction (the census runs at prepare time, before x0 is
+        # known; small-seed contagion is the framework's domain, and a
+        # mid-trajectory caller mispredicts by at most the measured engine
+        # gap, never correctness). ΔG̃ doubles ΔG for the time-shifted
+        # withdrawal-window exit wave (ADVICE r3/r4).
+        x0c = 1e-4
+        t = np.arange(n_steps + 1) * dt
+        g = x0c / (x0c + (1.0 - x0c) * np.exp(-beta_mean * t))
+        dgt = 2.0 * np.diff(g)
+        # A step falls back when the changed-agent count exceeds budget
+        # (deterministic at the census mass) or ≥1 hub changes. Hub change
+        # times follow the same dG law (2 changes each: entry + exit), so
+        # the expected number of hub-fallback steps saturates per step —
+        # Σ(1-exp(-H·ΔG̃)) — instead of the old 2·H count, which
+        # overcounted by orders of magnitude once hubs clustered into the
+        # same transition steps (H ≫ n_steps: measured incremental WIN of
+        # 1.42x at the 10^6 scale-free stretch shape that the old census
+        # routed to gather, ENGINE_COMPARE_sf_tpu_2026-07-31.json).
+        overflow = (n * dgt > budget) if budget > 0 else np.zeros_like(dgt, bool)
+        p_hub = -np.expm1(-hubs * dgt) if hubs > 0 else 0.0
+        fallback_steps = float(np.sum(np.where(overflow, 1.0, p_hub)))
     rho, eps = 0.35, 0.15
     cost_incremental = fallback_steps * (1.0 + eps) + max(
         n_steps - fallback_steps, 0.0
