@@ -770,6 +770,9 @@ class PreparedAgentGraph:
     row_ptr: object
     indeg: object
     inc: Optional[tuple]  # engine-specific extra arrays, engine="incremental"
+    # engine="measure" only: ((engine_name, measured agent-steps/sec), ...)
+    # for both candidates, in measurement order — None otherwise
+    measured_steps_per_sec: Optional[Tuple[Tuple[str, float], ...]] = None
 
 
 def prepare_agent_graph(
@@ -785,18 +788,92 @@ def prepare_agent_graph(
     engine: str = "auto",
     incremental_budget: Optional[int] = None,
     incremental_max_degree: int = 64,
+    measure_probe: Optional[dict] = None,
 ) -> PreparedAgentGraph:
     """Host-side canonicalization + upload, factored out of simulate_agents.
 
     ``config`` enters only through the engine="auto" census (n_steps and dt
     set the expected fallback rate); the prepared graph is reusable with
     any config whose engine choice you are happy to keep.
+
+    ``engine``: "gather" / "incremental" pick explicitly; "auto" (default)
+    picks by the zero-overhead fallback-cost census; "measure" runs one
+    timed simulation per candidate on this graph and hardware and keeps
+    the faster one (the measured rates land on
+    ``PreparedAgentGraph.measured_steps_per_sec``) — the right choice when
+    one graph will be simulated many times and ~2 simulations of
+    measurement overhead amortizes (engines are bit-identical in results,
+    so the choice affects only throughput).
     """
     dtype = np.dtype(dtype)
-    if engine not in ("auto", "gather", "incremental"):
+    if engine not in ("auto", "gather", "incremental", "measure"):
         raise ValueError(f"Unknown engine {engine!r}")
     if comm not in ("scatter", "allgather_psum"):
         raise ValueError(f"Unknown comm strategy {comm!r}")
+
+    if engine == "measure":
+        # A/B-measure the engines on THIS graph, config, and hardware, and
+        # return the winner's prepared graph. The census (engine="auto") is
+        # a zero-overhead model and deliberately conservative on heavy hub
+        # tails (benchmarks/RESULTS.md "Auto-engine census vs measurement":
+        # it routed two scale-free shapes to gather where the measurement
+        # said incremental by 1.42x and 1.14x); when the same graph will be
+        # simulated many times, measuring IS the right decision procedure.
+        # Cost: one warm-up (compile) + one timed full run of the given
+        # config per candidate — ~2x a single simulation plus compiles —
+        # plus one re-preparation of the winner (candidates are dropped
+        # after timing so only ONE engine's device arrays are resident at a
+        # time; holding both would double peak HBM at exactly the large
+        # shapes this targets). The probe trajectory defaults to the
+        # default seeding (x0=1e-4, seed=0); because incremental-engine
+        # throughput depends on the change mass per step, callers whose
+        # real runs start elsewhere should pass ``measure_probe`` (a dict
+        # of simulate_agents state kwargs: x0 / seed / informed0 / t_inf0 /
+        # exact_seeds) so the timed trajectory is representative. Engines
+        # are bit-identical in RESULTS; the probe shapes only which one is
+        # faster.
+        import time as _time
+
+        probe = dict(measure_probe or {})
+        bad = set(probe) - {"x0", "seed", "informed0", "t_inf0", "exact_seeds"}
+        if bad:
+            raise ValueError(f"measure_probe: unknown keys {sorted(bad)}")
+        if np.size(src) == 0:
+            # both candidates coerce to gather on an edgeless graph — no
+            # measurement to run, and labeling a rate "incremental" would lie
+            return prepare_agent_graph(
+                betas, src, dst, n, config=config, mesh=mesh,
+                mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine="gather",
+                incremental_budget=incremental_budget,
+                incremental_max_degree=incremental_max_degree,
+            )
+        measured = []
+        best = None
+        for cand in ("gather", "incremental"):
+            pg_c = prepare_agent_graph(
+                betas, src, dst, n, config=config, mesh=mesh,
+                mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=cand,
+                incremental_budget=incremental_budget,
+                incremental_max_degree=incremental_max_degree,
+            )
+            res = simulate_agents(prepared=pg_c, config=config, **probe)
+            float(res.informed_frac[-1])  # warm-up incl. compile
+            t0 = _time.perf_counter()
+            res = simulate_agents(prepared=pg_c, config=config, **probe)
+            float(res.informed_frac[-1])  # device→host fence
+            rate = n * config.n_steps / (_time.perf_counter() - t0)
+            measured.append((cand, rate))
+            if best is None or rate > best[0]:
+                best = (rate, cand)
+            del pg_c, res  # free this candidate's device arrays
+        winner = prepare_agent_graph(
+            betas, src, dst, n, config=config, mesh=mesh,
+            mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=best[1],
+            incremental_budget=incremental_budget,
+            incremental_max_degree=incremental_max_degree,
+        )
+        return dataclasses.replace(winner, measured_steps_per_sec=tuple(measured))
+
     from sbr_tpu.native import sort_edges_by_dst
 
     betas_h, src_h, dst_h, indeg_h, row_ptr_h = _canonicalize_graph(
@@ -1001,6 +1078,9 @@ def simulate_agents(
         edge-count sharding splits hub edges across devices) plus the
         logistic mass-change overflow estimate; a scale-free hub tail or a
         fast contagion (n·β·dt ≫ budget through the bulk) keeps "gather".
+        For repeated simulations on one graph, prepare with
+        ``prepare_agent_graph(..., engine="measure")`` to A/B-time the
+        candidates on the actual hardware instead of trusting the census.
       incremental_budget: max changed agents handled incrementally per step
         (single-device default n//64 clamped to [4096, 65536]). With a mesh
         the budget — including an explicit value — is PER DEVICE, but the
@@ -1028,6 +1108,16 @@ def simulate_agents(
     if prepared is None:
         if betas is None or src is None or dst is None or n is None:
             raise ValueError("simulate_agents needs (betas, src, dst, n) or prepared=")
+        if engine == "measure":
+            # measure runs 2 warm-ups + 2 timed FULL simulations before the
+            # real one — ~5x wall-clock hidden inside a one-shot call, with
+            # the measured rates discarded. It only makes sense through
+            # prepare_agent_graph, where the cost amortizes over reuse.
+            raise ValueError(
+                "engine='measure' is a prepare_agent_graph feature: prepare "
+                "the graph once (the A/B timing amortizes over repeated "
+                "simulations) and pass prepared= here"
+            )
         prepared = prepare_agent_graph(
             betas, src, dst, n, config=config, mesh=mesh, mesh_axis=mesh_axis,
             dtype=dtype, comm=comm, engine=engine,

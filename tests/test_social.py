@@ -726,3 +726,85 @@ class TestLaunchChunking:
         )
         np.testing.assert_array_equal(np.asarray(full.informed), np.asarray(b.informed))
         np.testing.assert_array_equal(np.asarray(full.t_inf), np.asarray(b.t_inf))
+
+
+class TestMeasuredEngine:
+    def test_measure_picks_a_winner_and_matches_both(self):
+        """engine="measure" must return one of the two engines with rates
+        recorded for both, and simulating with the winner must match both
+        explicit engines bit for bit (outputs are engine-invariant)."""
+        from sbr_tpu.social import prepare_agent_graph
+
+        n = 2000
+        src, dst = erdos_renyi_edges(n, 10.0, seed=21)
+        cfg = AgentSimConfig(n_steps=30, dt=0.1, exit_delay=0.1, reentry_delay=1.5)
+        pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine="measure")
+        assert pg.engine in ("gather", "incremental")
+        assert pg.measured_steps_per_sec is not None
+        names = [e for e, _ in pg.measured_steps_per_sec]
+        assert sorted(names) == ["gather", "incremental"]
+        assert all(rate > 0 for _, rate in pg.measured_steps_per_sec)
+        got = simulate_agents(prepared=pg, x0=0.01, config=cfg, seed=5)
+        for eng in ("gather", "incremental"):
+            want = simulate_agents(
+                1.0, src, dst, n, x0=0.01, config=cfg, seed=5, engine=eng
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.informed), np.asarray(want.informed)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.withdrawn_frac), np.asarray(want.withdrawn_frac)
+            )
+
+    def test_measure_rejected_alongside_prepared(self):
+        """The prepared= conflict guard still fires for engine='measure'."""
+        from sbr_tpu.social import prepare_agent_graph
+
+        n = 500
+        src, dst = erdos_renyi_edges(n, 5.0, seed=22)
+        cfg = AgentSimConfig(n_steps=5, dt=0.1)
+        pg = prepare_agent_graph(1.0, src, dst, n, config=cfg)
+        with pytest.raises(ValueError, match="conflict with prepared"):
+            simulate_agents(prepared=pg, config=cfg, engine="measure")
+
+    def test_measure_rejected_on_direct_simulate_call(self):
+        """engine='measure' hides ~5x wall-clock in a one-shot call and
+        discards the rates — only the prepare path accepts it."""
+        n = 500
+        src, dst = erdos_renyi_edges(n, 5.0, seed=23)
+        with pytest.raises(ValueError, match="prepare_agent_graph feature"):
+            simulate_agents(
+                1.0, src, dst, n, config=AgentSimConfig(n_steps=5, dt=0.1),
+                engine="measure",
+            )
+
+    def test_measure_probe_passthrough_and_validation(self):
+        """measure_probe shapes the timed trajectory; unknown keys fail."""
+        from sbr_tpu.social import prepare_agent_graph
+
+        n = 1000
+        src, dst = erdos_renyi_edges(n, 8.0, seed=24)
+        cfg = AgentSimConfig(n_steps=10, dt=0.1)
+        pg = prepare_agent_graph(
+            1.0, src, dst, n, config=cfg, engine="measure",
+            measure_probe={"x0": 0.3, "seed": 7},
+        )
+        assert pg.engine in ("gather", "incremental")
+        with pytest.raises(ValueError, match="unknown keys"):
+            prepare_agent_graph(
+                1.0, src, dst, n, config=cfg, engine="measure",
+                measure_probe={"not_a_key": 1},
+            )
+
+    def test_measure_empty_graph_short_circuits(self):
+        """No edges: both candidates coerce to gather, so measure returns
+        the gather prep without fake 'incremental' rates."""
+        from sbr_tpu.social import prepare_agent_graph
+
+        e = np.zeros(0, np.int32)
+        pg = prepare_agent_graph(
+            1.0, e, e, 100, config=AgentSimConfig(n_steps=3, dt=0.1),
+            engine="measure",
+        )
+        assert pg.engine == "gather"
+        assert pg.measured_steps_per_sec is None
