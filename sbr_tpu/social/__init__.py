@@ -18,7 +18,9 @@ from sbr_tpu.social.agents import (
     AgentSimResult,
     PreparedAgentGraph,
     erdos_renyi_edges,
+    load_agent_state,
     prepare_agent_graph,
+    save_agent_state,
     scale_free_edges,
     simulate_agents,
 )
@@ -35,6 +37,8 @@ __all__ = [
     "prepare_agent_graph",
     "scale_free_edges",
     "simulate_agents",
+    "save_agent_state",
+    "load_agent_state",
     "LoopComparison",
     "close_loop",
     "equilibrium_window",

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from pathlib import Path
 from typing import Optional, Tuple
 
 import jax
@@ -810,6 +811,12 @@ def prepare_agent_graph(
         raise ValueError(f"Unknown engine {engine!r}")
     if comm not in ("scatter", "allgather_psum"):
         raise ValueError(f"Unknown comm strategy {comm!r}")
+    if measure_probe is not None and engine != "measure":
+        # same loud-rejection policy as the prepared= conflict guard: a
+        # probe passed without engine="measure" would be silently ignored
+        raise ValueError(
+            f"measure_probe= only applies to engine='measure' (got engine={engine!r})"
+        )
 
     if engine == "measure":
         # A/B-measure the engines on THIS graph, config, and hardware, and
@@ -848,8 +855,9 @@ def prepare_agent_graph(
                 incremental_max_degree=incremental_max_degree,
             )
         measured = []
-        best = None
+        pg_c = None
         for cand in ("gather", "incremental"):
+            del pg_c  # previous candidate's device arrays, if any
             pg_c = prepare_agent_graph(
                 betas, src, dst, n, config=config, mesh=mesh,
                 mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=cand,
@@ -863,16 +871,17 @@ def prepare_agent_graph(
             float(res.informed_frac[-1])  # device→host fence
             rate = n * config.n_steps / (_time.perf_counter() - t0)
             measured.append((cand, rate))
-            if best is None or rate > best[0]:
-                best = (rate, cand)
-            del pg_c, res  # free this candidate's device arrays
-        winner = prepare_agent_graph(
-            betas, src, dst, n, config=config, mesh=mesh,
-            mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=best[1],
-            incremental_budget=incremental_budget,
-            incremental_max_degree=incremental_max_degree,
-        )
-        return dataclasses.replace(winner, measured_steps_per_sec=tuple(measured))
+            del res
+        winner_name = max(measured, key=lambda t: t[1])[0]
+        if winner_name != pg_c.engine:  # only the last candidate is resident
+            del pg_c
+            pg_c = prepare_agent_graph(
+                betas, src, dst, n, config=config, mesh=mesh,
+                mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=winner_name,
+                incremental_budget=incremental_budget,
+                incremental_max_degree=incremental_max_degree,
+            )
+        return dataclasses.replace(pg_c, measured_steps_per_sec=tuple(measured))
 
     from sbr_tpu.native import sort_edges_by_dst
 
@@ -1012,6 +1021,56 @@ def prepare_agent_graph(
         betas=put(betas_h), src=put(src_h), row_ptr=put(row_ptrs_h),
         indeg=put(indeg_h), inc=inc,
     )
+
+
+def save_agent_state(path, result: AgentSimResult, seed: int, dt: float) -> None:
+    """Persist a simulation's exact-resume state to ``path`` (atomic npz).
+
+    Captures everything `simulate_agents` needs to continue ``result``'s
+    trajectory bit-identically (the disk form of the in-memory
+    ``step_offset``/``informed0``/``t_inf0`` resume surface; the graph is
+    NOT stored — re-prepare it with `prepare_agent_graph`, it is
+    deterministic in its inputs). ``seed`` must be the seed the run used:
+    the per-(agent, step) RNG stream is keyed on it, so resuming under a
+    different seed is a different (valid) realization, not a continuation.
+    """
+    from sbr_tpu.utils.checkpoint import _save_atomic
+
+    t0 = float(result.t_grid[..., 0])
+    k0 = int(round(t0 / dt))
+    _save_atomic(
+        Path(path),
+        dict(
+            informed=np.asarray(result.informed),
+            t_inf=np.asarray(result.t_inf),
+            next_step=np.int64(k0 + result.t_grid.shape[-1]),
+            seed=np.int64(seed),
+            dt=np.float64(dt),
+        ),
+    )
+
+
+def load_agent_state(path, dt: Optional[float] = None) -> dict:
+    """Load a `save_agent_state` checkpoint as `simulate_agents` kwargs.
+
+    Returns ``{"informed0", "t_inf0", "step_offset", "seed"}`` — splat into
+    the resuming call (with the same graph and a config whose ``dt``
+    matches; pass ``dt`` here to validate that early). Resumption is
+    bit-identical to an uninterrupted run
+    (tests/test_social.py::TestLaunchChunking).
+    """
+    with np.load(Path(path)) as d:
+        if dt is not None and abs(float(d["dt"]) - dt) > 1e-12:
+            raise ValueError(
+                f"checkpoint was written at dt={float(d['dt'])}, resuming "
+                f"config has dt={dt} — the step grid would not line up"
+            )
+        return {
+            "informed0": d["informed"],
+            "t_inf0": d["t_inf"],
+            "step_offset": int(d["next_step"]),
+            "seed": int(d["seed"]),
+        }
 
 
 def simulate_agents(

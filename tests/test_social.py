@@ -808,3 +808,52 @@ class TestMeasuredEngine:
         )
         assert pg.engine == "gather"
         assert pg.measured_steps_per_sec is None
+
+
+class TestAgentStateCheckpoint:
+    def test_disk_resume_bit_identical(self, tmp_path):
+        """save → load → resume reproduces the uninterrupted run exactly
+        (the disk form of the step_offset resume surface)."""
+        from sbr_tpu.social import load_agent_state, save_agent_state
+
+        n = 2000
+        src, dst = erdos_renyi_edges(n, 12.0, seed=31)
+        mk = lambda steps: AgentSimConfig(
+            n_steps=steps, dt=0.1, exit_delay=0.3, reentry_delay=2.0
+        )
+        full = simulate_agents(2.0, src, dst, n, x0=0.02, seed=6, config=mk(30))
+        a = simulate_agents(2.0, src, dst, n, x0=0.02, seed=6, config=mk(18))
+        ckpt = tmp_path / "agents.npz"
+        save_agent_state(ckpt, a, seed=6, dt=0.1)
+        resume = load_agent_state(ckpt, dt=0.1)
+        assert resume["step_offset"] == 18 and resume["seed"] == 6
+        b = simulate_agents(2.0, src, dst, n, x0=0.02, config=mk(12), **resume)
+        np.testing.assert_array_equal(
+            np.asarray(full.informed_frac),
+            np.concatenate([np.asarray(a.informed_frac), np.asarray(b.informed_frac)]),
+        )
+        np.testing.assert_array_equal(np.asarray(full.informed), np.asarray(b.informed))
+        np.testing.assert_array_equal(np.asarray(full.t_inf), np.asarray(b.t_inf))
+
+    def test_dt_mismatch_rejected(self, tmp_path):
+        from sbr_tpu.social import load_agent_state, save_agent_state
+
+        n = 300
+        src, dst = erdos_renyi_edges(n, 5.0, seed=32)
+        r = simulate_agents(1.0, src, dst, n, x0=0.02, seed=0,
+                            config=AgentSimConfig(n_steps=4, dt=0.1))
+        ckpt = tmp_path / "s.npz"
+        save_agent_state(ckpt, r, seed=0, dt=0.1)
+        with pytest.raises(ValueError, match="dt"):
+            load_agent_state(ckpt, dt=0.05)
+
+    def test_probe_without_measure_engine_rejected(self):
+        from sbr_tpu.social import prepare_agent_graph
+
+        n = 300
+        src, dst = erdos_renyi_edges(n, 5.0, seed=33)
+        with pytest.raises(ValueError, match="only applies to engine='measure'"):
+            prepare_agent_graph(
+                1.0, src, dst, n, config=AgentSimConfig(n_steps=3, dt=0.1),
+                measure_probe={"x0": 0.1},
+            )
