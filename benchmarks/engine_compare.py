@@ -10,6 +10,11 @@ n_steps/4 threshold, so auto picks "gather"; the r3 measurement says the
 incremental engine wins 2.6x at this exact shape INCLUDING those
 fallbacks. If that ratio reproduces, the census threshold models the
 wrong quantity (fallback fraction, not expected cost) and gets retuned.
+[Resolved 2026-07-31: it reproduced at 3.6x (ENGINE_COMPARE_tpu_*.json),
+the census was retuned to expected cost and then to the saturating
+per-step model, and the scale-free runs (SBR_ABL_GRAPH=scale_free, at
+10^6 and chunked 10^7) measured the remaining conservative bias —
+benchmarks/RESULTS.md "Auto-engine census vs measurement".]
 
 Run: python benchmarks/engine_compare.py [n_agents] [avg_degree] [n_steps]
   SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.

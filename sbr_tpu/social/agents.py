@@ -23,9 +23,10 @@ counts, bit-identical in results (tested):
 - "incremental": event-driven — an agent's withdrawal status changes at
   most twice per run, so counts are maintained by ±1 updates over changed
   agents' out-edges, with the full recount as the overflow fallback
-  (2.6× end-to-end at the 10^6-agent shape; `_incremental_sim`). Under a
-  mesh, out-edges are sharded by EDGE COUNT (src-sorted chunks of exactly
-  E/n_dev), so it is the sharded default too (`_sharded_incremental_sim`).
+  (3.6× end-to-end at the 10^6-agent ER shape, 2026-07-31 re-anchor;
+  `_incremental_sim`). Under a mesh, out-edges are sharded by EDGE COUNT
+  (src-sorted chunks of exactly E/n_dev), and the same engine choice
+  machinery applies (`_sharded_incremental_sim`).
 
 The default ("auto") picks by expected fallback steps: the hub tail (per-
 chunk slice tail under a mesh) plus a logistic mass-change overflow
@@ -403,9 +404,9 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
     out-degree > budget_deg changed), fall back to the full segmented
     recount for that step via `lax.cond` — the invariant holds either way,
     so results are BIT-IDENTICAL to the gather engine (tested), only faster:
-    PER-STEP, compaction ~10 ms + grid scatter ~3 ms vs ~95 ms for the full
-    recount at the 10^6-agent north-star shape; end-to-end 2.6× (8.1 s vs
-    21.1 s on v5e — ablations in benchmarks/RESULTS.md).
+    PER-STEP, ~26 ms clean vs ~95 ms for the full recount at the
+    10^6-agent north-star shape; end-to-end 3.6× (5.2 s vs 18.9 s on v5e,
+    ENGINE_COMPARE_tpu_2026-07-31 — ablations in benchmarks/RESULTS.md).
 
     Step 0 initializes counts from dwd vs an all-False previous mask, so the
     x0·N founding seeds enter through the same event path.
@@ -1127,8 +1128,8 @@ def simulate_agents(
         resuming does not recompile.
       engine: "incremental" maintains withdrawn-neighbor counts by
         event-driven ±1 updates (each agent changes status ≤ 2× per run) —
-        2.6× faster end-to-end than "gather" at the 10^6-agent north-star
-        shape (8.1 s vs 21.1 s on v5e, benchmarks/RESULTS.md) and
+        3.6× faster end-to-end than "gather" at the 10^6-agent north-star
+        shape (5.2 s vs 18.9 s on v5e, benchmarks/RESULTS.md) and
         BIT-IDENTICAL in results (fallback to the full recount on budget
         overflow keeps exactness); "gather" recounts all edges every step;
         "auto" (default) chooses by the expected fallback-step count
