@@ -1,0 +1,153 @@
+"""A/B the incremental engines' per-step change compaction on hardware.
+
+`_compact_ids` (ascending True indices, dump-padded) runs every step of
+the incremental engines and is their largest clean-step cost: the
+cumsum+scatter lowering measured 8.2 ms standalone at N=10⁶ on v5e —
+~36% of the 22.7 ms headline step. The scatter writes all N ids (the ~N
+invalid ones collide on the dump slot and are sliced away), which is the
+suspected wall: TPU scatter serializes on colliding indices. The
+"searchsorted" lowering removes the scatter entirely — rank j's id is
+the first index where the monotone cumsum reaches j+1, i.e. `budget`
+vectorized binary searches (log₂N ≈ 20 gather rounds of `budget`
+elements ≈ 3×10⁵ gathers at the measured ~1.3×10⁸ elem/s ≫ the N-write
+scatter). Both lowerings are bit-identical (tests/test_social.py).
+
+This script times (a) the parts standalone — both lowerings, the shared
+cumsum, and the per-agent RNG for context — and (b) the incremental
+engine end-to-end at the headline bench shape under each
+`AgentSimConfig.compact_impl`, asserting identical final states. The
+winner becomes the config default (benchmarks/RESULTS.md records the
+verdict).
+
+Run: python benchmarks/ablate_compaction.py [n_agents] [avg_degree] [n_steps]
+  SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    if os.environ.get("SBR_ABL_PLATFORM", "") == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu.social import (
+        AgentSimConfig,
+        erdos_renyi_edges,
+        prepare_agent_graph,
+        simulate_agents,
+    )
+    from sbr_tpu.social.agents import (
+        _agent_uniforms,
+        _compact_ids,
+        _default_incremental_budget,
+    )
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    deg = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 200
+    budget = _default_incremental_budget(n)  # the engine's actual default
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} n={n} budget={budget}")
+
+    # -- parts, standalone, at a realistic clean-step change density -------
+    rng = np.random.default_rng(0)
+    mask_np = np.zeros(n, bool)
+    mask_np[rng.choice(n, size=max(1, n // 330), replace=False)] = True
+    mask = jnp.asarray(mask_np)
+
+    def timed(fn, *args, reps=50):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    parts = {}
+    for name, fn in [
+        ("scatter", jax.jit(lambda m: _compact_ids(m, budget, n, "scatter"))),
+        ("searchsorted", jax.jit(lambda m: _compact_ids(m, budget, n, "searchsorted"))),
+        ("cumsum_only", jax.jit(lambda m: jnp.cumsum(m.astype(jnp.int32)))),
+    ]:
+        parts[name] = round(timed(fn, mask) * 1e3, 3)
+        print(f"  part {name:>14}: {parts[name]:8.3f} ms")
+    ids = jnp.arange(n, dtype=jnp.uint32)
+    key = jax.random.PRNGKey(0)
+    parts["agent_uniforms"] = round(
+        timed(jax.jit(lambda k: _agent_uniforms(k, jnp.int32(3), ids, jnp.float32)), key,
+              reps=20) * 1e3, 3,
+    )
+    print(f"  part {'agent_uniforms':>14}: {parts['agent_uniforms']:8.3f} ms (context)")
+
+    # -- end to end at the bench shape ------------------------------------
+    src, dst = erdos_renyi_edges(n, deg, seed=0)
+    results = {}
+    final = {}
+    for impl in ("scatter", "searchsorted"):
+        cfg = AgentSimConfig(n_steps=n_steps, dt=0.05, compact_impl=impl)
+        pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine="incremental")
+        t0 = time.perf_counter()
+        res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+        jax.block_until_ready(res.withdrawn_frac)
+        first = time.perf_counter() - t0
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+            # device-side sync only inside the timed region; the final-state
+            # capture (an N-bool device->host copy) happens after the loop
+            jax.block_until_ready(res.withdrawn_frac)
+            times.append(time.perf_counter() - t0)
+        final[impl] = (
+            int(np.asarray(res.informed).sum()),
+            float(res.withdrawn_frac[-1]),
+        )
+        best = min(times)
+        results[impl] = {
+            "first_call_s": round(first, 2),
+            "steady_s": round(best, 3),
+            "agent_steps_per_sec": round(n * n_steps / best, 1),
+        }
+        print(
+            f"  e2e {impl:>14}: {best:.3f}s steady "
+            f"({n * n_steps / best / 1e6:.1f}M agent-steps/s; first {first:.1f}s)"
+        )
+
+    assert final["scatter"] == final["searchsorted"], final
+    ratio = results["scatter"]["steady_s"] / results["searchsorted"]["steady_s"]
+    verdict = "searchsorted" if ratio > 1.02 else ("scatter" if ratio < 0.98 else "tie")
+    print(f"  scatter/searchsorted steady ratio: {ratio:.2f} -> {verdict}")
+
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        payload = {
+            "platform": platform,
+            "n_agents": n,
+            "budget": budget,
+            "n_steps": n_steps,
+            "parts_ms": parts,
+            "end_to_end": results,
+            "ratio_scatter_over_searchsorted": round(ratio, 3),
+            "verdict": verdict,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

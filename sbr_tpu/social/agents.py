@@ -153,6 +153,11 @@ class AgentSimConfig:
     exit_delay: float = 0.0
     reentry_delay: float = float("inf")
     max_steps_per_launch: Optional[int] = None
+    # Lowering of the incremental engines' per-step change compaction
+    # ("scatter" | "searchsorted" — bit-identical, see `_compact_ids`).
+    # A perf-only knob in the `engine="measure"` spirit: the winner is
+    # hardware-dependent, so it stays selectable for on-device A/B.
+    compact_impl: str = "scatter"
 
     def __post_init__(self):
         if self.n_steps < 1:
@@ -161,6 +166,8 @@ class AgentSimConfig:
             raise ValueError("dt must be positive")
         if self.max_steps_per_launch is not None and self.max_steps_per_launch < 1:
             raise ValueError("max_steps_per_launch must be >= 1 (or None)")
+        if self.compact_impl not in ("scatter", "searchsorted"):
+            raise ValueError("compact_impl must be 'scatter' or 'searchsorted'")
 
 
 @struct.dataclass
@@ -195,14 +202,30 @@ def _withdrawn(informed, t_inf, t, exit_delay, reentry_delay):
     return informed & (t >= t_inf + exit_delay) & (t < t_inf + reentry_delay)
 
 
-def _compact_ids(mask, budget: int, dump: int):
+def _compact_ids(mask, budget: int, dump: int, impl: str = "scatter"):
     """Ascending indices of True entries, padded with ``dump`` — the
-    `jnp.nonzero(size=budget, fill_value=dump)[0]` contract, lowered
-    explicitly as cumsum + scatter: bit-identical output (incl. the
-    overflow case, where both keep the first ``budget`` True indices) and
-    measured 1.4× faster than the nonzero lowering on v5e at N=10⁶
-    (8.2 vs 11.1 ms standalone A/B) — this runs every step of the
-    incremental engines, where it is the largest clean-step cost."""
+    `jnp.nonzero(size=budget, fill_value=dump)[0]` contract. Runs every
+    step of the incremental engines, where it is the largest clean-step
+    cost; two bit-identical lowerings (incl. the overflow case, where both
+    keep the first ``budget`` True indices — tested against each other):
+
+    - "scatter": cumsum + scatter of the full id array (1.4× faster than
+      the `jnp.nonzero` lowering on v5e at N=10⁶: 8.2 vs 11.1 ms
+      standalone). Every one of the N writes lands — the ~N invalid ones
+      all collide on the dump slot and are sliced away.
+    - "searchsorted": rank j's id is the first index where the cumsum
+      reaches j+1, so ``budget`` vectorized binary searches (log₂N gather
+      rounds over the monotone cumsum) replace the N-write scatter
+      entirely; for ranks beyond the population the search falls off the
+      end at exactly ``mask.size`` → dump.
+
+    `benchmarks/ablate_compaction.py` A/Bs both (plus the parts) on
+    hardware; `AgentSimConfig.compact_impl` selects per run."""
+    if impl == "searchsorted":
+        c = jnp.cumsum(mask.astype(jnp.int32))
+        q = jnp.arange(1, budget + 1, dtype=jnp.int32)
+        res = jnp.searchsorted(c, q, side="left").astype(jnp.int32)
+        return jnp.where(res >= mask.shape[0], jnp.int32(dump), res)
     pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
     idx = jnp.where(mask & (pos < budget), pos, budget)
     ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
@@ -351,6 +374,17 @@ def _auto_engine(
     return "incremental" if cost_incremental <= n_steps else "gather"
 
 
+def _default_incremental_budget(n_block: int, floor: int = 4096) -> int:
+    """Default per-step changed-agent budget for the incremental engines —
+    the ONE definition shared by `prepare_agent_graph`'s auto census, its
+    runtime budget, and the compaction ablation (which must time
+    `_compact_ids` at the budget the engine actually uses). ``n_block`` is
+    the per-device agent-block length (= n on a single device); the
+    sharded path uses a lower floor (512) since its budget multiplies
+    across devices."""
+    return min(max(floor, n_block // 64), 65536)
+
+
 def _max_chunk_slice(out_ptr: np.ndarray, ec: int, n: int) -> np.ndarray:
     """Per-agent largest out-edge slice under edge-count sharding with chunk
     size ``ec``: an agent's contiguous src-sorted edge range [start, end)
@@ -445,7 +479,7 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
             changed = dwd != 0
             n_changed = jnp.sum(changed)
 
-            cids = _compact_ids(changed, budget_agents, n)
+            cids = _compact_ids(changed, budget_agents, n, config.compact_impl)
             valid = cids < n
             cids_c = jnp.minimum(cids, n - 1).astype(jnp.int32)
             degs = jnp.where(valid, outdeg[cids_c], 0)
@@ -690,7 +724,7 @@ def _sharded_incremental_sim(
 
             visible = changed & has_edges
             n_vis = jnp.sum(visible)
-            cids = _compact_ids(visible, budget_agents, n_gl)
+            cids = _compact_ids(visible, budget_agents, n_gl, config.compact_impl)
             valid = cids < n_gl
             cids_c = jnp.minimum(cids, n_gl - 1).astype(jnp.int32)
             degs = jnp.where(valid, ldeg[cids_c], 0)
@@ -914,7 +948,7 @@ def prepare_agent_graph(
             outdeg_c = np.bincount(src_h, minlength=n).astype(np.int64)
             if mesh is None:
                 census = outdeg_c
-                budget_est = incremental_budget or min(max(4096, n // 64), 65536)
+                budget_est = incremental_budget or _default_incremental_budget(n)
             else:
                 # edge-count sharding splits hub edges across chunks, and the
                 # per-device change budget multiplies across devices — census
@@ -930,7 +964,7 @@ def prepare_agent_graph(
                 n_gl_a = n + (-n) % (8 * n_dev_a)
                 nb_a = n_gl_a // n_dev_a
                 budget_est = (
-                    incremental_budget or min(max(512, nb_a // 64), 65536)
+                    incremental_budget or _default_incremental_budget(nb_a, floor=512)
                 ) * n_dev_a
             engine = _auto_engine(
                 census,
@@ -953,7 +987,7 @@ def prepare_agent_graph(
             dst2_h, _, outdeg_h, out_ptr_h = sort_edges_by_dst(dst_h, src_h, n)
             budget = incremental_budget
             if budget is None:
-                budget = min(max(4096, n // 64), 65536)
+                budget = _default_incremental_budget(n)
             inc = (
                 jnp.asarray(dst2_h),
                 jnp.asarray(out_ptr_h.astype(np.int32)),
@@ -1025,7 +1059,7 @@ def prepare_agent_graph(
             ldeg_h[d, :n] = (e_ - s).astype(np.int32)
         budget = incremental_budget
         if budget is None:
-            budget = min(max(512, nb // 64), 65536)
+            budget = _default_incremental_budget(nb, floor=512)
         inc = (put(dst2_sh), put(lstart_h), put(ldeg_h))
     else:
         budget, inc = 0, None

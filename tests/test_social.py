@@ -6,6 +6,8 @@ numpy mirror of the reference's damped fixed point
 limit of the explicit-agent simulation, which must recover the baseline
 logistic (AW = G ⇒ dG/dt = β·G·(1-G))."""
 
+from dataclasses import replace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -426,6 +428,57 @@ class TestIncrementalEngine:
         src, dst = erdos_renyi_edges(n, 4.0, seed=0)
         with pytest.raises(ValueError, match="Unknown engine"):
             simulate_agents(1.0, src, dst, n, engine="warp")
+
+    def test_compact_impls_bit_identical(self):
+        """The two `_compact_ids` lowerings (cumsum+scatter vs searchsorted)
+        are the same function: ascending True indices, dump-padded, first
+        `budget` kept on overflow — across densities incl. empty, full,
+        exactly-at-budget, and a dump sentinel different from n."""
+        from sbr_tpu.social.agents import _compact_ids
+
+        rng = np.random.default_rng(11)
+        for n, budget, dump, k in [
+            (1000, 64, 1000, 0),
+            (1000, 64, 1000, 1),
+            (1000, 64, 1000, 63),
+            (1000, 64, 1000, 64),
+            (1000, 64, 1000, 65),
+            (1000, 64, 1000, 1000),
+            (1000, 64, 2**30, 170),
+            (257, 300, 257, 40),  # budget > n
+        ]:
+            mask = np.zeros(n, bool)
+            if k:
+                mask[rng.choice(n, size=min(k, n), replace=False)] = True
+            a = np.asarray(_compact_ids(jnp.asarray(mask), budget, dump, "scatter"))
+            b = np.asarray(_compact_ids(jnp.asarray(mask), budget, dump, "searchsorted"))
+            np.testing.assert_array_equal(a, b, err_msg=f"n={n} budget={budget} k={k}")
+
+    def test_compact_impl_config_bit_identical(self):
+        """engine='incremental' under compact_impl='searchsorted' reproduces
+        the default lowering's results exactly (through fallback steps too)."""
+        n = 4000
+        src, dst = erdos_renyi_edges(n, 10.0, seed=23)
+        for extra in ({}, {"incremental_budget": 48}):
+            base = AgentSimConfig(n_steps=80, dt=0.1, exit_delay=0.2, reentry_delay=1.8)
+            alt = replace(base, compact_impl="searchsorted")
+            a = simulate_agents(
+                1.0, src, dst, n, x0=0.01, config=base, seed=6,
+                engine="incremental", **extra,
+            )
+            b = simulate_agents(
+                1.0, src, dst, n, x0=0.01, config=alt, seed=6,
+                engine="incremental", **extra,
+            )
+            np.testing.assert_array_equal(np.asarray(a.informed), np.asarray(b.informed))
+            np.testing.assert_array_equal(np.asarray(a.t_inf), np.asarray(b.t_inf))
+            np.testing.assert_array_equal(
+                np.asarray(a.withdrawn_frac), np.asarray(b.withdrawn_frac)
+            )
+
+    def test_compact_impl_validation(self):
+        with pytest.raises(ValueError, match="compact_impl"):
+            AgentSimConfig(compact_impl="bogus")
 
     def test_zero_edge_graph(self):
         """E = 0 routes to the gather kernel (the incremental dense grid
