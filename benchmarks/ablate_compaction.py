@@ -81,6 +81,10 @@ def main() -> None:
     for name, fn in [
         ("scatter", jax.jit(lambda m: _compact_ids(m, budget, n, "scatter"))),
         ("searchsorted", jax.jit(lambda m: _compact_ids(m, budget, n, "searchsorted"))),
+        (
+            "searchsorted_blocked",
+            jax.jit(lambda m: _compact_ids(m, budget, n, "searchsorted_blocked")),
+        ),
         ("cumsum_only", jax.jit(lambda m: jnp.cumsum(m.astype(jnp.int32)))),
     ]:
         parts[name] = round(timed(fn, mask) * 1e3, 3)
@@ -97,7 +101,7 @@ def main() -> None:
     src, dst = erdos_renyi_edges(n, deg, seed=0)
     results = {}
     final = {}
-    for impl in ("scatter", "searchsorted"):
+    for impl in ("scatter", "searchsorted", "searchsorted_blocked"):
         cfg = AgentSimConfig(n_steps=n_steps, dt=0.05, compact_impl=impl)
         pg = prepare_agent_graph(1.0, src, dst, n, config=cfg, engine="incremental")
         t0 = time.perf_counter()
@@ -127,10 +131,12 @@ def main() -> None:
             f"({n * n_steps / best / 1e6:.1f}M agent-steps/s; first {first:.1f}s)"
         )
 
-    assert final["scatter"] == final["searchsorted"], final
-    ratio = results["scatter"]["steady_s"] / results["searchsorted"]["steady_s"]
-    verdict = "searchsorted" if ratio > 1.02 else ("scatter" if ratio < 0.98 else "tie")
-    print(f"  scatter/searchsorted steady ratio: {ratio:.2f} -> {verdict}")
+    assert len(set(final.values())) == 1, final
+    best_impl = min(results, key=lambda k: results[k]["steady_s"])
+    ratio = results["scatter"]["steady_s"] / results[best_impl]["steady_s"]
+    # >2% over the incumbent to displace it; otherwise the proven default stays
+    verdict = best_impl if ratio > 1.02 else "scatter"
+    print(f"  best: {best_impl} (scatter/best steady ratio {ratio:.2f}) -> {verdict}")
 
     out_path = os.environ.get("SBR_ABL_JSON", "")
     if out_path:
@@ -141,7 +147,7 @@ def main() -> None:
             "n_steps": n_steps,
             "parts_ms": parts,
             "end_to_end": results,
-            "ratio_scatter_over_searchsorted": round(ratio, 3),
+            "ratio_scatter_over_best": round(ratio, 3),
             "verdict": verdict,
         }
         with open(out_path, "w") as fh:

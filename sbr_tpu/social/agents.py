@@ -154,9 +154,10 @@ class AgentSimConfig:
     reentry_delay: float = float("inf")
     max_steps_per_launch: Optional[int] = None
     # Lowering of the incremental engines' per-step change compaction
-    # ("scatter" | "searchsorted" — bit-identical, see `_compact_ids`).
-    # A perf-only knob in the `engine="measure"` spirit: the winner is
-    # hardware-dependent, so it stays selectable for on-device A/B.
+    # ("scatter" | "searchsorted" | "searchsorted_blocked" — bit-identical,
+    # see `_compact_ids`). A perf-only knob in the `engine="measure"`
+    # spirit: the winner is hardware-dependent, so it stays selectable for
+    # on-device A/B.
     compact_impl: str = "scatter"
 
     def __post_init__(self):
@@ -166,8 +167,15 @@ class AgentSimConfig:
             raise ValueError("dt must be positive")
         if self.max_steps_per_launch is not None and self.max_steps_per_launch < 1:
             raise ValueError("max_steps_per_launch must be >= 1 (or None)")
-        if self.compact_impl not in ("scatter", "searchsorted"):
-            raise ValueError("compact_impl must be 'scatter' or 'searchsorted'")
+        if self.compact_impl not in (
+            "scatter",
+            "searchsorted",
+            "searchsorted_blocked",
+        ):
+            raise ValueError(
+                "compact_impl must be 'scatter', 'searchsorted', or "
+                "'searchsorted_blocked'"
+            )
 
 
 @struct.dataclass
@@ -218,14 +226,31 @@ def _compact_ids(mask, budget: int, dump: int, impl: str = "scatter"):
       rounds over the monotone cumsum) replace the N-write scatter
       entirely; for ranks beyond the population the search falls off the
       end at exactly ``mask.size`` → dump.
+    - "searchsorted_blocked": same searches, but the cumsum is computed
+      two-level — an axis-1 cumsum over a (N/128, 128) lane-blocked
+      reshape (log₂128 = 7 full-width shifted adds) plus a 128×-shorter
+      row-offset scan — in case the backend's 1-D scan at N is the wall
+      rather than the scatter. Integer adds are exact, so the composed
+      cumsum (and hence the output) is bit-identical.
 
-    `benchmarks/ablate_compaction.py` A/Bs both (plus the parts) on
+    `benchmarks/ablate_compaction.py` A/Bs all three (plus the parts) on
     hardware; `AgentSimConfig.compact_impl` selects per run."""
-    if impl == "searchsorted":
-        c = jnp.cumsum(mask.astype(jnp.int32))
+    if impl in ("searchsorted", "searchsorted_blocked"):
+        n_mask = mask.shape[0]
+        if impl == "searchsorted_blocked":
+            m = mask.astype(jnp.int32)
+            pad = (-n_mask) % 128
+            if pad:
+                m = jnp.concatenate([m, jnp.zeros(pad, jnp.int32)])
+            within = jnp.cumsum(m.reshape(-1, 128), axis=1)
+            row_tot = within[:, -1]
+            offs = jnp.cumsum(row_tot) - row_tot
+            c = (within + offs[:, None]).reshape(-1)[:n_mask]
+        else:
+            c = jnp.cumsum(mask.astype(jnp.int32))
         q = jnp.arange(1, budget + 1, dtype=jnp.int32)
         res = jnp.searchsorted(c, q, side="left").astype(jnp.int32)
-        return jnp.where(res >= mask.shape[0], jnp.int32(dump), res)
+        return jnp.where(res >= n_mask, jnp.int32(dump), res)
     pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
     idx = jnp.where(mask & (pos < budget), pos, budget)
     ids = jnp.arange(mask.shape[0], dtype=jnp.int32)

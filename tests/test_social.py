@@ -451,8 +451,11 @@ class TestIncrementalEngine:
             if k:
                 mask[rng.choice(n, size=min(k, n), replace=False)] = True
             a = np.asarray(_compact_ids(jnp.asarray(mask), budget, dump, "scatter"))
-            b = np.asarray(_compact_ids(jnp.asarray(mask), budget, dump, "searchsorted"))
-            np.testing.assert_array_equal(a, b, err_msg=f"n={n} budget={budget} k={k}")
+            for impl in ("searchsorted", "searchsorted_blocked"):
+                b = np.asarray(_compact_ids(jnp.asarray(mask), budget, dump, impl))
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"impl={impl} n={n} budget={budget} k={k}"
+                )
 
     def test_compact_impl_config_bit_identical(self):
         """engine='incremental' under compact_impl='searchsorted' reproduces
@@ -461,20 +464,23 @@ class TestIncrementalEngine:
         src, dst = erdos_renyi_edges(n, 10.0, seed=23)
         for extra in ({}, {"incremental_budget": 48}):
             base = AgentSimConfig(n_steps=80, dt=0.1, exit_delay=0.2, reentry_delay=1.8)
-            alt = replace(base, compact_impl="searchsorted")
             a = simulate_agents(
                 1.0, src, dst, n, x0=0.01, config=base, seed=6,
                 engine="incremental", **extra,
             )
-            b = simulate_agents(
-                1.0, src, dst, n, x0=0.01, config=alt, seed=6,
-                engine="incremental", **extra,
-            )
-            np.testing.assert_array_equal(np.asarray(a.informed), np.asarray(b.informed))
-            np.testing.assert_array_equal(np.asarray(a.t_inf), np.asarray(b.t_inf))
-            np.testing.assert_array_equal(
-                np.asarray(a.withdrawn_frac), np.asarray(b.withdrawn_frac)
-            )
+            for impl in ("searchsorted", "searchsorted_blocked"):
+                alt = replace(base, compact_impl=impl)
+                b = simulate_agents(
+                    1.0, src, dst, n, x0=0.01, config=alt, seed=6,
+                    engine="incremental", **extra,
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(a.informed), np.asarray(b.informed)
+                )
+                np.testing.assert_array_equal(np.asarray(a.t_inf), np.asarray(b.t_inf))
+                np.testing.assert_array_equal(
+                    np.asarray(a.withdrawn_frac), np.asarray(b.withdrawn_frac)
+                )
 
     def test_compact_impl_validation(self):
         with pytest.raises(ValueError, match="compact_impl"):
