@@ -132,15 +132,18 @@ def main() -> None:
                 int(np.asarray(res.informed).sum()),
                 float(res.withdrawn_frac[-1]),
             )
+            n_rec = int(np.asarray(res.full_recount_steps).sum())
             best = min(times)
             results[name] = {
                 "first_call_s": round(first, 2),
                 "steady_s": round(best, 3),
                 "agent_steps_per_sec": round(n * n_steps / best, 1),
+                "recount_steps": n_rec,
             }
             print(
                 f"  e2e {name:>26}: {best:.3f}s steady "
-                f"({n * n_steps / best / 1e6:.1f}M agent-steps/s; first {first:.1f}s)"
+                f"({n * n_steps / best / 1e6:.1f}M agent-steps/s; "
+                f"{n_rec}/{n_steps} recounts; first {first:.1f}s)"
             )
 
     assert len(set(final.values())) == 1, final

@@ -191,6 +191,19 @@ class AgentSimResult:
     withdrawn_frac: jnp.ndarray  # (n_steps,)
     informed: jnp.ndarray  # (N,) bool, final
     t_inf: jnp.ndarray  # (N,) informed times (inf when never informed)
+    # (n_steps,) bool: True where the step recomputed neighbor counts via
+    # the full segmented recount — every step for the gather engines, only
+    # budget-overflow steps for the incremental ones (for the sharded
+    # incremental engine the flag is the psum'd any-device overflow, since
+    # one device's overflow triggers the global recount). Engine
+    # observability: the recount share is the term the `engine="auto"`
+    # census predicts, so this field is its ground truth on any platform.
+    # NOTE: telemetry, not simulation state — under `max_steps_per_launch`
+    # each launch re-seeds its counts through the event path, so the flag
+    # at chunk-start steps can differ from the unchunked run's (the counts
+    # themselves are exact either way; the bit-identical guarantee covers
+    # trajectories and agent state).
+    full_recount_steps: Optional[jnp.ndarray] = None
     # Static host-side int (not a device array: N·n_steps overflows int32 at
     # the advertised 10^6-agent scale under default x32).
     agent_steps: int = struct.field(pytree_node=False, default=0)
@@ -198,11 +211,14 @@ class AgentSimResult:
     def __repr__(self) -> str:
         from sbr_tpu.models.results import _fmt
 
+        rec = ""
+        if self.full_recount_steps is not None:
+            rec = f", recounts={int(np.asarray(self.full_recount_steps).sum())}"
         return (
             f"AgentSimResult(N={self.informed.shape[-1]}, "
             f"steps={self.t_grid.shape[-1]}, "
             f"final_G={_fmt(self.informed_frac[..., -1], 4)}, "
-            f"final_AW={_fmt(self.withdrawn_frac[..., -1], 4)})"
+            f"final_AW={_fmt(self.withdrawn_frac[..., -1], 4)}{rec})"
         )
 
 
@@ -529,11 +545,15 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
             newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)
-            obs = (jnp.mean(informed.astype(dtype)), jnp.mean(wd.astype(dtype)))
+            obs = (
+                jnp.mean(informed.astype(dtype)),
+                jnp.mean(wd.astype(dtype)),
+                overflow,
+            )
             return (informed2, t_inf2, counts2, wd), obs
 
         init = (informed0, t_inf0, jnp.zeros(n, jnp.int32), jnp.zeros(n, bool))
-        (informed, t_inf, _, _), (gs, aws) = lax.scan(
+        (informed, t_inf, _, _), (gs, aws, recs) = lax.scan(
             step, init, jnp.arange(config.n_steps) + k0
         )
         t_grid = (jnp.arange(config.n_steps) + k0).astype(dtype) * dt
@@ -543,6 +563,7 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
             withdrawn_frac=aws,
             informed=informed,
             t_inf=t_inf,
+            full_recount_steps=recs,
             agent_steps=n * config.n_steps,
         )
 
@@ -586,6 +607,8 @@ def _single_device_sim(config: AgentSimConfig):
             withdrawn_frac=aws,
             informed=informed,
             t_inf=t_inf,
+            # the gather engine recounts every step by construction
+            full_recount_steps=jnp.ones(config.n_steps, bool),
             agent_steps=n * config.n_steps,
         )
 
@@ -784,7 +807,7 @@ def _sharded_incremental_sim(
             t_inf2 = jnp.where(newly, t + dt, t_inf)
             g = lax.psum(jnp.sum(informed.astype(dtype)), axis) * inv_n
             aw = lax.psum(jnp.sum(wd.astype(dtype)), axis) * inv_n
-            return (informed2, t_inf2, counts2, bits_global), (g, aw)
+            return (informed2, t_inf2, counts2, bits_global), (g, aw, overflow_any)
 
         # fresh zero arrays are device-invariant constants; mark them varying
         # over the mesh axis so the scan carry types match the step outputs
@@ -794,17 +817,17 @@ def _sharded_incremental_sim(
             lax.pcast(jnp.zeros(nb, jnp.int32), (axis,), to="varying"),
             lax.pcast(jnp.zeros(n_gl // 8, jnp.uint8), (axis,), to="varying"),
         )
-        (informed, t_inf, _, _), (gs, aws) = lax.scan(
+        (informed, t_inf, _, _), (gs, aws, recs) = lax.scan(
             step, init, jnp.arange(config.n_steps) + k0
         )
-        return gs, aws, informed, t_inf
+        return gs, aws, recs, informed, t_inf
 
     fn = jax.jit(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(P(axis),) * 9 + (P(), P()),
-            out_specs=(P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P(), P(axis), P(axis)),
         )
     )
     return fn
@@ -1357,6 +1380,9 @@ def simulate_agents(
             withdrawn_frac=jnp.concatenate([p.withdrawn_frac for p in parts]),
             informed=parts[-1].informed,
             t_inf=parts[-1].t_inf,
+            full_recount_steps=jnp.concatenate(
+                [p.full_recount_steps for p in parts]
+            ),
             agent_steps=sum(p.agent_steps for p in parts),
         )
     if config.max_steps_per_launch is not None:
@@ -1396,7 +1422,7 @@ def simulate_agents(
         fn = _sharded_incremental_sim(
             config, mesh, mesh_axis, n, prepared.budget, prepared.max_degree
         )
-        gs, aws, informed, t_inf = fn(
+        gs, aws, recs, informed, t_inf = fn(
             prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
             dst2_sh, lstart_d, ldeg_d, informed0_d, t_init_d, key_repl, k0,
         )
@@ -1406,6 +1432,7 @@ def simulate_agents(
             prepared.betas, prepared.src, prepared.row_ptr, prepared.indeg,
             informed0_d, t_init_d, key_repl, k0,
         )
+        recs = jnp.ones(config.n_steps, bool)
     if n_pad:
         # The padding trim [:n] is not shard-aligned; all-gather the final
         # per-agent state (output-only, O(N) bytes) so the slice is local.
@@ -1419,5 +1446,6 @@ def simulate_agents(
         withdrawn_frac=aws,
         informed=informed[:n],
         t_inf=t_inf[:n],
+        full_recount_steps=recs,
         agent_steps=n * config.n_steps,
     )

@@ -486,6 +486,54 @@ class TestIncrementalEngine:
         with pytest.raises(ValueError, match="compact_impl"):
             AgentSimConfig(compact_impl="bogus")
 
+    def test_full_recount_telemetry(self):
+        """The per-step recount flag: all-True for the gather engine, only
+        the overflow steps for the incremental one (forced here via a tiny
+        budget), and its True steps still produce exact counts (the engines
+        agree bit-for-bit regardless of the flag pattern)."""
+        n = 3000
+        src, dst = erdos_renyi_edges(n, 8.0, seed=14)
+        cfg = AgentSimConfig(n_steps=60, dt=0.1)
+        g = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=5, engine="gather")
+        assert np.asarray(g.full_recount_steps).all()
+        assert g.full_recount_steps.shape == (60,)
+        inc_small = simulate_agents(
+            1.0, src, dst, n, x0=0.01, config=cfg, seed=5,
+            engine="incremental", incremental_budget=16,
+        )
+        recs = np.asarray(inc_small.full_recount_steps)
+        assert 0 < recs.sum() < 60  # some overflow steps, not all
+        inc_big = simulate_agents(
+            1.0, src, dst, n, x0=0.01, config=cfg, seed=5,
+            engine="incremental", incremental_budget=4096,
+        )
+        assert np.asarray(inc_big.full_recount_steps).sum() < recs.sum()
+        np.testing.assert_array_equal(
+            np.asarray(g.informed), np.asarray(inc_small.informed)
+        )
+        assert "recounts=" in repr(inc_small)
+
+    def test_full_recount_telemetry_sharded(self):
+        """The sharded incremental flag is the psum'd any-device overflow:
+        replicated, (n_steps,), and present through the chunked path."""
+        n = 2048
+        src, dst = erdos_renyi_edges(n, 8.0, seed=15)
+        mesh = jax.make_mesh((8,), ("agents",))
+        cfg = AgentSimConfig(n_steps=40, dt=0.1)
+        r = simulate_agents(
+            1.0, src, dst, n, x0=0.02, config=cfg, seed=3, mesh=mesh,
+            engine="incremental", incremental_budget=8,
+        )
+        recs = np.asarray(r.full_recount_steps)
+        assert recs.shape == (40,) and recs.sum() > 0
+        cfg_c = replace(cfg, max_steps_per_launch=17)
+        rc = simulate_agents(
+            1.0, src, dst, n, x0=0.02, config=cfg_c, seed=3, mesh=mesh,
+            engine="incremental", incremental_budget=8,
+        )
+        assert np.asarray(rc.full_recount_steps).shape == (40,)
+        np.testing.assert_array_equal(np.asarray(r.informed), np.asarray(rc.informed))
+
     def test_zero_edge_graph(self):
         """E = 0 routes to the gather kernel (the incremental dense grid
         cannot gather from an empty edge array): no crash, no contagion."""
