@@ -1,0 +1,130 @@
+"""Calibrate the auto-engine census against measured recount telemetry.
+
+The `engine="auto"` census predicts the incremental engine's full-recount
+steps from the logistic trajectory + a saturating hub term
+(`agents._census_fallback_steps`). Its known bias (benchmarks/RESULTS.md,
+"Auto-engine census vs measurement"): on Chung-Lu hub tails it
+over-predicts — hub changes FRONT-LOAD into an early tight wave (a hub's
+high in-degree samples the true small G(t) while degree-10 agents'
+quantized neighbor fractions lag), so late bulk steps are hub-clean. With
+only two TPU end-to-end data points that bias could not be fit.
+
+`AgentSimResult.full_recount_steps` (round-5 telemetry) changes that: the
+fallback PATTERN is a property of the simulation dynamics, bit-identical
+on any platform, so the census's prediction can be diffed against ground
+truth wholesale on CPU. This script does exactly that across a shape grid
+(ER + Chung-Lu tails at several γ and n, constant and lognormal β) and
+reports predicted vs measured recount steps per shape.
+
+Run: python benchmarks/census_calibration.py [--quick]
+  SBR_ABL_JSON=path writes the artifact. CPU by default (the point is
+  platform independence); runs anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    from sbr_tpu.utils.platform import pin_cpu_platform
+
+    if os.environ.get("SBR_ABL_PLATFORM", "cpu") == "cpu":
+        pin_cpu_platform()
+    import numpy as np
+
+    from sbr_tpu.social import (
+        AgentSimConfig,
+        erdos_renyi_edges,
+        prepare_agent_graph,
+        scale_free_edges,
+        simulate_agents,
+    )
+    from sbr_tpu.social.agents import (
+        _census_fallback_steps,
+        _default_incremental_budget,
+    )
+
+    quick = "--quick" in sys.argv
+    scale = 0.1 if quick else 1.0
+
+    def logn_betas(n, seed=1):
+        return (
+            np.random.default_rng(seed)
+            .lognormal(mean=0.0, sigma=0.5, size=n)
+            .astype(np.float32)
+        )
+
+    # (name, n, graph builder, betas, n_steps, dt)
+    shapes = [
+        ("er_1e6_b1", int(1e6 * scale), lambda n: erdos_renyi_edges(n, 10.0, seed=0),
+         1.0, 200, 0.05),
+        ("er_3e5_b3", int(3e5 * scale), lambda n: erdos_renyi_edges(n, 10.0, seed=0),
+         3.0, 120, 0.05),
+        ("cl_g2.5_1e6_logn", int(1e6 * scale),
+         lambda n: scale_free_edges(n, avg_degree=10.0, gamma=2.5, seed=0),
+         "logn", 200, 0.05),
+        ("cl_g2.5_3e5_logn", int(3e5 * scale),
+         lambda n: scale_free_edges(n, avg_degree=10.0, gamma=2.5, seed=0),
+         "logn", 200, 0.05),
+        ("cl_g2.2_3e5_logn", int(3e5 * scale),
+         lambda n: scale_free_edges(n, avg_degree=10.0, gamma=2.2, seed=0),
+         "logn", 200, 0.05),
+        ("cl_g3.0_1e6_logn", int(1e6 * scale),
+         lambda n: scale_free_edges(n, avg_degree=10.0, gamma=3.0, seed=0),
+         "logn", 200, 0.05),
+    ]
+
+    rows = {}
+    for name, n, build, beta_spec, n_steps, dt in shapes:
+        t0 = time.perf_counter()
+        src, dst = build(n)
+        betas = logn_betas(n) if beta_spec == "logn" else beta_spec
+        beta_mean = float(np.mean(betas)) if beta_spec == "logn" else float(beta_spec)
+        cfg = AgentSimConfig(n_steps=n_steps, dt=dt)
+        pg = prepare_agent_graph(betas, src, dst, n, config=cfg, engine="incremental")
+        outdeg = np.bincount(np.asarray(src), minlength=n)
+        budget = _default_incremental_budget(n)
+        hubs = int((outdeg > 64).sum())
+        # waves=1: these configs use the default window (no reentry), so
+        # each agent changes once — the same value prepare_agent_graph
+        # derives from the config
+        predicted = _census_fallback_steps(
+            outdeg, 64, n_steps, n, beta_mean, dt, budget, waves=1.0
+        )
+        res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+        measured = int(np.asarray(res.full_recount_steps).sum())
+        final_g = float(res.informed_frac[-1])
+        rows[name] = {
+            "n": n,
+            "hubs_gt64": hubs,
+            "beta_mean": round(beta_mean, 4),
+            "n_steps": n_steps,
+            "predicted_recounts": round(predicted, 1),
+            "measured_recounts": measured,
+            "ratio_pred_over_meas": round(predicted / max(measured, 1), 2),
+            # a die-out (final_G ≈ x0) voids the row: the census models a
+            # realized contagion, not extinction fluctuations
+            "final_G": round(final_g, 4),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+        print(
+            f"  {name:>20}: predicted {predicted:6.1f} vs measured {measured:4d} "
+            f"of {n_steps} (H={hubs}, ratio {rows[name]['ratio_pred_over_meas']}, "
+            f"final G={final_g:.3f})"
+        )
+
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump({"scale": scale, "shapes": rows}, fh, indent=1)
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -344,6 +344,7 @@ def _auto_engine(
     beta_mean: float,
     dt: float,
     budget: int,
+    waves: float = 2.0,
 ) -> str:
     """Engine choice for engine="auto" (single-device and sharded).
 
@@ -361,12 +362,16 @@ def _auto_engine(
     or the per-agent MAX CHUNK SLICE under a mesh (edge-count sharding
     splits a hub's edges across chunks, so the sharded census is milder).
 
-    Approximation (ADVICE r4): the factor 2 in ΔG̃ treats informed
-    transitions and withdrawal-window entries/exits as one synchronous
-    band. With reentry_delay − exit_delay larger than the band width the
-    exit wave is a second time-shifted band and fallback steps can be
-    undercounted — harmless for correctness (fallback is bit-identical),
-    only for the throughput of a misclassified "incremental" choice.
+    ``waves`` is the number of change waves per agent the withdrawal
+    window produces WITHIN the simulated horizon: 1 when only the entry
+    wave can occur before T = n_steps·dt (e.g. the framework's default
+    no-reentry window), 2 when exits land inside the horizon too, 0 when
+    the window is empty or opens after T. `prepare_agent_graph` derives
+    the exact value from its config; the conservative 2 is only the
+    default for direct callers. (Resolves ADVICE r4: the old census
+    hard-coded the factor 2, which measured as 44 predicted-vs-0 observed
+    recount steps at the ER bench shape — see
+    benchmarks/CENSUS_CALIBRATION_cpu_2026-08-01.json.)
 
     The decision compares EXPECTED COST, not fallback fraction: a fallback
     step costs one recount plus detection overhead (1+ε ≈ 1.15 recounts)
@@ -382,37 +387,71 @@ def _auto_engine(
     so prepared graphs are portable, tuned for the hardware the framework
     targets.
     """
-    hubs = int((np.asarray(edge_slices) > max_degree).sum())
-    fallback_steps = 0.0
-    if beta_mean > 0:
-        # Per-step change mass from the logistic census trajectory
-        # G(t) = x0/(x0+(1-x0)e^{-βt}) started at the framework's default
-        # seed fraction (the census runs at prepare time, before x0 is
-        # known; small-seed contagion is the framework's domain, and a
-        # mid-trajectory caller mispredicts by at most the measured engine
-        # gap, never correctness). ΔG̃ doubles ΔG for the time-shifted
-        # withdrawal-window exit wave (ADVICE r3/r4).
-        x0c = 1e-4
-        t = np.arange(n_steps + 1) * dt
-        g = x0c / (x0c + (1.0 - x0c) * np.exp(-beta_mean * t))
-        dgt = 2.0 * np.diff(g)
-        # A step falls back when the changed-agent count exceeds budget
-        # (deterministic at the census mass) or ≥1 hub changes. Hub change
-        # times follow the same dG law (2 changes each: entry + exit), so
-        # the expected number of hub-fallback steps saturates per step —
-        # Σ(1-exp(-H·ΔG̃)) — instead of the old 2·H count, which
-        # overcounted by orders of magnitude once hubs clustered into the
-        # same transition steps (H ≫ n_steps: measured incremental WIN of
-        # 1.42x at the 10^6 scale-free stretch shape that the old census
-        # routed to gather, ENGINE_COMPARE_sf_tpu_2026-07-31.json).
-        overflow = (n * dgt > budget) if budget > 0 else np.zeros_like(dgt, bool)
-        p_hub = -np.expm1(-hubs * dgt) if hubs > 0 else 0.0
-        fallback_steps = float(np.sum(np.where(overflow, 1.0, p_hub)))
+    fallback_steps = _census_fallback_steps(
+        edge_slices, max_degree, n_steps, n, beta_mean, dt, budget, waves
+    )
     rho, eps = 0.35, 0.15
     cost_incremental = fallback_steps * (1.0 + eps) + max(
         n_steps - fallback_steps, 0.0
     ) * rho
     return "incremental" if cost_incremental <= n_steps else "gather"
+
+
+# The realized transition is wider than the mean-field logistic: degree-10
+# neighbor fractions are quantized, so the explicit-agent band lags and
+# spreads by ~25% (the scale-demo physics check measures band 0.40-0.43 vs
+# the mean-field ~1/3 — benchmarks/RESULTS.md). The census runs its
+# trajectory at β/1.25 to match: calibrated against measured recount
+# telemetry over 6 shapes (ER + Chung-Lu γ∈{2.2,2.5,3.0}, constant and
+# lognormal β; CENSUS_CALIBRATION_cpu_2026-08-01.json) — 5 of 6 within
+# ~5% with the remaining barely-spreading contagion over-predicted, i.e.
+# conservative toward the gather engine.
+_CENSUS_BAND_STRETCH = 1.25
+
+
+def _census_fallback_steps(
+    edge_slices,
+    max_degree: int,
+    n_steps: int,
+    n: int,
+    beta_mean: float,
+    dt: float,
+    budget: int,
+    waves: float = 2.0,
+) -> float:
+    """Predicted full-recount steps for the incremental engine — the
+    quantity `_auto_engine`'s cost model consumes, exposed separately so
+    it can be diffed against the measured ground truth
+    (`AgentSimResult.full_recount_steps`;
+    benchmarks/census_calibration.py)."""
+    hubs = int((np.asarray(edge_slices) > max_degree).sum())
+    fallback_steps = 0.0
+    if beta_mean > 0:
+        # Per-step change mass from the band-stretched logistic census
+        # trajectory G(t) = x0/(x0+(1-x0)e^{-(β/S)t}) started at the
+        # framework's default seed fraction (the census runs at prepare
+        # time, before x0 is known; small-seed contagion is the
+        # framework's domain, and a mid-trajectory caller mispredicts by
+        # at most the measured engine gap, never correctness). ΔG̃ scales
+        # ΔG by the window's change-wave count (see `_auto_engine`).
+        x0c = 1e-4
+        t = np.arange(n_steps + 1) * dt
+        beta_eff = beta_mean / _CENSUS_BAND_STRETCH
+        g = x0c / (x0c + (1.0 - x0c) * np.exp(-beta_eff * t))
+        dgt = waves * np.diff(g)
+        # A step falls back when the changed-agent count exceeds budget
+        # (deterministic at the census mass) or ≥1 hub changes. Hub change
+        # times follow the same dG law, so the expected number of
+        # hub-fallback steps saturates per step — Σ(1-exp(-H·ΔG̃)) —
+        # instead of the old 2·H count, which overcounted by orders of
+        # magnitude once hubs clustered into the same transition steps
+        # (H ≫ n_steps: measured incremental WIN of 1.42x at the 10^6
+        # scale-free stretch shape that the round-4 census routed to
+        # gather, ENGINE_COMPARE_sf_tpu_2026-07-31.json).
+        overflow = (n * dgt > budget) if budget > 0 else np.zeros_like(dgt, bool)
+        p_hub = -np.expm1(-hubs * dgt) if hubs > 0 else 0.0
+        fallback_steps = float(np.sum(np.where(overflow, 1.0, p_hub)))
+    return fallback_steps
 
 
 def _default_incremental_budget(n_block: int, floor: int = 4096) -> int:
@@ -1014,6 +1053,19 @@ def prepare_agent_graph(
                 budget_est = (
                     incremental_budget or _default_incremental_budget(nb_a, floor=512)
                 ) * n_dev_a
+            # Change waves per agent within the simulated horizon: the
+            # entry wave counts only if entries can occur before T, the
+            # exit wave only if exits can (earliest exit at reentry_delay
+            # for t=0 seeds); an empty window (exit ≥ reentry) never
+            # changes anyone.
+            horizon = config.n_steps * config.dt
+            if (
+                config.exit_delay >= config.reentry_delay
+                or config.exit_delay >= horizon
+            ):
+                waves = 0.0
+            else:
+                waves = 1.0 + float(config.reentry_delay < horizon)
             engine = _auto_engine(
                 census,
                 incremental_max_degree,
@@ -1022,6 +1074,7 @@ def prepare_agent_graph(
                 float(np.mean(betas_h)),
                 config.dt,
                 int(budget_est),
+                waves=waves,
             )
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an

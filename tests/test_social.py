@@ -18,6 +18,7 @@ from sbr_tpu.models.params import SolverConfig, make_model_params
 from sbr_tpu.social import (
     AgentSimConfig,
     erdos_renyi_edges,
+    prepare_agent_graph,
     scale_free_edges,
     simulate_agents,
     solve_equilibrium_social,
@@ -688,6 +689,66 @@ class TestAutoEngine:
         assert _auto_engine(outdeg, 64, 6, 2_000_000, 10.0, 0.3, 4096) == "gather"
         # budget 3e5 leaves only the steepest steps above budget
         assert _auto_engine(outdeg, 64, 80, 2_000_000, 5.0, 0.1, 300_000) == "incremental"
+
+    def test_census_matches_measured_zero_at_bench_shape(self):
+        """CENSUS_CALIBRATION_cpu_2026-08-01.json ground truth: the ER bench
+        shape (10^6 agents, β=1, dt=0.05, default budget, no-exit window)
+        measured ZERO recount steps; the window-aware census must predict
+        none (the old hard-coded 2-wave factor predicted 44)."""
+        from sbr_tpu.social.agents import _census_fallback_steps
+
+        outdeg = np.full(1000, 10)  # no hubs; only the overflow term acts
+        assert (
+            _census_fallback_steps(outdeg, 64, 200, 1_000_000, 1.0, 0.05, 15625, 1.0)
+            == 0.0
+        )
+        # a finite window doubles the change mass back above budget (over a
+        # horizon that covers the stretched transition peak: t_mid ≈ 11.5
+        # at β_eff = 1/1.25, beyond the 200-step bench window)
+        assert (
+            _census_fallback_steps(outdeg, 64, 280, 1_000_000, 1.0, 0.05, 15625, 2.0)
+            > 0.0
+        )
+
+    def test_auto_waves_from_window_geometry(self):
+        """prepare_agent_graph derives the census wave count from the
+        window's overlap with the horizon: a finite reentry_delay beyond
+        T behaves like the infinite window (one wave), and an empty or
+        post-horizon window produces no changes at all (zero waves →
+        incremental, trivially clean)."""
+        n = 3000
+        src, dst = erdos_renyi_edges(n, 8.0, seed=2)
+        # β=3 pushes the one-wave change mass just under this small budget;
+        # the doubled mass would overflow — the engine choice is the probe
+        for reentry, want_engine in [
+            (np.inf, "incremental"),  # no exits ever
+            (1e6, "incremental"),  # exits exist but far beyond T=12
+            (2.0, "incremental"),  # in-horizon exits: 2 waves, still cheap here
+        ]:
+            cfg = AgentSimConfig(n_steps=120, dt=0.1, reentry_delay=reentry)
+            pg = prepare_agent_graph(3.0, src, dst, n, config=cfg)
+            assert pg.engine == want_engine, (reentry, pg.engine)
+        # empty window: no agent ever changes; incremental is trivially clean
+        cfg = AgentSimConfig(n_steps=120, dt=0.1, exit_delay=5.0, reentry_delay=2.0)
+        pg = prepare_agent_graph(3.0, src, dst, n, config=cfg)
+        assert pg.engine == "incremental"
+        res = simulate_agents(prepared=pg, x0=0.01, config=cfg, seed=0)
+        assert np.asarray(res.full_recount_steps).sum() == 0
+        assert float(res.withdrawn_frac.max()) == 0.0
+
+    def test_census_routes_stretch_tail_to_incremental(self):
+        """The stretch scale-free shape (H=12098 hubs, 10^6 agents,
+        lognormal-β mean 1.1331) measured incremental 1.42x faster on TPU
+        (ENGINE_COMPARE_sf_tpu_2026-07-31.json) but the round-4 census
+        routed it to gather; the telemetry-recalibrated census routes it
+        to the measured winner (prediction 147 of 200 recount steps vs
+        144 measured — CENSUS_CALIBRATION_cpu_2026-08-01.json)."""
+        from sbr_tpu.social.agents import _auto_engine
+
+        outdeg = np.zeros(1_000_000, np.int64)
+        outdeg[:12098] = 200  # the stretch census's hub count
+        args = (outdeg, 64, 200, 1_000_000, 1.1331, 0.05, 15625)
+        assert _auto_engine(*args, waves=1.0) == "incremental"
 
     def test_max_chunk_slice_splits_hubs(self):
         """Edge-count sharding: a hub whose out-edges span chunk boundaries
