@@ -1,0 +1,120 @@
+"""Trade hub recounts against dense-grid width: the max_degree axis.
+
+At the stretch shape (10^6 agents, Chung-Lu γ=2.5, lognormal β) the
+recalibrated census and the recount telemetry agree: ~144 of 200 steps
+are HUB-caused full recounts (a changed agent's out-degree exceeds
+incremental_max_degree=64), and on TPU each recount costs ~95 ms against
+a ~10 ms clean step — recounts dominate the stretch runtime. Raising
+max_degree shrinks the hub set on the power-law tail fast (measured on
+CPU telemetry, bit-identical dynamics on any platform):
+
+    d:        64     128    256    512    1024
+    hubs:     12098  4284   1493   533    190
+    recounts: 144    121    101    74     45     (of 200 steps)
+
+but widens the incremental engine's dense (budget × d) out-edge grid,
+whose gather + scatter-add runs every clean step. The net is a TPU cost
+curve this script measures end-to-end per d, with the recount counts
+alongside so the two effects separate.
+
+Run: python benchmarks/ablate_max_degree.py [n_agents] [n_steps]
+  SBR_ABL_PLATFORM=cpu pins CPU; SBR_ABL_JSON=path writes the artifact.
+  SBR_ABL_CHUNK bounds single-launch duration (axon tunnel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    if os.environ.get("SBR_ABL_PLATFORM", "") == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    import jax
+    import numpy as np
+
+    from sbr_tpu.social import (
+        AgentSimConfig,
+        prepare_agent_graph,
+        scale_free_edges,
+        simulate_agents,
+    )
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    platform = jax.devices()[0].platform
+    chunk = int(os.environ.get("SBR_ABL_CHUNK", "0")) or None
+    print(f"platform={platform} n={n} steps={n_steps} (stretch graph/β laws)")
+
+    src, dst = scale_free_edges(n, avg_degree=10.0, gamma=2.5, seed=0)
+    betas = (
+        np.random.default_rng(1).lognormal(mean=0.0, sigma=0.5, size=n)
+        .astype(np.float32)
+    )
+    outdeg = np.bincount(src, minlength=n)
+    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05, max_steps_per_launch=chunk)
+
+    results = {}
+    final = {}
+    for d in (64, 256, 512, 1024):
+        pg = prepare_agent_graph(
+            betas, src, dst, n, config=cfg, engine="incremental",
+            incremental_max_degree=d,
+        )
+        t0 = time.perf_counter()
+        res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+        jax.block_until_ready(res.withdrawn_frac)
+        first = time.perf_counter() - t0
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            res = simulate_agents(prepared=pg, x0=1e-4, config=cfg, seed=7)
+            jax.block_until_ready(res.withdrawn_frac)
+            times.append(time.perf_counter() - t0)
+        final[d] = (int(np.asarray(res.informed).sum()), float(res.withdrawn_frac[-1]))
+        n_rec = int(np.asarray(res.full_recount_steps).sum())
+        best = min(times)
+        results[str(d)] = {
+            "hubs": int((outdeg > d).sum()),
+            "recount_steps": n_rec,
+            "first_call_s": round(first, 2),
+            "steady_s": round(best, 3),
+            "agent_steps_per_sec": round(n * n_steps / best, 1),
+        }
+        print(
+            f"  d={d:5d}: {best:7.3f}s steady ({n * n_steps / best / 1e6:5.1f}M "
+            f"agent-steps/s; {n_rec}/{n_steps} recounts; first {first:.1f}s)"
+        )
+
+    assert len(set(final.values())) == 1, final  # d is perf-only: outputs identical
+    best_d = min(results, key=lambda k: results[k]["steady_s"])
+    gain = results["64"]["steady_s"] / results[best_d]["steady_s"]
+    print(f"  best: d={best_d} ({gain:.2f}x vs the d=64 default)")
+
+    out_path = os.environ.get("SBR_ABL_JSON", "")
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(
+                {
+                    "platform": platform,
+                    "n_agents": n,
+                    "n_steps": n_steps,
+                    "per_max_degree": results,
+                    "best_max_degree": int(best_d),
+                    "gain_vs_default": round(gain, 3),
+                },
+                fh,
+                indent=1,
+            )
+        print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,11 @@ SBR_ABL_JSON=benchmarks/ABLATE_COMPACT_tpu_${STAMP}.json \
   timeout 1200 python benchmarks/ablate_compaction.py 2>&1 | tail -12 \
   || echo "FAILED: compaction ablation"
 
+echo "--- [2b/7] max_degree axis at the stretch shape (round-5: hub recounts vs grid width)"
+SBR_ABL_JSON=benchmarks/ABLATE_MAXDEG_tpu_${STAMP}.json SBR_ABL_CHUNK=40 \
+  timeout 1800 python benchmarks/ablate_max_degree.py 2>&1 | tail -8 \
+  || echo "FAILED: max_degree ablation"
+
 echo "--- [3/7] pallas VMEM-resident recount experiment (VERDICT r3 task 2)"
 SBR_ABL_JSON=benchmarks/PALLAS_RECOUNT_tpu_${STAMP}.json \
   timeout 1200 python benchmarks/ablate_pallas_recount.py 1000000 10000000 \
