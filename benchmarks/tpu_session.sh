@@ -4,6 +4,12 @@
 # Every device touch goes through killable children (bench harness) or a
 # bounded `timeout`, so a mid-session tunnel drop cannot hang the shell.
 #
+# Ordered by THIS round's open questions first (a short window should
+# still answer them): headline bench, then the round-5 A/Bs (compaction
+# lowering x budget; max_degree hub tradeoff), then stretch (the workload
+# those axes target), then the re-confirmation passes (grid roofline,
+# pallas lowering), then the long scale demo.
+#
 # Usage: bash benchmarks/tpu_session.sh
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -27,30 +33,33 @@ run_bench () {  # $1 = script, $2 = artifact path, $3 = per-phase budget (s)
   fi
 }
 
-echo "--- [1/7] headline bench (probe skipped: caller confirmed the tunnel)"
+echo "--- [1/8] headline bench (probe skipped: caller confirmed the tunnel)"
 run_bench bench.py "benchmarks/BENCH_tpu_session_${STAMP}.json" 1800
 
-echo "--- [2/7] compaction lowering A/B (round-5: scatter vs searchsorted)"
+echo "--- [2/8] compaction lowering A/B (round-5: scatter vs searchsorted, x budget)"
 SBR_ABL_JSON=benchmarks/ABLATE_COMPACT_tpu_${STAMP}.json \
-  timeout 1200 python benchmarks/ablate_compaction.py 2>&1 | tail -12 \
+  timeout 1200 python benchmarks/ablate_compaction.py 2>&1 | tail -14 \
   || echo "FAILED: compaction ablation"
 
-echo "--- [2b/7] max_degree axis at the stretch shape (round-5: hub recounts vs grid width)"
+echo "--- [3/8] max_degree axis at the stretch shape (round-5: hub recounts vs grid width)"
 SBR_ABL_JSON=benchmarks/ABLATE_MAXDEG_tpu_${STAMP}.json SBR_ABL_CHUNK=40 \
   timeout 1800 python benchmarks/ablate_max_degree.py 2>&1 | tail -8 \
   || echo "FAILED: max_degree ablation"
 
-echo "--- [3/7] pallas VMEM-resident recount experiment (VERDICT r3 task 2)"
-SBR_ABL_JSON=benchmarks/PALLAS_RECOUNT_tpu_${STAMP}.json \
-  timeout 1200 python benchmarks/ablate_pallas_recount.py 1000000 10000000 \
-  2>&1 | tail -8 || echo "FAILED: pallas ablation"
+echo "--- [4/8] stretch config"
+run_bench benchmarks/stretch.py "benchmarks/STRETCH_tpu_session_${STAMP}.json" 1800
 
-echo "--- [4/7] grid-cell roofline at bench shape (VERDICT r3 task 5)"
+echo "--- [5/8] grid-cell roofline at bench shape (VERDICT r3 task 5)"
 SBR_ABL_JSON=benchmarks/ABLATE_GRID_tpu_${STAMP}.json \
   timeout 2400 python benchmarks/ablate_grid_cell.py 640 640 2>&1 | tail -12 \
   || echo "FAILED: grid ablation"
 
-echo "--- [5/7] sharded engine ablation (needs >1 device; expected to skip on 1 chip)"
+echo "--- [6/8] pallas VMEM-resident recount experiment (VERDICT r3 task 2)"
+SBR_ABL_JSON=benchmarks/PALLAS_RECOUNT_tpu_${STAMP}.json \
+  timeout 1200 python benchmarks/ablate_pallas_recount.py 1000000 10000000 \
+  2>&1 | tail -8 || echo "FAILED: pallas ablation"
+
+echo "--- [7/8] sharded engine ablation (needs >1 device; expected to skip on 1 chip)"
 if SBR_COMM_BENCH_JSON=benchmarks/SHARDED_ENGINES_tpu_${STAMP}.json \
    timeout 1200 python benchmarks/agent_comm.py 1000000 10 50 \
    > "benchmarks/tpu_session_${STAMP}_comm.log" 2>&1; then
@@ -59,10 +68,7 @@ else
   echo "(agent_comm failed or needs >1 device; see tpu_session_${STAMP}_comm.log)"
 fi
 
-echo "--- [6/7] stretch config"
-run_bench benchmarks/stretch.py "benchmarks/STRETCH_tpu_session_${STAMP}.json" 1800
-
-echo "--- [7/7] 10^7-agent / 10^8-edge scale demonstration (VERDICT r4 task 7)"
+echo "--- [8/8] 10^7-agent / 10^8-edge scale demonstration (VERDICT r4 task 7)"
 run_bench benchmarks/scale_demo.py "benchmarks/SCALE_DEMO_tpu_session_${STAMP}.json" 2400
 
 echo "=== session done; check for FAILED lines above; artifacts: benchmarks/*_${STAMP}* ==="
