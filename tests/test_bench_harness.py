@@ -224,3 +224,61 @@ def test_scale_demo_emits_contract_json():
     assert extra["headline"]["prep_s"] >= 0
     # the logistic-limit physics check must pass even at smoke scale
     assert extra["physics"]["pass"] is True
+
+
+def _run_ablation(script: str, args, tmp_path, timeout=560, extra_env=None) -> dict:
+    """Round-5 ablation scripts: artifact-JSON contract at tiny shapes (the
+    scripts guard the one TPU window — a plumbing bug there wastes it)."""
+    art = tmp_path / "abl.json"
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO),
+        "SBR_ABL_PLATFORM": "cpu",
+        "SBR_ABL_JSON": str(art),
+        **(extra_env or {}),
+    }
+    out = subprocess.run(
+        [sys.executable, str(REPO / script), *map(str, args)],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, f"{script} rc={out.returncode}\n{out.stderr[-800:]}"
+    assert art.exists(), f"{script} wrote no artifact\n{out.stdout[-500:]}"
+    return json.loads(art.read_text())
+
+
+def test_ablate_compaction_contract(tmp_path):
+    d = _run_ablation("benchmarks/ablate_compaction.py", [20000, 8, 12], tmp_path)
+    assert set(d["parts_ms"]) >= {"scatter", "searchsorted", "searchsorted_blocked"}
+    e2e = d["end_to_end"]
+    assert set(e2e) == {
+        f"{impl}_b{m}x"
+        for impl in ("scatter", "searchsorted", "searchsorted_blocked")
+        for m in (1, 4)
+    }
+    for row in e2e.values():
+        assert row["steady_s"] > 0 and row["recount_steps"] >= 0
+    assert d["verdict"] in e2e or d["verdict"] == "scatter_b1x"
+
+
+def test_ablate_max_degree_contract(tmp_path):
+    d = _run_ablation("benchmarks/ablate_max_degree.py", [20000, 12], tmp_path)
+    per = d["per_max_degree"]
+    assert set(per) == {"64", "256", "512", "1024"}
+    hubs = [per[k]["hubs"] for k in ("64", "256", "512", "1024")]
+    assert hubs == sorted(hubs, reverse=True)  # hub set shrinks with d
+    assert d["best_max_degree"] in (64, 256, 512, 1024)
+
+
+def test_census_calibration_contract(tmp_path):
+    d = _run_ablation(
+        "benchmarks/census_calibration.py", ["--quick"], tmp_path, timeout=560
+    )
+    shapes = d["shapes"]
+    assert len(shapes) == 6
+    for row in shapes.values():
+        assert row["predicted_recounts"] >= 0
+        assert 0 <= row["measured_recounts"] <= row["n_steps"]
