@@ -550,6 +550,8 @@ def bench_agents(platform: str) -> dict:
     H2D upload — several seconds at 10^7 edges, reported separately as
     `prep_s`), so the steady-state metric measures device simulation
     throughput the way a repeated-use caller experiences it."""
+    import numpy as np
+
     from sbr_tpu.social import (
         AgentSimConfig,
         erdos_renyi_edges,
@@ -578,7 +580,7 @@ def bench_agents(platform: str) -> dict:
         return res, fence
 
     t0 = time.perf_counter()
-    _, frac0 = run(0)
+    res0, frac0 = run(0)
     first_s = time.perf_counter() - t0
     times = []
     for seed in (1, 2):
@@ -586,12 +588,16 @@ def bench_agents(platform: str) -> dict:
         _, _ = run(seed)
         times.append(time.perf_counter() - t0)
     elapsed = min(times)
+    # engine observability in the artifact: which steps were full recounts
+    # (telemetry is seed-stable at this shape in aggregate; seed 0's count
+    # documents the capture's engine behavior)
+    recounts = int(np.asarray(res0.full_recount_steps).sum())
 
     steps = n * n_steps
     _log(
         f"agents: {steps} agent-steps in {elapsed:.3f}s steady-state "
         f"(first call {first_s:.1f}s incl. compile, prep {prep_s:.1f}s); "
-        f"final G = {frac0:.4f}"
+        f"final G = {frac0:.4f}; {recounts}/{n_steps} recount steps"
     )
     return {
         "agent_steps_per_sec": steps / elapsed,
@@ -600,6 +606,8 @@ def bench_agents(platform: str) -> dict:
         "first_call_s": first_s,
         "steady_s": elapsed,
         "prep_s": prep_s,
+        "engine": pg.engine,
+        "recount_steps": recounts,
     }
 
 
@@ -639,6 +647,8 @@ def measure(platform: str) -> None:
         out["extra"]["agents_first_call_s"] = round(agents["first_call_s"], 2)
         out["extra"]["agents_steady_s"] = round(agents["steady_s"], 3)
         out["extra"]["agents_prep_s"] = round(agents["prep_s"], 2)
+        out["extra"]["agents_engine"] = agents["engine"]
+        out["extra"]["agents_recount_steps"] = agents["recount_steps"]
     print(json.dumps(out))
 
 
