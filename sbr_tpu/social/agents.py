@@ -454,6 +454,19 @@ def _census_fallback_steps(
     return fallback_steps
 
 
+def _census_waves(config: AgentSimConfig) -> float:
+    """Change waves per agent within the simulated horizon T = n_steps·dt:
+    the entry wave counts only if entries can occur before T, the exit
+    wave only if exits can (earliest exit at reentry_delay for t=0
+    seeds); an empty window (exit ≥ reentry) never changes anyone. The
+    ONE definition shared by the engine="auto" census and the
+    engine="measure" wide-cap gate."""
+    horizon = config.n_steps * config.dt
+    if config.exit_delay >= config.reentry_delay or config.exit_delay >= horizon:
+        return 0.0
+    return 1.0 + float(config.reentry_delay < horizon)
+
+
 def _default_incremental_budget(n_block: int, floor: int = 4096) -> int:
     """Default per-step changed-agent budget for the incremental engines —
     the ONE definition shared by `prepare_agent_graph`'s auto census, its
@@ -907,8 +920,10 @@ class PreparedAgentGraph:
     row_ptr: object
     indeg: object
     inc: Optional[tuple]  # engine-specific extra arrays, engine="incremental"
-    # engine="measure" only: ((engine_name, measured agent-steps/sec), ...)
-    # for both candidates, in measurement order — None otherwise
+    # engine="measure" only: ((label, measured agent-steps/sec), ...) for
+    # each timed candidate in measurement order (2, or 3 when the
+    # widened-cap candidate ran — its label carries a "(max_degree=d)"
+    # suffix, e.g. "incremental(max_degree=512)") — None otherwise
     measured_steps_per_sec: Optional[Tuple[Tuple[str, float], ...]] = None
 
 
@@ -924,7 +939,7 @@ def prepare_agent_graph(
     comm: str = "scatter",
     engine: str = "auto",
     incremental_budget: Optional[int] = None,
-    incremental_max_degree: int = 64,
+    incremental_max_degree: Optional[int] = None,
     measure_probe: Optional[dict] = None,
 ) -> PreparedAgentGraph:
     """Host-side canonicalization + upload, factored out of simulate_agents.
@@ -941,8 +956,19 @@ def prepare_agent_graph(
     one graph will be simulated many times and ~2 simulations of
     measurement overhead amortizes (engines are bit-identical in results,
     so the choice affects only throughput).
+
+    ``incremental_max_degree``: out-degree cap of the incremental engines'
+    dense per-step event grid; None (default) means the framework's 64.
+    On a heavy hub tail the cap sets the recount rate (every changed agent
+    above it forces a full recount), so ``engine="measure"`` additionally
+    tries a widened cap when the census predicts a recount-heavy run and
+    the cap was not pinned by the caller — the measured-fastest
+    (engine, cap) pair wins (results are identical for any cap; only
+    throughput differs).
     """
     dtype = np.dtype(dtype)
+    md_pinned = incremental_max_degree is not None
+    d0 = int(incremental_max_degree) if md_pinned else 64
     if engine not in ("auto", "gather", "incremental", "measure"):
         raise ValueError(f"Unknown engine {engine!r}")
     if comm not in ("scatter", "allgather_psum"):
@@ -988,36 +1014,89 @@ def prepare_agent_graph(
                 betas, src, dst, n, config=config, mesh=mesh,
                 mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine="gather",
                 incremental_budget=incremental_budget,
-                incremental_max_degree=incremental_max_degree,
+                incremental_max_degree=d0,
             )
+        candidates = [("gather", d0), ("incremental", d0)]
+        if not md_pinned:
+            # On a heavy hub tail the cap d0 sets the recount rate; when the
+            # census predicts a recount-heavy run AND widening actually
+            # shrinks the hub set, an 8x-wider cap is worth a timed try
+            # (measured on CPU telemetry: 144 -> 74 recount steps from
+            # d=64 -> 512 at the stretch shape; the e2e winner is
+            # hardware-dependent — the dense grid widens 8x too). The gate
+            # mirrors the auto census's single-device inputs (window-derived
+            # waves, default budget, global out-degrees) — under a mesh the
+            # engine's true criterion is the per-chunk slice tail, but a
+            # mis-gate here only costs one timed candidate or skips one,
+            # never correctness.
+            outdeg_m = np.bincount(np.asarray(src).ravel(), minlength=n)
+            d_wide = 8 * d0
+            predicted = _census_fallback_steps(
+                outdeg_m, d0, config.n_steps, n,
+                float(np.mean(np.broadcast_to(np.asarray(betas), (n,)))),
+                config.dt,
+                incremental_budget or _default_incremental_budget(n),
+                waves=_census_waves(config),
+            )
+            if predicted > 0.1 * config.n_steps and int(
+                (outdeg_m > d_wide).sum()
+            ) < int((outdeg_m > d0).sum()):
+                candidates.append(("incremental", d_wide))
         measured = []
         pg_c = None
-        for cand in ("gather", "incremental"):
+        cand_resident = None
+        for cand in candidates:
+            cand_eng, cand_d = cand
+            is_speculative = cand_d != d0
             del pg_c  # previous candidate's device arrays, if any
-            pg_c = prepare_agent_graph(
-                betas, src, dst, n, config=config, mesh=mesh,
-                mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=cand,
-                incremental_budget=incremental_budget,
-                incremental_max_degree=incremental_max_degree,
-            )
-            res = simulate_agents(prepared=pg_c, config=config, **probe)
-            float(res.informed_frac[-1])  # warm-up incl. compile
-            t0 = _time.perf_counter()
-            res = simulate_agents(prepared=pg_c, config=config, **probe)
-            float(res.informed_frac[-1])  # device→host fence
-            rate = n * config.n_steps / (_time.perf_counter() - t0)
-            measured.append((cand, rate))
+            try:
+                pg_c = prepare_agent_graph(
+                    betas, src, dst, n, config=config, mesh=mesh,
+                    mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=cand_eng,
+                    incremental_budget=incremental_budget,
+                    incremental_max_degree=cand_d,
+                )
+                cand_resident = cand
+                res = simulate_agents(prepared=pg_c, config=config, **probe)
+                float(res.informed_frac[-1])  # warm-up incl. compile
+                t0 = _time.perf_counter()
+                res = simulate_agents(prepared=pg_c, config=config, **probe)
+                float(res.informed_frac[-1])  # device→host fence
+                rate = n * config.n_steps / (_time.perf_counter() - t0)
+            except Exception:
+                # A speculative candidate must not abort a measure prep
+                # that succeeds without it (its 8x dense grid can exceed
+                # HBM at exactly the large shapes measure targets); the
+                # baseline candidates keep the old failure envelope.
+                if not is_speculative:
+                    raise
+                import warnings
+
+                warnings.warn(
+                    f"engine='measure': widened-cap candidate "
+                    f"(max_degree={cand_d}) failed to prepare/run and was "
+                    "dropped from the A/B",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                pg_c, cand_resident = None, None
+                continue
+            label = cand_eng if cand_d == d0 else f"{cand_eng}(max_degree={cand_d})"
+            measured.append(((cand_eng, cand_d), label, rate))
             del res
-        winner_name = max(measured, key=lambda t: t[1])[0]
-        if winner_name != pg_c.engine:  # only the last candidate is resident
+        winner = max(measured, key=lambda t: t[2])[0]
+        if winner != cand_resident:  # only the last candidate is resident
             del pg_c
             pg_c = prepare_agent_graph(
                 betas, src, dst, n, config=config, mesh=mesh,
-                mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=winner_name,
+                mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=winner[0],
                 incremental_budget=incremental_budget,
-                incremental_max_degree=incremental_max_degree,
+                incremental_max_degree=winner[1],
             )
-        return dataclasses.replace(pg_c, measured_steps_per_sec=tuple(measured))
+        return dataclasses.replace(
+            pg_c,
+            measured_steps_per_sec=tuple((lbl, rate) for _, lbl, rate in measured),
+        )
 
     from sbr_tpu.native import sort_edges_by_dst
 
@@ -1053,28 +1132,15 @@ def prepare_agent_graph(
                 budget_est = (
                     incremental_budget or _default_incremental_budget(nb_a, floor=512)
                 ) * n_dev_a
-            # Change waves per agent within the simulated horizon: the
-            # entry wave counts only if entries can occur before T, the
-            # exit wave only if exits can (earliest exit at reentry_delay
-            # for t=0 seeds); an empty window (exit ≥ reentry) never
-            # changes anyone.
-            horizon = config.n_steps * config.dt
-            if (
-                config.exit_delay >= config.reentry_delay
-                or config.exit_delay >= horizon
-            ):
-                waves = 0.0
-            else:
-                waves = 1.0 + float(config.reentry_delay < horizon)
             engine = _auto_engine(
                 census,
-                incremental_max_degree,
+                d0,
                 config.n_steps,
                 n,
                 float(np.mean(betas_h)),
                 config.dt,
                 int(budget_est),
-                waves=waves,
+                waves=_census_waves(config),
             )
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an
@@ -1099,7 +1165,7 @@ def prepare_agent_graph(
         return PreparedAgentGraph(
             n=n, n_gl=n, n_pad=0, n_edges=len(src_h), dtype=dtype, mesh=None,
             mesh_axis=mesh_axis, comm=comm, engine=engine, budget=int(budget),
-            max_degree=int(incremental_max_degree),
+            max_degree=int(d0),
             betas=jnp.asarray(betas_h), src=jnp.asarray(src_h),
             row_ptr=jnp.asarray(row_ptr_h), indeg=jnp.asarray(indeg_h), inc=inc,
         )
@@ -1167,7 +1233,7 @@ def prepare_agent_graph(
     return PreparedAgentGraph(
         n=n, n_gl=n_gl, n_pad=n_pad, n_edges=len(src_h0), dtype=dtype, mesh=mesh,
         mesh_axis=mesh_axis, comm=comm, engine=engine, budget=int(budget),
-        max_degree=int(incremental_max_degree),
+        max_degree=int(d0),
         betas=put(betas_h), src=put(src_h), row_ptr=put(row_ptrs_h),
         indeg=put(indeg_h), inc=inc,
     )
@@ -1240,7 +1306,7 @@ def simulate_agents(
     t_inf0=None,
     engine: str = "auto",
     incremental_budget: Optional[int] = None,
-    incremental_max_degree: int = 64,
+    incremental_max_degree: Optional[int] = None,
     prepared: Optional[PreparedAgentGraph] = None,
     step_offset: int = 0,
 ) -> AgentSimResult:
@@ -1301,7 +1367,9 @@ def simulate_agents(
         steps fall back to the full recount.
       incremental_max_degree: out-degree cap per changed agent for the
         dense update grid; a changed agent above it triggers the fallback
-        for that step (hubs change rarely — at most twice each).
+        for that step (hubs change rarely — at most twice each). None
+        (default) means the framework's 64; `prepare_agent_graph`'s
+        engine="measure" may try a wider cap when it is not pinned.
 
     The simulation dtype defaults to float32: aggregates are O(1) means over
     ≥10^4 agents, where Monte-Carlo error dominates rounding by orders of
@@ -1347,7 +1415,7 @@ def simulate_agents(
                 ("mesh", mesh is not None),
                 ("comm", comm != "scatter"), ("engine", engine != "auto"),
                 ("incremental_budget", incremental_budget is not None),
-                ("incremental_max_degree", incremental_max_degree != 64),
+                ("incremental_max_degree", incremental_max_degree is not None),
             )
             if passed
         ]

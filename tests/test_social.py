@@ -919,6 +919,30 @@ class TestLaunchChunking:
 
 
 class TestMeasuredEngine:
+    def test_measure_tries_wider_cap_on_heavy_tails(self):
+        """When the census predicts a recount-heavy run and max_degree was
+        not pinned, engine='measure' adds an 8x-wider cap candidate; the
+        winner's results stay bit-identical to the explicit engines (the
+        cap is perf-only). Pinning max_degree suppresses the candidate."""
+        n = 4000
+        src, dst = scale_free_edges(n, 10.0, gamma=2.2, seed=9)
+        cfg = AgentSimConfig(n_steps=100, dt=0.1)
+        pg = prepare_agent_graph(3.0, src, dst, n, config=cfg, engine="measure")
+        labels = [lbl for lbl, _ in pg.measured_steps_per_sec]
+        assert "incremental(max_degree=512)" in labels, labels
+        assert len(labels) == 3
+        got = simulate_agents(prepared=pg, x0=0.01, config=cfg, seed=2)
+        want = simulate_agents(
+            3.0, src, dst, n, x0=0.01, config=cfg, seed=2, engine="gather"
+        )
+        np.testing.assert_array_equal(np.asarray(got.informed), np.asarray(want.informed))
+        np.testing.assert_array_equal(np.asarray(got.t_inf), np.asarray(want.t_inf))
+        pinned = prepare_agent_graph(
+            3.0, src, dst, n, config=cfg, engine="measure",
+            incremental_max_degree=64,
+        )
+        assert len(pinned.measured_steps_per_sec) == 2
+
     def test_measure_picks_a_winner_and_matches_both(self):
         """engine="measure" must return one of the two engines with rates
         recorded for both, and simulating with the winner must match both
@@ -956,6 +980,11 @@ class TestMeasuredEngine:
         pg = prepare_agent_graph(1.0, src, dst, n, config=cfg)
         with pytest.raises(ValueError, match="conflict with prepared"):
             simulate_agents(prepared=pg, config=cfg, engine="measure")
+        # ANY explicit incremental_max_degree alongside prepared= is a
+        # conflict since the None-default change — including the old
+        # default value 64, which used to slip through unchecked
+        with pytest.raises(ValueError, match="conflict with prepared"):
+            simulate_agents(prepared=pg, config=cfg, incremental_max_degree=64)
 
     def test_measure_rejected_on_direct_simulate_call(self):
         """engine='measure' hides ~5x wall-clock in a one-shot call and
