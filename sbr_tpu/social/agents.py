@@ -159,6 +159,14 @@ class AgentSimConfig:
     # spirit: the winner is hardware-dependent, so it stays selectable for
     # on-device A/B.
     compact_impl: str = "scatter"
+    # Per-agent RNG stream ("counter" | "foldin" — see `_agent_uniforms`).
+    # Both are pure functions of (key, step, global id), so every
+    # engine/sharding equivalence holds under either. "counter" (default
+    # since 0.7.0) does one Threefry block per agent instead of foldin's
+    # two-plus-construction — measured 2.3x END-TO-END on the CPU bench
+    # shape (19.6M -> 45.1M agent-steps/s) and strictly less work on any
+    # platform; "foldin" reproduces the realizations of pre-0.7 artifacts.
+    rng_stream: str = "counter"
 
     def __post_init__(self):
         if self.n_steps < 1:
@@ -176,6 +184,8 @@ class AgentSimConfig:
                 "compact_impl must be 'scatter', 'searchsorted', or "
                 "'searchsorted_blocked'"
             )
+        if self.rng_stream not in ("foldin", "counter"):
+            raise ValueError("rng_stream must be 'foldin' or 'counter'")
 
 
 @struct.dataclass
@@ -323,15 +333,72 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_see
     return betas, src, dst, indeg, row_ptr, informed0
 
 
-def _agent_uniforms(key, step_k, ids, dtype):
+def _threefry2x32(k0, k1, c0, c1):
+    """One Threefry-2x32 block (Salmon et al. 2011), vectorized over the
+    counter arrays — bit-exact vs `jax._src.prng.threefry_2x32` (tested).
+    Re-implemented on public jnp ops so the counter RNG stream below does
+    not depend on a private JAX API."""
+
+    def rotl(x, r):
+        return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+    ks = (k0, k1, jnp.uint32(0x1BD11BDA) ^ k0 ^ k1)
+    x0 = c0 + ks[0]
+    x1 = c1 + ks[1]
+    rot_a, rot_b = (13, 15, 26, 6), (17, 29, 16, 24)
+    for i in range(5):
+        for r in rot_a if i % 2 == 0 else rot_b:
+            x0 = x0 + x1
+            x1 = rotl(x1, r)
+            x1 = x1 ^ x0
+        j = i + 1
+        x0 = x0 + ks[j % 3]
+        x1 = x1 + ks[(j + 1) % 3] + jnp.uint32(j)
+    return x0, x1
+
+
+def _agent_uniforms(key, step_k, ids, dtype, impl: str = "counter"):
     """Per-agent uniform draw as a pure function of (key, step, GLOBAL agent id).
 
     Keying the stream by global agent id — not by device or array position —
     makes the simulation invariant to sharding: a single-device run and an
     n-device run draw bit-identical randomness per agent, so the two paths
     are exactly equivalent (tested), not merely statistically close.
+
+    Two streams, both with that invariance (`AgentSimConfig.rng_stream`;
+    the default here matches the config default):
+
+    - "counter" (default since 0.7.0): one Threefry block per agent — the
+      per-step key pair hashes the id directly as the block counter, and
+      the uniform is built from the block's first word (both words for
+      f64's 52-bit mantissa).
+    - "foldin": uniform(fold_in(fold_in(key, step), id)) — two full
+      Threefry blocks per agent per step plus the vmapped key
+      construction (~16x the CPU cost); the stream every pre-0.7
+      committed measurement used.
+
+    A run is comparable across engines/shardings/platforms under either
+    stream, but the streams are different (equally valid) realizations.
     """
     step_key = jax.random.fold_in(key, step_k)
+    if impl == "counter":
+        kd = (
+            step_key
+            if getattr(step_key, "dtype", None) == jnp.uint32
+            else jax.random.key_data(step_key)
+        )
+        c0 = ids.astype(jnp.uint32)
+        x0, x1 = _threefry2x32(kd[0], kd[1], c0, jnp.zeros_like(c0))
+        if np.dtype(dtype) == np.float64:
+            hi = x0.astype(jnp.uint64) << jnp.uint64(32)
+            mant = (hi | x1.astype(jnp.uint64)) >> jnp.uint64(12)
+            one_to_two = jax.lax.bitcast_convert_type(
+                mant | jnp.uint64(0x3FF0000000000000), jnp.float64
+            )
+            return one_to_two - 1.0
+        mant = (x0 >> jnp.uint32(9)) | jnp.uint32(0x3F800000)
+        one_to_two = jax.lax.bitcast_convert_type(mant, jnp.float32)
+        return (one_to_two - 1.0).astype(dtype)
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(step_key, ids)
     return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
 
@@ -593,7 +660,7 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
             counts2 = lax.cond(overflow, full, incr, counts)
             frac = counts2.astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype)
+            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
             newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)
@@ -642,7 +709,7 @@ def _single_device_sim(config: AgentSimConfig):
             counts = _seg_counts(wd[src], row_ptr)
             frac = counts.astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype)
+            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
             newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)
@@ -728,7 +795,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
             # the last row of the pointer table and is dropped.
             frac = neighbor_counts(wd).astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype)
+            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
             newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)
@@ -853,7 +920,7 @@ def _sharded_incremental_sim(
             counts2 = lax.cond(overflow_any, full, incr, counts)
             frac = counts2.astype(dtype) / safe_deg
             p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype)
+            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
             newly = (~informed) & (draws < p_inf)
             informed2 = informed | newly
             t_inf2 = jnp.where(newly, t + dt, t_inf)

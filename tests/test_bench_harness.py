@@ -252,13 +252,16 @@ def _run_ablation(script: str, args, tmp_path, timeout=560, extra_env=None) -> d
 
 def test_ablate_compaction_contract(tmp_path):
     d = _run_ablation("benchmarks/ablate_compaction.py", [20000, 8, 12], tmp_path)
-    assert set(d["parts_ms"]) >= {"scatter", "searchsorted", "searchsorted_blocked"}
+    assert set(d["parts_ms"]) >= {
+        "scatter", "searchsorted", "searchsorted_blocked",
+        "uniforms_foldin", "uniforms_counter",
+    }
     e2e = d["end_to_end"]
     assert set(e2e) == {
         f"{impl}_b{m}x"
         for impl in ("scatter", "searchsorted", "searchsorted_blocked")
         for m in (1, 4)
-    }
+    } | {"scatter_b1x_rngfoldin"}
     for row in e2e.values():
         assert row["steady_s"] > 0 and row["recount_steps"] >= 0
     assert d["verdict"] in e2e or d["verdict"] == "scatter_b1x"

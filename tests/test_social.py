@@ -186,15 +186,24 @@ class TestGraphGenerators:
 class TestAgentSimulation:
     def test_dense_graph_recovers_logistic(self):
         """Immediate exit on a dense graph ⇒ AW=G ⇒ baseline logistic ODE
-        (SURVEY §4(e): representative-agent limit)."""
-        n, beta, x0 = 20000, 1.0, 1e-3
+        (SURVEY §4(e): representative-agent limit).
+
+        exact_seeds + x0=1e-2 (200 founding seeds) keep the early
+        stochastic-growth drift small enough that the bound is
+        seed-robust: measured max-rel 0.089 ± 0.020 over 12 seeds — ~8σ
+        below 0.25. (At the old x0=1e-3 Bernoulli seeding the growth
+        phase's lognormal drift made the same bound fail ~40% of seeds
+        under EITHER rng stream; the original seed was just lucky.)"""
+        n, beta, x0 = 20000, 1.0, 1e-2
         src, dst = erdos_renyi_edges(n, 120.0, seed=3)
         cfg = AgentSimConfig(n_steps=300, dt=0.05)
-        res = simulate_agents(beta, src, dst, n, x0=x0, config=cfg, seed=0)
+        res = simulate_agents(
+            beta, src, dst, n, x0=x0, config=cfg, seed=0, exact_seeds=True
+        )
         t = np.asarray(res.t_grid)
         got = np.asarray(res.informed_frac)
         # the logistic preserves initial perturbations (G ∝ x0·e^{βt} while
-        # small), so compare against the REALIZED Bernoulli seed fraction
+        # small), so compare against the REALIZED seed fraction
         x0_eff = got[0]
         want = np.asarray(logistic_cdf(jnp.asarray(t), beta, float(x0_eff)))
         active = want > 0.01
@@ -916,6 +925,106 @@ class TestLaunchChunking:
         )
         np.testing.assert_array_equal(np.asarray(full.informed), np.asarray(b.informed))
         np.testing.assert_array_equal(np.asarray(full.t_inf), np.asarray(b.t_inf))
+
+
+class TestCounterRng:
+    def test_threefry_block_matches_jax_internal(self):
+        """The hand-rolled Threefry-2x32 must be the real algorithm —
+        cross-checked bit-for-bit against JAX's own implementation."""
+        jprng = pytest.importorskip("jax._src.prng")
+        from sbr_tpu.social.agents import _threefry2x32
+
+        k = jnp.array([0x12345678, 0x9ABCDEF0], dtype=jnp.uint32)
+        counts = jnp.arange(128, dtype=jnp.uint32)
+        ref = jprng.threefry_2x32(k, counts)
+        x0, x1 = _threefry2x32(k[0], k[1], counts[:64], counts[64:])
+        np.testing.assert_array_equal(np.asarray(ref[:64]), np.asarray(x0))
+        np.testing.assert_array_equal(np.asarray(ref[64:]), np.asarray(x1))
+
+    def test_counter_uniform_statistics(self):
+        from sbr_tpu.social.agents import _agent_uniforms
+
+        n = 200_000
+        ids = jnp.arange(n, dtype=jnp.uint32)
+        key = jax.random.PRNGKey(3)
+        u = np.asarray(
+            _agent_uniforms(key, jnp.int32(7), ids, jnp.float32, "counter")
+        )
+        assert u.dtype == np.float32
+        assert 0.0 <= u.min() and u.max() < 1.0
+        assert abs(u.mean() - 0.5) < 2e-3  # ~3 sigma of sqrt(1/12)/sqrt(n)
+        assert abs(u.var() - 1.0 / 12.0) < 2e-3
+        # adjacent-id independence (lag-1 correlation)
+        r = np.corrcoef(u[:-1], u[1:])[0, 1]
+        assert abs(r) < 0.01
+        # different steps decorrelate
+        u2 = np.asarray(
+            _agent_uniforms(key, jnp.int32(8), ids, jnp.float32, "counter")
+        )
+        assert abs(np.corrcoef(u, u2)[0, 1]) < 0.01
+
+    def test_counter_f64_uniforms(self):
+        from sbr_tpu.social.agents import _agent_uniforms
+
+        ids = jnp.arange(50_000, dtype=jnp.uint32)
+        u = np.asarray(
+            _agent_uniforms(jax.random.PRNGKey(1), jnp.int32(2), ids, jnp.float64,
+                            "counter")
+        )
+        assert u.dtype == np.float64
+        assert 0.0 <= u.min() and u.max() < 1.0
+        assert abs(u.mean() - 0.5) < 4e-3
+        # f64 draws carry sub-f32 resolution (52-bit mantissa path)
+        assert (np.abs(u - u.astype(np.float32)) > 0).any()
+
+    def test_counter_stream_engine_and_sharding_invariance(self):
+        """Under rng_stream='counter' every equivalence the default stream
+        guarantees must still hold: gather == incremental == 8-device
+        sharded, bit for bit."""
+        n = 5003
+        src, dst = erdos_renyi_edges(n, 10.0, seed=17)
+        cfg = AgentSimConfig(
+            n_steps=60, dt=0.1, exit_delay=0.2, reentry_delay=2.0,
+            rng_stream="counter",
+        )
+        mesh = jax.make_mesh((8,), ("agents",))
+        base = simulate_agents(1.0, src, dst, n, x0=0.01, config=cfg, seed=5,
+                               engine="gather")
+        for kwargs in (
+            dict(engine="incremental"),
+            dict(engine="gather", mesh=mesh),
+            dict(engine="incremental", mesh=mesh),
+        ):
+            other = simulate_agents(
+                1.0, src, dst, n, x0=0.01, config=cfg, seed=5, **kwargs
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.informed), np.asarray(other.informed), err_msg=str(kwargs)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.t_inf), np.asarray(other.t_inf), err_msg=str(kwargs)
+            )
+
+    def test_streams_are_different_realizations_of_same_dynamics(self):
+        """foldin vs counter: same physics, different draws — final G
+        differs but only within statistical scatter."""
+        n = 4000
+        src, dst = erdos_renyi_edges(n, 12.0, seed=19)
+        a = simulate_agents(
+            1.0, src, dst, n, x0=0.01,
+            config=AgentSimConfig(n_steps=50, dt=0.1, rng_stream="foldin"), seed=5,
+        )
+        b = simulate_agents(
+            1.0, src, dst, n, x0=0.01,
+            config=AgentSimConfig(n_steps=50, dt=0.1, rng_stream="counter"), seed=5,
+        )
+        ga, gb = float(a.informed_frac[-1]), float(b.informed_frac[-1])
+        assert ga != gb  # different realization...
+        assert abs(ga - gb) < 0.1  # ...of the same dynamics
+
+    def test_rng_stream_validation(self):
+        with pytest.raises(ValueError, match="rng_stream"):
+            AgentSimConfig(rng_stream="xor")
 
 
 class TestMeasuredEngine:
