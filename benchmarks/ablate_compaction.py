@@ -156,11 +156,17 @@ def main() -> None:
             )
 
     assert len(set(final.values())) == 1, final
+    # the incumbent is whatever the shipped config defaults to (b1x budget),
+    # so the verdict always protects the CURRENT default, not a hard-coded one
+    incumbent = f"{AgentSimConfig().compact_impl}_b1x"
     best_name = min(results, key=lambda k: results[k]["steady_s"])
-    ratio = results["scatter_b1x"]["steady_s"] / results[best_name]["steady_s"]
+    ratio = results[incumbent]["steady_s"] / results[best_name]["steady_s"]
     # >2% over the incumbent config to displace it; otherwise it stays
-    verdict = best_name if ratio > 1.02 else "scatter_b1x"
-    print(f"  best: {best_name} (incumbent/best steady ratio {ratio:.2f}) -> {verdict}")
+    verdict = best_name if ratio > 1.02 else incumbent
+    print(
+        f"  best: {best_name} (incumbent {incumbent}/best steady ratio "
+        f"{ratio:.2f}) -> {verdict}"
+    )
 
     # One extra e2e config for the RNG axis: the main grid runs the default
     # "counter" stream; this one measures the pre-0.7 "foldin" stream for

@@ -154,11 +154,17 @@ class AgentSimConfig:
     reentry_delay: float = float("inf")
     max_steps_per_launch: Optional[int] = None
     # Lowering of the incremental engines' per-step change compaction
-    # ("scatter" | "searchsorted" | "searchsorted_blocked" — bit-identical,
+    # ("searchsorted" | "searchsorted_blocked" | "scatter" — bit-identical,
     # see `_compact_ids`). A perf-only knob in the `engine="measure"`
-    # spirit: the winner is hardware-dependent, so it stays selectable for
-    # on-device A/B.
-    compact_impl: str = "scatter"
+    # spirit; default "searchsorted" since 0.7.0: 1.18× end-to-end on CPU
+    # at the bench shape (ABLATE_COMPACT_cpu_2026-08-01.json), and at the
+    # DEFAULT budget its budget·log₂N search gathers (~3×10⁵) are far
+    # cheaper than the scatter's ~N colliding writes on any plausible TPU
+    # cost model while the cumsum is shared — the exposure is a raised
+    # budget, where the search cost grows and scatter's does not (the
+    # queued TPU A/B measures both axes and confirms or reverts:
+    # `ablate_compaction.py`, tpu_session.sh step 2).
+    compact_impl: str = "searchsorted"
     # Per-agent RNG stream ("counter" | "foldin" — see `_agent_uniforms`).
     # Both are pure functions of (key, step, global id), so every
     # engine/sharding equivalence holds under either. "counter" (default

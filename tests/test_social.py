@@ -478,7 +478,7 @@ class TestIncrementalEngine:
                 1.0, src, dst, n, x0=0.01, config=base, seed=6,
                 engine="incremental", **extra,
             )
-            for impl in ("searchsorted", "searchsorted_blocked"):
+            for impl in ("scatter", "searchsorted", "searchsorted_blocked"):
                 alt = replace(base, compact_impl=impl)
                 b = simulate_agents(
                     1.0, src, dst, n, x0=0.01, config=alt, seed=6,
