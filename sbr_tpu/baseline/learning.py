@@ -51,13 +51,17 @@ def solve_learning(
     curves (e.g. as the social-learning initial guess,
     `social_learning_solver.jl:90-94`).
     """
+    from sbr_tpu import obs
+
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))  # x64-aware
     t0, t1 = params.tspan
-    grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
-    beta = jnp.asarray(params.beta, dtype=dtype)
-    x0 = jnp.asarray(params.x0, dtype=dtype)
-    cdf = logistic_cdf(grid, beta, x0)
-    pdf = logistic_pdf(grid, beta, x0)
+    with obs.span("baseline.learning", n_grid=config.n_grid) as sp:
+        grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
+        beta = jnp.asarray(params.beta, dtype=dtype)
+        x0 = jnp.asarray(params.x0, dtype=dtype)
+        cdf = logistic_cdf(grid, beta, x0)
+        pdf = logistic_pdf(grid, beta, x0)
+        sp.sync(cdf, pdf)
     return LearningSolution(
         grid=grid,
         cdf=cdf,

@@ -21,6 +21,7 @@ Design notes vs the reference:
 
 from __future__ import annotations
 
+import functools
 import time
 
 import jax
@@ -377,21 +378,35 @@ def solve_equilibrium_core(
     Faithful to `solve_equilibrium_baseline` (`solver.jl:413-462`) including
     the trivial no-crossing branch, expressed branchlessly via status codes.
     """
+    from sbr_tpu import obs
+
     dtype = ls.cdf.dtype
     u = jnp.asarray(u, dtype=dtype)
     nan = jnp.asarray(jnp.nan, dtype=dtype)
 
-    tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
+    # Spans are host-boundary no-ops inside traced code (sweeps, the social
+    # while_loop); on the eager path they give the per-stage wall split.
+    with obs.span("baseline.hazard") as sp:
+        tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
+        sp.sync(hr)
     hazard_at = (
         _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config)
         if (ls.closed_form and config.refine_crossings)
         else None
     )
-    tau_in_unc, tau_out_unc = optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=hazard_at)
+    with obs.span("baseline.buffers") as sp:
+        tau_in_unc, tau_out_unc = optimal_buffer(
+            u, tau_grid, hr, tspan_end, hazard_at=hazard_at
+        )
+        sp.sync(tau_in_unc, tau_out_unc)
 
     no_crossing = tau_in_unc == tau_out_unc
 
-    xi_c, err, root_ok, increasing = compute_xi(tau_in_unc, tau_out_unc, ls, kappa, config)
+    with obs.span("baseline.xi") as sp:
+        xi_c, err, root_ok, increasing = compute_xi(
+            tau_in_unc, tau_out_unc, ls, kappa, config
+        )
+        sp.sync(xi_c)
 
     run = jnp.logical_and(jnp.logical_not(no_crossing), jnp.logical_and(root_ok, increasing))
     status = jnp.where(
@@ -440,6 +455,15 @@ def solve_equilibrium_core(
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_core(config: SolverConfig):
+    """Jitted `solve_equilibrium_core`, cached per static config — the
+    telemetry path of the convenience entry: one compiled program whose
+    compile/execute split `obs.jit_call` can attribute (AOT lower/compile),
+    where the eager path's op-by-op dispatch has no compile step to time."""
+    return jax.jit(functools.partial(solve_equilibrium_core, config=config))
+
+
 def solve_equilibrium_baseline(
     ls: LearningSolution,
     econ: EconomicParams,
@@ -450,11 +474,30 @@ def solve_equilibrium_baseline(
     (`solver.jl:413`). ``tspan_end`` defaults to the learning grid's end, the
     reference's `lr.params.tspan[2]` (`solver.jl:421`). The result carries
     wall-clock ``solve_time`` with a device fence, like every reference
-    result struct (`solver.jl:414,458`)."""
+    result struct (`solver.jl:414,458`).
+
+    With telemetry active (`sbr_tpu.obs`), the solve runs as ONE jitted
+    program through `obs.jit_call`, logging a stage span plus the
+    compile/execute split and XLA cost analysis; results are the same pure
+    function of the inputs either way (jit vs eager may differ in the last
+    ulp of f64, well inside every tolerance in the package)."""
+    from sbr_tpu import obs
+
     if tspan_end is None:
         tspan_end = ls.grid[-1]
     t0 = time.perf_counter()
-    res = solve_equilibrium_core(
-        ls, econ.u, econ.p, econ.kappa, econ.lam, econ.eta, tspan_end, config
-    )
+    if obs.enabled():
+        dtype = ls.cdf.dtype
+        args = tuple(
+            jnp.asarray(v, dtype)
+            for v in (econ.u, econ.p, econ.kappa, econ.lam, econ.eta, tspan_end)
+        )
+        with obs.span("baseline.equilibrium"):
+            res = obs.jit_call(
+                "baseline.equilibrium", _jitted_core(config), ls, *args
+            )
+    else:
+        res = solve_equilibrium_core(
+            ls, econ.u, econ.p, econ.kappa, econ.lam, econ.eta, tspan_end, config
+        )
     return _stamp_solve_time(res, t0)

@@ -13,6 +13,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from sbr_tpu.obs.metrics import metrics
+
 
 def trapz(y, x=None, dx=1.0):
     """Trapezoid integral along the last axis."""
@@ -50,6 +52,10 @@ def cumulative_gauss_legendre(f, grid, order: int = 8):
 
     Returns an array shaped like ``grid`` with value 0 at ``grid[0]``.
     """
+    # Trace-time counter (see core.rootfind.bisect): quadrature instances ×
+    # order, a proxy for the transcendental-evaluation volume per program.
+    metrics().inc("core.quad_gl.calls")
+    metrics().inc("core.quad_gl.node_evals", order * (int(grid.shape[0]) - 1))
     nodes, weights = np.polynomial.legendre.leggauss(order)
     a = grid[:-1]
     b = grid[1:]

@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from sbr_tpu.obs.metrics import metrics
+
 
 def first_upcrossing(x, y, level, default, return_flag: bool = False):
     """First t where ``y`` crosses ``level`` from below, linearly interpolated.
@@ -98,6 +100,12 @@ def bisect(f, lo, hi, num_iters: int = 90, x0=None):
 
     Returns the final iterate. Fully vmappable when f broadcasts.
     """
+    # Trace-time counters (obs.metrics jit-safety contract): host code that
+    # counts bisection instances and their fixed iteration budgets as
+    # programs are traced/eagerly run — compile-complexity attribution with
+    # zero effect on the computation graph.
+    metrics().inc("core.bisect.calls")
+    metrics().inc("core.bisect.iters", num_iters)
     x = 0.5 * (lo + hi) if x0 is None else x0
 
     def body(_, state):

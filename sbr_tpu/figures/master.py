@@ -18,6 +18,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 import time
 from pathlib import Path
@@ -405,6 +406,13 @@ def main(argv=None) -> int:
     parser.add_argument("--fast", action="store_true", help="reduced sweep resolutions for smoke runs")
     parser.add_argument("--f32", action="store_true", help="run in float32 (default float64 parity mode)")
     parser.add_argument(
+        "--obs",
+        action="store_true",
+        help="write run telemetry (events.jsonl + manifest.json) under OUTPUT/obs/; "
+        "render with `python -m sbr_tpu.obs.report <run_dir>` "
+        "(SBR_OBS=1 in the environment enables the same thing)",
+    )
+    parser.add_argument(
         "--paper",
         action="store_true",
         help="also generate the paper-resolution heatmap via tiled checkpoint/resume "
@@ -459,6 +467,15 @@ def main(argv=None) -> int:
     sections = sorted({int(s) for s in args.sections.split(",") if s.strip()})
     runners = {1: run_baseline, 2: run_heterogeneity, 3: run_interest, 4: run_social}
     names = {1: "Baseline", 2: "Heterogeneity", 3: "Interest Rates", 4: "Social Learning"}
+    slugs = {1: "baseline", 2: "heterogeneity", 3: "interest_rates", 4: "social_learning"}
+
+    from sbr_tpu import obs
+
+    obs_ctx = (
+        obs.run_context(label="figures", root=str(outdir / "obs"))
+        if args.obs
+        else contextlib.nullcontext()
+    )
 
     t_start = time.time()
     skipped = set()
@@ -495,25 +512,29 @@ def main(argv=None) -> int:
         )
         _PDF_DOC = doc
     ok_run = False
+    obs_run = None
     try:
-        for sec in sections:
-            print("=" * 70)
-            print(f"SECTION {sec}/4: {names[sec]}")
-            print("=" * 70)
-            _PDF_PENDING_HEADER = names[sec]
-            t0 = time.time()
-            skipped |= runners[sec](figdir, args.fast) or set()
-            print(f"  section time: {time.time() - t0:.1f}s")
+        with obs_ctx as obs_run:
+            for sec in sections:
+                print("=" * 70)
+                print(f"SECTION {sec}/4: {names[sec]}")
+                print("=" * 70)
+                _PDF_PENDING_HEADER = names[sec]
+                t0 = time.time()
+                with obs.span(f"figures.{slugs[sec]}", fast=args.fast):
+                    skipped |= runners[sec](figdir, args.fast) or set()
+                print(f"  section time: {time.time() - t0:.1f}s")
 
-        if args.paper:
-            print("=" * 70)
-            print("PAPER-RESOLUTION HEATMAP (tiled, resumable)")
-            print("=" * 70)
-            _PDF_PENDING_HEADER = "Paper-resolution heatmap"
-            t0 = time.time()
-            ckpt = Path(args.checkpoint_dir) if args.checkpoint_dir else outdir / "checkpoints/heatmap_large"
-            run_paper_heatmap(figdir, ckpt, args.paper_res, args.paper_tile)
-            print(f"  paper heatmap time: {time.time() - t0:.1f}s")
+            if args.paper:
+                print("=" * 70)
+                print("PAPER-RESOLUTION HEATMAP (tiled, resumable)")
+                print("=" * 70)
+                _PDF_PENDING_HEADER = "Paper-resolution heatmap"
+                t0 = time.time()
+                ckpt = Path(args.checkpoint_dir) if args.checkpoint_dir else outdir / "checkpoints/heatmap_large"
+                with obs.span("figures.paper_heatmap", res=args.paper_res, tile=args.paper_tile):
+                    run_paper_heatmap(figdir, ckpt, args.paper_res, args.paper_tile)
+                print(f"  paper heatmap time: {time.time() - t0:.1f}s")
         ok_run = True
     finally:
         if doc is not None:
@@ -561,6 +582,9 @@ def main(argv=None) -> int:
     if doc is not None and doc_path.exists():
         print(f"  ✓ {doc_path} (combined figure document)")
     print(f"  ✓ {tex_path}")
+    if obs_run is not None:
+        print(f"  ✓ {obs_run.run_dir} (run telemetry; "
+              f"render: python -m sbr_tpu.obs.report {obs_run.run_dir})")
     return 1 if missing else 0
 
 

@@ -72,7 +72,9 @@ def solve_learning_hetero_arrays(
         # The scan carry becomes device-varying (it mixes in the sharded
         # betas); mark the constant-filled initial state as varying too so
         # shard_map's manual-axes check accepts the loop.
-        g0 = lax.pcast(g0, (axis_name,), to="varying")
+        from sbr_tpu.parallel.compat import pcast
+
+        g0 = pcast(g0, (axis_name,), to="varying")
     cdfs = rk4(hetero_rhs, g0, grid, args=(betas, dist, axis_name), substeps=substeps)  # (n, K)
     cdfs = jnp.clip(cdfs.T, 0.0, 1.0)  # (K, n)
 

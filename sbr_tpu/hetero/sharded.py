@@ -121,8 +121,10 @@ def solve_hetero_sharded(
     dist = jax.device_put(jnp.asarray(params.learning.dist, dtype=dtype), shard)
 
     table_args = tables if exact else ()
+    from sbr_tpu.parallel.compat import shard_map
+
     fn_sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(axis), P(axis)) + (P(),) * len(table_args),

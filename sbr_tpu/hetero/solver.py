@@ -188,6 +188,8 @@ def solve_equilibrium_hetero(
     """
     import time
 
+    from sbr_tpu import obs
+
     t_start = time.perf_counter()
     dtype = lsh.cdfs.dtype
     if tspan_end is None:
@@ -195,20 +197,29 @@ def solve_equilibrium_hetero(
     u = jnp.asarray(econ.u, dtype=dtype)
     nan = jnp.asarray(jnp.nan, dtype=dtype)
 
-    tau_grid, hrs = hazard_rates_hetero(econ.p, econ.lam, lsh, econ.eta, config)
+    # Host-boundary spans: no-ops under shard_map/jit (trace guard), so the
+    # sharded group-axis path is untouched; the eager path gets the
+    # per-stage wall split with honest fences.
+    with obs.span("hetero.hazards", groups=int(lsh.cdfs.shape[0])) as sp:
+        tau_grid, hrs = hazard_rates_hetero(econ.p, econ.lam, lsh, econ.eta, config)
+        sp.sync(hrs)
 
-    default = jnp.asarray(tspan_end, dtype=dtype)
-    tau_in_uncs = jax.vmap(lambda hr: first_upcrossing(tau_grid, hr, u, default))(hrs)
-    tau_out_uncs = jax.vmap(lambda hr: last_downcrossing(tau_grid, hr, u, default))(hrs)
+    with obs.span("hetero.buffers") as sp:
+        default = jnp.asarray(tspan_end, dtype=dtype)
+        tau_in_uncs = jax.vmap(lambda hr: first_upcrossing(tau_grid, hr, u, default))(hrs)
+        tau_out_uncs = jax.vmap(lambda hr: last_downcrossing(tau_grid, hr, u, default))(hrs)
+        sp.sync(tau_in_uncs, tau_out_uncs)
 
     # No group can optimally exit (`heterogeneity_solver.jl:266-272`); the
     # ALL-groups condition completes across shards as a summed crossing count.
     n_crossing = _wreduce(jnp.sum(tau_in_uncs != tau_out_uncs), axis_name)
     no_crossing = n_crossing == 0
 
-    xi_c, err, root_ok, increasing, first_ok = compute_xi_hetero(
-        tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config, axis_name=axis_name
-    )
+    with obs.span("hetero.xi") as sp:
+        xi_c, err, root_ok, increasing, first_ok = compute_xi_hetero(
+            tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config, axis_name=axis_name
+        )
+        sp.sync(xi_c)
 
     valid = jnp.logical_and(root_ok, jnp.logical_and(increasing, first_ok))
     run = jnp.logical_and(~no_crossing, valid)

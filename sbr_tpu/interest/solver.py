@@ -61,6 +61,8 @@ def solve_equilibrium_interest_core(
     config: SolverConfig = SolverConfig(),
 ) -> EquilibriumResultInterest:
     """Scalar-parameter interest-rate solve — the vmap unit for policy sweeps."""
+    from sbr_tpu import obs
+
     dtype = ls.cdf.dtype
     u = jnp.asarray(u, dtype=dtype)
     r = jnp.asarray(r, dtype=dtype)
@@ -73,16 +75,20 @@ def solve_equilibrium_interest_core(
     # the baseline sweep does. ``warped`` is static (config is concrete at
     # trace time), so the uniform fast path costs nothing when warp is off.
     warped = not hazard_grid_is_uniform(ls, config)
-    tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
+    with obs.span("interest.hazard") as sp:
+        tau_grid, hr, integ, int_eta = _hazard_parts(p, lam, ls, eta, config)
+        sp.sync(hr)
     index_fn = None
     if warped:
         eta_c = jnp.asarray(eta, dtype=dtype)
         index_fn = lambda t: warped_grid_index(
             t, eta_c, ls.beta, ls.x0, config.n_grid, config.grid_warp
         )
-    v = solve_value_function(
-        tau_grid, hr, delta, r, u, config, uniform=not warped, index_fn=index_fn
-    )
+    with obs.span("interest.value_function") as sp:
+        v = solve_value_function(
+            tau_grid, hr, delta, r, u, config, uniform=not warped, index_fn=index_fn
+        )
+        sp.sync(v)
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
 
     # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`).
@@ -105,14 +111,20 @@ def solve_equilibrium_interest_core(
         def hazard_eff_at(tau):
             return hazard_at(tau) - r * v_at(tau)
 
-    tau_in_unc, tau_out_unc = optimal_buffer(
-        u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at
-    )
+    with obs.span("interest.buffers") as sp:
+        tau_in_unc, tau_out_unc = optimal_buffer(
+            u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at
+        )
+        sp.sync(tau_in_unc, tau_out_unc)
     no_crossing = tau_in_unc == tau_out_unc
 
     # ξ and AW use the baseline machinery on the word-of-mouth CDF unchanged
     # (`interest_rate_solver.jl:122`, `get_AW_functions_interest!:161-184`).
-    xi_c, err, root_ok, increasing = compute_xi(tau_in_unc, tau_out_unc, ls, kappa, config)
+    with obs.span("interest.xi") as sp:
+        xi_c, err, root_ok, increasing = compute_xi(
+            tau_in_unc, tau_out_unc, ls, kappa, config
+        )
+        sp.sync(xi_c)
 
     run = jnp.logical_and(~no_crossing, jnp.logical_and(root_ok, increasing))
     status = jnp.where(

@@ -1,0 +1,63 @@
+"""Run telemetry subsystem: structured event logs, per-stage tracing with
+compile/execute attribution, device metrics, and a metrics registry.
+
+Layers (SURVEY §5.1, §5.5; torchode's solver step statistics and ABMax's
+per-step ABM counters are the design references from PAPERS.md):
+
+- ``obs.timing``  — low-level primitives: honest device `fence`,
+  `StageTimer`, `jax.profiler` `trace` capture (formerly `utils.timing`).
+- ``obs.metrics`` — process-global counters/gauges/timer histograms,
+  recorded only at host boundaries (jit-safe); zero overhead disabled.
+- ``obs.runlog``  — `RunContext` per-run directories (`events.jsonl` +
+  `manifest.json`), `span` stage tracing, `jit_call` AOT compile/execute
+  attribution, status-grid accounting, memory snapshots.
+- ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
+  a run directory or diffs two runs.
+
+Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
+land under ``SBR_OBS_DIR``, default ``obs_runs/``), or programmatically::
+
+    from sbr_tpu import obs
+    with obs.run_context(label="sweep") as run:
+        grid = beta_u_grid(...)
+    print(run.run_dir)  # manifest.json + events.jsonl
+
+Disabled (the default), every instrumentation site is a single global read
+— no events, no fences, no extra device work, and no retraces of library
+jit caches (asserted by tests/test_obs.py).
+"""
+
+from sbr_tpu.obs.metrics import MetricsRegistry, metrics
+from sbr_tpu.obs.runlog import (
+    RunContext,
+    current_run,
+    enabled,
+    end_run,
+    event,
+    jit_call,
+    log_status,
+    run_context,
+    span,
+    start_run,
+    suspended,
+)
+from sbr_tpu.obs.timing import StageTimer, fence, trace
+
+__all__ = [
+    "MetricsRegistry",
+    "RunContext",
+    "StageTimer",
+    "current_run",
+    "enabled",
+    "end_run",
+    "event",
+    "fence",
+    "jit_call",
+    "log_status",
+    "metrics",
+    "run_context",
+    "span",
+    "start_run",
+    "suspended",
+    "trace",
+]

@@ -1,0 +1,118 @@
+"""Metrics registry: counters, gauges, and timer histograms.
+
+Jit-safety contract (SURVEY §5.1, torchode-style step statistics): metrics
+are only ever recorded from HOST code — either at the host boundary from
+returned arrays (status codes, iteration counts, residuals) or as
+trace-time counters (host Python that runs while a program is being traced,
+counting traced solver instances without touching the computation graph).
+Nothing here may appear inside traced code, so enabling or disabling
+metrics can never change a jaxpr or force a retrace.
+
+Overhead contract: every recording method starts with a single attribute
+test and returns immediately when the registry is disabled, so dormant
+instrumentation in hot host loops (tile drivers, graph preprocessing) costs
+one branch per call and allocates nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List
+
+
+class MetricsRegistry:
+    """Process-local counters / gauges / timer histograms.
+
+    Disabled by default; `RunContext` enables it for the run's duration and
+    folds `summary()` into the run manifest. All recording methods are
+    no-ops while disabled (see module docstring for the overhead contract).
+    """
+
+    __slots__ = ("_on", "counters", "gauges", "timers")
+
+    def __init__(self) -> None:
+        self._on = False
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.timers: Dict[str, List[float]] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._on
+
+    def enable(self) -> None:
+        self._on = True
+
+    def disable(self) -> None:
+        self._on = False
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.gauges.clear()
+        self.timers.clear()
+
+    # -- recording (all no-ops while disabled) ------------------------------
+    def inc(self, name: str, n: float = 1) -> None:
+        """Increment counter ``name`` by ``n``."""
+        if not self._on:
+            return
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set gauge ``name`` to its latest ``value``."""
+        if not self._on:
+            return
+        self.gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one duration sample into timer histogram ``name``."""
+        if not self._on:
+            return
+        self.timers.setdefault(name, []).append(float(seconds))
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """Time the enclosed block into histogram ``name`` (host wall-clock;
+        callers timing device work should fence first — see obs.timing)."""
+        if not self._on:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    # -- reporting ----------------------------------------------------------
+    def summary(self) -> dict:
+        """JSON-ready snapshot: counters/gauges verbatim, timers reduced to
+        count/total/min/mean/p50/p95/max (keys sorted for determinism)."""
+
+        def _hist(samples: List[float]) -> dict:
+            s = sorted(samples)
+            n = len(s)
+            return {
+                "count": n,
+                "total_s": sum(s),
+                "min_s": s[0],
+                "mean_s": sum(s) / n,
+                "p50_s": s[n // 2],
+                "p95_s": s[min(n - 1, (19 * n) // 20)],
+                "max_s": s[-1],
+            }
+
+        return {
+            "counters": {k: self.counters[k] for k in sorted(self.counters)},
+            "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+            "timers": {k: _hist(v) for k, v in sorted(self.timers.items())},
+        }
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def metrics() -> MetricsRegistry:
+    """The process-global registry (instrumentation call sites and tests)."""
+    return _GLOBAL

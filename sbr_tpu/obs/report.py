@@ -1,0 +1,244 @@
+"""Render an obs run directory as a human-readable timing/throughput table,
+or diff two runs.
+
+Usage:
+    python -m sbr_tpu.obs.report RUN_DIR            # render one run
+    python -m sbr_tpu.obs.report RUN_DIR OTHER_DIR  # diff two runs
+    python -m sbr_tpu.obs.report RUN_DIR --events 20  # also tail raw events
+
+Reads only `manifest.json` + `events.jsonl` — no JAX import, so the report
+never touches (or hangs on) an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_run(run_dir) -> dict:
+    """Load a run directory: manifest (required) + parsed events (optional)."""
+    run_dir = Path(run_dir)
+    manifest_path = run_dir / "manifest.json"
+    if not manifest_path.exists():
+        raise FileNotFoundError(f"{manifest_path} not found — not an obs run directory")
+    manifest = json.loads(manifest_path.read_text())
+    events = []
+    events_path = run_dir / "events.jsonl"
+    if events_path.exists():
+        for line in events_path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                events.append({"kind": "_unparseable", "raw": line[:120]})
+    return {"dir": str(run_dir), "manifest": manifest, "events": events}
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    return f"{v * 1e3:.1f} ms" if v < 1.0 else f"{v:.3f} s"
+
+
+def _fmt_bytes(v) -> str:
+    if not v:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if v < 1024 or unit == "GiB":
+            return f"{v:.1f} {unit}"
+        v /= 1024
+    return f"{v:.1f} GiB"
+
+
+def _table(headers, rows) -> str:
+    widths = [len(h) for h in headers]
+    rows = [[str(c) for c in r] for r in rows]
+    for r in rows:
+        widths = [max(w, len(c)) for w, c in zip(widths, r)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*r) for r in rows]
+    return "\n".join(lines)
+
+
+def _jit_by_name(events) -> dict:
+    """Aggregate jit_call events by name."""
+    agg: dict = {}
+    for ev in events:
+        if ev.get("kind") != "jit_call":
+            continue
+        a = agg.setdefault(
+            ev.get("name", "?"),
+            {"calls": 0, "trace_s": 0.0, "compile_s": 0.0, "execute_s": 0.0, "flops": 0.0},
+        )
+        a["calls"] += 1
+        for k in ("trace_s", "compile_s", "execute_s"):
+            a[k] += float(ev.get(k, 0.0))
+        if ev.get("flops") is not None:
+            # accumulate per event: one name can cover several compiled
+            # shapes, so a single per-call flops value is not representative
+            a["flops"] += float(ev["flops"])
+    return agg
+
+
+def _status_by_stage(events) -> dict:
+    out: dict = {}
+    for ev in events:
+        if ev.get("kind") == "status":
+            out[ev.get("stage", "?")] = ev.get("counts", {})
+    return out
+
+
+def render(run: dict) -> str:
+    m = run["manifest"]
+    events = run["events"]
+    out = []
+    dev = m.get("device") or {}
+    out.append(f"run      {run['dir']}")
+    out.append(
+        f"label    {m.get('label')}   status {m.get('status')}   "
+        f"started {m.get('started_at')}   duration {_fmt_s(m.get('duration_s'))}"
+    )
+    out.append(
+        f"device   {dev.get('device_kind', '?')} ({dev.get('platform', '?')} ×"
+        f"{dev.get('device_count', '?')})   jax {dev.get('jax_version', '?')}"
+    )
+    mem = m.get("memory") or {}
+    out.append(
+        f"memory   peak live buffers {_fmt_bytes(mem.get('peak_live_buffer_bytes'))}"
+        + (
+            f"   device peak {_fmt_bytes(mem.get('peak_device_bytes'))}"
+            if mem.get("peak_device_bytes")
+            else ""
+        )
+    )
+    out.append(f"events   {m.get('n_events')}")
+
+    stages = m.get("stages") or {}
+    if stages:
+        total = sum(v["total_s"] for v in stages.values()) or 1.0
+        out += ["", "STAGES"]
+        out.append(
+            _table(
+                ["stage", "count", "total", "share"],
+                [
+                    [k, v["count"], _fmt_s(v["total_s"]), f"{100 * v['total_s'] / total:.1f}%"]
+                    for k, v in stages.items()
+                ],
+            )
+        )
+
+    jit = _jit_by_name(events)
+    if jit:
+        out += ["", "JIT (compile vs execute)"]
+        rows = []
+        for name, a in sorted(jit.items()):
+            rate = ""
+            if a["flops"] and a["execute_s"]:
+                rate = f"{a['flops'] / a['execute_s'] / 1e9:.2f} GFLOP/s"
+            rows.append(
+                [name, a["calls"], _fmt_s(a["trace_s"]), _fmt_s(a["compile_s"]), _fmt_s(a["execute_s"]), rate]
+            )
+        out.append(_table(["program", "calls", "trace", "compile", "execute", "throughput"], rows))
+        j = m.get("jit") or {}
+        out.append(
+            f"totals: {j.get('calls', 0)} calls ({j.get('cache_hits', 0)} cache hits), "
+            f"compile {_fmt_s(j.get('compile_s'))}, execute {_fmt_s(j.get('execute_s'))}"
+        )
+
+    status = _status_by_stage(events)
+    if status:
+        out += ["", "STATUS GRIDS"]
+        rows = [
+            [stage, ", ".join(f"{k}={v}" for k, v in counts.items() if v)]
+            for stage, counts in status.items()
+        ]
+        out.append(_table(["stage", "counts"], rows))
+
+    mx = m.get("metrics") or {}
+    if mx.get("counters") or mx.get("timers") or mx.get("gauges"):
+        out += ["", "METRICS"]
+        rows = [["counter", k, v] for k, v in (mx.get("counters") or {}).items()]
+        rows += [["gauge", k, v] for k, v in (mx.get("gauges") or {}).items()]
+        rows += [
+            ["timer", k, f"n={h['count']} total={_fmt_s(h['total_s'])} p50={_fmt_s(h['p50_s'])}"]
+            for k, h in (mx.get("timers") or {}).items()
+        ]
+        out.append(_table(["type", "name", "value"], rows))
+
+    return "\n".join(out)
+
+
+def diff(a: dict, b: dict) -> str:
+    """Stage/jit-level diff of two runs (b relative to a)."""
+    ma, mb = a["manifest"], b["manifest"]
+    out = [f"A: {a['dir']}", f"B: {b['dir']}", ""]
+    out.append(
+        f"duration  A {_fmt_s(ma.get('duration_s'))}   B {_fmt_s(mb.get('duration_s'))}"
+    )
+    ja, jb = ma.get("jit") or {}, mb.get("jit") or {}
+    out.append(
+        f"compile   A {_fmt_s(ja.get('compile_s'))}   B {_fmt_s(jb.get('compile_s'))}"
+    )
+    out.append(
+        f"execute   A {_fmt_s(ja.get('execute_s'))}   B {_fmt_s(jb.get('execute_s'))}"
+    )
+    sa, sb = ma.get("stages") or {}, mb.get("stages") or {}
+    names = sorted(set(sa) | set(sb))
+    if names:
+        rows = []
+        for n in names:
+            ta = sa.get(n, {}).get("total_s")
+            tb = sb.get(n, {}).get("total_s")
+            if ta is not None and tb is not None:
+                # presence, not truthiness: a sub-µs span rounds to 0.0 in
+                # the manifest but is still in both runs
+                ratio = f"{tb / ta:.2f}x" if ta else "-"
+            else:
+                ratio = "only A" if ta is not None else ("only B" if tb is not None else "-")
+            rows.append([n, _fmt_s(ta), _fmt_s(tb), ratio])
+        out += ["", "STAGES (B vs A)", _table(["stage", "A", "B", "B/A"], rows)]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report",
+        description="Render an obs run directory, or diff two runs",
+    )
+    parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")
+    parser.add_argument("--events", type=int, default=0, metavar="N", help="also print the last N raw events")
+    args = parser.parse_args(argv)
+
+    try:
+        run = load_run(args.run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    if args.other_dir:
+        try:
+            other = load_run(args.other_dir)
+        except (FileNotFoundError, json.JSONDecodeError) as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+        print(diff(run, other))
+    else:
+        print(render(run))
+        if args.events:
+            print(f"\nLAST {args.events} EVENTS")
+            for ev in run["events"][-args.events :]:
+                print(f"  {ev.get('mono', 0):>12.6f}  {ev.get('kind', '?'):<12} "
+                      + " ".join(f"{k}={v}" for k, v in ev.items() if k not in ("mono", "ts", "kind")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

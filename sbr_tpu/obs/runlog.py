@@ -1,0 +1,553 @@
+"""Structured run telemetry: `RunContext` event logs, per-stage spans, and
+AOT compile/execute attribution.
+
+The reference package's only observability is ad-hoc prints plus a
+`solve_time` field per result struct (SURVEY §5.1, §5.5) — none of which
+survives `jit`. This module is the structured replacement:
+
+- A **RunContext** owns a per-run directory holding `events.jsonl` (one
+  structured event per line: stage start/end, jit compile/execute splits,
+  status-grid accounting, device/memory snapshots) plus a single
+  machine-readable `manifest.json` summarizing the run. The manifest is
+  written at start (status "running") and atomically rewritten at
+  finalize, so an interrupted run still leaves a parseable artifact.
+- **Spans** (`obs.span`) time named pipeline stages at the HOST boundary
+  with an honest device fence (`obs.timing.fence`). Inside traced code
+  they are no-ops (`jax.core.trace_state_clean` guard), so instrumented
+  library functions behave identically under `vmap`/`jit`.
+- **jit_call** attributes a jitted entry point's wall-clock to trace vs
+  compile vs execute via the AOT path (`fn.lower(args).compile()`), plus
+  XLA cost/memory analysis of the compiled executable. Compiled
+  executables are cached per (fn, abstract signature) inside the run, so
+  steady-state calls report a pure execute time with `cache: "hit"`.
+
+Zero-overhead contract when disabled: every module-level helper first
+checks for an active run (one global read) and returns immediately —
+no jax import, no clock read, no allocation. Nothing here ever inserts
+host callbacks or changes traced code, so enabling telemetry cannot
+trigger retraces of library jit caches (asserted by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+from sbr_tpu.obs.metrics import metrics
+
+SCHEMA = "sbr-obs/1"
+
+# Active run stack: module-level so instrumentation sites need one global
+# read on the disabled path. The env var SBR_OBS=1 auto-starts a run lazily
+# on the first instrumented call (dir from SBR_OBS_DIR, default obs_runs/).
+_STACK: list = []
+_ENV_CHECKED = False
+
+
+def _trace_clean() -> bool:
+    """True when not inside a jax trace (host instrumentation is allowed)."""
+    try:
+        import jax
+
+        return jax.core.trace_state_clean()
+    except Exception:  # ancient/newer jax without the helper: fail open
+        return True
+
+
+def _json_default(obj):
+    """Best-effort JSON coercion for numpy/jax scalars and arrays."""
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except Exception:
+        pass
+    # numpy ndarrays and jax Arrays both expose tolist(); scalars item().
+    if hasattr(obj, "tolist"):
+        try:
+            return obj.tolist()
+        except Exception:
+            pass
+    if hasattr(obj, "item"):
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    return repr(obj)
+
+
+class _Span:
+    """Live span handle: accumulate arrays to fence at exit via `.sync()`."""
+
+    __slots__ = ("_arrays",)
+
+    def __init__(self) -> None:
+        self._arrays: list = []
+
+    def sync(self, *arrays) -> None:
+        """Register arrays whose producing computation must complete before
+        the span's end time is taken (the honest-fence contract)."""
+        self._arrays.extend(arrays)
+
+
+class _NullSpan:
+    """Disabled-path span: every method is a no-op."""
+
+    __slots__ = ()
+
+    def sync(self, *arrays) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class RunContext:
+    """One telemetry run: a directory with `events.jsonl` + `manifest.json`.
+
+    Construction touches only the filesystem — never a JAX backend — so the
+    bench harness parent (which must not initialize an accelerator) can hold
+    a RunContext safely; device info is captured lazily on the first
+    instrumented call that already implies a live backend.
+    """
+
+    def __init__(
+        self,
+        run_dir: Optional[str] = None,
+        label: str = "run",
+        root: Optional[str] = None,
+    ) -> None:
+        if run_dir is None:
+            root = Path(root or os.environ.get("SBR_OBS_DIR", "obs_runs"))
+            stamp = time.strftime("%Y%m%dT%H%M%S")
+            base = root / f"{label}_{stamp}_p{os.getpid()}"
+            # The stamp has second granularity: two same-label runs within
+            # one second must not share a directory (interleaved events,
+            # clobbered manifest) — claim a unique dir with exist_ok=False.
+            run_dir, i = base, 0
+            while True:
+                try:
+                    run_dir.mkdir(parents=True, exist_ok=False)
+                    break
+                except FileExistsError:
+                    i += 1
+                    run_dir = Path(f"{base}_{i}")
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        self.label = label
+        self.t_wall0 = time.time()
+        self.t_mono0 = time.monotonic()
+        self._fh = open(self.run_dir / "events.jsonl", "a")
+        self._n_events = 0
+        self._closed = False
+        # aggregates folded into the manifest
+        self.stages: dict = {}  # name -> {count, total_s}
+        self.jit: dict = {"calls": 0, "cache_hits": 0, "trace_s": 0.0, "compile_s": 0.0, "execute_s": 0.0}
+        self.mem_peak_live = 0  # peak sum of live jax buffer nbytes
+        self.mem_peak_device = 0  # peak allocator peak_bytes_in_use (if exposed)
+        self.device: Optional[dict] = None
+        self._aot_cache: dict = {}
+        self._metrics_was_on = metrics().enabled
+        if not self._metrics_was_on:
+            # This run owns the registry: start it from zero so the manifest
+            # carries per-run metrics, not process-lifetime accumulation.
+            metrics().reset()
+        metrics().enable()
+        self._write_manifest(status="running")
+        self.event("run_start", label=label, argv=list(sys.argv), pid=os.getpid())
+
+    # -- events -------------------------------------------------------------
+    def event(self, kind: str, **fields) -> None:
+        """Append one structured event line. `mono` is seconds since run
+        start on the monotonic clock (orders events); `ts` is wall time."""
+        if self._closed:
+            return
+        rec = {
+            "mono": round(time.monotonic() - self.t_mono0, 9),
+            "ts": round(time.time(), 6),
+            "kind": kind,
+        }
+        rec.update(fields)
+        self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+        self._fh.flush()
+        self._n_events += 1
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Stage span: emits stage_start/stage_end events and accumulates
+        per-stage totals. Yields a handle whose `.sync(*arrays)` registers
+        arrays to fence before the end timestamp (device-honest timing)."""
+        self.event("stage_start", stage=name, **attrs)
+        handle = _Span()
+        t0 = time.monotonic()
+        err = None
+        try:
+            yield handle
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            if handle._arrays:
+                try:
+                    from sbr_tpu.obs.timing import fence
+
+                    fence(*handle._arrays)
+                except Exception:
+                    pass  # fencing must never sink the instrumented call
+            dur = time.monotonic() - t0
+            agg = self.stages.setdefault(name, {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += dur
+            end_fields = dict(stage=name, dur_s=round(dur, 6), **attrs)
+            if err is not None:
+                end_fields["error"] = repr(err)
+            self.event("stage_end", **end_fields)
+            self._memory_event(name)
+
+    # -- jit compile/execute attribution ------------------------------------
+    def jit_call(self, name: str, fn, *args):
+        """Call jitted ``fn(*args)`` through the AOT path, attributing
+        wall-clock to trace/lower vs compile vs execute and logging XLA
+        cost/memory analysis. Falls back to a plain call (with a fallback
+        event) if the function cannot be lowered."""
+        sig = _abstract_sig(args)
+        key = (name, id(fn), sig)
+        entry = self._aot_cache.get(key)
+        trace_s = compile_s = 0.0
+        info: dict = {}
+        if entry is None:
+            t0 = time.monotonic()
+            try:
+                lowered = fn.lower(*args)
+                t1 = time.monotonic()
+                compiled = lowered.compile()
+                t2 = time.monotonic()
+            except Exception as err:
+                self.event("jit_call_fallback", name=name, error=repr(err))
+                return fn(*args)
+            trace_s = t1 - t0
+            compile_s = t2 - t1
+            info = _compiled_info(compiled)
+            entry = (compiled, info)
+            self._aot_cache[key] = entry
+            cache = "miss"
+        else:
+            compiled, info = entry
+            cache = "hit"
+        compiled = entry[0]
+        t3 = time.monotonic()
+        out = compiled(*args)
+        try:
+            from sbr_tpu.obs.timing import fence
+
+            import jax
+
+            fence(*jax.tree_util.tree_leaves(out))
+        except Exception:
+            pass
+        execute_s = time.monotonic() - t3
+        self.jit["calls"] += 1
+        self.jit["cache_hits"] += int(cache == "hit")
+        self.jit["trace_s"] += trace_s
+        self.jit["compile_s"] += compile_s
+        self.jit["execute_s"] += execute_s
+        self.event(
+            "jit_call",
+            name=name,
+            cache=cache,
+            trace_s=round(trace_s, 6),
+            compile_s=round(compile_s, 6),
+            execute_s=round(execute_s, 6),
+            **info,
+        )
+        self._device_event()
+        self._memory_event(name)
+        return out
+
+    # -- device / memory snapshots ------------------------------------------
+    def _device_event(self) -> None:
+        """Record device info once, from a context where a backend is
+        already live (never force backend init from telemetry)."""
+        if self.device is not None:
+            return
+        try:
+            import jax
+
+            d = jax.devices()[0]
+            self.device = {
+                "platform": d.platform,
+                "device_kind": d.device_kind,
+                "device_count": jax.device_count(),
+                "process_count": getattr(jax, "process_count", lambda: 1)(),
+                "jax_version": jax.__version__,
+            }
+            self.event("device", **self.device)
+        except Exception:
+            pass
+
+    def _memory_event(self, where: str) -> None:
+        """Live-buffer + allocator snapshot (guarded: `memory_stats` is
+        None on CPU and may be unsupported behind tunnels)."""
+        try:
+            import jax
+
+            # Only span ends and jit calls land here, both of which imply
+            # device work already happened — so recording the device info
+            # cannot be the thing that forces backend init.
+            self._device_event()
+            live = sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+            snap = {"where": where, "live_buffer_bytes": int(live)}
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                for k in ("bytes_in_use", "peak_bytes_in_use"):
+                    if k in stats:
+                        snap[k] = int(stats[k])
+                self.mem_peak_device = max(
+                    self.mem_peak_device, int(stats.get("peak_bytes_in_use", 0))
+                )
+            self.mem_peak_live = max(self.mem_peak_live, int(live))
+            self.event("memory", **snap)
+        except Exception:
+            pass
+
+    # -- summary / finalize ---------------------------------------------------
+    def summary(self) -> dict:
+        """Machine-readable roll-up (the bench JSON `obs` block)."""
+        return {
+            "run_dir": str(self.run_dir),
+            "device": (self.device or {}).get("device_kind"),
+            "platform": (self.device or {}).get("platform"),
+            "compile_s": round(self.jit["compile_s"], 4),
+            "execute_s": round(self.jit["execute_s"], 4),
+            "jit_calls": self.jit["calls"],
+            "memory_peak_bytes": self.mem_peak_device or self.mem_peak_live,
+            "n_events": self._n_events,
+        }
+
+    def _write_manifest(self, status: str) -> None:
+        manifest = {
+            "schema": SCHEMA,
+            "label": self.label,
+            "status": status,
+            "started_at": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(self.t_wall0)),
+            "duration_s": round(time.monotonic() - self.t_mono0, 6),
+            "argv": list(sys.argv),
+            "n_events": self._n_events,
+            "device": self.device,
+            "stages": {
+                k: {"count": v["count"], "total_s": round(v["total_s"], 6)}
+                for k, v in sorted(self.stages.items())
+            },
+            "jit": {
+                **{k: self.jit[k] for k in ("calls", "cache_hits")},
+                **{k: round(self.jit[k], 6) for k in ("trace_s", "compile_s", "execute_s")},
+            },
+            "memory": {
+                "peak_live_buffer_bytes": self.mem_peak_live,
+                "peak_device_bytes": self.mem_peak_device,
+            },
+            "metrics": metrics().summary() if metrics().enabled else None,
+        }
+        tmp = self.run_dir / "manifest.json.tmp"
+        tmp.write_text(json.dumps(manifest, indent=1, default=_json_default) + "\n")
+        os.replace(tmp, self.run_dir / "manifest.json")
+
+    def finalize(self) -> None:
+        """Write the final manifest and close the event log (idempotent)."""
+        if self._closed:
+            return
+        self.event("run_end", n_events=self._n_events)
+        self._write_manifest(status="complete")
+        self._closed = True
+        self._fh.close()
+        if not self._metrics_was_on:
+            metrics().disable()
+
+    def __enter__(self) -> "RunContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finalize()
+
+
+# ---------------------------------------------------------------------------
+# Module-level API: instrumentation call sites use these; all are no-ops
+# (one global read) when no run is active and SBR_OBS is unset.
+# ---------------------------------------------------------------------------
+
+
+def current_run() -> Optional[RunContext]:
+    """The active RunContext, auto-starting one if SBR_OBS=1 in the
+    environment (checked once per process). None when telemetry is off."""
+    global _ENV_CHECKED
+    if not _STACK and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        if os.environ.get("SBR_OBS", "").strip() not in ("", "0"):
+            start_run(label=os.environ.get("SBR_OBS_LABEL", "run"))
+    return _STACK[-1] if _STACK else None
+
+
+def enabled() -> bool:
+    return current_run() is not None
+
+
+def start_run(label: str = "run", run_dir: Optional[str] = None, root: Optional[str] = None) -> RunContext:
+    """Start (and stack) a run; finalized by `end_run`, `run_context`, or at
+    interpreter exit — an abandoned run still lands a complete manifest."""
+    global _ENV_CHECKED
+    # An explicit run satisfies SBR_OBS's intent; without this, a later
+    # empty-stack moment (obs.suspended, or after end_run) would auto-start
+    # a surprise second run from the env var.
+    _ENV_CHECKED = True
+    run = RunContext(run_dir=run_dir, label=label, root=root)
+    _STACK.append(run)
+    atexit.register(_finalize_if_active, run)
+    return run
+
+
+def _finalize_if_active(run: RunContext) -> None:
+    if run in _STACK:
+        _STACK.remove(run)
+    run.finalize()
+
+
+def end_run() -> Optional[RunContext]:
+    """Finalize and pop the innermost active run."""
+    if not _STACK:
+        return None
+    run = _STACK.pop()
+    run.finalize()
+    return run
+
+
+@contextlib.contextmanager
+def run_context(label: str = "run", run_dir: Optional[str] = None, root: Optional[str] = None):
+    run = start_run(label=label, run_dir=run_dir, root=root)
+    try:
+        yield run
+    finally:
+        _finalize_if_active(run)
+
+
+@contextlib.contextmanager
+def suspended():
+    """Temporarily disable telemetry for a measurement-critical section.
+
+    The bench harness's steady-state protocols (pipelined dispatch with one
+    trailing fence) would be perturbed by `jit_call`'s per-call output fence
+    and per-event file IO; inside this context every instrumentation site
+    sees no active run and takes its untelemetered path, so measured numbers
+    are identical to a telemetry-off process. The run itself stays open —
+    events emitted after the block land in the same log."""
+    # Resolve any pending SBR_OBS auto-start FIRST: otherwise the first
+    # instrumented call inside the block would see an empty stack with
+    # _ENV_CHECKED still unset and start a fresh (orphaned) run mid-section.
+    current_run()
+    saved = _STACK[:]
+    _STACK.clear()
+    try:
+        yield
+    finally:
+        _STACK[:] = saved
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Module-level stage span: delegates to the active run; yields a no-op
+    handle (still exposing `.sync`) when telemetry is off or while tracing."""
+    run = current_run()
+    if run is None or not _trace_clean():
+        yield _NULL_SPAN
+        return
+    with run.span(name, **attrs) as handle:
+        yield handle
+
+
+def event(kind: str, **fields) -> None:
+    """Emit one event on the active run (no-op when off or while tracing)."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.event(kind, **fields)
+
+
+def jit_call(name: str, fn, *args):
+    """Call jitted ``fn(*args)`` with compile/execute attribution when a run
+    is active; otherwise exactly ``fn(*args)``."""
+    run = current_run()
+    if run is None or not _trace_clean() or not hasattr(fn, "lower"):
+        return fn(*args)
+    return run.jit_call(name, fn, *args)
+
+
+def log_status(stage: str, status) -> None:
+    """Status-grid accounting event (utils.status codes) for a finished
+    sweep/solve. Forces a device→host fetch of the status array — only when
+    telemetry is on."""
+    run = current_run()
+    if run is None or not _trace_clean():
+        return
+    import numpy as np
+
+    from sbr_tpu.utils.status import status_counts
+
+    arr = np.asarray(status)
+    run.event("status", stage=stage, total=int(arr.size), counts=status_counts(arr))
+
+
+# ---------------------------------------------------------------------------
+# AOT helpers
+# ---------------------------------------------------------------------------
+
+
+def _abstract_sig(args) -> tuple:
+    """Hashable abstract signature of a pytree of arguments: treedef plus
+    (shape, dtype) per array leaf, type+value for hashable scalars."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append((type(leaf).__name__, leaf if isinstance(leaf, (int, float, bool, str, type(None))) else id(leaf)))
+    return (str(treedef), tuple(sig))
+
+
+def _compiled_info(compiled) -> dict:
+    """Static facts about a compiled executable: flop estimate and memory
+    footprint from XLA's cost/memory analysis (best-effort per backend)."""
+    info: dict = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if isinstance(cost, dict):
+            for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+                if src in cost:
+                    info[dst] = float(cost[src])
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for attr, key in (
+            ("argument_size_in_bytes", "arg_bytes"),
+            ("output_size_in_bytes", "out_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("generated_code_size_in_bytes", "code_bytes"),
+        ):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                info[key] = int(v)
+    except Exception:
+        pass
+    return info

@@ -8,6 +8,7 @@ social-learning simulation (`jax.sharding` + shard_map; collectives ride
 ICI).
 """
 
+from sbr_tpu.parallel.compat import pcast, shard_map
 from sbr_tpu.parallel.distributed import (
     initialize_distributed,
     run_tiled_grid_multihost,
@@ -24,7 +25,9 @@ __all__ = [
     "balanced_2d",
     "make_agent_mesh",
     "make_grid_mesh",
+    "pcast",
     "shard_axis_values",
+    "shard_map",
     "initialize_distributed",
     "run_tiled_grid_multihost",
     "tile_assignment",

@@ -1,0 +1,69 @@
+"""Sharding API compatibility across jax versions.
+
+The sharded code paths are written against the modern surface —
+``jax.shard_map`` plus ``lax.pcast`` (the sharding-in-types system's
+replicated→varying cast). Older jax (e.g. 0.4.x, the CPU CI image) has
+shard_map only under ``jax.experimental.shard_map`` and no varying-type
+system at all. These two shims bridge the gap:
+
+- ``shard_map``: delegates to ``jax.shard_map`` when present; otherwise the
+  experimental one with ``check_rep=False`` — without pcast there is no way
+  to annotate replicated inputs as varying, and the replication checker is
+  exactly the machinery pcast exists to satisfy. The per-device program is
+  identical either way (equivalence vs the unsharded path is asserted by
+  the sharded-vs-single-device test suites).
+- ``pcast``: delegates to ``lax.pcast`` when present; otherwise identity —
+  the cast has no runtime effect, it only adjusts the type system's
+  replication bookkeeping, which the old API does not track.
+
+Import from here, never from jax directly, in any sharded module.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_HAS_CAST = hasattr(lax, "pcast") or hasattr(lax, "pvary")
+
+if hasattr(jax, "shard_map") and _HAS_CAST:
+    shard_map = jax.shard_map
+elif hasattr(jax, "shard_map"):
+    # Modern shard_map but no varying cast at all: the manual-axes check
+    # cannot be satisfied, so it must be disabled (kwarg name varies).
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+            try:
+                return jax.shard_map(
+                    f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+                )
+            except TypeError:
+                continue
+        raise TypeError("no compatible jax.shard_map signature found")
+
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+elif hasattr(lax, "pvary"):
+    # The 0.6/0.7-era spelling of the replicated→varying cast.
+    def pcast(x, axes, to="varying"):
+        if to != "varying":
+            raise NotImplementedError(f"pcast shim only supports to='varying', got {to!r}")
+        return lax.pvary(x, axes)
+
+else:
+
+    def pcast(x, axes, to="varying"):
+        del axes, to
+        return x
+
+
+__all__ = ["pcast", "shard_map"]

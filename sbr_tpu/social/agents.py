@@ -66,6 +66,8 @@ from flax import struct
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sbr_tpu.parallel.compat import pcast, shard_map
+
 
 # ---------------------------------------------------------------------------
 # Graph generation (host-side, numpy; static inputs to the jitted kernel)
@@ -815,7 +817,7 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
         return gs, aws, informed, t_inf
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(), P()),
@@ -939,8 +941,8 @@ def _sharded_incremental_sim(
         init = (
             informed0,
             t_inf0,
-            lax.pcast(jnp.zeros(nb, jnp.int32), (axis,), to="varying"),
-            lax.pcast(jnp.zeros(n_gl // 8, jnp.uint8), (axis,), to="varying"),
+            pcast(jnp.zeros(nb, jnp.int32), (axis,), to="varying"),
+            pcast(jnp.zeros(n_gl // 8, jnp.uint8), (axis,), to="varying"),
         )
         (informed, t_inf, _, _), (gs, aws, recs) = lax.scan(
             step, init, jnp.arange(config.n_steps) + k0
@@ -948,7 +950,7 @@ def _sharded_incremental_sim(
         return gs, aws, recs, informed, t_inf
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(P(axis),) * 9 + (P(), P()),

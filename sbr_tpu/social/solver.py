@@ -210,6 +210,7 @@ def solve_equilibrium_social(
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     import time
 
+    from sbr_tpu import obs
     from sbr_tpu.baseline.solver import _stamp_solve_time
 
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
@@ -219,8 +220,7 @@ def solve_equilibrium_social(
     run = _build_fixed_point(
         config, float(tol), int(max_iter), float(damping), bool(verbose)
     )
-    t0 = time.perf_counter()
-    res = run(
+    args = (
         jnp.asarray(model.learning.beta, dtype),
         jnp.asarray(model.learning.x0, dtype),
         jnp.asarray(econ.u, dtype),
@@ -230,4 +230,43 @@ def solve_equilibrium_social(
         jnp.asarray(eta, dtype),
         grid,
     )
-    return _stamp_solve_time(res, t0)
+    t0 = time.perf_counter()
+    with obs.span("social.fixed_point", n_grid=config.n_grid, max_iter=int(max_iter)) as sp:
+        res = obs.jit_call("social.fixed_point", run, *args)
+        sp.sync(res.aw, res.xi)
+    res = _stamp_solve_time(res, t0)
+    _log_fixed_point(res)
+    return res
+
+
+def _log_fixed_point(res: SocialFixedPointResult) -> None:
+    """Host-boundary telemetry for a finished fixed-point solve: iteration
+    count, convergence flags, and the damping residual trace (the per-
+    iteration err/ξ ring the reference prints when verbose) — computed from
+    the RETURNED arrays only, per the obs jit-safety contract."""
+    from sbr_tpu import obs
+    from sbr_tpu.obs.metrics import metrics
+
+    if isinstance(res.iterations, jax.core.Tracer):
+        return  # traced caller (jit/vmap): no host values to log, same
+        # guard as baseline.solver._stamp_solve_time
+    n_iter = int(res.iterations)
+    metrics().inc("social.fixed_point.solves")
+    metrics().inc("social.fixed_point.iterations", n_iter)
+    metrics().observe("social.fixed_point.solve_s", float(res.solve_time))
+    if not obs.enabled():
+        return
+    err_trace, xi_trace = res.history()
+    obs.event(
+        "fixed_point",
+        stage="social.fixed_point",
+        iterations=n_iter,
+        converged=bool(res.converged),
+        aborted=bool(res.aborted),
+        error=float(res.error),
+        xi=float(res.xi),
+        bankrun=bool(res.equilibrium.bankrun),
+        history_err=[float(e) for e in err_trace],
+        history_xi=[float(x) for x in xi_trace],
+    )
+    obs.log_status("social.fixed_point", res.equilibrium.status)

@@ -81,15 +81,16 @@ def _u_sweep_fn(config: SolverConfig, mesh=None, mesh_axis=None):
         # vmapped program on its local block (independent cells; sharded
         # gather indexing against the replicated learning solution trips
         # XLA's sharding-in-types inference otherwise, as in policy_sweeps).
-        from jax import lax
         from jax.sharding import PartitionSpec as P
 
+        from sbr_tpu.parallel.compat import pcast, shard_map
+
         def body(ls, u_values, *scalars):
-            vary = lambda x: lax.pcast(x, (mesh_axis,), to="varying")
+            vary = lambda x: pcast(x, (mesh_axis,), to="varying")
             ls = jax.tree_util.tree_map(vary, ls)
             return fn(ls, u_values, *(vary(s) for s in scalars))
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(mesh_axis)) + (P(),) * 5,
@@ -115,6 +116,9 @@ def u_sweep(
     With ``mesh``, the u axis is sharded over ``mesh_axis`` (cells are
     independent; the shared learning solution replicates). The mesh axis
     size must divide len(u_values)."""
+    from sbr_tpu import obs
+    from sbr_tpu.obs.metrics import metrics
+
     if tspan_end is None:
         tspan_end = ls.grid[-1]
     dtype = ls.cdf.dtype
@@ -124,9 +128,8 @@ def u_sweep(
 
         (u_values,) = shard_axis_values(mesh, (mesh_axis,), u_values)
 
-    xi, tau_in, aw_max, status = _u_sweep_fn(
-        config, mesh, mesh_axis if mesh is not None else None
-    )(
+    fn = _u_sweep_fn(config, mesh, mesh_axis if mesh is not None else None)
+    args = (
         ls,
         u_values,
         jnp.asarray(econ.p, dtype),
@@ -135,6 +138,12 @@ def u_sweep(
         jnp.asarray(econ.eta, dtype),
         jnp.asarray(tspan_end, dtype),
     )
+    n_u = int(u_values.shape[0])
+    with obs.span("sweeps.u_sweep", n_u=n_u, sharded=mesh is not None) as sp:
+        xi, tau_in, aw_max, status = obs.jit_call("sweeps.u_sweep", fn, *args)
+        sp.sync(status)
+    metrics().inc("sweeps.u_sweep.cells", n_u)
+    obs.log_status("sweeps.u_sweep", status)
     return USweepResult(
         u_values=u_values,
         max_withdrawals=aw_max,
@@ -191,11 +200,23 @@ def beta_u_grid(
 
         beta_values, u_values = shard_axis_values(mesh, mesh_axes, beta_values, u_values)
 
+    from sbr_tpu import obs
+    from sbr_tpu.obs.metrics import metrics
+
     grid_fn = _grid_fn(config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None)
     scalars = tuple(
         jnp.asarray(v, dtype) for v in (econ.p, econ.kappa, econ.lam, econ.eta, tspan[0], tspan[1], x0)
     )
-    xi, tau_in, aw_max, status = grid_fn(beta_values, u_values, *scalars)
+    n_b, n_u = int(beta_values.shape[0]), int(u_values.shape[0])
+    with obs.span(
+        "sweeps.beta_u_grid", n_beta=n_b, n_u=n_u, dtype=dtype.name, sharded=mesh is not None
+    ) as sp:
+        xi, tau_in, aw_max, status = obs.jit_call(
+            "sweeps.beta_u_grid", grid_fn, beta_values, u_values, *scalars
+        )
+        sp.sync(status)
+    metrics().inc("sweeps.beta_u_grid.cells", n_b * n_u)
+    obs.log_status("sweeps.beta_u_grid", status)
     return GridSweepResult(
         beta_values=beta_values, u_values=u_values, max_aw=aw_max, xi=xi, status=status
     )

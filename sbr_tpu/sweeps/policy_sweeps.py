@@ -64,8 +64,9 @@ def _policy_fn(config: SolverConfig, dtype_name: str, mesh=None, mesh_axes=None)
         # independent, so there are no collectives and no sharded-indexing
         # propagation inside the traced cell (gather-heavy interp under 3
         # batched axes trips XLA's sharding-in-types inference otherwise).
-        from jax import lax
         from jax.sharding import PartitionSpec as P
+
+        from sbr_tpu.parallel.compat import pcast, shard_map
 
         b_ax, u_ax = mesh_axes
 
@@ -73,12 +74,12 @@ def _policy_fn(config: SolverConfig, dtype_name: str, mesh=None, mesh_axes=None)
             # replicated inputs are device-invariant; mark every input
             # varying over both mesh axes (each only over the axes it does
             # not already vary on) so internal scan carries are consistent
-            b = lax.pcast(b, (u_ax,), to="varying")
-            u = lax.pcast(u, (b_ax,), to="varying")
-            vary = lambda x: lax.pcast(x, (b_ax, u_ax), to="varying")
+            b = pcast(b, (u_ax,), to="varying")
+            u = pcast(u, (b_ax,), to="varying")
+            vary = lambda x: pcast(x, (b_ax, u_ax), to="varying")
             return fn(b, u, vary(r), *(vary(s) for s in scalars))
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(b_ax), P(u_ax), P()) + (P(),) * 8,
@@ -151,10 +152,23 @@ def policy_sweep_interest(
             base.learning.x0,
         )
     )
+    from sbr_tpu import obs
+    from sbr_tpu.obs.metrics import metrics
+
     fn = _policy_fn(
         config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None
     )
-    xi, aw_max, status = fn(beta_values, u_values, r_values, *scalars)
+    n_b, n_u, n_r = (int(v.shape[0]) for v in (beta_values, u_values, r_values))
+    with obs.span(
+        "sweeps.policy_interest",
+        n_beta=n_b, n_u=n_u, n_r=n_r, dtype=dtype.name, sharded=mesh is not None,
+    ) as sp:
+        xi, aw_max, status = obs.jit_call(
+            "sweeps.policy_interest", fn, beta_values, u_values, r_values, *scalars
+        )
+        sp.sync(status)
+    metrics().inc("sweeps.policy_interest.cells", n_b * n_u * n_r)
+    obs.log_status("sweeps.policy_interest", status)
     return PolicySweepResult(
         beta_values=beta_values,
         u_values=u_values,

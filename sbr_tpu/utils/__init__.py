@@ -6,8 +6,9 @@ The reference's equivalents are hand-rolled `time()` deltas stored as
 accounting (`scripts/1_baseline.jl:188-191,261-271`), and no checkpointing
 at all (every run recomputes everything — SURVEY §5.4). Here:
 
-- ``timing``     — wall-clock stage timers with honest device fences and
-                   `jax.profiler` trace capture.
+- ``timing``     — re-export shim for `sbr_tpu.obs.timing` (the wall-clock
+                   stage timers and honest device fences moved into the
+                   run-telemetry subsystem `sbr_tpu.obs`).
 - ``status``     — structured per-cell status accounting (the jit-safe
                    replacement for the reference's early-termination prints).
 - ``checkpoint`` — tiled sweep execution with on-disk resume and per-tile
@@ -16,6 +17,6 @@ at all (every run recomputes everything — SURVEY §5.4). Here:
 
 from sbr_tpu.utils.checkpoint import run_tiled_grid
 from sbr_tpu.utils.status import status_counts, status_summary
-from sbr_tpu.utils.timing import StageTimer, trace
+from sbr_tpu.obs.timing import StageTimer, trace
 
 __all__ = ["StageTimer", "run_tiled_grid", "status_counts", "status_summary", "trace"]

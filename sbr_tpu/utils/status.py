@@ -4,7 +4,8 @@ The reference reports sweep outcomes through prints: percent-progress
 counters and early-termination totals (`scripts/1_baseline.jl:188-191,
 261-271`). Under jit there are no prints; every sweep instead returns an
 int32 status array (`models.results.Status`), and these helpers turn it
-into the same accounting after the fact.
+into the same accounting after the fact. The obs subsystem logs the same
+accounting as structured `status` events (`obs.log_status`).
 """
 
 from __future__ import annotations
@@ -15,17 +16,32 @@ import numpy as np
 
 from sbr_tpu.models.results import Status
 
+# Codes outside the Status enum (e.g. the tiled checkpoint driver's -1
+# "never computed" fill) are accounted under this key so counts always sum
+# to the grid size.
+UNKNOWN_KEY = "UNKNOWN"
+
 
 def status_counts(status) -> Dict[str, int]:
-    """Histogram of `Status` codes in a sweep's status array."""
+    """Histogram of `Status` codes in a sweep's status array.
+
+    Key order is deterministic: `Status` enum declaration order, then
+    ``UNKNOWN`` (out-of-enum codes) last — stable across runs and Python
+    processes, so event logs and manifests diff cleanly.
+    """
     status = np.asarray(status)
-    return {s.name: int((status == int(s)).sum()) for s in Status}
+    counts = {s.name: int((status == int(s)).sum()) for s in Status}
+    unknown = int(status.size) - sum(counts.values())
+    if unknown:
+        counts[UNKNOWN_KEY] = unknown
+    return counts
 
 
 def status_summary(status) -> str:
     """One-line summary matching the reference's accounting: run cells vs
     the no-run region it skips via early termination
-    (`1_baseline.jl:269-271`)."""
+    (`1_baseline.jl:269-271`). Deterministic part order (see
+    `status_counts`); an all-no-run grid reads "0/N run, ..."."""
     counts = status_counts(status)
     total = int(np.asarray(status).size)
     run = counts.get("RUN", 0)

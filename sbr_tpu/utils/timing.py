@@ -1,86 +1,8 @@
-"""Stage timing and profiler hooks (SURVEY §5.1).
+"""Compatibility shim: the timing primitives moved to ``sbr_tpu.obs.timing``
+as part of the run-telemetry subsystem (PR 1). Import from ``sbr_tpu.obs``
+going forward; this module re-exports the full original surface so existing
+call sites (`bench.py`, benchmarks/, tests) keep working unchanged."""
 
-The reference stores `solve_time = time() - start` in every result struct
-(`src/baseline/learning.jl:110,121`, `src/baseline/solver.jl:414,458`) and
-prints per-phase timings inside the fixed-point loop
-(`social_learning_solver.jl:129-147`). The TPU equivalents:
+from sbr_tpu.obs.timing import StageTimer, fence, trace
 
-- `StageTimer` — named wall-clock stages with an honest device fence: a
-  device→host fetch of a scalar, because `block_until_ready` can return
-  before remote execution completes on tunneled backends (measured on the
-  axon TPU tunnel; see bench.py).
-- `trace` — context manager around `jax.profiler.trace` for XLA-level
-  compile/execute breakdowns viewable in TensorBoard/XProf.
-"""
-
-from __future__ import annotations
-
-import contextlib
-import time
-from typing import Dict
-
-import jax
-import jax.numpy as jnp
-
-
-def fence(*arrays) -> None:
-    """Force completion of the computations producing ``arrays``.
-
-    Fetches a scalar reduction to host — the only fence that is reliable
-    across local and tunneled backends.
-    """
-    acc = jnp.zeros(())
-    for a in arrays:
-        x = jnp.asarray(a)
-        # sum works for float/int/bool; NaN statuses must not poison the
-        # fence, hence nansum on floats.
-        acc = acc + (jnp.nansum(x) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.sum(x))
-    float(acc)
-
-
-class StageTimer:
-    """Accumulates named wall-clock stages.
-
-    Usage::
-
-        timer = StageTimer()
-        with timer.stage("learning"):
-            ls = solve_learning(params)
-            timer.sync(ls.cdf)
-        print(timer.report())
-    """
-
-    def __init__(self) -> None:
-        self.times: Dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def stage(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield self
-        finally:
-            self.times[name] = self.times.get(name, 0.0) + time.perf_counter() - t0
-
-    def sync(self, *arrays) -> None:
-        fence(*arrays)
-
-    def total(self) -> float:
-        return sum(self.times.values())
-
-    def report(self) -> str:
-        width = max((len(k) for k in self.times), default=0)
-        lines = [f"  {k:<{width}} {v * 1e3:10.1f} ms" for k, v in self.times.items()]
-        lines.append(f"  {'total':<{width}} {self.total() * 1e3:10.1f} ms")
-        return "\n".join(lines)
-
-
-@contextlib.contextmanager
-def trace(log_dir: str, create_perfetto_link: bool = False):
-    """Capture a `jax.profiler` trace for the enclosed block.
-
-    The trace records compile vs execute time per XLA module — the
-    compile-dominated profile of this framework (execution is ms, f64 sweep
-    compiles are minutes) is directly visible there.
-    """
-    with jax.profiler.trace(log_dir, create_perfetto_link=create_perfetto_link):
-        yield
+__all__ = ["StageTimer", "fence", "trace"]

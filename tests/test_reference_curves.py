@@ -84,6 +84,10 @@ class TestCommittedArtifact:
         assert not failures, failures
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference/output/figures/baseline/learning_dynamics.pdf").exists(),
+    reason="reference replication tree not present in this image (environment-bound)",
+)
 class TestParserLive:
     """The extraction pipeline against the reference tree, no solver work."""
 

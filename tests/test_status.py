@@ -1,0 +1,66 @@
+"""Unit tests for utils.status accounting (obs satellite, PR 1).
+
+`status_counts`/`status_summary` feed the obs subsystem's structured
+`status` events and run manifests, so their key ORDER must be deterministic
+(enum declaration order, UNKNOWN last) and their counts must always sum to
+the grid size — including the all-no-run edge case and out-of-enum codes
+(the tiled checkpoint driver's -1 "never computed" fill).
+"""
+
+import numpy as np
+
+from sbr_tpu.models.results import Status
+from sbr_tpu.utils.status import UNKNOWN_KEY, status_counts, status_summary
+
+
+def test_status_counts_mixed_grid():
+    grid = np.array(
+        [
+            [Status.RUN, Status.RUN, Status.NO_CROSSING],
+            [Status.NO_ROOT, Status.FALSE_EQ, Status.RUN],
+        ],
+        dtype=np.int32,
+    )
+    counts = status_counts(grid)
+    assert counts == {"RUN": 3, "NO_CROSSING": 1, "NO_ROOT": 1, "FALSE_EQ": 1}
+    assert sum(counts.values()) == grid.size
+
+
+def test_status_counts_key_order_deterministic():
+    grid = np.array([Status.FALSE_EQ, Status.RUN, -1, Status.NO_ROOT], dtype=np.int32)
+    counts = status_counts(grid)
+    # Enum declaration order first, UNKNOWN (out-of-enum codes) last —
+    # independent of the order codes appear in the data.
+    assert list(counts) == [s.name for s in Status] + [UNKNOWN_KEY]
+    assert counts[UNKNOWN_KEY] == 1
+    assert sum(counts.values()) == grid.size
+
+
+def test_status_counts_all_no_run_grid():
+    # Edge case: a grid where NO cell found a bank-run equilibrium.
+    grid = np.full((4, 5), int(Status.NO_CROSSING), dtype=np.int32)
+    counts = status_counts(grid)
+    assert counts["RUN"] == 0
+    assert counts["NO_CROSSING"] == 20
+    assert sum(counts.values()) == 20
+    assert UNKNOWN_KEY not in counts
+
+    summary = status_summary(grid)
+    assert summary.startswith("0/20 run")
+    assert "20 no_crossing" in summary
+
+
+def test_status_summary_mixed():
+    grid = np.array([Status.RUN, Status.RUN, Status.NO_ROOT], dtype=np.int32)
+    s = status_summary(grid)
+    assert s.startswith("2/3 run")
+    assert "1 no_root" in s
+    # zero-count categories are omitted
+    assert "false_eq" not in s
+
+
+def test_status_counts_accepts_jax_arrays():
+    import jax.numpy as jnp
+
+    grid = jnp.zeros((3,), dtype=jnp.int32)
+    assert status_counts(grid) == {"RUN": 3, "NO_CROSSING": 0, "NO_ROOT": 0, "FALSE_EQ": 0}
