@@ -48,7 +48,10 @@ Env overrides: SBR_BENCH_PLATFORM=cpu|tpu skips the probe;
 SBR_BENCH_PROBE_ATTEMPTS / SBR_BENCH_PROBE_TIMEOUT_S /
 SBR_BENCH_MEASURE_TIMEOUT_S / SBR_BENCH_BUDGET_S tune budgets;
 SBR_BENCH_SIZES=tiny shrinks every workload to smoke-test scale (used by
-tests/test_bench_harness.py).
+tests/test_bench_harness.py); SBR_BENCH_PROBE_CACHE_TTL_S tunes the probe
+outcome cache (`SBR_OBS_DIR/.probe_cache.json`, default 900 s, 0 disables)
+that lets repeated runs against a hung backend skip the timeout ladder;
+SBR_OBS_KEEP caps retained obs run dirs (bench default 16).
 
 Run telemetry (PR 1): the measure child writes an `sbr_tpu.obs` run
 directory (events.jsonl + manifest.json, dir from SBR_OBS_DIR, default
@@ -154,6 +157,64 @@ def _probe_accelerator(timeout_s: float) -> tuple:
     return "", f"rc={rc}", dur
 
 
+def _obs_event(kind: str, **fields) -> None:
+    """Emit an obs event from the PARENT process. Guarded on SBR_OBS so the
+    default parent path never imports sbr_tpu (and with it the jax module) —
+    the parent's contract is to stay off the accelerator stack entirely.
+    RunContext construction is filesystem-only, so emission is safe when
+    telemetry IS configured."""
+    if os.environ.get("SBR_OBS", "").strip() in ("", "0"):
+        return
+    try:
+        from sbr_tpu import obs
+
+        obs.event(kind, **fields)
+    except Exception as err:
+        _log(f"obs event failed (non-fatal): {err!r}")
+
+
+def _probe_cache_path() -> Path:
+    return Path(os.environ.get("SBR_OBS_DIR", "obs_runs")) / ".probe_cache.json"
+
+
+def _probe_cache_ttl_s() -> float:
+    """Probe-outcome cache TTL. The point (ISSUE 2 satellite): a machine
+    with a HUNG backend pays the full 3×300 s probe ladder on every harness
+    run; caching the resolved platform — including the cpu fallback after a
+    failed ladder — makes repeated runs within the TTL instant. 0 disables."""
+    return float(os.environ.get("SBR_BENCH_PROBE_CACHE_TTL_S", "900"))
+
+
+def _read_probe_cache() -> dict | None:
+    ttl = _probe_cache_ttl_s()
+    if ttl <= 0:
+        return None
+    try:
+        entry = json.loads(_probe_cache_path().read_text())
+        age = time.time() - float(entry["ts"])
+        if 0 <= age <= ttl and entry.get("platform"):
+            entry["age_s"] = round(age, 1)
+            return entry
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        pass
+    return None
+
+
+def _write_probe_cache(platform: str, history: list) -> None:
+    if _probe_cache_ttl_s() <= 0:
+        return
+    try:
+        path = _probe_cache_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(
+            json.dumps({"ts": time.time(), "platform": platform, "history": history})
+        )
+        os.replace(tmp, path)
+    except OSError as err:
+        _log(f"probe cache write failed (non-fatal): {err!r}")
+
+
 class _Budget:
     """Wall-clock envelope for one harness run (ADVICE r3 #3: the former
     worst case of 3x300s probes + backoffs + 2x2700s measures was ~107 min,
@@ -180,7 +241,29 @@ class _Budget:
 
 
 def _probe_loop(budget: "_Budget" = None) -> tuple:
-    """Probe with retry/backoff; returns (platform, history list)."""
+    """Probe with retry/backoff; returns (platform, history list).
+
+    Outcomes are cached (`SBR_OBS_DIR/.probe_cache.json`, TTL
+    SBR_BENCH_PROBE_CACHE_TTL_S, default 900 s) so back-to-back harness
+    runs against a hung tunnel skip the timeout ladder, and every attempt
+    is ALSO recorded as an obs ``probe`` event when telemetry is on
+    (SBR_OBS=1) — the run log carries the probe story, not just the JSON
+    line's `extra.probe_history`."""
+    cached = _read_probe_cache()
+    if cached is not None:
+        entry = {
+            "cached": True,
+            "platform": cached["platform"],
+            "age_s": cached["age_s"],
+            "ttl_s": _probe_cache_ttl_s(),
+        }
+        _obs_event("probe", **entry)
+        _log(
+            f"probe cache hit ({cached['age_s']:.0f}s old): "
+            f"platform={cached['platform']} — skipping probe ladder"
+        )
+        return cached["platform"], [entry]
+
     attempts = int(os.environ.get("SBR_BENCH_PROBE_ATTEMPTS", "3"))
     timeout_s = float(os.environ.get("SBR_BENCH_PROBE_TIMEOUT_S", "300"))
     history = []
@@ -200,6 +283,7 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
                 "platform": platform or None,
             }
         )
+        _obs_event("probe", **history[-1])
         if platform:
             break
         backoff = 10.0 * (2 ** (attempt - 1))
@@ -215,6 +299,7 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
     if not platform:
         platform = "cpu"
         _log("accelerator unreachable after all probes — falling back to CPU")
+    _write_probe_cache(platform, history)
     return platform, history
 
 
@@ -647,7 +732,15 @@ def measure(platform: str) -> None:
     # the metrics are identical to a telemetry-off process.
     from sbr_tpu import obs
 
-    obs_run = obs.start_run(label="bench")
+    # Retention (ISSUE 2 satellite): every measure child lands a run dir,
+    # so repeated benches accumulate them; keep the most recent N
+    # (SBR_OBS_KEEP overrides; empty means unset, matching obs.runlog)
+    # and prune the rest at finalize.
+    keep_env = os.environ.get("SBR_OBS_KEEP", "").strip()
+    obs_run = obs.start_run(
+        label="bench",
+        auto_prune_keep=int(keep_env) if keep_env else 16,
+    )
     with obs.span("bench.grid"):
         grid = bench_grid(platform)
     obs.event("bench_grid", **{k: round(v, 6) if isinstance(v, float) else v for k, v in grid.items()})

@@ -31,6 +31,11 @@ Subpackage map (reference component in parens):
                  graph simulation (new capability).
 - ``sweeps``   — vmapped / mesh-sharded comparative statics
                  (``scripts/1_baseline.jl`` sweeps).
+- ``diag``     — in-jit numerical-health diagnostics: the `Health` pytree
+                 (residuals, bracket widths, NaN/fallback flags) threaded
+                 through every solver stack and sweep (new capability).
+- ``obs``      — run telemetry: event logs, stage spans, jit attribution,
+                 metrics, the report/health/gc CLI (new capability).
 - ``parallel`` — mesh construction, sharding specs, collective helpers.
 - ``figures``  — matplotlib parity layer for the 13 reference figures
                  (``src/baseline/plotting.jl``, script-inline figures).

@@ -213,19 +213,37 @@ def _make_hazard_at(p, lam, ls: LearningSolution, tau_grid, integ, int_eta, conf
     return hazard_at
 
 
-def optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=None, refine_iters: int = 60):
+def optimal_buffer(
+    u, tau_grid, hr, tspan_end, hazard_at=None, refine_iters: int = 60, with_health: bool = False
+):
     """Unconstrained buffer times (τ̄_IN, τ̄_OUT) where h crosses u
     (`solver.jl:211-264`), with the reference's boundary fallbacks.
 
     With ``hazard_at`` (continuous exact hazard), genuine crossings are
     refined by bisection within ±one grid interval of the coarse estimate;
-    fallback lanes keep their grid values.
+    fallback lanes keep their grid values. With ``with_health`` a merged
+    `diag.Health` of the two crossing detections (fallback rungs + NaN
+    poison in the hazard/level) is appended; the refinement bisections stay
+    health-free — in fallback lanes their brackets are legitimately
+    degenerate and the coarse crossing flags already tell the story.
     """
+    from sbr_tpu.diag.health import as_out_crossing
+
     default = jnp.asarray(tspan_end, dtype=hr.dtype)
-    t_in, has_up = first_upcrossing(tau_grid, hr, u, default, return_flag=True)
-    t_out, has_dn = last_downcrossing(tau_grid, hr, u, default, return_flag=True)
+    if with_health:
+        t_in, has_up, h_in = first_upcrossing(
+            tau_grid, hr, u, default, return_flag=True, with_health=True
+        )
+        t_out, has_dn, h_out = last_downcrossing(
+            tau_grid, hr, u, default, return_flag=True, with_health=True
+        )
+        cross_health = h_in.merge(as_out_crossing(h_out))
+    else:
+        t_in, has_up = first_upcrossing(tau_grid, hr, u, default, return_flag=True)
+        t_out, has_dn = last_downcrossing(tau_grid, hr, u, default, return_flag=True)
+        cross_health = None
     if hazard_at is None:
-        return t_in, t_out
+        return (t_in, t_out, cross_health) if with_health else (t_in, t_out)
 
     eta = tau_grid[-1]
     n = tau_grid.shape[0]
@@ -243,7 +261,9 @@ def optimal_buffer(u, tau_grid, hr, tspan_end, hazard_at=None, refine_iters: int
     lo_o, hi_o = bracket(t_out)
     # down-crossing: u - h is locally increasing
     t_out_ref = bisect(lambda t: u - hazard_at(t), lo_o, hi_o, num_iters=refine_iters)
-    return jnp.where(has_up, t_in_ref, t_in), jnp.where(has_dn, t_out_ref, t_out)
+    t_in = jnp.where(has_up, t_in_ref, t_in)
+    t_out = jnp.where(has_dn, t_out_ref, t_out)
+    return (t_in, t_out, cross_health) if with_health else (t_in, t_out)
 
 
 def compute_xi(
@@ -255,6 +275,7 @@ def compute_xi(
     lo=None,
     hi=None,
     x0=None,
+    with_health: bool = False,
 ):
     """Bisection for AW(ξ)=κ with first-crossing validation (`solver.jl:308-376`).
 
@@ -266,6 +287,9 @@ def compute_xi(
     - is_increasing: finite-difference slope of the withdrawal path at ξ* with
       ε = the learning-grid spacing (`solver.jl:336-343`); False is the
       reference's "false equilibrium" (root on the decreasing branch).
+    With ``with_health`` the bisection's `diag.Health` (final residual —
+    identical to abs_error, XLA CSEs the shared evaluation — bracket width,
+    bracket-validity and NaN flags) is appended.
     """
     dtype = ls.cdf.dtype
     kappa = jnp.asarray(kappa, dtype=dtype)
@@ -277,7 +301,15 @@ def compute_xi(
         t_in = jnp.minimum(tau_bar_in_unc, xi)
         return ls.cdf_at(t_out) - ls.cdf_at(t_in)
 
-    xi = bisect(lambda x: aw_of(x) - kappa, lo, hi, num_iters=config.bisect_iters, x0=x0)
+    out = bisect(
+        lambda x: aw_of(x) - kappa,
+        lo,
+        hi,
+        num_iters=config.bisect_iters,
+        x0=x0,
+        with_health=with_health,
+    )
+    xi, xi_health = out if with_health else (out, None)
 
     aw = aw_of(xi)
     err = jnp.abs(aw - kappa)
@@ -301,6 +333,8 @@ def compute_xi(
         eps = ls.dt
         aw_eps = ls.cdf_at(t_out + eps) - ls.cdf_at(t_in + eps)
         is_increasing = aw_eps >= aw
+    if with_health:
+        return xi, err, root_ok, is_increasing, xi_health
     return xi, err, root_ok, is_increasing
 
 
@@ -395,18 +429,19 @@ def solve_equilibrium_core(
         else None
     )
     with obs.span("baseline.buffers") as sp:
-        tau_in_unc, tau_out_unc = optimal_buffer(
-            u, tau_grid, hr, tspan_end, hazard_at=hazard_at
+        tau_in_unc, tau_out_unc, cross_health = optimal_buffer(
+            u, tau_grid, hr, tspan_end, hazard_at=hazard_at, with_health=True
         )
         sp.sync(tau_in_unc, tau_out_unc)
 
     no_crossing = tau_in_unc == tau_out_unc
 
     with obs.span("baseline.xi") as sp:
-        xi_c, err, root_ok, increasing = compute_xi(
-            tau_in_unc, tau_out_unc, ls, kappa, config
+        xi_c, err, root_ok, increasing, xi_health = compute_xi(
+            tau_in_unc, tau_out_unc, ls, kappa, config, with_health=True
         )
         sp.sync(xi_c)
+    health = cross_health.merge(xi_health)
 
     run = jnp.logical_and(jnp.logical_not(no_crossing), jnp.logical_and(root_ok, increasing))
     status = jnp.where(
@@ -452,6 +487,7 @@ def solve_equilibrium_core(
         aw_out=aw_out,
         aw_in=aw_in,
         aw_max=aw_max,
+        health=health,
     )
 
 
@@ -500,4 +536,6 @@ def solve_equilibrium_baseline(
         res = solve_equilibrium_core(
             ls, econ.u, econ.p, econ.kappa, econ.lam, econ.eta, tspan_end, config
         )
-    return _stamp_solve_time(res, t0)
+    res = _stamp_solve_time(res, t0)
+    obs.log_health("baseline.equilibrium", res.health, res.status)
+    return res

@@ -13,7 +13,20 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from sbr_tpu.diag.health import Health
 from sbr_tpu.obs.metrics import metrics
+
+
+def _quad_health(values, csum, n_panels) -> Health:
+    """Health of one cumulative quadrature: NaN among the integrand samples
+    (poison propagates silently through cumsum otherwise) and non-finite
+    values in the cumulative result; iterations counts panels."""
+    return Health.of_nan_probe(
+        nan_in=jnp.any(jnp.isnan(values)),
+        nonfinite_out=jnp.any(~jnp.isfinite(csum)),
+        iterations=n_panels,
+        dtype=csum.dtype,
+    )
 
 
 def trapz(y, x=None, dx=1.0):
@@ -25,12 +38,14 @@ def trapz(y, x=None, dx=1.0):
     return jnp.sum(0.5 * (y[..., 1:] + y[..., :-1]) * d, axis=-1)
 
 
-def cumtrapz(y, x=None, dx=1.0):
+def cumtrapz(y, x=None, dx=1.0, with_health: bool = False):
     """Cumulative trapezoid along the last axis, zero at the first knot.
 
     Matches the reference recurrence
     ``int[i] = int[i-1] + 0.5*(f(t[i-1])+f(t[i]))*(t[i]-t[i-1])``
-    (`src/baseline/solver.jl:172-175`) as one parallel cumsum.
+    (`src/baseline/solver.jl:172-175`) as one parallel cumsum. With
+    ``with_health`` returns ``(out, Health)`` flagging NaN samples and a
+    non-finite cumulative result.
     """
     if x is not None:
         d = jnp.diff(x)
@@ -39,10 +54,13 @@ def cumtrapz(y, x=None, dx=1.0):
     inc = 0.5 * (y[..., 1:] + y[..., :-1]) * d
     csum = jnp.cumsum(inc, axis=-1)
     zero = jnp.zeros(csum.shape[:-1] + (1,), dtype=csum.dtype)
-    return jnp.concatenate([zero, csum], axis=-1)
+    out = jnp.concatenate([zero, csum], axis=-1)
+    if with_health:
+        return out, _quad_health(y, out, int(y.shape[-1]) - 1)
+    return out
 
 
-def cumulative_gauss_legendre(f, grid, order: int = 8):
+def cumulative_gauss_legendre(f, grid, order: int = 8, with_health: bool = False):
     """Cumulative integral of callable ``f`` at the knots of ``grid``.
 
     Composite Gauss-Legendre with ``order`` nodes per interval: error
@@ -50,7 +68,9 @@ def cumulative_gauss_legendre(f, grid, order: int = 8):
     in this model (e^{λt} g(t) with closed-form g). ``f`` must accept an array
     of evaluation points and broadcast.
 
-    Returns an array shaped like ``grid`` with value 0 at ``grid[0]``.
+    Returns an array shaped like ``grid`` with value 0 at ``grid[0]``; with
+    ``with_health`` also a `diag.Health` flagging NaN integrand samples and
+    a non-finite cumulative result.
     """
     # Trace-time counter (see core.rootfind.bisect): quadrature instances ×
     # order, a proxy for the transcendental-evaluation volume per program.
@@ -67,4 +87,7 @@ def cumulative_gauss_legendre(f, grid, order: int = 8):
     seg = half * jnp.tensordot(jnp.asarray(weights, dtype=grid.dtype), vals, axes=(0, 0))
     csum = jnp.cumsum(seg, axis=-1)
     zero = jnp.zeros(csum.shape[:-1] + (1,), dtype=csum.dtype)
-    return jnp.concatenate([zero, csum], axis=-1)
+    out = jnp.concatenate([zero, csum], axis=-1)
+    if with_health:
+        return out, _quad_health(vals, out, int(grid.shape[0]) - 1)
+    return out

@@ -14,14 +14,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from sbr_tpu.diag.health import Health
 
-def rk4(f, y0, ts, args=None, substeps: int = 1):
+
+def rk4(f, y0, ts, args=None, substeps: int = 1, with_health: bool = False):
     """Integrate dy/dt = f(t, y, args) over save grid ``ts`` with classic RK4.
 
     - ``y0``: initial state, any array shape (scalar ODEs pass a 0-d array).
     - ``ts``: shape (n,) save points; integration uses ``substeps`` uniform
       RK4 steps inside each interval.
-    - Returns ys with shape (n, *y0.shape); ys[0] == y0.
+    - Returns ys with shape (n, *y0.shape); ys[0] == y0. With ``with_health``
+      returns ``(ys, Health)`` flagging NaN in the initial state / grid and
+      non-finite values anywhere in the trajectory (a blown-up fixed-step
+      integration has no tolerance exit to catch it otherwise); iterations
+      records the total micro-steps taken.
     """
     y0 = jnp.asarray(y0)
     ts = jnp.asarray(ts)
@@ -43,4 +49,13 @@ def rk4(f, y0, ts, args=None, substeps: int = 1):
 
     tpairs = jnp.stack([ts[:-1], ts[1:]], axis=1)
     _, ys = lax.scan(interval, y0, tpairs)
-    return jnp.concatenate([y0[None], ys], axis=0)
+    out = jnp.concatenate([y0[None], ys], axis=0)
+    if not with_health:
+        return out
+    health = Health.of_nan_probe(
+        nan_in=jnp.any(jnp.isnan(y0)) | jnp.any(jnp.isnan(ts)),
+        nonfinite_out=jnp.any(~jnp.isfinite(out)),
+        iterations=(int(ts.shape[0]) - 1) * substeps,
+        dtype=out.dtype,
+    )
+    return out, health

@@ -16,17 +16,50 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from sbr_tpu.diag.health import (
+    FALLBACK_IN_DEFAULT,
+    FALLBACK_IN_KNOT,
+    NAN_INPUT,
+    NAN_OUTPUT,
+    NO_BRACKET,
+    NONFINITE_RESIDUAL,
+    Health,
+)
 from sbr_tpu.obs.metrics import metrics
 
 
-def first_upcrossing(x, y, level, default, return_flag: bool = False):
+def _crossing_health(y, level, has_cross, has_above) -> Health:
+    """Health of one crossing detection: which rung of the fallback ladder
+    fired (generic IN-positioned bits; `diag.as_out_crossing` re-keys the
+    down-crossing) plus NaN poison in the curve or the level — a fully
+    NaN curve silently takes the ``default`` rung otherwise, the exact
+    failure the flags exist to surface."""
+    dtype = jnp.asarray(y).dtype
+    flags = jnp.where(
+        has_cross,
+        jnp.int32(0),
+        jnp.where(has_above, jnp.int32(FALLBACK_IN_KNOT), jnp.int32(FALLBACK_IN_DEFAULT)),
+    )
+    nan_in = jnp.any(jnp.isnan(y), axis=-1) | jnp.isnan(jnp.asarray(level, dtype))
+    flags = flags | jnp.where(nan_in, jnp.int32(NAN_INPUT), jnp.int32(0))
+    nan = jnp.full(flags.shape, jnp.nan, dtype)
+    return Health(
+        residual=nan,
+        bracket_width=nan,
+        iterations=jnp.zeros(flags.shape, jnp.int32),
+        flags=flags,
+    )
+
+
+def first_upcrossing(x, y, level, default, return_flag: bool = False, with_health: bool = False):
     """First t where ``y`` crosses ``level`` from below, linearly interpolated.
 
     Fallback ladder mirrors `src/baseline/solver.jl:221-261`: if no up-crossing
     exists but some samples are above the level, return the first above-level
     knot; if nothing is above, return ``default``. With ``return_flag`` also
     returns whether a genuine interpolated crossing was found (callers use it
-    to gate sub-grid refinement).
+    to gate sub-grid refinement); with ``with_health`` a `diag.Health`
+    recording the fallback rung and NaN poison is appended to the return.
     """
     above = y > level
     up = jnp.logical_and(~above[..., :-1], above[..., 1:])
@@ -36,16 +69,19 @@ def first_upcrossing(x, y, level, default, return_flag: bool = False):
     j = jnp.argmax(above, axis=-1)
     has_above = jnp.any(above, axis=-1)
     t = jnp.where(has_up, t_cross, jnp.where(has_above, x[j], default))
-    if return_flag:
-        return t, has_up
-    return t
+    out = (t, has_up) if return_flag else (t,)
+    if with_health:
+        out = out + (_crossing_health(y, level, has_up, has_above),)
+    return out if len(out) > 1 else out[0]
 
 
-def last_downcrossing(x, y, level, default, return_flag: bool = False):
+def last_downcrossing(x, y, level, default, return_flag: bool = False, with_health: bool = False):
     """Last t where ``y`` crosses ``level`` from above, linearly interpolated.
 
     Fallbacks: last above-level knot if no down-crossing, ``default`` if
-    nothing is above (`src/baseline/solver.jl:242-261`).
+    nothing is above (`src/baseline/solver.jl:242-261`). Health (opt-in, see
+    `first_upcrossing`) reports in the generic IN-positioned fallback bits;
+    callers merging both crossings re-key it with `diag.as_out_crossing`.
     """
     above = y > level
     dn = jnp.logical_and(above[..., :-1], ~above[..., 1:])
@@ -57,9 +93,10 @@ def last_downcrossing(x, y, level, default, return_flag: bool = False):
     j = n - 1 - jnp.argmax(above[..., ::-1], axis=-1)
     has_above = jnp.any(above, axis=-1)
     t = jnp.where(has_dn, t_cross, jnp.where(has_above, x[j], default))
-    if return_flag:
-        return t, has_dn
-    return t
+    out = (t, has_dn) if return_flag else (t,)
+    if with_health:
+        out = out + (_crossing_health(y, level, has_dn, has_above),)
+    return out if len(out) > 1 else out[0]
 
 
 def _interp_cross(x, y, level, i):
@@ -87,7 +124,7 @@ def threshold_crossings(x, y, level, default):
     )
 
 
-def bisect(f, lo, hi, num_iters: int = 90, x0=None):
+def bisect(f, lo, hi, num_iters: int = 90, x0=None, with_health: bool = False):
     """Fixed-iteration bisection for a root of ``f`` in [lo, hi].
 
     Reproduces the reference update rule exactly (`src/baseline/solver.jl:
@@ -98,7 +135,12 @@ def bisect(f, lo, hi, num_iters: int = 90, x0=None):
     the returned candidate (root / no-root / false equilibrium) from f's value
     and slope, preserving the reference's NaN semantics without branching.
 
-    Returns the final iterate. Fully vmappable when f broadcasts.
+    Returns the final iterate. Fully vmappable when f broadcasts. With
+    ``with_health`` returns ``(x, Health)``: the loop and the iterate are
+    IDENTICAL (the carry is untouched), and three extra evaluations of ``f``
+    (original endpoints + final iterate — cheap closed-form calls in every
+    caller) fill in the final residual |f(x)|, the final bracket width, a
+    no-sign-change bracket check, and NaN sentinels.
     """
     # Trace-time counters (obs.metrics jit-safety contract): host code that
     # counts bisection instances and their fixed iteration budgets as
@@ -117,5 +159,26 @@ def bisect(f, lo, hi, num_iters: int = 90, x0=None):
         xn = jnp.where(pos, 0.5 * (x + lo), 0.5 * (x + hi))
         return lo2, hi2, xn
 
-    _, _, x = lax.fori_loop(0, num_iters, body, (lo, hi, x))
-    return x
+    lo_f, hi_f, x = lax.fori_loop(0, num_iters, body, (lo, hi, x))
+    if not with_health:
+        return x
+
+    res = jnp.abs(f(x))
+    dtype = jnp.asarray(res).dtype
+    no_bracket = f(jnp.asarray(lo, dtype)) * f(jnp.asarray(hi, dtype)) > 0
+    nan_in = jnp.isnan(jnp.asarray(lo, dtype)) | jnp.isnan(jnp.asarray(hi, dtype))
+    if x0 is not None:
+        nan_in = nan_in | jnp.isnan(jnp.asarray(x0, dtype))
+    flags = (
+        jnp.where(no_bracket, jnp.int32(NO_BRACKET), jnp.int32(0))
+        | jnp.where(~jnp.isfinite(res), jnp.int32(NONFINITE_RESIDUAL), jnp.int32(0))
+        | jnp.where(nan_in, jnp.int32(NAN_INPUT), jnp.int32(0))
+        | jnp.where(jnp.isnan(x), jnp.int32(NAN_OUTPUT), jnp.int32(0))
+    )
+    health = Health(
+        residual=res,
+        bracket_width=jnp.abs(jnp.asarray(hi_f, dtype) - lo_f),
+        iterations=jnp.full(jnp.shape(flags), num_iters, jnp.int32),
+        flags=flags,
+    )
+    return x, health

@@ -1,0 +1,79 @@
+"""Numerical-health diagnostics: in-jit convergence evidence for every
+silent numerical judgment in the pipeline.
+
+The solver stacks hinge on judgments that used to leave no trace of *how
+well* they went: the hazard-vs-utility crossing search with its fallback
+ladder, the 90-halving blind bisection for the crash time ξ, the slope
+check that rejects false equilibria, and the damped fixed point of the
+social extension. PR 1's `sbr_tpu.obs` explains where wall-clock goes;
+this layer (torchode's solver event/status introspection is the design
+reference, PAPERS.md) explains whether the *numbers* can be trusted:
+
+- `Health` — a small pytree (final residual, bracket width, iteration
+  count, NaN/fallback flag bitmask) computed branchlessly INSIDE jit and
+  returned next to results. Core primitives (`core.rootfind.bisect`,
+  `first_upcrossing`/`last_downcrossing`, `core.ode.rk4`,
+  `core.integrate`) produce it only when asked (``with_health=True``), so
+  unconverted call sites pay nothing; the four solver stacks always
+  thread it into their result pytrees, and the sweeps modules return
+  per-cell health grids. Because health is always part of the traced
+  program, turning diagnostics *reporting* on or off at the host boundary
+  changes no solver output and causes no retrace (same discipline as
+  `obs.metrics`; asserted by tests/test_diag.py).
+- Host boundary — `obs.log_health(stage, health, status)` reduces a
+  (possibly million-cell) health grid to a census (`summarize`: flag
+  counts, divergent-cell count, worst cells, residual histogram), emits
+  it as a ``health`` event, and folds a per-stage roll-up into the run
+  manifest.
+- Reporting — ``python -m sbr_tpu.obs.report health RUN_DIR`` renders
+  worst-cell tables, the NaN census, and residual histograms, and exits
+  nonzero when any cell carries a `DIVERGENT_MASK` flag — the CI gate.
+
+Flag semantics: `Status` codes classify economic outcomes (a NO_ROOT cell
+NaN-ing its ξ is the reference's intended semantics); health flags
+classify numerical trust. Only NaN poison, non-finite residuals, and
+fixed-point non-convergence count as divergence — fallback-ladder and
+no-bracket bits are corroborating detail.
+"""
+
+from sbr_tpu.diag.health import (
+    ALL_FLAGS,
+    DIVERGENT_MASK,
+    FALLBACK_IN_DEFAULT,
+    FALLBACK_IN_KNOT,
+    FALLBACK_OUT_DEFAULT,
+    FALLBACK_OUT_KNOT,
+    FLAG_NAMES,
+    FP_ABORTED,
+    FP_NOT_CONVERGED,
+    NAN_INPUT,
+    NAN_OUTPUT,
+    NO_BRACKET,
+    NONFINITE_RESIDUAL,
+    Health,
+    as_out_crossing,
+    flag_names,
+    or_reduce_flags,
+    summarize,
+)
+
+__all__ = [
+    "ALL_FLAGS",
+    "DIVERGENT_MASK",
+    "FALLBACK_IN_DEFAULT",
+    "FALLBACK_IN_KNOT",
+    "FALLBACK_OUT_DEFAULT",
+    "FALLBACK_OUT_KNOT",
+    "FLAG_NAMES",
+    "FP_ABORTED",
+    "FP_NOT_CONVERGED",
+    "NAN_INPUT",
+    "NAN_OUTPUT",
+    "NO_BRACKET",
+    "NONFINITE_RESIDUAL",
+    "Health",
+    "as_out_crossing",
+    "flag_names",
+    "or_reduce_flags",
+    "summarize",
+]

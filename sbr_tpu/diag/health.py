@@ -1,0 +1,284 @@
+"""The `Health` pytree: in-jit numerical-health diagnostics.
+
+A `Health` is a tiny pytree of per-solve (or per-cell, when vmapped)
+scalars that rides through jit/vmap/shard_map next to the numerical
+result it describes:
+
+- ``residual``       — final |f(x*)| of the defining equation (the xi
+                       bisection's |AW(ξ*)−κ|, the social fixed point's
+                       sup-norm error); NaN where not applicable.
+- ``bracket_width``  — final bisection bracket width |hi−lo|; NaN where
+                       not applicable.
+- ``iterations``     — int32 iterations actually executed (bisection
+                       halvings, fixed-point steps), summed under `merge`.
+- ``flags``          — int32 bitmask of the `FALLBACK_*` / `NAN_*` /
+                       `FP_*` bits below: which fallback path a crossing
+                       detector took, NaN/Inf sentinels, bracket validity,
+                       fixed-point convergence.
+
+Everything is branchless array arithmetic, so carrying a `Health` through
+a `lax.while_loop`/`fori_loop` costs a few scalar lanes; the core
+primitives (`core.rootfind`, `core.ode`, `core.integrate`) only compute it
+when a caller passes ``with_health=True``, so call sites that skip it pay
+nothing — the loop carries and jaxprs are unchanged.
+
+The split between ``flags`` and `models.results.Status` matters: status
+codes classify *economic* outcomes (no-run cells are SUPPOSED to carry
+NaN ξ), while health flags classify *numerical* trust. Only the
+`DIVERGENT_MASK` bits — NaN poison, non-finite residuals, fixed-point
+non-convergence — mean "do not trust this cell"; fallback-ladder and
+no-bracket bits are informational corroboration of the status code.
+
+Host-side, `summarize` reduces a (possibly million-cell) batched Health
+to a JSON-ready census (flag counts, worst cells, residual histogram)
+that `obs.log_health` emits as a ``health`` event and
+`python -m sbr_tpu.obs.report health` renders and gates on.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+# ---------------------------------------------------------------------------
+# Flag bits. Plain ints (not a jnp enum) so host code — the report CLI, flag
+# name tables — can use them without importing JAX. Bits 0-1 are the
+# "generic" crossing-fallback positions emitted by the core crossing
+# primitives; `as_out_crossing` shifts them into the OUT positions (2-3) so
+# a merged per-solve mask keeps the two crossings distinguishable.
+# ---------------------------------------------------------------------------
+
+FALLBACK_IN_KNOT = 1 << 0  # no up-crossing; fell back to first above-level knot
+FALLBACK_IN_DEFAULT = 1 << 1  # nothing above the level; returned `default`
+FALLBACK_OUT_KNOT = 1 << 2  # no down-crossing; fell back to last above-level knot
+FALLBACK_OUT_DEFAULT = 1 << 3  # nothing above the level; returned `default`
+NO_BRACKET = 1 << 4  # bisection endpoints do not bracket a sign change
+NONFINITE_RESIDUAL = 1 << 5  # final residual is NaN/Inf
+NAN_INPUT = 1 << 6  # NaN among the primitive's inputs (curve, level, bracket)
+NAN_OUTPUT = 1 << 7  # non-finite values in a computed result (iterate, curve)
+FP_NOT_CONVERGED = 1 << 8  # fixed point hit max_iter without converging
+FP_ABORTED = 1 << 9  # fixed point's ξ search exceeded η and gave up
+
+FLAG_NAMES = {
+    FALLBACK_IN_KNOT: "fallback_in_knot",
+    FALLBACK_IN_DEFAULT: "fallback_in_default",
+    FALLBACK_OUT_KNOT: "fallback_out_knot",
+    FALLBACK_OUT_DEFAULT: "fallback_out_default",
+    NO_BRACKET: "no_bracket",
+    NONFINITE_RESIDUAL: "nonfinite_residual",
+    NAN_INPUT: "nan_input",
+    NAN_OUTPUT: "nan_output",
+    FP_NOT_CONVERGED: "fp_not_converged",
+    FP_ABORTED: "fp_aborted",
+}
+ALL_FLAGS = tuple(FLAG_NAMES)
+
+# Bits that mean "this cell's numbers cannot be trusted" — `report health`
+# exits nonzero when any cell carries one. Fallback/no-bracket bits are NOT
+# here: they corroborate expected NO_CROSSING / NO_ROOT status outcomes.
+DIVERGENT_MASK = (
+    NONFINITE_RESIDUAL | NAN_INPUT | NAN_OUTPUT | FP_NOT_CONVERGED | FP_ABORTED
+)
+
+_IN_FALLBACK_MASK = FALLBACK_IN_KNOT | FALLBACK_IN_DEFAULT
+
+
+def flag_names(mask: int) -> list:
+    """Decode a host-side int bitmask into sorted flag-name strings."""
+    mask = int(mask)
+    return [name for bit, name in FLAG_NAMES.items() if mask & bit]
+
+
+@struct.dataclass
+class Health:
+    """Per-solve numerical-health scalars (see module docstring).
+
+    All leaves are arrays so a vmapped solve yields batched health — the
+    per-cell health grids of the sweeps modules. 0-d per scalar solve.
+    """
+
+    residual: jnp.ndarray  # final |f(x*)|; NaN = not applicable
+    bracket_width: jnp.ndarray  # final bisection bracket; NaN = n/a
+    iterations: jnp.ndarray  # int32, summed by merge
+    flags: jnp.ndarray  # int32 bitmask of the module-level bits
+
+    @classmethod
+    def empty(cls, dtype=jnp.float32) -> "Health":
+        """A neutral health: nothing measured, nothing flagged."""
+        nan = jnp.asarray(jnp.nan, dtype)
+        return cls(
+            residual=nan,
+            bracket_width=nan,
+            iterations=jnp.zeros((), jnp.int32),
+            flags=jnp.zeros((), jnp.int32),
+        )
+
+    @classmethod
+    def of_flags(cls, flags, dtype=jnp.float32) -> "Health":
+        """Health carrying only a flag mask (curve finiteness probes)."""
+        nan = jnp.asarray(jnp.nan, dtype)
+        return cls(
+            residual=nan,
+            bracket_width=nan,
+            iterations=jnp.zeros((), jnp.int32),
+            flags=jnp.asarray(flags, jnp.int32),
+        )
+
+    @classmethod
+    def of_nan_probe(cls, nan_in, nonfinite_out, iterations, dtype=jnp.float32) -> "Health":
+        """Health of a residual-free computation (ODE trajectory, cumulative
+        quadrature): NaN-poisoned inputs and non-finite outputs are the only
+        failure modes; ``iterations`` records the step/panel count."""
+        dtype = dtype if jnp.issubdtype(jnp.dtype(dtype), jnp.floating) else jnp.float32
+        nan = jnp.asarray(jnp.nan, dtype)
+        return cls(
+            residual=nan,
+            bracket_width=nan,
+            iterations=jnp.asarray(iterations, jnp.int32),
+            flags=jnp.where(nan_in, jnp.int32(NAN_INPUT), jnp.int32(0))
+            | jnp.where(nonfinite_out, jnp.int32(NAN_OUTPUT), jnp.int32(0)),
+        )
+
+    def merge(self, *others: "Health") -> "Health":
+        """Combine healths of sequential stages into one per-solve health:
+        worst (max) residual/bracket via NaN-ignoring `fmax`, summed
+        iterations, OR'd flags. Broadcasts, so batched merges batched."""
+        h = self
+        for o in others:
+            h = Health(
+                residual=jnp.fmax(h.residual, o.residual),
+                bracket_width=jnp.fmax(h.bracket_width, o.bracket_width),
+                iterations=h.iterations + o.iterations,
+                flags=h.flags | o.flags,
+            )
+        return h
+
+
+def as_out_crossing(h: Health) -> Health:
+    """Re-key a crossing primitive's health as the OUT (down-)crossing:
+    shift the generic fallback bits (0-1) into the OUT positions (2-3) so
+    merging IN and OUT crossing healths stays lossless."""
+    fall = h.flags & _IN_FALLBACK_MASK
+    return h.replace(flags=(h.flags & ~_IN_FALLBACK_MASK) | (fall << 2))
+
+
+def or_reduce_flags(flags, reduce_scalar=None):
+    """OR-reduce a flag-mask array to one scalar mask using only SUM-shaped
+    reductions, so it works where OR has no collective: under a sharded
+    axis, pass ``reduce_scalar=lambda s: lax.psum(s, axis_name)`` and each
+    bit's presence count completes across shards; the local case is the
+    identity. ~10 tiny scalar reductions — negligible in any program."""
+    if reduce_scalar is None:
+        reduce_scalar = lambda s: s
+    out = jnp.zeros((), jnp.int32)
+    for bit in ALL_FLAGS:
+        present = reduce_scalar(jnp.sum((flags & bit) != 0)) > 0
+        out = out | jnp.where(present, jnp.int32(bit), jnp.int32(0))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side reduction: Health (possibly batched) -> JSON-ready census.
+# ---------------------------------------------------------------------------
+
+
+def summarize(health: Health, status=None, worst_k: int = 5) -> dict:
+    """Reduce a Health pytree to a JSON-ready dict at the host boundary.
+
+    Forces a device→host fetch of the health leaves (callers gate on
+    telemetry being enabled, same discipline as `obs.log_status`). With
+    ``status`` (the matching Status grid) worst cells carry their status
+    name, separating expected no-run NaN sentinels from genuine poison.
+
+    Residual accounting is restricted to cells whose bisection MEANT
+    something: NO_CROSSING / NO_ROOT cells run their fixed halvings on a
+    degenerate or non-bracketing interval by design, and their large-but-
+    expected |AW−κ| values would otherwise drown the genuinely converged
+    cells out of ``max_residual``, the histogram, and the worst-cell
+    ranking (code-review finding). With ``status`` given, RUN cells
+    qualify; without it, cells free of NO_BRACKET / default-fallback flags
+    do. Divergent-flag cells always rank first regardless.
+    """
+    import numpy as np
+
+    res = np.atleast_1d(np.asarray(health.residual, dtype=np.float64))
+    shape = res.shape
+    res = res.ravel()
+    flags = np.atleast_1d(np.asarray(health.flags, dtype=np.int64)).ravel()
+    iters = np.atleast_1d(np.asarray(health.iterations, dtype=np.int64)).ravel()
+    status_flat = (
+        np.atleast_1d(np.asarray(status)).ravel() if status is not None else None
+    )
+
+    n = int(flags.size)
+    flag_counts = {}
+    for bit, name in FLAG_NAMES.items():
+        c = int(((flags & bit) != 0).sum())
+        if c:
+            flag_counts[name] = c
+    divergent = int(((flags & DIVERGENT_MASK) != 0).sum())
+
+    out = {
+        "cells": n,
+        "divergent": divergent,
+        "flag_counts": flag_counts,
+        "iterations_total": int(iters.sum()),
+    }
+
+    finite = np.isfinite(res)
+    if status_flat is not None:
+        from sbr_tpu.models.results import Status  # lazy: results imports us
+
+        meaningful = finite & (status_flat == int(Status.RUN))
+    else:
+        degenerate = NO_BRACKET | FALLBACK_IN_DEFAULT | FALLBACK_OUT_DEFAULT
+        meaningful = finite & ((flags & degenerate) == 0)
+    if meaningful.any():
+        r = res[meaningful]
+        out["max_residual"] = float(r.max())
+        # log10 histogram with fixed integer-decade buckets (clamped to
+        # [1e-18, 1e2]) so histograms diff cleanly across runs; zeros land
+        # in the lowest bucket.
+        exps = np.clip(
+            np.floor(np.log10(np.clip(r, 1e-20, None))), -18.0, 2.0
+        ).astype(int)
+        hist = {}
+        for e in np.sort(np.unique(exps)):
+            hist[f"1e{int(e):+d}"] = int((exps == e).sum())
+        out["residual_hist"] = hist
+
+    # Worst cells: divergent cells first, then by meaningful residual —
+    # the cells a human should look at. Unflagged cells whose residual is
+    # NaN or expected-degenerate never qualify.
+    score = np.where(
+        (flags & DIVERGENT_MASK) != 0,
+        np.inf,
+        np.where(meaningful, res, -np.inf),
+    )
+    order = np.argsort(-score, kind="stable")
+    worst = []
+    for i in order[: max(worst_k, 0)]:
+        i = int(i)
+        if score[i] == -np.inf and flags[i] == 0:
+            continue
+        cell = {
+            "index": [int(v) for v in np.unravel_index(i, shape)],
+            "residual": float(res[i]) if meaningful[i] else None,
+            "flags": flag_names(flags[i]),
+        }
+        if status_flat is not None:
+            cell["status"] = _status_name(int(status_flat[i]))
+        worst.append(cell)
+    if worst:
+        out["worst_cells"] = worst
+    return out
+
+
+def _status_name(code: int) -> str:
+    # Lazy import: models.results imports this module for the Health type.
+    from sbr_tpu.models.results import Status
+
+    try:
+        return Status(code).name
+    except ValueError:
+        return str(code)

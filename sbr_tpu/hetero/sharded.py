@@ -30,6 +30,7 @@ from sbr_tpu.hetero.learning import (
     solve_learning_hetero_arrays,
     solve_learning_hetero_exact,
 )
+from sbr_tpu.diag.health import Health
 from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
 from sbr_tpu.models.params import ModelParamsHetero, SolverConfig
 from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero
@@ -102,6 +103,11 @@ def solve_hetero_sharded(
         converged=P(),
         tolerance=P(),
         solve_time=P(),  # replicated scalar leaf (0.0 inside the traced body)
+        # health scalars are replicated by construction: the ξ bisection
+        # health is computed from psum-completed AW on every shard, and the
+        # per-group crossing flags fold across shards via summed presence
+        # counts (diag.or_reduce_flags) before entering the mask
+        health=Health(residual=P(), bracket_width=P(), iterations=P(), flags=P()),
     )
     spec_aw = (
         AWHetero(

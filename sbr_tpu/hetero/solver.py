@@ -92,6 +92,7 @@ def compute_xi_hetero(
     kappa,
     config: SolverConfig = SolverConfig(),
     axis_name=None,
+    with_health: bool = False,
 ):
     """Bisection for the weighted AW root (`compute_ξ_hetero`,
     `heterogeneity_solver.jl:48-144`).
@@ -99,7 +100,9 @@ def compute_xi_hetero(
     Returns (xi, err, root_ok, is_increasing, first_crossing_ok). With
     ``axis_name`` (sharded group axis), all shards run the identical
     bisection on psum-completed AW values, so ξ is replicated by
-    construction.
+    construction. With ``with_health`` the bisection's `diag.Health` is
+    appended — its extra endpoint/final evaluations run the same
+    psum-completed AW on every shard, so the health scalars replicate too.
     """
     dtype = lsh.cdfs.dtype
     kappa = jnp.asarray(kappa, dtype=dtype)
@@ -119,7 +122,15 @@ def compute_xi_hetero(
         hi = lax.pmax(hi, axis_name)
     x0 = _wreduce(jnp.dot(dist, 0.5 * (tau_bar_in_uncs + tau_bar_out_uncs)), axis_name)
 
-    xi = bisect(lambda x: aw_of(x) - kappa, lo, hi, num_iters=config.bisect_iters, x0=x0)
+    out = bisect(
+        lambda x: aw_of(x) - kappa,
+        lo,
+        hi,
+        num_iters=config.bisect_iters,
+        x0=x0,
+        with_health=with_health,
+    )
+    xi, xi_health = out if with_health else (out, None)
 
     aw = aw_of(xi)
     err = jnp.abs(aw - kappa)
@@ -144,6 +155,8 @@ def compute_xi_hetero(
     is_increasing = aw_eps >= aw
 
     first_ok = _first_crossing_ok(xi, tau_bar_in_uncs, lsh, kappa, axis_name=axis_name)
+    if with_health:
+        return xi, err, root_ok, is_increasing, first_ok, xi_health
     return xi, err, root_ok, is_increasing, first_ok
 
 
@@ -206,8 +219,12 @@ def solve_equilibrium_hetero(
 
     with obs.span("hetero.buffers") as sp:
         default = jnp.asarray(tspan_end, dtype=dtype)
-        tau_in_uncs = jax.vmap(lambda hr: first_upcrossing(tau_grid, hr, u, default))(hrs)
-        tau_out_uncs = jax.vmap(lambda hr: last_downcrossing(tau_grid, hr, u, default))(hrs)
+        tau_in_uncs, h_in = jax.vmap(
+            lambda hr: first_upcrossing(tau_grid, hr, u, default, with_health=True)
+        )(hrs)
+        tau_out_uncs, h_out = jax.vmap(
+            lambda hr: last_downcrossing(tau_grid, hr, u, default, with_health=True)
+        )(hrs)
         sp.sync(tau_in_uncs, tau_out_uncs)
 
     # No group can optimally exit (`heterogeneity_solver.jl:266-272`); the
@@ -216,10 +233,21 @@ def solve_equilibrium_hetero(
     no_crossing = n_crossing == 0
 
     with obs.span("hetero.xi") as sp:
-        xi_c, err, root_ok, increasing, first_ok = compute_xi_hetero(
-            tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config, axis_name=axis_name
+        xi_c, err, root_ok, increasing, first_ok, xi_health = compute_xi_hetero(
+            tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config,
+            axis_name=axis_name, with_health=True,
         )
         sp.sync(xi_c)
+
+    # Per-group crossing flags fold into one scalar mask via SUM-shaped
+    # reductions only (diag.or_reduce_flags), so the same code completes
+    # across shards when the group axis is sharded — OR has no collective,
+    # per-bit presence counts psum like any other group reduction.
+    from sbr_tpu.diag.health import as_out_crossing, or_reduce_flags
+
+    group_flags = h_in.flags | as_out_crossing(h_out).flags  # (K_local,)
+    cross_flags = or_reduce_flags(group_flags, lambda s: _wreduce(s, axis_name))
+    health = xi_health.replace(flags=xi_health.flags | cross_flags)
 
     valid = jnp.logical_and(root_ok, jnp.logical_and(increasing, first_ok))
     run = jnp.logical_and(~no_crossing, valid)
@@ -241,7 +269,7 @@ def solve_equilibrium_hetero(
 
     from sbr_tpu.baseline.solver import _stamp_solve_time
 
-    return _stamp_solve_time(
+    res = _stamp_solve_time(
         EquilibriumResultHetero(
             xi=xi,
             tau_bar_in_uncs=tau_in_uncs,
@@ -252,9 +280,12 @@ def solve_equilibrium_hetero(
             status=status,
             converged=converged,
             tolerance=tolerance,
+            health=health,
         ),
         t_start,
     )
+    obs.log_health("hetero.equilibrium", res.health, res.status)
+    return res
 
 
 def get_aw_hetero(

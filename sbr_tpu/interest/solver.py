@@ -112,8 +112,8 @@ def solve_equilibrium_interest_core(
             return hazard_at(tau) - r * v_at(tau)
 
     with obs.span("interest.buffers") as sp:
-        tau_in_unc, tau_out_unc = optimal_buffer(
-            u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at
+        tau_in_unc, tau_out_unc, cross_health = optimal_buffer(
+            u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at, with_health=True
         )
         sp.sync(tau_in_unc, tau_out_unc)
     no_crossing = tau_in_unc == tau_out_unc
@@ -121,10 +121,21 @@ def solve_equilibrium_interest_core(
     # ξ and AW use the baseline machinery on the word-of-mouth CDF unchanged
     # (`interest_rate_solver.jl:122`, `get_AW_functions_interest!:161-184`).
     with obs.span("interest.xi") as sp:
-        xi_c, err, root_ok, increasing = compute_xi(
-            tau_in_unc, tau_out_unc, ls, kappa, config
+        xi_c, err, root_ok, increasing, xi_health = compute_xi(
+            tau_in_unc, tau_out_unc, ls, kappa, config, with_health=True
         )
         sp.sync(xi_c)
+
+    # Value-function finiteness probe: the HJB scan has no adaptive-solver
+    # divergence exit, so a blown-up V would silently poison the effective
+    # hazard — flag it (the crossing health already catches the NaN case
+    # via hr_eff, this adds the Inf case and attributes it to V).
+    from sbr_tpu.diag.health import NAN_OUTPUT, Health
+
+    v_flags = jnp.where(
+        jnp.any(~jnp.isfinite(v)), jnp.int32(NAN_OUTPUT), jnp.int32(0)
+    )
+    health = cross_health.merge(xi_health, Health.of_flags(v_flags, dtype))
 
     run = jnp.logical_and(~no_crossing, jnp.logical_and(root_ok, increasing))
     status = jnp.where(
@@ -164,6 +175,7 @@ def solve_equilibrium_interest_core(
         aw_out=aw_out,
         aw_in=aw_in,
         aw_max=jnp.where(run, jnp.max(aw_cum), nan),
+        health=health,
     )
     return EquilibriumResultInterest(base=base, v=v, hr_effective=hr_eff)
 
@@ -196,4 +208,8 @@ def solve_equilibrium_interest(
         tspan_end,
         config,
     )
-    return res.replace(base=_stamp_solve_time(res.base, t0))
+    res = res.replace(base=_stamp_solve_time(res.base, t0))
+    from sbr_tpu import obs
+
+    obs.log_health("interest.equilibrium", res.base.health, res.base.status)
+    return res

@@ -13,9 +13,12 @@ semantics inside vmap.
 from __future__ import annotations
 
 import enum
+from typing import Optional
 
 import jax.numpy as jnp
 from flax import struct
+
+from sbr_tpu.diag.health import Health
 
 
 def _fmt(x, digits: int = 6) -> str:
@@ -162,6 +165,11 @@ class EquilibriumResult:
     # a distinct pytree type (breaking tree_map across results and
     # retracing every jit that takes one).
     solve_time: float = 0.0
+    # Numerical-health diagnostics (sbr_tpu.diag): residual/bracket/flags of
+    # the crossing search and ξ bisection, computed in-jit alongside the
+    # solve (XLA dead-code-eliminates it for callers that drop it). None
+    # only for results assembled outside the solvers (tile checkpoints).
+    health: Optional[Health] = None
 
     def __repr__(self) -> str:  # reference `Base.show`, `solver.jl:116-129`
         return (
@@ -192,6 +200,7 @@ class EquilibriumResultHetero:
     converged: jnp.ndarray  # bool
     tolerance: jnp.ndarray  # achieved |AW(ξ)-κ|
     solve_time: float = 0.0  # pytree leaf; see EquilibriumResult.solve_time
+    health: Optional[Health] = None  # see EquilibriumResult.health
 
     def __repr__(self) -> str:
         k = self.hrs.shape[0] if self.hrs.ndim >= 1 else "?"

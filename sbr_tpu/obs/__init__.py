@@ -10,9 +10,12 @@ per-step ABM counters are the design references from PAPERS.md):
   recorded only at host boundaries (jit-safe); zero overhead disabled.
 - ``obs.runlog``  — `RunContext` per-run directories (`events.jsonl` +
   `manifest.json`), `span` stage tracing, `jit_call` AOT compile/execute
-  attribution, status-grid accounting, memory snapshots.
+  attribution, status-grid accounting, numerical-health censuses
+  (`log_health`, fed by `sbr_tpu.diag`), memory snapshots, and run-dir
+  retention (`gc_runs`, `SBR_OBS_KEEP`).
 - ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
-  a run directory or diffs two runs.
+  a run directory or diffs two runs; the `health` subcommand renders and
+  gates on numerical health, `gc` prunes old run directories.
 
 Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
 land under ``SBR_OBS_DIR``, default ``obs_runs/``), or programmatically::
@@ -34,7 +37,9 @@ from sbr_tpu.obs.runlog import (
     enabled,
     end_run,
     event,
+    gc_runs,
     jit_call,
+    log_health,
     log_status,
     run_context,
     span,
@@ -52,7 +57,9 @@ __all__ = [
     "end_run",
     "event",
     "fence",
+    "gc_runs",
     "jit_call",
+    "log_health",
     "log_status",
     "metrics",
     "run_context",

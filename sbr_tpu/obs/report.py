@@ -1,10 +1,20 @@
 """Render an obs run directory as a human-readable timing/throughput table,
-or diff two runs.
+diff two runs, gate on numerical health, or garbage-collect old runs.
 
 Usage:
     python -m sbr_tpu.obs.report RUN_DIR            # render one run
     python -m sbr_tpu.obs.report RUN_DIR OTHER_DIR  # diff two runs
     python -m sbr_tpu.obs.report RUN_DIR --events 20  # also tail raw events
+    python -m sbr_tpu.obs.report health RUN_DIR     # numerical-health report;
+                                                    # exits 1 on divergence,
+                                                    # 3 if no health data
+    python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs
+
+The ``health`` subcommand renders the `sbr_tpu.diag` census (worst-cell
+tables, NaN/fallback flag counts, residual histograms) recorded by
+`obs.log_health`, and its exit code is the CI gate: nonzero whenever any
+cell carries a divergent flag (NaN poison, non-finite residual,
+fixed-point non-convergence).
 
 Reads only `manifest.json` + `events.jsonl` — no JAX import, so the report
 never touches (or hangs on) an accelerator backend.
@@ -162,6 +172,21 @@ def render(run: dict) -> str:
         ]
         out.append(_table(["stage", "counts"], rows))
 
+    health = m.get("health") or {}
+    if health:
+        worst = sum(v.get("divergent", 0) for v in health.values())
+        out += ["", f"HEALTH ({'DIVERGENT' if worst else 'ok'})"]
+        out.append(
+            _table(
+                ["stage", "cells", "divergent", "max residual"],
+                [
+                    [k, v.get("cells", "-"), v.get("divergent", 0), _fmt_resid(v.get("max_residual"))]
+                    for k, v in sorted(health.items())
+                ],
+            )
+        )
+        out.append("(details: python -m sbr_tpu.obs.report health RUN_DIR)")
+
     mx = m.get("metrics") or {}
     if mx.get("counters") or mx.get("timers") or mx.get("gauges"):
         out += ["", "METRICS"]
@@ -174,6 +199,125 @@ def render(run: dict) -> str:
         out.append(_table(["type", "name", "value"], rows))
 
     return "\n".join(out)
+
+
+def _fmt_resid(v) -> str:
+    return "-" if v is None else f"{float(v):.2e}"
+
+
+def _health_by_stage(events) -> dict:
+    """Fold `health` events per stage: summed cells/divergent/flag counts,
+    max residual, last worst-cells table and residual histogram."""
+    out: dict = {}
+    for ev in events:
+        if ev.get("kind") != "health":
+            continue
+        stage = ev.get("stage", "?")
+        agg = out.setdefault(
+            stage,
+            {
+                "events": 0,
+                "cells": 0,
+                "divergent": 0,
+                "max_residual": None,
+                "flag_counts": {},
+                "worst_cells": [],
+                "residual_hist": {},
+                "iterations_total": 0,
+            },
+        )
+        agg["events"] += 1
+        agg["cells"] += int(ev.get("cells", 0))
+        agg["divergent"] += int(ev.get("divergent", 0))
+        agg["iterations_total"] += int(ev.get("iterations_total", 0))
+        mr = ev.get("max_residual")
+        if mr is not None:
+            prev = agg["max_residual"]
+            agg["max_residual"] = mr if prev is None else max(prev, mr)
+        for name, n in (ev.get("flag_counts") or {}).items():
+            agg["flag_counts"][name] = agg["flag_counts"].get(name, 0) + int(n)
+        if ev.get("worst_cells"):
+            agg["worst_cells"] = ev["worst_cells"]
+        if ev.get("residual_hist"):
+            agg["residual_hist"] = ev["residual_hist"]
+    return out
+
+
+def _ascii_hist(hist: dict, width: int = 40) -> list:
+    """Render a {bucket_label: count} histogram as aligned ASCII bars."""
+    if not hist:
+        return []
+    peak = max(hist.values()) or 1
+    label_w = max(len(k) for k in hist)
+    lines = []
+    for label, count in hist.items():
+        bar = "#" * max(1, round(width * count / peak)) if count else ""
+        lines.append(f"  {label:>{label_w}}  {count:>8}  {bar}")
+    return lines
+
+
+def render_health(run: dict) -> tuple:
+    """Numerical-health report; returns (text, exit_code). Exit codes:
+    0 healthy, 1 divergence detected, 3 no health data recorded (a run
+    that was supposed to carry diagnostics but emitted none must not pass
+    a CI gate silently)."""
+    events = run["events"]
+    stages = _health_by_stage(events)
+    out = [f"run      {run['dir']}"]
+    if not stages:
+        out.append("no health events recorded — was the run produced by an "
+                    "instrumented solver/sweep with telemetry on?")
+        return "\n".join(out), 3
+
+    total_divergent = sum(v["divergent"] for v in stages.values())
+    total_cells = sum(v["cells"] for v in stages.values())
+    out.append(
+        f"health   {'DIVERGENCE DETECTED' if total_divergent else 'OK'}: "
+        f"{total_divergent}/{total_cells} divergent cells across {len(stages)} stage(s)"
+    )
+
+    out += ["", "STAGES"]
+    rows = []
+    for name, v in sorted(stages.items()):
+        flags = ", ".join(f"{k}={n}" for k, n in sorted(v["flag_counts"].items())) or "-"
+        rows.append([name, v["cells"], v["divergent"], _fmt_resid(v["max_residual"]), flags])
+    out.append(_table(["stage", "cells", "divergent", "max resid", "flags"], rows))
+
+    # NaN census: the poison-tracking subset of the flag counts.
+    nan_rows = []
+    for name, v in sorted(stages.items()):
+        fc = v["flag_counts"]
+        nan_in, nan_out, nonf = (
+            fc.get("nan_input", 0), fc.get("nan_output", 0), fc.get("nonfinite_residual", 0),
+        )
+        if nan_in or nan_out or nonf:
+            nan_rows.append([name, nan_in, nan_out, nonf])
+    if nan_rows:
+        out += ["", "NaN CENSUS"]
+        out.append(_table(["stage", "nan_input", "nan_output", "nonfinite_residual"], nan_rows))
+
+    for name, v in sorted(stages.items()):
+        if v["worst_cells"]:
+            out += ["", f"WORST CELLS — {name}"]
+            out.append(
+                _table(
+                    ["index", "residual", "status", "flags"],
+                    [
+                        [
+                            ",".join(str(i) for i in c.get("index", [])),
+                            _fmt_resid(c.get("residual")),
+                            c.get("status", "-"),
+                            ",".join(c.get("flags", [])) or "-",
+                        ]
+                        for c in v["worst_cells"]
+                    ],
+                )
+            )
+        if v["residual_hist"]:
+            out += ["", f"RESIDUAL HISTOGRAM — {name} (|f(x*)| by decade)"]
+            out += _ascii_hist(v["residual_hist"])
+
+    return "\n".join(out), 1 if total_divergent else 0
 
 
 def diff(a: dict, b: dict) -> str:
@@ -208,10 +352,60 @@ def diff(a: dict, b: dict) -> str:
     return "\n".join(out)
 
 
+def _main_health(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report health",
+        description="Numerical-health report for one run; nonzero exit on divergence",
+    )
+    parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    args = parser.parse_args(argv)
+    try:
+        run = load_run(args.run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    text, code = render_health(run)
+    print(text)
+    return code
+
+
+def _main_gc(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report gc",
+        description="Prune old obs run directories, keeping the N most recent",
+    )
+    parser.add_argument(
+        "root", nargs="?", default=None,
+        help="run root to prune (default: $SBR_OBS_DIR or obs_runs/)",
+    )
+    parser.add_argument("--keep", type=int, required=True, metavar="N",
+                        help="number of most-recent run directories to keep")
+    args = parser.parse_args(argv)
+    import os
+
+    from sbr_tpu.obs.runlog import gc_runs
+
+    root = args.root or os.environ.get("SBR_OBS_DIR", "obs_runs")
+    removed = gc_runs(root, args.keep)
+    print(f"removed {len(removed)} run dir(s) under {root} (keep {args.keep})")
+    for d in removed:
+        print(f"  {d}")
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Subcommand dispatch; a bare run-dir path keeps the legacy render/diff
+    # interface (a directory named "health"/"gc" can be reached as ./health).
+    if argv and argv[0] == "health":
+        return _main_health(argv[1:])
+    if argv and argv[0] == "gc":
+        return _main_gc(argv[1:])
+
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
-        description="Render an obs run directory, or diff two runs",
+        description="Render an obs run directory, diff two runs, or run the "
+        "'health' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

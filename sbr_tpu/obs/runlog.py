@@ -123,6 +123,7 @@ class RunContext:
         run_dir: Optional[str] = None,
         label: str = "run",
         root: Optional[str] = None,
+        auto_prune_keep: Optional[int] = None,
     ) -> None:
         if run_dir is None:
             root = Path(root or os.environ.get("SBR_OBS_DIR", "obs_runs"))
@@ -153,7 +154,15 @@ class RunContext:
         self.mem_peak_live = 0  # peak sum of live jax buffer nbytes
         self.mem_peak_device = 0  # peak allocator peak_bytes_in_use (if exposed)
         self.device: Optional[dict] = None
+        self.health: dict = {}  # stage -> folded numerical-health roll-up
         self._aot_cache: dict = {}
+        # Retention: prune sibling run dirs at finalize when a keep budget
+        # is configured (SBR_OBS_KEEP env, or explicit ctor argument — the
+        # bench harness and the SBR_OBS=1 auto-start path set one).
+        if auto_prune_keep is None:
+            env_keep = os.environ.get("SBR_OBS_KEEP", "").strip()
+            auto_prune_keep = int(env_keep) if env_keep else None
+        self._auto_prune_keep = auto_prune_keep
         self._metrics_was_on = metrics().enabled
         if not self._metrics_was_on:
             # This run owns the registry: start it from zero so the manifest
@@ -353,11 +362,28 @@ class RunContext:
                 "peak_live_buffer_bytes": self.mem_peak_live,
                 "peak_device_bytes": self.mem_peak_device,
             },
+            "health": self.health or None,
             "metrics": metrics().summary() if metrics().enabled else None,
         }
         tmp = self.run_dir / "manifest.json.tmp"
         tmp.write_text(json.dumps(manifest, indent=1, default=_json_default) + "\n")
         os.replace(tmp, self.run_dir / "manifest.json")
+
+    def log_health(self, stage: str, summary: dict) -> None:
+        """Emit one ``health`` event and fold it into the per-stage manifest
+        roll-up (sum cells/divergent, max residual, summed flag counts)."""
+        self.event("health", stage=stage, **summary)
+        agg = self.health.setdefault(
+            stage, {"cells": 0, "divergent": 0, "max_residual": None, "flag_counts": {}}
+        )
+        agg["cells"] += int(summary.get("cells", 0))
+        agg["divergent"] += int(summary.get("divergent", 0))
+        mr = summary.get("max_residual")
+        if mr is not None:
+            prev = agg["max_residual"]
+            agg["max_residual"] = mr if prev is None else max(prev, mr)
+        for name, n in (summary.get("flag_counts") or {}).items():
+            agg["flag_counts"][name] = agg["flag_counts"].get(name, 0) + int(n)
 
     def finalize(self) -> None:
         """Write the final manifest and close the event log (idempotent)."""
@@ -369,6 +395,11 @@ class RunContext:
         self._fh.close()
         if not self._metrics_was_on:
             metrics().disable()
+        if self._auto_prune_keep is not None:
+            try:
+                gc_runs(self.run_dir.parent, self._auto_prune_keep, skip=(self.run_dir,))
+            except Exception:
+                pass  # retention must never sink the run
 
     def __enter__(self) -> "RunContext":
         return self
@@ -385,12 +416,18 @@ class RunContext:
 
 def current_run() -> Optional[RunContext]:
     """The active RunContext, auto-starting one if SBR_OBS=1 in the
-    environment (checked once per process). None when telemetry is off."""
+    environment (checked once per process). None when telemetry is off.
+    Env-started runs get a retention budget (SBR_OBS_KEEP, default 32) so
+    always-on telemetry cannot grow the run root without bound."""
     global _ENV_CHECKED
     if not _STACK and not _ENV_CHECKED:
         _ENV_CHECKED = True
         if os.environ.get("SBR_OBS", "").strip() not in ("", "0"):
-            start_run(label=os.environ.get("SBR_OBS_LABEL", "run"))
+            keep = os.environ.get("SBR_OBS_KEEP", "").strip()
+            start_run(
+                label=os.environ.get("SBR_OBS_LABEL", "run"),
+                auto_prune_keep=int(keep) if keep else 32,
+            )
     return _STACK[-1] if _STACK else None
 
 
@@ -398,7 +435,12 @@ def enabled() -> bool:
     return current_run() is not None
 
 
-def start_run(label: str = "run", run_dir: Optional[str] = None, root: Optional[str] = None) -> RunContext:
+def start_run(
+    label: str = "run",
+    run_dir: Optional[str] = None,
+    root: Optional[str] = None,
+    auto_prune_keep: Optional[int] = None,
+) -> RunContext:
     """Start (and stack) a run; finalized by `end_run`, `run_context`, or at
     interpreter exit — an abandoned run still lands a complete manifest."""
     global _ENV_CHECKED
@@ -406,7 +448,7 @@ def start_run(label: str = "run", run_dir: Optional[str] = None, root: Optional[
     # empty-stack moment (obs.suspended, or after end_run) would auto-start
     # a surprise second run from the env var.
     _ENV_CHECKED = True
-    run = RunContext(run_dir=run_dir, label=label, root=root)
+    run = RunContext(run_dir=run_dir, label=label, root=root, auto_prune_keep=auto_prune_keep)
     _STACK.append(run)
     atexit.register(_finalize_if_active, run)
     return run
@@ -499,6 +541,86 @@ def log_status(stage: str, status) -> None:
 
     arr = np.asarray(status)
     run.event("status", stage=stage, total=int(arr.size), counts=status_counts(arr))
+
+
+def log_health(stage: str, health, status=None) -> None:
+    """Numerical-health census event (`sbr_tpu.diag`) for a finished
+    sweep/solve: reduces the (possibly per-cell) Health pytree to flag
+    counts, divergent-cell count, worst cells, and a residual histogram,
+    and folds a roll-up into the run manifest. Forces a device→host fetch
+    of the health leaves — only when telemetry is on; a no-op while
+    tracing and when ``health`` is None (results assembled outside the
+    solvers, e.g. tile checkpoints)."""
+    run = current_run()
+    if run is None or health is None or not _trace_clean():
+        return
+    from sbr_tpu.diag.health import summarize
+
+    run.log_health(stage, summarize(health, status))
+
+
+def _run_mtime(d: Path) -> float:
+    """Recency of a run directory: the newest of the dir and its log files.
+    Appending to events.jsonl does NOT touch the directory mtime, so the
+    dir stat alone would age a long-running live run into gc range."""
+    ts = [d.stat().st_mtime]
+    for name in ("events.jsonl", "manifest.json"):
+        try:
+            ts.append((d / name).stat().st_mtime)
+        except OSError:
+            pass
+    return max(ts)
+
+
+def _run_is_live(d: Path, grace_s: float) -> bool:
+    """Heuristic cross-process liveness: a manifest still in status
+    "running" with recent activity belongs to another process's open run —
+    deleting it would crash that run's finalize and lose its telemetry. A
+    "running" manifest with no activity for ``grace_s`` is a crashed run's
+    leftovers and IS collectable."""
+    try:
+        manifest = json.loads((d / "manifest.json").read_text())
+    except (OSError, json.JSONDecodeError):
+        return False
+    if manifest.get("status") != "running":
+        return False
+    return (time.time() - _run_mtime(d)) < grace_s
+
+
+def gc_runs(root, keep: int, skip=(), running_grace_s: float = 6 * 3600.0) -> list:
+    """Retention sweep for an obs run root: keep the ``keep`` most recently
+    active run directories (dirs holding a ``manifest.json`` — anything
+    else is not ours to delete), remove the rest. Never removed: ``skip``
+    entries, this process's active runs, and other processes' apparently
+    live runs (manifest status "running" with activity within
+    ``running_grace_s``). Returns the removed paths."""
+    import shutil
+
+    root = Path(root)
+    if keep < 0 or not root.is_dir():
+        return []
+    protected = {Path(p).resolve() for p in skip}
+    protected.update(r.run_dir.resolve() for r in _STACK)
+    runs = sorted(
+        (
+            d
+            for d in root.iterdir()
+            if d.is_dir()
+            and (d / "manifest.json").exists()
+            and d.resolve() not in protected
+            and not _run_is_live(d, running_grace_s)
+        ),
+        key=_run_mtime,
+    )
+    doomed = runs[: max(len(runs) - keep, 0)]
+    removed = []
+    for d in doomed:
+        try:
+            shutil.rmtree(d)
+            removed.append(d)
+        except OSError:
+            pass  # a concurrently-held run dir is not worth failing over
+    return removed
 
 
 # ---------------------------------------------------------------------------

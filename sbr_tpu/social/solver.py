@@ -59,6 +59,11 @@ class SocialFixedPointResult:
     # slots are iterations that never ran.
     history_err: jnp.ndarray = None  # (HISTORY_LEN,)
     history_xi: jnp.ndarray = None  # (HISTORY_LEN,)
+    # Numerical health (sbr_tpu.diag): the final inner equilibrium's health
+    # merged with the fixed-point level — residual = final undamped sup-norm
+    # error, iterations = outer steps, FP_* flags for non-convergence/abort,
+    # NAN_OUTPUT if the converged AW curve itself carries non-finite values.
+    health: "jnp.ndarray" = None
     solve_time: float = 0.0  # pytree leaf; see EquilibriumResult.solve_time
 
     def history(self):
@@ -173,6 +178,27 @@ def _build_fixed_point(
             ls=ls0,
         )
         final = jax.lax.while_loop(cond, body, init)
+
+        # Fixed-point-level health, merged with the last inner solve's
+        # (which rode the while_loop carry inside final.res). max_iter
+        # exhaustion is the only way out with neither converged nor aborted.
+        from sbr_tpu.diag.health import FP_ABORTED, FP_NOT_CONVERGED, NAN_OUTPUT, Health
+
+        not_conv = (~final.converged) & (~final.aborted)
+        fp_flags = (
+            jnp.where(not_conv, jnp.int32(FP_NOT_CONVERGED), jnp.int32(0))
+            | jnp.where(final.aborted, jnp.int32(FP_ABORTED), jnp.int32(0))
+            | jnp.where(
+                jnp.any(~jnp.isfinite(final.aw)), jnp.int32(NAN_OUTPUT), jnp.int32(0)
+            )
+        )
+        nan = jnp.asarray(jnp.nan, dtype)
+        fp_health = Health(
+            residual=final.err,
+            bracket_width=nan,
+            iterations=final.it,
+            flags=fp_flags,
+        )
         return SocialFixedPointResult(
             equilibrium=final.res,
             learning=final.ls,
@@ -185,6 +211,7 @@ def _build_fixed_point(
             error=final.err,
             history_err=final.hist_err,
             history_xi=final.hist_xi,
+            health=final.res.health.merge(fp_health),
         )
 
     return run
@@ -270,3 +297,4 @@ def _log_fixed_point(res: SocialFixedPointResult) -> None:
         history_xi=[float(x) for x in xi_trace],
     )
     obs.log_status("social.fixed_point", res.equilibrium.status)
+    obs.log_health("social.fixed_point", res.health, res.equilibrium.status)

@@ -42,6 +42,7 @@ class USweepResult:
     collapse_times: jnp.ndarray  # ξ
     return_times: jnp.ndarray  # ξ - τ̄_IN (`1_baseline.jl:177`)
     status: jnp.ndarray  # int32 Status codes
+    health: object = None  # per-cell diag.Health grid (leaves (n_u,))
 
 
 @struct.dataclass
@@ -54,12 +55,16 @@ class GridSweepResult:
     max_aw: jnp.ndarray  # (B, U)
     xi: jnp.ndarray  # (B, U)
     status: jnp.ndarray  # (B, U)
+    # per-cell diag.Health (leaves (B, U)); None for results assembled from
+    # tile checkpoints, whose on-disk format predates diagnostics
+    health: object = None
 
 
 def _lean_cell(ls: LearningSolution, u, p, kappa, lam, eta, tspan_end, config: SolverConfig):
-    """One cell -> scalars only; XLA dead-code-eliminates the curve outputs."""
+    """One cell -> scalars only; XLA dead-code-eliminates the curve outputs
+    (the health scalars ride along — a handful of flag/residual lanes)."""
     r = solve_equilibrium_core(ls, u, p, kappa, lam, eta, tspan_end, config)
-    return r.xi, r.tau_bar_in_unc, r.aw_max, r.status
+    return r.xi, r.tau_bar_in_unc, r.aw_max, r.status, r.health
 
 
 @functools.lru_cache(maxsize=None)
@@ -140,16 +145,18 @@ def u_sweep(
     )
     n_u = int(u_values.shape[0])
     with obs.span("sweeps.u_sweep", n_u=n_u, sharded=mesh is not None) as sp:
-        xi, tau_in, aw_max, status = obs.jit_call("sweeps.u_sweep", fn, *args)
+        xi, tau_in, aw_max, status, health = obs.jit_call("sweeps.u_sweep", fn, *args)
         sp.sync(status)
     metrics().inc("sweeps.u_sweep.cells", n_u)
     obs.log_status("sweeps.u_sweep", status)
+    obs.log_health("sweeps.u_sweep", health, status)
     return USweepResult(
         u_values=u_values,
         max_withdrawals=aw_max,
         collapse_times=xi,
         return_times=xi - tau_in,
         status=status,
+        health=health,
     )
 
 
@@ -211,14 +218,16 @@ def beta_u_grid(
     with obs.span(
         "sweeps.beta_u_grid", n_beta=n_b, n_u=n_u, dtype=dtype.name, sharded=mesh is not None
     ) as sp:
-        xi, tau_in, aw_max, status = obs.jit_call(
+        xi, tau_in, aw_max, status, health = obs.jit_call(
             "sweeps.beta_u_grid", grid_fn, beta_values, u_values, *scalars
         )
         sp.sync(status)
     metrics().inc("sweeps.beta_u_grid.cells", n_b * n_u)
     obs.log_status("sweeps.beta_u_grid", status)
+    obs.log_health("sweeps.beta_u_grid", health, status)
     return GridSweepResult(
-        beta_values=beta_values, u_values=u_values, max_aw=aw_max, xi=xi, status=status
+        beta_values=beta_values, u_values=u_values, max_aw=aw_max, xi=xi,
+        status=status, health=health,
     )
 
 
@@ -253,6 +262,8 @@ def _grid_fn(config: SolverConfig, dtype_name: str, mesh, mesh_axes):
     fn = jax.vmap(jax.vmap(cell, in_axes=(None, 0) + bcast), in_axes=(0, None) + bcast)
 
     if mesh is not None:
+        # A single sharding is a pytree prefix: it applies to every output
+        # leaf, including the per-cell Health scalars.
         out_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*mesh_axes))
-        return jax.jit(fn, out_shardings=(out_sharding,) * 4)
+        return jax.jit(fn, out_shardings=out_sharding)
     return jax.jit(fn)

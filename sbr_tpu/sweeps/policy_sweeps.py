@@ -38,6 +38,7 @@ class PolicySweepResult:
     xi: jnp.ndarray  # (B, U, R)
     aw_max: jnp.ndarray  # (B, U, R)
     status: jnp.ndarray  # (B, U, R) int32
+    health: object = None  # per-cell diag.Health grid (leaves (B, U, R))
 
 
 @functools.lru_cache(maxsize=None)
@@ -48,7 +49,7 @@ def _policy_fn(config: SolverConfig, dtype_name: str, mesh=None, mesh_axes=None)
     def cell(beta, u, r, p, kappa, lam, eta, delta, t0, t1, x0):
         ls = solve_learning(_TracedLearning(beta=beta, tspan=(t0, t1), x0=x0), config, dtype=dtype)
         res = solve_equilibrium_interest_core(ls, u, p, kappa, lam, eta, r, delta, t1, config)
-        return res.base.xi, res.base.aw_max, res.base.status
+        return res.base.xi, res.base.aw_max, res.base.status, res.base.health
 
     bcast = (None,) * 8
     fn = jax.vmap(  # β axis
@@ -163,12 +164,13 @@ def policy_sweep_interest(
         "sweeps.policy_interest",
         n_beta=n_b, n_u=n_u, n_r=n_r, dtype=dtype.name, sharded=mesh is not None,
     ) as sp:
-        xi, aw_max, status = obs.jit_call(
+        xi, aw_max, status, health = obs.jit_call(
             "sweeps.policy_interest", fn, beta_values, u_values, r_values, *scalars
         )
         sp.sync(status)
     metrics().inc("sweeps.policy_interest.cells", n_b * n_u * n_r)
     obs.log_status("sweeps.policy_interest", status)
+    obs.log_health("sweeps.policy_interest", health, status)
     return PolicySweepResult(
         beta_values=beta_values,
         u_values=u_values,
@@ -176,4 +178,5 @@ def policy_sweep_interest(
         xi=xi,
         aw_max=aw_max,
         status=status,
+        health=health,
     )

@@ -1,0 +1,497 @@
+"""Tests for the numerical-health diagnostics layer (`sbr_tpu.diag`,
+ISSUE 2 tentpole).
+
+Covers the acceptance criteria: degenerate rootfind inputs (non-bracketing
+bisection intervals, all-above/all-below crossing fallbacks, NaN-poisoned
+curves) surface `Health` flags instead of silently returning defaults;
+health riding the solver stacks changes no output value and causes no
+retrace when telemetry toggles; `report health` renders a run and exits
+nonzero on a deliberately NaN-poisoned sweep; `report gc` retention; the
+bench probe cache.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu import diag, obs
+from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
+from sbr_tpu.diag import (
+    DIVERGENT_MASK,
+    FALLBACK_IN_DEFAULT,
+    FALLBACK_IN_KNOT,
+    FALLBACK_OUT_DEFAULT,
+    FALLBACK_OUT_KNOT,
+    FP_NOT_CONVERGED,
+    NAN_INPUT,
+    NAN_OUTPUT,
+    NO_BRACKET,
+    NONFINITE_RESIDUAL,
+    Health,
+)
+from sbr_tpu.obs import report
+
+
+@pytest.fixture(autouse=True)
+def _no_active_run():
+    assert obs.current_run() is None
+    was_on = obs.metrics().enabled
+    yield
+    while obs.end_run() is not None:
+        pass
+    (obs.metrics().enable if was_on else obs.metrics().disable)()
+
+
+# -- core primitives: degenerate inputs --------------------------------------
+
+
+def test_bisect_health_clean_root():
+    f = lambda x: x**3 - 2.0
+    x_plain = bisect(f, jnp.asarray(0.0), jnp.asarray(2.0), num_iters=90)
+    x, h = bisect(f, jnp.asarray(0.0), jnp.asarray(2.0), num_iters=90, with_health=True)
+    assert float(x) == float(x_plain)  # health must not perturb the iterate
+    assert float(h.residual) < 1e-13
+    assert float(h.bracket_width) < 1e-13
+    assert int(h.iterations) == 90
+    assert int(h.flags) == 0
+
+
+def test_bisect_health_non_bracketing_interval():
+    # f > 0 on the whole interval: no sign change, the returned "root" is
+    # the bracket collapse point — NO_BRACKET must say so.
+    f = lambda x: x**2 + 1.0
+    x, h = bisect(f, jnp.asarray(1.0), jnp.asarray(2.0), num_iters=60, with_health=True)
+    assert int(h.flags) & NO_BRACKET
+    assert not (int(h.flags) & DIVERGENT_MASK)  # informational, not divergence
+    assert np.isfinite(float(x))
+
+
+def test_bisect_health_nan_poisoned():
+    f = lambda x: x - jnp.nan
+    x, h = bisect(f, jnp.asarray(0.0), jnp.asarray(1.0), num_iters=30, with_health=True)
+    flags = int(h.flags)
+    assert flags & NONFINITE_RESIDUAL
+    assert flags & DIVERGENT_MASK
+    x2, h2 = bisect(
+        lambda t: t - 0.5, jnp.asarray(jnp.nan), jnp.asarray(1.0), num_iters=30, with_health=True
+    )
+    assert int(h2.flags) & NAN_INPUT
+
+
+def test_crossing_health_fallback_ladder():
+    x = jnp.linspace(0.0, 1.0, 64)
+    # all below the level -> default rung on both crossings
+    t, h = first_upcrossing(x, jnp.zeros(64), 0.5, 42.0, with_health=True)
+    assert float(t) == 42.0
+    assert int(h.flags) & FALLBACK_IN_DEFAULT
+    t, h = last_downcrossing(x, jnp.zeros(64), 0.5, 42.0, with_health=True)
+    assert float(t) == 42.0
+    assert int(diag.as_out_crossing(h).flags) & FALLBACK_OUT_DEFAULT
+    # all above the level -> first/last-knot rung
+    t, h = first_upcrossing(x, jnp.ones(64), 0.5, 42.0, with_health=True)
+    assert float(t) == 0.0
+    assert int(h.flags) & FALLBACK_IN_KNOT
+    t, h = last_downcrossing(x, jnp.ones(64), 0.5, 42.0, with_health=True)
+    assert float(t) == 1.0
+    assert int(diag.as_out_crossing(h).flags) & FALLBACK_OUT_KNOT
+    # genuine crossing -> no flags
+    y = 1.0 - (np.asarray(x) - 0.5) ** 2 * 8.0
+    t, has, h = first_upcrossing(x, jnp.asarray(y), 0.5, 42.0, return_flag=True, with_health=True)
+    assert bool(has) and int(h.flags) == 0
+
+
+def test_crossing_health_nan_poisoned_curve():
+    """A fully-NaN curve silently takes the `default` rung; the flags must
+    report the poison instead of letting it pass as a no-crossing."""
+    x = jnp.linspace(0.0, 1.0, 32)
+    t, h = first_upcrossing(x, jnp.full(32, jnp.nan), 0.5, 7.0, with_health=True)
+    assert float(t) == 7.0  # value semantics unchanged (reference fallback)
+    assert int(h.flags) & NAN_INPUT
+    assert int(h.flags) & DIVERGENT_MASK
+    # NaN level, clean curve
+    t, h = first_upcrossing(x, jnp.ones(32), jnp.nan, 7.0, with_health=True)
+    assert int(h.flags) & NAN_INPUT
+
+
+def test_rk4_and_quadrature_health():
+    from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre
+    from sbr_tpu.core.ode import rk4
+
+    ts = jnp.linspace(0.0, 1.0, 11)
+    ys, h = rk4(lambda t, y, a: -y, jnp.asarray(1.0), ts, substeps=2, with_health=True)
+    assert int(h.flags) == 0 and int(h.iterations) == 20
+    ys, h = rk4(lambda t, y, a: -y, jnp.asarray(jnp.nan), ts, with_health=True)
+    assert int(h.flags) & NAN_INPUT and int(h.flags) & NAN_OUTPUT
+
+    out, h = cumtrapz(jnp.ones(16), dx=0.1, with_health=True)
+    assert int(h.flags) == 0 and int(h.iterations) == 15
+    out, h = cumtrapz(jnp.full(16, jnp.nan), dx=0.1, with_health=True)
+    assert int(h.flags) & NAN_INPUT
+
+    grid = jnp.linspace(0.0, 1.0, 9)
+    out, h = cumulative_gauss_legendre(lambda t: jnp.exp(t), grid, with_health=True)
+    assert int(h.flags) == 0
+    out, h = cumulative_gauss_legendre(
+        lambda t: jnp.full_like(t, jnp.nan), grid, with_health=True
+    )
+    assert int(h.flags) & NAN_INPUT
+
+
+def test_or_reduce_flags_matches_elementwise_or():
+    flags = jnp.asarray([FALLBACK_IN_KNOT, NO_BRACKET, 0, NAN_INPUT | NO_BRACKET], jnp.int32)
+    got = int(diag.or_reduce_flags(flags))
+    assert got == FALLBACK_IN_KNOT | NO_BRACKET | NAN_INPUT
+
+
+def test_health_merge():
+    a = Health.empty(jnp.float64).replace(
+        residual=jnp.asarray(1e-9), flags=jnp.int32(FALLBACK_IN_KNOT)
+    )
+    b = Health.empty(jnp.float64).replace(
+        residual=jnp.asarray(1e-3),
+        iterations=jnp.int32(90),
+        flags=jnp.int32(NO_BRACKET),
+    )
+    m = a.merge(b)
+    assert float(m.residual) == 1e-3  # fmax ignores the NaN slots
+    assert int(m.iterations) == 90
+    assert int(m.flags) == FALLBACK_IN_KNOT | NO_BRACKET
+
+
+# -- solver stacks -----------------------------------------------------------
+
+
+def _solve_config():
+    from sbr_tpu.models.params import SolverConfig
+
+    return SolverConfig(n_grid=128, bisect_iters=40)
+
+
+def test_baseline_result_carries_health():
+    from sbr_tpu import make_model_params, solve_learning
+    from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+
+    m = make_model_params()
+    cfg = _solve_config()
+    ls = solve_learning(m.learning, cfg)
+    res = solve_equilibrium_baseline(ls, m.economic, config=cfg)
+    assert res.health is not None
+    assert bool(res.bankrun)
+    assert not (int(res.health.flags) & DIVERGENT_MASK)
+    # achieved residual must agree with the reported tolerance field
+    assert float(res.health.residual) == pytest.approx(float(res.tolerance), abs=1e-12)
+
+
+def test_diagnostics_no_value_change_no_retrace(tmp_path):
+    """The acceptance criterion: health is always part of the traced
+    program, so toggling telemetry on/off neither changes any solver
+    output nor invalidates a traced jit cache (obs.metrics discipline)."""
+    from sbr_tpu import make_model_params, solve_learning
+    from sbr_tpu.baseline.solver import solve_equilibrium_core
+
+    m = make_model_params()
+    cfg = _solve_config()
+    ls = solve_learning(m.learning, cfg)
+    traces = []
+
+    @jax.jit
+    def solve(u):
+        traces.append(1)  # runs only at trace time
+        return solve_equilibrium_core(
+            ls, u, m.economic.p, m.economic.kappa, m.economic.lam,
+            m.economic.eta, ls.grid[-1], cfg,
+        )
+
+    u = jnp.asarray(m.economic.u)
+    res_off = solve(u)
+    assert len(traces) == 1
+    with obs.run_context(run_dir=str(tmp_path / "r")):
+        res_on = solve(u)
+        obs.log_health("toggle", res_on.health, res_on.status)
+    res_off2 = solve(u)
+    assert len(traces) == 1, "telemetry toggle retraced the solver"
+    for a, b, c in zip(
+        jax.tree_util.tree_leaves(res_off),
+        jax.tree_util.tree_leaves(res_on),
+        jax.tree_util.tree_leaves(res_off2),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_sweep_health_grid_shapes_and_census(tmp_path):
+    import numpy as np
+
+    from sbr_tpu import make_model_params
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        grid = beta_u_grid(np.array([0.5, 1.0]), np.array([0.05, 0.1, 0.5]), m, config=cfg)
+    assert grid.health.residual.shape == (2, 3)
+    assert grid.health.flags.shape == (2, 3)
+    # run cells must be clean of divergent flags, and the census must agree
+    flags = np.asarray(grid.health.flags)
+    assert not np.any(flags & DIVERGENT_MASK)
+    events = [
+        json.loads(line)
+        for line in (run.run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    (health_ev,) = [e for e in events if e["kind"] == "health"]
+    assert health_ev["stage"] == "sweeps.beta_u_grid"
+    assert health_ev["cells"] == 6
+    assert health_ev["divergent"] == 0
+    assert "residual_hist" in health_ev
+    manifest = json.loads((run.run_dir / "manifest.json").read_text())
+    assert manifest["health"]["sweeps.beta_u_grid"]["cells"] == 6
+    assert manifest["health"]["sweeps.beta_u_grid"]["divergent"] == 0
+
+
+def test_social_fixed_point_health_flags_non_convergence():
+    from sbr_tpu import make_model_params
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.social.solver import solve_equilibrium_social
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=96, bisect_iters=30)
+    # starved iteration budget -> FP_NOT_CONVERGED must be flagged
+    res = solve_equilibrium_social(m, cfg, max_iter=3)
+    assert not bool(res.converged)
+    assert int(res.health.flags) & FP_NOT_CONVERGED
+    assert int(res.health.iterations) >= 3
+    # the default calibration's ξ search walks past η -> FP_ABORTED
+    res = solve_equilibrium_social(m, cfg, max_iter=250)
+    assert bool(res.aborted)
+    assert int(res.health.flags) & diag.FP_ABORTED
+    # converging calibration (test_social's Figure-12 config) -> clean flags
+    m_run = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+    res = solve_equilibrium_social(m_run, SolverConfig(n_grid=512), tol=1e-4, max_iter=500)
+    assert bool(res.converged)
+    assert not (int(res.health.flags) & (FP_NOT_CONVERGED | DIVERGENT_MASK))
+    assert float(res.health.residual) == pytest.approx(float(res.error))
+
+
+def test_hetero_health_clean_and_poisoned():
+    from sbr_tpu.hetero.learning import solve_learning_hetero
+    from sbr_tpu.hetero.solver import solve_equilibrium_hetero
+    from sbr_tpu.models.params import make_hetero_params
+
+    cfg = _solve_config()
+    hp = make_hetero_params(betas=(0.5, 1.0, 2.0), dist=(0.3, 0.4, 0.3))
+    lsh = solve_learning_hetero(hp.learning, cfg)
+    res = solve_equilibrium_hetero(lsh, hp.economic, cfg)
+    assert not (int(res.health.flags) & DIVERGENT_MASK)
+    assert float(res.health.residual) == pytest.approx(float(res.tolerance), abs=1e-12)
+    # poison one group's curves: the per-group crossing census must flag it
+    lsh_bad = lsh.replace(cdfs=lsh.cdfs.at[1].set(jnp.nan), pdfs=lsh.pdfs.at[1].set(jnp.nan))
+    res_bad = solve_equilibrium_hetero(lsh_bad, hp.economic, cfg)
+    assert int(res_bad.health.flags) & NAN_INPUT
+
+
+# -- summarize + report health CLI -------------------------------------------
+
+
+def test_summarize_worst_cells_and_divergence():
+    h = Health(
+        residual=jnp.asarray([1e-8, jnp.nan, 0.3]),
+        bracket_width=jnp.asarray([1e-12, jnp.nan, 1.0]),
+        iterations=jnp.asarray([90, 0, 90], jnp.int32),
+        flags=jnp.asarray([0, NAN_INPUT, NO_BRACKET], jnp.int32),
+    )
+    s = diag.summarize(h, status=jnp.asarray([0, 1, 2], jnp.int32))
+    assert s["cells"] == 3
+    assert s["divergent"] == 1
+    assert s["flag_counts"] == {"no_bracket": 1, "nan_input": 1}
+    # the NO_ROOT cell's 0.3 is an expected-degenerate residual and must
+    # NOT pollute max_residual; only the RUN cell's counts
+    assert s["max_residual"] == pytest.approx(1e-8)
+    # the divergent cell ranks first even with a NaN residual
+    assert s["worst_cells"][0]["index"] == [1]
+    assert s["worst_cells"][0]["flags"] == ["nan_input"]
+    assert s["worst_cells"][0]["status"] == "NO_CROSSING"
+    # the degenerate cell still appears (it carries a flag) but residual-less
+    no_root = [c for c in s["worst_cells"] if c["index"] == [2]]
+    assert no_root and no_root[0]["residual"] is None
+
+
+def test_report_health_poisoned_run_exits_nonzero(tmp_path, capsys):
+    """ISSUE 2 acceptance: a deliberately NaN-poisoned sweep must flag and
+    `report health` must exit nonzero so CI can gate on it."""
+    import numpy as np
+
+    from sbr_tpu import make_model_params
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+    with obs.run_context(run_dir=str(tmp_path / "bad")) as run:
+        beta_u_grid(np.array([0.5, np.nan]), np.array([0.05, 0.1]), m, config=cfg)
+    rc = report.main(["health", str(run.run_dir)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "DIVERGENCE DETECTED" in out
+    assert "nan_input" in out
+    assert "NaN CENSUS" in out
+
+
+def test_report_health_clean_run_exits_zero(tmp_path, capsys):
+    import numpy as np
+
+    from sbr_tpu import make_model_params
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+    with obs.run_context(run_dir=str(tmp_path / "ok")) as run:
+        beta_u_grid(np.array([0.5, 1.0]), np.array([0.05, 0.1]), m, config=cfg)
+    rc = report.main(["health", str(run.run_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "OK" in out
+    assert "RESIDUAL HISTOGRAM" in out
+
+
+def test_report_health_without_health_events_exits_3(tmp_path, capsys):
+    with obs.run_context(run_dir=str(tmp_path / "empty")) as run:
+        obs.event("custom")
+    assert report.main(["health", str(run.run_dir)]) == 3
+
+
+def test_legacy_report_still_renders_health_block(tmp_path, capsys):
+    import numpy as np
+
+    from sbr_tpu import make_model_params
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        beta_u_grid(np.array([1.0]), np.array([0.1]), m, config=cfg)
+    assert report.main([str(run.run_dir)]) == 0
+    assert "HEALTH" in capsys.readouterr().out
+
+
+# -- retention (report gc + auto-prune) --------------------------------------
+
+
+def _mk_runs(root, n):
+    dirs = []
+    for i in range(n):
+        with obs.run_context(label=f"r{i}", root=str(root)) as run:
+            pass
+        (run.run_dir / "touch").write_text(str(i))
+        import os
+        import time
+
+        # distinct mtimes without sleeping a full second; gc recency reads
+        # the log files, not just the directory, so age those too
+        t = time.time() - (n - i) * 10
+        for p in (run.run_dir, run.run_dir / "events.jsonl", run.run_dir / "manifest.json"):
+            os.utime(p, (t, t))
+        dirs.append(run.run_dir)
+    return dirs
+
+
+def test_report_gc_keeps_most_recent(tmp_path, capsys):
+    dirs = _mk_runs(tmp_path, 4)
+    assert report.main(["gc", str(tmp_path), "--keep", "2"]) == 0
+    remaining = sorted(d.name for d in tmp_path.iterdir() if d.is_dir())
+    assert [d.name for d in dirs[2:]] == remaining
+    assert "removed 2 run dir(s)" in capsys.readouterr().out
+
+
+def test_gc_runs_skips_active_and_foreign_dirs(tmp_path):
+    from sbr_tpu.obs.runlog import gc_runs
+
+    _mk_runs(tmp_path, 2)
+    (tmp_path / "not_a_run").mkdir()  # no manifest.json: not ours to delete
+    active = obs.start_run(root=str(tmp_path), label="active")
+    removed = gc_runs(tmp_path, keep=0)
+    obs.end_run()
+    assert active.run_dir.exists()
+    assert (tmp_path / "not_a_run").exists()
+    assert len(removed) == 2
+
+
+def test_gc_runs_protects_other_process_live_run(tmp_path):
+    """A manifest still in status "running" with recent activity belongs to
+    ANOTHER process's open run (this process's stack can't vouch for it) —
+    gc must leave it alone; once stale past the grace window it is a
+    crashed run's leftovers and is collectable (code-review finding)."""
+    import os
+    import time
+
+    from sbr_tpu.obs.runlog import gc_runs
+
+    live = tmp_path / "live_run"
+    live.mkdir()
+    (live / "manifest.json").write_text(json.dumps({"status": "running"}))
+    (live / "events.jsonl").write_text("{}\n")
+    assert gc_runs(tmp_path, keep=0) == []
+    assert live.exists()
+    # stale: no activity for longer than the grace window -> collectable
+    t = time.time() - 10_000.0
+    for p in (live, live / "manifest.json", live / "events.jsonl"):
+        os.utime(p, (t, t))
+    removed = gc_runs(tmp_path, keep=0, running_grace_s=3600.0)
+    assert removed and not live.exists()
+
+
+def test_auto_prune_on_finalize(tmp_path):
+    _mk_runs(tmp_path, 3)
+    run = obs.start_run(root=str(tmp_path), label="pruner", auto_prune_keep=1)
+    obs.end_run()
+    dirs = [d for d in tmp_path.iterdir() if d.is_dir()]
+    # the pruning run itself + 1 kept survivor
+    assert len(dirs) == 2
+    assert run.run_dir.exists()
+
+
+# -- bench probe cache -------------------------------------------------------
+
+
+def test_probe_cache_skips_ladder(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setenv("SBR_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("SBR_BENCH_PROBE_CACHE_TTL_S", "900")
+
+    def boom(timeout):
+        raise AssertionError("probe ladder must not run on a cache hit")
+
+    bench._write_probe_cache("cpu", [{"attempt": 1, "outcome": "ok"}])
+    monkeypatch.setattr(bench, "_probe_accelerator", boom)
+    platform, history = bench._probe_loop()
+    assert platform == "cpu"
+    assert history[0]["cached"] is True
+
+    # expired cache -> the ladder runs again
+    stale = json.loads(bench._probe_cache_path().read_text())
+    stale["ts"] -= 10_000
+    bench._probe_cache_path().write_text(json.dumps(stale))
+    monkeypatch.setattr(bench, "_probe_accelerator", lambda t: ("tpu", "ok", 0.1))
+    platform, history = bench._probe_loop()
+    assert platform == "tpu"
+    assert history[0].get("cached") is None
+    # and the fresh outcome was re-cached
+    assert json.loads(bench._probe_cache_path().read_text())["platform"] == "tpu"
+
+
+def test_probe_cache_disabled_by_zero_ttl(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setenv("SBR_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("SBR_BENCH_PROBE_CACHE_TTL_S", "0")
+    bench._write_probe_cache("cpu", [])
+    assert not bench._probe_cache_path().exists()
+    monkeypatch.setattr(bench, "_probe_accelerator", lambda t: ("tpu", "ok", 0.1))
+    platform, _ = bench._probe_loop()
+    assert platform == "tpu"
