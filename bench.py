@@ -60,6 +60,19 @@ compile/execute split, device kind, and memory peak. Measurement loops run
 with telemetry suspended, so metrics are unchanged by instrumentation.
 `python bench.py --dry-run` smokes the whole pipeline on CPU at tiny sizes
 in-process and renders with `python -m sbr_tpu.obs.report <run_dir>`.
+
+Performance observatory (PR 3): every probe/measure history entry now has
+ONE uniform, versioned shape (`"schema": 1` — phase, attempt, outcome,
+platform, duration_s, timeout_s, backoff_s), mirrored into obs `probe`
+events; each measure child appends its headline metrics (equilibria/sec,
+agent-steps/sec, compile/dispatch splits, health divergent-count) to the
+append-only perf history (`SBR_OBS_HISTORY`, default
+benchmarks/bench_history.jsonl — tiny smoke runs skip unless the env var
+is set), gated in CI by `python -m sbr_tpu.obs.report trend --check`;
+and `SBR_OBS_PROFILE=1` captures a size-bounded `jax.profiler` trace of
+one steady-state rep per workload into the run directory (summarized as a
+`profile` event; the old always-on SBR_BENCH_TRACE_DIR capture is
+superseded by this opt-in path).
 """
 
 from __future__ import annotations
@@ -173,6 +186,39 @@ def _obs_event(kind: str, **fields) -> None:
         _log(f"obs event failed (non-fatal): {err!r}")
 
 
+# Version of the probe/measure history record shape (ISSUE 3 satellite:
+# probe and measure entries used to carry different key sets; now every
+# entry has the same keys, and consumers can key on the schema number).
+PROBE_HISTORY_SCHEMA = 1
+
+
+def _history_entry(
+    phase: str,
+    outcome: str,
+    platform: str = None,
+    attempt: int = 0,
+    duration_s: float = 0.0,
+    timeout_s: float = 0.0,
+    backoff_s: float = 0.0,
+    **extra,
+) -> dict:
+    """One uniform probe/measure history record — identical key set for
+    every phase (missing numerics are 0.0, missing platform None), plus
+    phase-specific extras (cached/forced/watch_attempt) appended after."""
+    entry = {
+        "schema": PROBE_HISTORY_SCHEMA,
+        "phase": phase,
+        "attempt": int(attempt),
+        "outcome": outcome,
+        "platform": platform or None,
+        "duration_s": round(float(duration_s), 1),
+        "timeout_s": round(float(timeout_s), 1),
+        "backoff_s": round(float(backoff_s), 1),
+    }
+    entry.update(extra)
+    return entry
+
+
 def _probe_cache_path() -> Path:
     return Path(os.environ.get("SBR_OBS_DIR", "obs_runs")) / ".probe_cache.json"
 
@@ -251,12 +297,14 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
     line's `extra.probe_history`."""
     cached = _read_probe_cache()
     if cached is not None:
-        entry = {
-            "cached": True,
-            "platform": cached["platform"],
-            "age_s": cached["age_s"],
-            "ttl_s": _probe_cache_ttl_s(),
-        }
+        entry = _history_entry(
+            "probe",
+            "cached",
+            platform=cached["platform"],
+            cached=True,
+            age_s=cached["age_s"],
+            ttl_s=_probe_cache_ttl_s(),
+        )
         _obs_event("probe", **entry)
         _log(
             f"probe cache hit ({cached['age_s']:.0f}s old): "
@@ -274,28 +322,34 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
             _log("probe budget exhausted before attempt — skipping")
             break
         platform, outcome, dur = _probe_accelerator(eff_timeout)
+        # ADVICE r4: count the upcoming backoff sleep against the budget
+        # check, so backoffs cannot push the run past SBR_BENCH_BUDGET_S.
+        # The backoff decision is made BEFORE the entry is recorded so the
+        # JSON history and the mirrored obs `probe` event carry the same
+        # backoff_s (the event used to fire before the field was set).
+        backoff = 10.0 * (2 ** (attempt - 1))
+        budget_left = budget is None or budget.remaining() >= 60.0 + backoff
+        will_sleep = not platform and attempt < attempts and budget_left
         history.append(
-            {
-                "attempt": attempt,
-                "timeout_s": eff_timeout,
-                "duration_s": round(dur, 1),
-                "outcome": outcome,
-                "platform": platform or None,
-            }
+            _history_entry(
+                "probe",
+                outcome,
+                platform=platform,
+                attempt=attempt,
+                duration_s=dur,
+                timeout_s=eff_timeout,
+                backoff_s=backoff if will_sleep else 0.0,
+            )
         )
         _obs_event("probe", **history[-1])
         if platform:
             break
-        backoff = 10.0 * (2 ** (attempt - 1))
-        # ADVICE r4: count the upcoming backoff sleep against the budget
-        # check, so backoffs cannot push the run past SBR_BENCH_BUDGET_S
-        if budget is not None and budget.remaining() < 60.0 + backoff:
+        if not budget_left:
             _log("probe budget exhausted — skipping remaining attempts")
             break
-        if attempt < attempts:
+        if will_sleep:
             _log(f"probe attempt {attempt}/{attempts} failed; backing off {backoff:.0f}s")
             time.sleep(backoff)
-            history[-1]["backoff_s"] = backoff
     if not platform:
         platform = "cpu"
         _log("accelerator unreachable after all probes — falling back to CPU")
@@ -384,35 +438,33 @@ def run_harness(script: str = None, fallback: dict = None) -> None:
     budget = _Budget()
     forced = os.environ.get("SBR_BENCH_PLATFORM", "").strip().lower()
     if forced:
-        platform, history = forced, [{"forced": forced}]
+        platform, history = forced, [
+            _history_entry("probe", "forced", platform=forced, forced=True)
+        ]
     else:
         platform, history = _probe_loop(budget)
 
     measure_timeout = float(os.environ.get("SBR_BENCH_MEASURE_TIMEOUT_S", "2700"))
-    result, outcome, dur = _run_measurement(
-        platform, budget.clamp(measure_timeout, floor_s=60.0), script
-    )
+    eff_timeout = budget.clamp(measure_timeout, floor_s=60.0)
+    result, outcome, dur = _run_measurement(platform, eff_timeout, script)
     history.append(
-        {
-            "phase": "measure",
-            "platform": platform,
-            "outcome": outcome,
-            "duration_s": round(dur, 1),
-        }
+        _history_entry(
+            "measure", outcome, platform=platform, attempt=1,
+            duration_s=dur, timeout_s=eff_timeout,
+        )
     )
+    _obs_event("probe", **history[-1])
     if result is None and platform != "cpu":
         _log("accelerator measurement failed — re-running pinned to CPU")
-        result, outcome, dur = _run_measurement(
-            "cpu", budget.clamp(measure_timeout, floor_s=60.0), script
-        )
+        eff_timeout = budget.clamp(measure_timeout, floor_s=60.0)
+        result, outcome, dur = _run_measurement("cpu", eff_timeout, script)
         history.append(
-            {
-                "phase": "measure",
-                "platform": "cpu",
-                "outcome": outcome,
-                "duration_s": round(dur, 1),
-            }
+            _history_entry(
+                "measure", outcome, platform="cpu", attempt=2,
+                duration_s=dur, timeout_s=eff_timeout,
+            )
         )
+        _obs_event("probe", **history[-1])
     if result is None:
         result = dict(fallback or {})
         result.setdefault("extra", {})["error"] = "all measurement children failed"
@@ -457,9 +509,15 @@ def watch(max_attempts: int, interval_s: float) -> int:
             }
             if result is not None and measured not in ("", "cpu"):
                 result.setdefault("extra", {})["probe_history"] = [
-                    {"watch_attempt": attempt, "outcome": outcome, "duration_s": round(dur, 1)},
-                    {"phase": "measure", "platform": measured, "outcome": m_outcome,
-                     "duration_s": round(m_dur, 1)},
+                    _history_entry(
+                        "probe", outcome, platform=platform, attempt=attempt,
+                        duration_s=dur, timeout_s=probe_timeout,
+                        watch_attempt=attempt,
+                    ),
+                    _history_entry(
+                        "measure", m_outcome, platform=measured, attempt=1,
+                        duration_s=m_dur, timeout_s=measure_timeout,
+                    ),
                 ]
                 entry["value"] = result.get("value")
                 _persist_capture(result)
@@ -510,12 +568,62 @@ def _init_child_backend(platform: str):
     return devices
 
 
+def _append_history(result: dict, obs_run=None, label: str = "bench") -> None:
+    """Append this measurement's headline metrics to the perf history
+    (`sbr_tpu.obs.history`): equilibria/sec, agent-steps/sec, compile and
+    dispatch splits, and the run's health divergent-count. Runs in the
+    MEASURE CHILD (jax already up there; the parent stays off the sbr_tpu
+    import path). Tiny smoke runs skip unless SBR_OBS_HISTORY is set — the
+    test suite must not pollute the committed benchmarks history."""
+    if _tiny() and not os.environ.get("SBR_OBS_HISTORY", "").strip():
+        return
+    try:
+        from sbr_tpu.obs import history
+
+        metrics = history.bench_metrics(result)
+        if obs_run is not None:
+            metrics["health_divergent"] = sum(
+                int(v.get("divergent", 0)) for v in obs_run.health.values()
+            )
+        env_path = os.environ.get("SBR_OBS_HISTORY", "").strip()
+        path = history.append(
+            metrics,
+            label=label,
+            platform=(result.get("extra") or {}).get("platform"),
+            path=env_path or _benchmarks_dir() / "bench_history.jsonl",
+        )
+        _log(f"perf history appended -> {path}")
+    except Exception as err:  # the history must never sink the measurement
+        _log(f"perf history append failed (non-fatal): {err!r}")
+
+
 def _tiny() -> bool:
     """SBR_BENCH_SIZES=tiny shrinks every workload to smoke-test scale so the
     harness itself (probe → child → JSON) can be exercised in seconds — the
     driver depends on this script emitting valid JSON at round end, so the
     test suite runs the whole pipeline at tiny sizes."""
     return os.environ.get("SBR_BENCH_SIZES", "").strip().lower() == "tiny"
+
+
+def _profile_rep(label: str, step: int, rep_fn) -> None:
+    """Opt-in profiler capture (SBR_OBS_PROFILE=1) of ONE steady-state rep:
+    the XLA-level breakdown lands in a size-bounded xplane trace inside the
+    obs run directory (pruned with it by the gc machinery) with a compact
+    `profile` summary event. The rep runs with telemetry suspended —
+    jit_call's per-call fence must not reshape the profiled dispatch — and a
+    StepTraceAnnotation frames it on the timeline. Profiling must never
+    sink the measurement: any failure here is logged and swallowed (the
+    metrics are already in hand when this runs)."""
+    from sbr_tpu import obs
+
+    try:
+        with obs.profile(label) as trace_dir:
+            if trace_dir is not None:
+                with obs.suspended(), obs.step_annotation(step, f"{label}.rep"):
+                    rep_fn()
+                _log(f"profiler trace captured: {trace_dir}")
+    except Exception as err:
+        _log(f"profiler capture failed (non-fatal): {err!r}")
 
 
 def pipelined_time(dispatch, start_rep: int, n_pipe: int | None = None):
@@ -557,7 +665,6 @@ def bench_grid(platform: str) -> dict:
 
     from sbr_tpu.models.params import SolverConfig, make_model_params
     from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
-    from sbr_tpu.utils import timing
 
     if _tiny():
         n_beta, n_u = 8, 8
@@ -621,18 +728,7 @@ def bench_grid(platform: str) -> dict:
         pipelined_s, n_pipe = pipelined_time(dispatch, start_rep=5)
     elapsed = min(dispatch_s, pipelined_s)
 
-    # Profiler capture around ONE steady-state rep (SURVEY §5.1; VERDICT r1
-    # task 5): the XLA-level compile/execute breakdown lands in an xplane
-    # trace a human can open in XProf/TensorBoard; the wall-clock split is
-    # summarized here from the first-call-minus-steady delta.
-    trace_dir = os.environ.get("SBR_BENCH_TRACE_DIR", "/tmp/sbr_bench_trace")
-    try:
-        with obs.suspended(), timing.trace(trace_dir):
-            run(5)
-        n_trace = sum(1 for _ in Path(trace_dir).rglob("*") if _.is_file())
-        _log(f"profiler trace captured: {trace_dir} ({n_trace} files)")
-    except Exception as err:  # profiling must never sink the measurement
-        _log(f"profiler trace skipped: {err!r}")
+    _profile_rep("bench.grid", 5, lambda: run(5))
 
     n_cells = n_beta * n_u
     n_run = int(np.sum(np.asarray(grid.status) == 0))
@@ -698,6 +794,7 @@ def bench_agents(platform: str) -> dict:
         _, _ = run(seed)
         times.append(time.perf_counter() - t0)
     elapsed = min(times)
+    _profile_rep("bench.agents", 3, lambda: run(3))
     # engine observability in the artifact: which steps were full recounts
     # (telemetry is seed-stable at this shape in aggregate; seed 0's count
     # documents the capture's engine behavior)
@@ -786,6 +883,9 @@ def measure(platform: str) -> None:
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")
+    # Perf history (ISSUE 3): this measurement's headline metrics become one
+    # appended line the `report trend` gate can baseline future runs against.
+    _append_history(out, obs_run)
     print(json.dumps(out))
 
 

@@ -497,7 +497,16 @@ def _jitted_core(config: SolverConfig):
     telemetry path of the convenience entry: one compiled program whose
     compile/execute split `obs.jit_call` can attribute (AOT lower/compile),
     where the eager path's op-by-op dispatch has no compile step to time."""
-    return jax.jit(functools.partial(solve_equilibrium_core, config=config))
+
+    def core(ls, u, p, kappa, lam, eta, tspan_end):
+        # Trace-time retrace accounting (obs.prof): runs once per jit cache
+        # miss, never at execute time — churn detection with zero graph cost.
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("baseline.equilibrium")
+        return solve_equilibrium_core(ls, u, p, kappa, lam, eta, tspan_end, config)
+
+    return jax.jit(core)
 
 
 def solve_equilibrium_baseline(

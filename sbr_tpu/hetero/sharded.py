@@ -76,6 +76,15 @@ def solve_hetero_sharded(
         x0_c = jnp.asarray(x0, dtype=dtype)
 
     def fn(betas_l, dist_l, *tabs):
+        # Trace-time retrace accounting (obs.prof). NOTE the enclosing jit
+        # is rebuilt per call (closure over params/mesh), so every
+        # solve_hetero_sharded call re-traces — the counter makes that cost
+        # visible in the run manifest, but with an unbounded budget: the
+        # per-call retrace is this entry point's known shape, not churn, so
+        # it must not trip the over-budget warning on healthy runs.
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("hetero.sharded", budget=1 << 30)
         if exact:
             lsh = hetero_solution_from_omega(betas_l, dist_l, x0_c, *tabs)
         else:

@@ -193,23 +193,28 @@ def solve_equilibrium_interest(
 
     from sbr_tpu.baseline.solver import _stamp_solve_time
 
+    from sbr_tpu import obs
+
     t0 = time.perf_counter()
     if tspan_end is None:
         tspan_end = ls.grid[-1]
-    res = solve_equilibrium_interest_core(
-        ls,
-        econ.u,
-        econ.p,
-        econ.kappa,
-        econ.lam,
-        econ.eta,
-        econ.r,
-        econ.delta,
-        tspan_end,
-        config,
-    )
+    # Whole-solve span (parity with baseline.equilibrium): the per-stage
+    # spans inside the core nest under it, and with SBR_OBS_PROFILE=1 the
+    # solve is one TraceAnnotation block on the profiler timeline.
+    with obs.span("interest.equilibrium") as sp:
+        res = solve_equilibrium_interest_core(
+            ls,
+            econ.u,
+            econ.p,
+            econ.kappa,
+            econ.lam,
+            econ.eta,
+            econ.r,
+            econ.delta,
+            tspan_end,
+            config,
+        )
+        sp.sync(res.base.xi, res.base.status)
     res = res.replace(base=_stamp_solve_time(res.base, t0))
-    from sbr_tpu import obs
-
     obs.log_health("interest.equilibrium", res.base.health, res.base.status)
     return res

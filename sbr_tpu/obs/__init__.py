@@ -13,9 +13,18 @@ per-step ABM counters are the design references from PAPERS.md):
   attribution, status-grid accounting, numerical-health censuses
   (`log_health`, fed by `sbr_tpu.diag`), memory snapshots, and run-dir
   retention (`gc_runs`, `SBR_OBS_KEEP`).
+- ``obs.prof``    — performance observatory: `jax.monitoring` compile
+  listeners (per-span XLA compile attribution), the per-jitted-function
+  retrace registry (`note_trace` + ``retrace`` warning events), and
+  opt-in profiler capture (`obs.profile`, ``SBR_OBS_PROFILE=1``) with
+  `TraceAnnotation`/`StepTraceAnnotation` stage framing.
+- ``obs.history`` — append-only perf history (``bench_history.jsonl``):
+  every bench run's headline metrics, trend rendering and the regression
+  gate (`report trend --check`).
 - ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
   a run directory or diffs two runs; the `health` subcommand renders and
-  gates on numerical health, `gc` prunes old run directories.
+  gates on numerical health, `trend` renders/gates the perf history, `gc`
+  prunes old run directories. Every subcommand takes ``--json``.
 
 Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
 land under ``SBR_OBS_DIR``, default ``obs_runs/``), or programmatically::
@@ -30,8 +39,12 @@ Disabled (the default), every instrumentation site is a single global read
 jit caches (asserted by tests/test_obs.py).
 """
 
+from sbr_tpu.obs import history, prof
 from sbr_tpu.obs.metrics import MetricsRegistry, metrics
+from sbr_tpu.obs.prof import annotate, note_trace, profile, step_annotation
 from sbr_tpu.obs.runlog import (
+    active_run,
+    active_span,
     RunContext,
     current_run,
     enabled,
@@ -52,19 +65,27 @@ __all__ = [
     "MetricsRegistry",
     "RunContext",
     "StageTimer",
+    "active_run",
+    "active_span",
+    "annotate",
     "current_run",
     "enabled",
     "end_run",
     "event",
     "fence",
     "gc_runs",
+    "history",
     "jit_call",
     "log_health",
     "log_status",
     "metrics",
+    "note_trace",
+    "prof",
+    "profile",
     "run_context",
     "span",
     "start_run",
+    "step_annotation",
     "suspended",
     "trace",
 ]

@@ -1,0 +1,387 @@
+"""Append-only performance history + trend/regression gating (ISSUE 3).
+
+The repo accumulates a perf trajectory on disk (``BENCH_r*.json``, obs run
+dirs) that nothing aggregates or gates — a silent 2× regression in
+``beta_u_grid_equilibria_per_sec`` would merge without a red signal. This
+module is the missing memory: every bench/sweep run appends one line of
+headline metrics to an append-only ``bench_history.jsonl`` (path from
+``SBR_OBS_HISTORY``, default ``benchmarks/bench_history.jsonl``), and the
+``report trend`` CLI renders per-metric timelines (sparkline + rolling-
+median baseline) and gates:
+
+    python -m sbr_tpu.obs.report trend [HISTORY]            # timelines
+    python -m sbr_tpu.obs.report trend --check --tolerance 0.15
+    # exit 0 flat/improving · 1 regression beyond tolerance · 3 missing or
+    # short history (a gate with nothing to compare must not pass silently)
+
+Regression semantics: the LATEST record is compared per metric against the
+rolling median of up to ``--window`` prior records from the SAME platform
+(a cpu-fallback bench must never read as a 100× tpu regression). Metric
+polarity is inferred from the name: ``*_per_sec``/throughput counts are
+higher-better; ``*_s`` durations, byte counts, and divergent-cell counts
+are lower-better. A lower-better metric whose baseline is 0 regresses on
+ANY increase (the health gate shape: one divergent cell is a signal, not a
+percentage).
+
+No jax import anywhere in this module — the trend gate runs on CI boxes
+and bench parents that must never wake an accelerator backend (same
+contract as ``obs.report``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+SCHEMA = 1
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def history_path(path=None) -> Path:
+    """Resolve the history file: explicit arg > SBR_OBS_HISTORY env >
+    ``benchmarks/bench_history.jsonl`` (the committed perf trajectory)."""
+    if path:
+        return Path(path)
+    env = os.environ.get("SBR_OBS_HISTORY", "").strip()
+    return Path(env) if env else Path("benchmarks/bench_history.jsonl")
+
+
+def append(metrics: dict, label: str = "bench", platform: Optional[str] = None,
+           path=None, meta: Optional[dict] = None) -> Path:
+    """Append one history record (single buffered write — concurrent
+    appenders interleave whole lines on POSIX). Non-finite and non-numeric
+    metric values are dropped: the history carries only gateable numbers."""
+    clean = {}
+    for k, v in (metrics or {}).items():
+        if isinstance(v, bool):
+            v = int(v)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            clean[str(k)] = v
+    rec = {
+        "schema": SCHEMA,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "label": label,
+        "platform": platform,
+        "metrics": clean,
+    }
+    if meta:
+        rec["meta"] = meta
+    p = history_path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with open(p, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    return p
+
+
+def load(path=None) -> list:
+    """Parse a history file into record dicts, in file order; unparseable
+    or schema-less lines are skipped (an append-only log must tolerate a
+    torn tail write)."""
+    p = history_path(path)
+    records = []
+    try:
+        lines = p.read_text().splitlines()
+    except OSError:
+        return records
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("metrics"), dict):
+            records.append(rec)
+    return records
+
+
+def bench_metrics(result: dict) -> dict:
+    """Headline metrics from one bench-JSON result dict (the ``extra``
+    layout of bench.py / benchmarks/*.py): primary metric under its own
+    name, throughput/duration extras, and the obs compile/execute split."""
+    out = {}
+    value = result.get("value")
+    if isinstance(value, (int, float)):
+        out[str(result.get("metric") or "value")] = value
+    extra = result.get("extra") or {}
+    for key in (
+        "agent_steps_per_sec",
+        "grid_first_call_s",
+        "grid_dispatch_s",
+        "grid_pipelined_s",
+        "agents_steady_s",
+        "agents_prep_s",
+    ):
+        v = extra.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = v
+    obs_blk = extra.get("obs") or {}
+    for src, dst in (
+        ("compile_s", "obs_compile_s"),
+        ("execute_s", "obs_execute_s"),
+        ("xla_backend_compile_s", "xla_backend_compile_s"),
+    ):
+        v = obs_blk.get(src)
+        if isinstance(v, (int, float)):
+            out[dst] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Trend analysis
+# ---------------------------------------------------------------------------
+
+
+def polarity(metric: str) -> int:
+    """+1 when higher is better (throughput), -1 when lower is better
+    (durations, byte counts, divergence counts)."""
+    m = metric.lower()
+    if m.endswith("_per_sec") or "per_sec" in m or "throughput" in m:
+        return 1
+    if m.endswith("_s") or m.endswith("_bytes") or "divergent" in m or "retrace" in m:
+        return -1
+    return 1
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _same_platform(records: list, platform) -> list:
+    """Records comparable to ``platform``: exact matches, plus records
+    that never recorded one (legacy lines gate against everything)."""
+    return [r for r in records if r.get("platform") in (platform, None)]
+
+
+def check(records: list, tolerance: float = 0.15, min_points: int = 3,
+          window: int = 5, metrics_filter=None) -> tuple:
+    """Regression verdicts for the latest record vs a rolling-median
+    baseline of up to ``window`` prior same-platform records.
+
+    Returns ``(verdicts, status)`` where status is "ok", "regression", or
+    "short" (no metric reached ``min_points`` records — the gate has
+    nothing trustworthy to compare). Per-metric verdicts carry latest /
+    baseline / signed relative change / direction / status.
+    """
+    if not records:
+        return {}, "short"
+    latest = records[-1]
+    prior = _same_platform(records[:-1], latest.get("platform"))
+    verdicts = {}
+    gateable = 0
+    for metric, value in sorted((latest.get("metrics") or {}).items()):
+        if metrics_filter and metric not in metrics_filter:
+            continue
+        hist = [
+            r["metrics"][metric]
+            for r in prior
+            if isinstance(r["metrics"].get(metric), (int, float))
+        ]
+        n = len(hist) + 1
+        if n < min_points:
+            verdicts[metric] = {"latest": value, "n": n, "status": "short"}
+            continue
+        gateable += 1
+        base = _median(hist[-window:])
+        pol = polarity(metric)
+        direction = "higher_better" if pol > 0 else "lower_better"
+        if base == 0:
+            # Relative change is undefined; for lower-better counts (e.g.
+            # health_divergent) any increase from a clean baseline regresses.
+            change = None
+            regressed = pol < 0 and value > 0
+        else:
+            change = (value - base) / abs(base)
+            worsening = -change if pol > 0 else change
+            regressed = worsening > tolerance
+        verdicts[metric] = {
+            "latest": value,
+            "baseline": base,
+            "n": n,
+            "change": None if change is None else round(change, 4),
+            "direction": direction,
+            "status": "regression" if regressed else "ok",
+        }
+    if gateable == 0:
+        return verdicts, "short"
+    status = (
+        "regression"
+        if any(v["status"] == "regression" for v in verdicts.values())
+        else "ok"
+    )
+    return verdicts, status
+
+
+def sparkline(values: list, width: int = 24) -> str:
+    """Unicode sparkline of a series, downsampled to ``width`` points."""
+    vals = [v for v in values if isinstance(v, (int, float)) and math.isfinite(v)]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [vals[min(int(i * step), len(vals) - 1)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    if hi == lo:
+        return _SPARK[3] * len(vals)
+    return "".join(_SPARK[int((v - lo) / (hi - lo) * (len(_SPARK) - 1))] for v in vals)
+
+
+def _fmt_val(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_trend(records: list, window: int = 5, metrics_filter=None) -> str:
+    """Per-metric timeline table, grouped by platform: count, latest value,
+    rolling-median baseline of the prior window, signed change, sparkline."""
+    if not records:
+        return "no history records"
+    from sbr_tpu.obs.report import _table  # shared table renderer (jax-free)
+
+    out = [
+        f"history  {len(records)} record(s)   "
+        f"{records[0].get('ts', '?')} .. {records[-1].get('ts', '?')}"
+    ]
+    platforms = sorted({r.get("platform") or "-" for r in records})
+    for platform in platforms:
+        recs = [r for r in records if (r.get("platform") or "-") == platform]
+        metric_names = sorted({m for r in recs for m in (r.get("metrics") or {})})
+        rows = []
+        for metric in metric_names:
+            if metrics_filter and metric not in metrics_filter:
+                continue
+            series = [
+                r["metrics"][metric]
+                for r in recs
+                if isinstance(r["metrics"].get(metric), (int, float))
+            ]
+            if not series:
+                continue
+            base = _median(series[:-1][-window:]) if len(series) > 1 else None
+            change = (
+                f"{100 * (series[-1] - base) / abs(base):+.1f}%"
+                if base not in (None, 0)
+                else "-"
+            )
+            arrow = "↑" if polarity(metric) > 0 else "↓"
+            rows.append(
+                [
+                    metric,
+                    arrow,
+                    len(series),
+                    _fmt_val(series[-1]),
+                    _fmt_val(base),
+                    change,
+                    sparkline(series),
+                ]
+            )
+        if rows:
+            out += ["", f"PLATFORM {platform}"]
+            out.append(
+                _table(
+                    ["metric", "good", "n", "latest", "baseline", "change", "trend"],
+                    rows,
+                )
+            )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# CLI (dispatched from `python -m sbr_tpu.obs.report trend ...`)
+# ---------------------------------------------------------------------------
+
+
+def main_trend(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report trend",
+        description="Render the perf history; with --check, gate on regressions "
+        "(exit 1 regression, 3 missing/short history)",
+    )
+    parser.add_argument(
+        "history", nargs="?", default=None,
+        help="history JSONL (default: $SBR_OBS_HISTORY or benchmarks/bench_history.jsonl)",
+    )
+    parser.add_argument("--check", action="store_true",
+                        help="gate: exit 1 on regression beyond tolerance")
+    parser.add_argument("--tolerance", type=float, default=0.15, metavar="FRAC",
+                        help="allowed relative worsening vs baseline (default 0.15)")
+    parser.add_argument("--window", type=int, default=5, metavar="N",
+                        help="rolling-median baseline window (default 5)")
+    parser.add_argument("--min-points", type=int, default=3, metavar="N",
+                        help="records required before a metric gates (default 3)")
+    parser.add_argument("--metric", action="append", default=None, metavar="NAME",
+                        help="restrict to metric NAME (repeatable)")
+    parser.add_argument("--platform", default=None,
+                        help="restrict to records from one platform")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    path = history_path(args.history)
+    records = load(path)
+    if args.platform:
+        records = _same_platform(records, args.platform)
+    if not records:
+        # Missing/empty history only FAILS the gate (--check exit 3); a
+        # render-only invocation on a fresh checkout is not an error.
+        code = 3 if args.check else 0
+        if args.json:
+            print(json.dumps({"history": str(path), "n_records": 0, "status": "short",
+                              "verdicts": {}, "exit": code}))
+        else:
+            print(f"no perf history at {path} — nothing to trend or gate",
+                  file=sys.stderr)
+        return code
+
+    verdicts, status = check(
+        records,
+        tolerance=args.tolerance,
+        min_points=args.min_points,
+        window=args.window,
+        metrics_filter=set(args.metric) if args.metric else None,
+    )
+    code = {"ok": 0, "regression": 1, "short": 3}[status] if args.check else 0
+    if args.json:
+        print(json.dumps({
+            "history": str(path),
+            "n_records": len(records),
+            "platform": records[-1].get("platform"),
+            "tolerance": args.tolerance,
+            "window": args.window,
+            "status": status,
+            "verdicts": verdicts,
+            "exit": code,
+        }))
+        return code
+
+    print(render_trend(records, window=args.window,
+                       metrics_filter=set(args.metric) if args.metric else None))
+    if args.check:
+        print()
+        if status == "short":
+            print(f"GATE: history too short (<{args.min_points} comparable records) "
+                  "— not gateable (exit 3)")
+        else:
+            bad = [m for m, v in verdicts.items() if v["status"] == "regression"]
+            for m in bad:
+                v = verdicts[m]
+                print(
+                    f"REGRESSION  {m}: {_fmt_val(v['latest'])} vs baseline "
+                    f"{_fmt_val(v['baseline'])} ({100 * v['change']:+.1f}%, "
+                    f"{v['direction']}, tolerance {100 * args.tolerance:.0f}%)"
+                )
+            if not bad:
+                print(f"GATE: ok — no metric regressed beyond "
+                      f"{100 * args.tolerance:.0f}% of its rolling-median baseline")
+    return code

@@ -1,0 +1,306 @@
+"""Compile/retrace tracking and profiler capture — the performance-
+observatory layer of the ``obs`` telemetry subsystem (ISSUE 3 tentpole).
+
+Three concerns, all host-side and all zero-overhead when dormant:
+
+- **Global compile tracking** (`install`): `jax.monitoring` duration
+  listeners fold every XLA compile phase (jaxpr trace, MLIR lowering,
+  backend compile) into process totals and — when a run is active — into
+  the run's ``xla`` manifest block, attributed to the innermost open
+  `obs.span`. Listeners are process-global and cannot be removed per-run
+  (``clear_event_listeners`` would nuke jax's own), so they install once
+  and route to ``runlog.active_run()`` at fire time. On jax builds without
+  `jax.monitoring` everything degrades to a no-op (`monitoring_available`
+  reports it, the manifest says so).
+- **Retrace registry** (`note_trace`): a per-jitted-function trace counter.
+  Call it at the top of the Python body of a function about to be
+  ``jax.jit``-ed: the body runs once per TRACE (a shape/dtype/static-arg
+  cache miss) and never at execute time, so the count is exactly jit's
+  miss count for that name — and, being pure host Python, it cannot change
+  the traced computation (asserted by tests/test_prof.py). When a run is
+  active and the within-run count exceeds the name's budget, a ``retrace``
+  warning event lands in the log: the signature of argument shape/dtype
+  churn silently recompiling a hot program.
+- **Profiler capture** (`profile`): a context manager around
+  ``jax.profiler.trace`` gated on ``SBR_OBS_PROFILE=1`` (or ``force=``).
+  The trace directory lives INSIDE the active run directory, so the
+  existing retention machinery (`report gc` / ``SBR_OBS_KEEP``) prunes
+  captures with their runs; a capture larger than
+  ``SBR_OBS_PROFILE_MAX_MB`` (default 256) is deleted on the spot and
+  recorded as pruned. A compact host-side summary (path, file count,
+  bytes, capture window) is emitted as a ``profile`` event and folded into
+  the manifest. `annotate`/`step_annotation` wrap solver stages and bench
+  reps in ``jax.profiler.TraceAnnotation``/``StepTraceAnnotation`` so the
+  xplane timeline carries the pipeline's stage names — both are no-ops
+  unless profiling is enabled, so the default path stays untouched.
+
+Nothing in this module imports jax at module scope: the bench parent and
+the report CLI can import it without waking an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+# Map of the jax.monitoring duration events we fold -> manifest keys.
+_COMPILE_EVENTS = {
+    "/jax/core/compile/jaxpr_trace_duration": "jaxpr_trace_s",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "mlir_lowering_s",
+    "/jax/core/compile/backend_compile_duration": "backend_compile_s",
+}
+
+_INSTALLED = False
+_MONITORING_OK: Optional[bool] = None
+# Process-lifetime totals (runs report deltas via their own aggregates).
+_TOTALS = {
+    "compiles": 0,
+    "jaxpr_trace_s": 0.0,
+    "mlir_lowering_s": 0.0,
+    "backend_compile_s": 0.0,
+}
+
+# Per-jitted-function trace counts (process-lifetime; runs snapshot at start
+# and report deltas) and per-name retrace budgets.
+_TRACE_COUNTS: dict = {}
+_TRACE_BUDGETS: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# Compile tracking (jax.monitoring listeners)
+# ---------------------------------------------------------------------------
+
+
+def _on_compile_duration(event: str, duration: float, **kw) -> None:
+    """Duration listener: fires on every XLA compile phase in the process.
+    Must never raise (jax would surface it mid-compile) and must be cheap
+    when no run is active — two dict ops."""
+    key = _COMPILE_EVENTS.get(event)
+    if key is None:
+        return
+    _TOTALS[key] += duration
+    if key == "backend_compile_s":
+        _TOTALS["compiles"] += 1
+    try:
+        from sbr_tpu.obs import runlog
+
+        run = runlog.active_run()  # never auto-starts from the env
+        if run is not None:
+            run._note_xla(key, float(duration), runlog.active_span())
+    except Exception:
+        pass
+
+
+def install() -> bool:
+    """Register the compile listeners once per process (idempotent).
+    Returns whether `jax.monitoring` is available; on jax builds without it
+    the observatory degrades gracefully to span/jit_call timing only."""
+    global _INSTALLED, _MONITORING_OK
+    if _INSTALLED:
+        return bool(_MONITORING_OK)
+    _INSTALLED = True
+    try:
+        import jax.monitoring as monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_compile_duration)
+        _MONITORING_OK = True
+    except Exception:
+        _MONITORING_OK = False
+    return bool(_MONITORING_OK)
+
+
+def monitoring_available() -> bool:
+    """True when the jax.monitoring listeners are installed and live."""
+    return bool(_MONITORING_OK) if _INSTALLED else False
+
+
+def compile_totals() -> dict:
+    """Process-lifetime XLA compile totals folded by the listeners."""
+    return dict(_TOTALS)
+
+
+# ---------------------------------------------------------------------------
+# Retrace registry
+# ---------------------------------------------------------------------------
+
+
+def _default_budget() -> int:
+    env = os.environ.get("SBR_OBS_RETRACE_BUDGET", "").strip()
+    return int(env) if env else 3
+
+
+def trace_budget(name: str) -> int:
+    return _TRACE_BUDGETS.get(name, _default_budget())
+
+
+def note_trace(name: str, budget: Optional[int] = None) -> int:
+    """Record one TRACE of the named jitted program; returns the new
+    process-lifetime count. Call sites place this at the top of the Python
+    body handed to ``jax.jit`` — see the module docstring for why that is
+    exactly a trace counter and can never perturb the computation. A
+    ``budget`` given here sticks for the name (first writer wins per call,
+    last writer overall)."""
+    n = _TRACE_COUNTS.get(name, 0) + 1
+    _TRACE_COUNTS[name] = n
+    if budget is not None:
+        _TRACE_BUDGETS[name] = int(budget)
+    try:
+        from sbr_tpu.obs import runlog
+
+        run = runlog.active_run()
+        if run is not None:
+            run._note_trace(name, n)
+    except Exception:
+        pass
+    return n
+
+
+def trace_counts() -> dict:
+    """Snapshot of the per-name process-lifetime trace counts."""
+    return dict(_TRACE_COUNTS)
+
+
+def reset_trace_counts() -> None:
+    """Test hook: forget all counts and budgets."""
+    _TRACE_COUNTS.clear()
+    _TRACE_BUDGETS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture + annotations
+# ---------------------------------------------------------------------------
+
+
+def profiling_enabled() -> bool:
+    """Opt-in flag for profiler capture and annotations (SBR_OBS_PROFILE=1).
+    Read per call — cheap, and tests/one-off shells can toggle it live."""
+    return os.environ.get("SBR_OBS_PROFILE", "").strip() not in ("", "0")
+
+
+def _profile_budget_bytes() -> int:
+    env = os.environ.get("SBR_OBS_PROFILE_MAX_MB", "").strip()
+    return int(float(env) * 1024 * 1024) if env else 256 * 1024 * 1024
+
+
+def _summarize_dir(d: Path) -> dict:
+    files = 0
+    total = 0
+    try:
+        for p in d.rglob("*"):
+            if p.is_file():
+                files += 1
+                total += p.stat().st_size
+    except OSError:
+        pass
+    return {"files": files, "bytes": total}
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """``jax.profiler.TraceAnnotation`` around a host-side stage — the
+    xplane timeline then carries the pipeline's span names. No-op (and
+    jax-import-free) unless profiling is enabled."""
+    if not profiling_enabled():
+        yield
+        return
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:
+        yield
+        return
+    with TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def step_annotation(step: int, name: str = "step"):
+    """``jax.profiler.StepTraceAnnotation`` for per-rep/step framing in
+    bench loops. No-op unless profiling is enabled."""
+    if not profiling_enabled():
+        yield
+        return
+    try:
+        from jax.profiler import StepTraceAnnotation
+    except Exception:
+        yield
+        return
+    with StepTraceAnnotation(name, step_num=int(step)):
+        yield
+
+
+@contextlib.contextmanager
+def profile(label: str = "capture", force: bool = False):
+    """Capture a size-bounded ``jax.profiler.trace`` for the enclosed block.
+
+    Yields the trace directory (a Path) while capturing, or None when
+    profiling is off (``SBR_OBS_PROFILE`` unset and not ``force``) or the
+    profiler is unavailable — callers use that to skip profile-only work::
+
+        with obs.profile("bench.grid") as trace_dir:
+            if trace_dir is not None:
+                run_one_rep()
+
+    The directory lands inside the active run dir (``<run>/profile/``), so
+    run retention prunes captures with their runs; with no run active it
+    falls back to ``SBR_OBS_PROFILE_DIR`` (default ``obs_profile/``). A
+    compact summary (path, files, bytes, window) is emitted as a
+    ``profile`` event and folded into the manifest; captures exceeding
+    ``SBR_OBS_PROFILE_MAX_MB`` are deleted and recorded as pruned.
+    """
+    if not (force or profiling_enabled()):
+        yield None
+        return
+    from sbr_tpu.obs import runlog
+
+    run = runlog.active_run()
+    root = (
+        run.run_dir / "profile"
+        if run is not None
+        else Path(os.environ.get("SBR_OBS_PROFILE_DIR", "obs_profile"))
+    )
+    trace_dir = root / f"{label.replace('/', '_')}_{time.strftime('%Y%m%dT%H%M%S')}"
+    i = 0
+    while trace_dir.exists():
+        i += 1
+        trace_dir = Path(f"{trace_dir}_{i}")
+    try:
+        import jax.profiler
+
+        ctx = jax.profiler.trace(str(trace_dir))
+    except Exception as err:  # profiler unavailable: never sink the caller
+        if run is not None:
+            run.event("profile", label=label, error=repr(err))
+        yield None
+        return
+    t0 = time.monotonic()
+    started_at = time.strftime("%Y-%m-%dT%H:%M:%S")
+    try:
+        with ctx:
+            yield trace_dir
+    finally:
+        window_s = time.monotonic() - t0
+        summary = _summarize_dir(trace_dir)
+        budget = _profile_budget_bytes()
+        pruned = summary["bytes"] > budget
+        if pruned:
+            import shutil
+
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        rec = {
+            "label": label,
+            "trace_dir": str(trace_dir),
+            "files": summary["files"],
+            "bytes": summary["bytes"],
+            "pruned": pruned,
+            "max_bytes": budget,
+            "window_s": round(window_s, 6),
+            "started_at": started_at,
+        }
+        # `run` was resolved at entry: the capture is attributed to the run
+        # that owned it even if the block suspended telemetry inside.
+        if run is not None:
+            run.event("profile", **rec)
+            run.profiles.append(rec)

@@ -1,5 +1,6 @@
 """Render an obs run directory as a human-readable timing/throughput table,
-diff two runs, gate on numerical health, or garbage-collect old runs.
+diff two runs, gate on numerical health or perf regressions, or
+garbage-collect old runs.
 
 Usage:
     python -m sbr_tpu.obs.report RUN_DIR            # render one run
@@ -8,16 +9,28 @@ Usage:
     python -m sbr_tpu.obs.report health RUN_DIR     # numerical-health report;
                                                     # exits 1 on divergence,
                                                     # 3 if no health data
+    python -m sbr_tpu.obs.report trend [HISTORY]    # perf-history timelines
+    python -m sbr_tpu.obs.report trend --check --tolerance 0.15
+                                                    # regression gate: exit 1
+                                                    # beyond tolerance, 3 on
+                                                    # missing/short history
     python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs
+
+Every reporting subcommand (timing render, diff, health, trend) takes
+``--json`` and then prints one machine-readable JSON document instead of
+tables — CI and scripts consume that rather than scraping text.
 
 The ``health`` subcommand renders the `sbr_tpu.diag` census (worst-cell
 tables, NaN/fallback flag counts, residual histograms) recorded by
 `obs.log_health`, and its exit code is the CI gate: nonzero whenever any
 cell carries a divergent flag (NaN poison, non-finite residual,
-fixed-point non-convergence).
+fixed-point non-convergence). The ``trend`` subcommand is the matching
+PERF gate over `sbr_tpu.obs.history`'s append-only ``bench_history.jsonl``
+(see that module for baseline/polarity semantics).
 
-Reads only `manifest.json` + `events.jsonl` — no JAX import, so the report
-never touches (or hangs on) an accelerator backend.
+Reads only `manifest.json` + `events.jsonl` (or the history JSONL) — no
+JAX import, so the report never touches (or hangs on) an accelerator
+backend.
 """
 
 from __future__ import annotations
@@ -187,6 +200,62 @@ def render(run: dict) -> str:
         )
         out.append("(details: python -m sbr_tpu.obs.report health RUN_DIR)")
 
+    xla = m.get("xla") or {}
+    if xla.get("compiles"):
+        out += ["", "XLA COMPILES (jax.monitoring)"]
+        out.append(
+            f"{xla['compiles']} backend compile(s): "
+            f"jaxpr trace {_fmt_s(xla.get('jaxpr_trace_s'))}, "
+            f"mlir lowering {_fmt_s(xla.get('mlir_lowering_s'))}, "
+            f"backend compile {_fmt_s(xla.get('backend_compile_s'))}"
+        )
+        by_span = xla.get("by_span") or {}
+        if by_span:
+            out.append(
+                _table(
+                    ["span", "compiles", "backend compile"],
+                    [
+                        [k, v.get("compiles", 0), _fmt_s(v.get("backend_compile_s"))]
+                        for k, v in by_span.items()
+                    ],
+                )
+            )
+    elif xla and not xla.get("monitoring", True):
+        out += ["", "XLA COMPILES: jax.monitoring unavailable on this jax build"]
+
+    retraces = m.get("retraces") or {}
+    if retraces:
+        over = [k for k, v in retraces.items() if v.get("over_budget")]
+        out += ["", f"RETRACES{' (OVER BUDGET: ' + ', '.join(over) + ')' if over else ''}"]
+        out.append(
+            _table(
+                ["program", "traces", "budget", "over budget"],
+                [
+                    [k, v.get("traces"), v.get("budget"), "YES" if v.get("over_budget") else "-"]
+                    for k, v in retraces.items()
+                ],
+            )
+        )
+
+    profiles = m.get("profiles") or []
+    if profiles:
+        out += ["", "PROFILER CAPTURES"]
+        out.append(
+            _table(
+                ["label", "files", "size", "window", "trace dir"],
+                [
+                    [
+                        p.get("label"),
+                        p.get("files"),
+                        ("pruned" if p.get("pruned") else _fmt_bytes(p.get("bytes"))),
+                        _fmt_s(p.get("window_s")),
+                        p.get("trace_dir"),
+                    ]
+                    for p in profiles
+                ],
+            )
+        )
+
     mx = m.get("metrics") or {}
     if mx.get("counters") or mx.get("timers") or mx.get("gauges"):
         out += ["", "METRICS"]
@@ -320,6 +389,58 @@ def render_health(run: dict) -> tuple:
     return "\n".join(out), 1 if total_divergent else 0
 
 
+def render_json(run: dict) -> dict:
+    """Machine-readable equivalent of `render` (--json): the manifest plus
+    the per-name jit aggregation and per-stage status counts from events."""
+    return {
+        "dir": run["dir"],
+        "manifest": run["manifest"],
+        "jit_by_name": _jit_by_name(run["events"]),
+        "status_by_stage": _status_by_stage(run["events"]),
+    }
+
+
+def health_json(run: dict) -> tuple:
+    """Machine-readable equivalent of `render_health` (--json); returns
+    (doc, exit_code) with the same exit-code contract."""
+    stages = _health_by_stage(run["events"])
+    if not stages:
+        return {"dir": run["dir"], "stages": {}, "exit": 3}, 3
+    total_divergent = sum(v["divergent"] for v in stages.values())
+    code = 1 if total_divergent else 0
+    return {
+        "dir": run["dir"],
+        "stages": stages,
+        "total_cells": sum(v["cells"] for v in stages.values()),
+        "total_divergent": total_divergent,
+        "exit": code,
+    }, code
+
+
+def diff_json(a: dict, b: dict) -> dict:
+    """Machine-readable equivalent of `diff` (--json)."""
+    ma, mb = a["manifest"], b["manifest"]
+    ja, jb = ma.get("jit") or {}, mb.get("jit") or {}
+    sa, sb = ma.get("stages") or {}, mb.get("stages") or {}
+    stages = {}
+    for n in sorted(set(sa) | set(sb)):
+        ta = sa.get(n, {}).get("total_s")
+        tb = sb.get(n, {}).get("total_s")
+        stages[n] = {
+            "a_s": ta,
+            "b_s": tb,
+            "ratio": (tb / ta) if (ta and tb is not None) else None,
+        }
+    return {
+        "a": a["dir"],
+        "b": b["dir"],
+        "duration": {"a_s": ma.get("duration_s"), "b_s": mb.get("duration_s")},
+        "compile": {"a_s": ja.get("compile_s"), "b_s": jb.get("compile_s")},
+        "execute": {"a_s": ja.get("execute_s"), "b_s": jb.get("execute_s")},
+        "stages": stages,
+    }
+
+
 def diff(a: dict, b: dict) -> str:
     """Stage/jit-level diff of two runs (b relative to a)."""
     ma, mb = a["manifest"], b["manifest"]
@@ -358,12 +479,17 @@ def _main_health(argv) -> int:
         description="Numerical-health report for one run; nonzero exit on divergence",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
     args = parser.parse_args(argv)
     try:
         run = load_run(args.run_dir)
     except (FileNotFoundError, json.JSONDecodeError) as err:
         print(f"error: {err}", file=sys.stderr)
         return 2
+    if args.json:
+        doc, code = health_json(run)
+        print(json.dumps(doc, default=str))
+        return code
     text, code = render_health(run)
     print(text)
     return code
@@ -401,15 +527,21 @@ def main(argv=None) -> int:
         return _main_health(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
+    if argv and argv[0] == "trend":
+        # Perf-history trend/regression gate — jax-free, like this module.
+        from sbr_tpu.obs.history import main_trend
+
+        return main_trend(argv[1:])
 
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
-        "'health' / 'gc' subcommands",
+        "'health' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")
     parser.add_argument("--events", type=int, default=0, metavar="N", help="also print the last N raw events")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
     args = parser.parse_args(argv)
 
     try:
@@ -423,8 +555,11 @@ def main(argv=None) -> int:
         except (FileNotFoundError, json.JSONDecodeError) as err:
             print(f"error: {err}", file=sys.stderr)
             return 1
-        print(diff(run, other))
+        print(json.dumps(diff_json(run, other), default=str) if args.json else diff(run, other))
     else:
+        if args.json:
+            print(json.dumps(render_json(run), default=str))
+            return 0
         print(render(run))
         if args.events:
             print(f"\nLAST {args.events} EVENTS")

@@ -49,6 +49,22 @@ SCHEMA = "sbr-obs/1"
 _STACK: list = []
 _ENV_CHECKED = False
 
+# Innermost-open-span names: the compile listeners (obs.prof) attribute XLA
+# compiles to whatever stage was active when the compile fired.
+_SPAN_NAMES: list = []
+
+
+def active_run():
+    """The active RunContext WITHOUT the SBR_OBS auto-start side effect —
+    for listeners/hooks that may fire at arbitrary points (obs.prof's
+    jax.monitoring callbacks must never start a run mid-compile)."""
+    return _STACK[-1] if _STACK else None
+
+
+def active_span() -> Optional[str]:
+    """Name of the innermost open span, or None outside any span."""
+    return _SPAN_NAMES[-1] if _SPAN_NAMES else None
+
 
 def _trace_clean() -> bool:
     """True when not inside a jax trace (host instrumentation is allowed)."""
@@ -156,6 +172,22 @@ class RunContext:
         self.device: Optional[dict] = None
         self.health: dict = {}  # stage -> folded numerical-health roll-up
         self._aot_cache: dict = {}
+        # Performance observatory (obs.prof): XLA compile attribution from
+        # the jax.monitoring listeners, per-run retrace accounting, and
+        # profiler-capture summaries. Listener installation is idempotent
+        # and jax-import-free until a compile actually fires.
+        from sbr_tpu.obs import prof
+
+        prof.install()
+        self.xla: dict = {
+            "compiles": 0,
+            "jaxpr_trace_s": 0.0,
+            "mlir_lowering_s": 0.0,
+            "backend_compile_s": 0.0,
+            "by_span": {},
+        }
+        self._trace_counts0 = prof.trace_counts()
+        self.profiles: list = []
         # Retention: prune sibling run dirs at finalize when a keep budget
         # is configured (SBR_OBS_KEEP env, or explicit ctor argument — the
         # bench harness and the SBR_OBS=1 auto-start path set one).
@@ -193,16 +225,24 @@ class RunContext:
         """Stage span: emits stage_start/stage_end events and accumulates
         per-stage totals. Yields a handle whose `.sync(*arrays)` registers
         arrays to fence before the end timestamp (device-honest timing)."""
+        from sbr_tpu.obs import prof
+
         self.event("stage_start", stage=name, **attrs)
         handle = _Span()
+        _SPAN_NAMES.append(name)
         t0 = time.monotonic()
         err = None
         try:
-            yield handle
+            # With SBR_OBS_PROFILE=1 the stage also lands as a
+            # TraceAnnotation on the xplane timeline; otherwise free.
+            with prof.annotate(name):
+                yield handle
         except BaseException as e:
             err = e
             raise
         finally:
+            if _SPAN_NAMES and _SPAN_NAMES[-1] == name:
+                _SPAN_NAMES.pop()
             if handle._arrays:
                 try:
                     from sbr_tpu.obs.timing import fence
@@ -326,9 +366,65 @@ class RunContext:
         except Exception:
             pass
 
+    # -- performance observatory hooks (obs.prof) -----------------------------
+    def _note_xla(self, key: str, duration_s: float, span: Optional[str]) -> None:
+        """Fold one XLA compile-phase duration (from the jax.monitoring
+        listeners) into the run, attributed to the innermost open span.
+        Called from a listener mid-compile: must stay cheap and non-raising."""
+        self.xla[key] = self.xla.get(key, 0.0) + duration_s
+        if key == "backend_compile_s":
+            self.xla["compiles"] += 1
+            agg = self.xla["by_span"].setdefault(
+                span or "-", {"compiles": 0, "backend_compile_s": 0.0}
+            )
+            agg["compiles"] += 1
+            agg["backend_compile_s"] += duration_s
+        self.event(
+            "xla_compile", phase=key[: -len("_s")], duration_s=round(duration_s, 6), span=span
+        )
+
+    def _note_trace(self, name: str, total: int) -> None:
+        """Per-run retrace accounting (obs.prof.note_trace): when the
+        within-run trace count for ``name`` exceeds its budget, emit a
+        ``retrace`` warning event — the signature of argument shape/dtype
+        churn recompiling a hot program. Fires DURING tracing, which is
+        fine: the event is pure host-side file IO."""
+        from sbr_tpu.obs import prof
+
+        count = total - self._trace_counts0.get(name, 0)
+        budget = prof.trace_budget(name)
+        if count > budget:
+            self.event(
+                "retrace",
+                name=name,
+                count=count,
+                total=total,
+                budget=budget,
+                span=active_span(),
+                hint="trace count exceeds budget — argument shape/dtype churn?",
+            )
+
+    def _retrace_summary(self) -> dict:
+        """Per-name trace counts accumulated DURING this run (manifest
+        roll-up; over_budget mirrors the retrace warning events)."""
+        from sbr_tpu.obs import prof
+
+        out = {}
+        for name, total in sorted(prof.trace_counts().items()):
+            count = total - self._trace_counts0.get(name, 0)
+            if count > 0:
+                budget = prof.trace_budget(name)
+                out[name] = {
+                    "traces": count,
+                    "budget": budget,
+                    "over_budget": count > budget,
+                }
+        return out
+
     # -- summary / finalize ---------------------------------------------------
     def summary(self) -> dict:
         """Machine-readable roll-up (the bench JSON `obs` block)."""
+        retraces = self._retrace_summary()
         return {
             "run_dir": str(self.run_dir),
             "device": (self.device or {}).get("device_kind"),
@@ -338,6 +434,28 @@ class RunContext:
             "jit_calls": self.jit["calls"],
             "memory_peak_bytes": self.mem_peak_device or self.mem_peak_live,
             "n_events": self._n_events,
+            "xla_compiles": self.xla["compiles"],
+            "xla_backend_compile_s": round(self.xla["backend_compile_s"], 4),
+            "retraces_over_budget": sum(1 for v in retraces.values() if v["over_budget"]),
+        }
+
+    def _xla_manifest(self) -> dict:
+        """The jax.monitoring compile-attribution block (durations rounded;
+        `monitoring: false` flags a jax build without the listener API, so a
+        zeroed block reads as "couldn't watch", not "nothing compiled")."""
+        from sbr_tpu.obs import prof
+
+        return {
+            "monitoring": prof.monitoring_available(),
+            "compiles": self.xla["compiles"],
+            **{
+                k: round(self.xla[k], 6)
+                for k in ("jaxpr_trace_s", "mlir_lowering_s", "backend_compile_s")
+            },
+            "by_span": {
+                k: {"compiles": v["compiles"], "backend_compile_s": round(v["backend_compile_s"], 6)}
+                for k, v in sorted(self.xla["by_span"].items())
+            },
         }
 
     def _write_manifest(self, status: str) -> None:
@@ -364,6 +482,9 @@ class RunContext:
             },
             "health": self.health or None,
             "metrics": metrics().summary() if metrics().enabled else None,
+            "xla": self._xla_manifest(),
+            "retraces": self._retrace_summary() or None,
+            "profiles": self.profiles or None,
         }
         tmp = self.run_dir / "manifest.json.tmp"
         tmp.write_text(json.dumps(manifest, indent=1, default=_json_default) + "\n")

@@ -631,6 +631,12 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
 
     @jax.jit
     def run(betas, src, row_ptr, indeg, dst2, out_ptr, outdeg, informed0, t_init, key, k0):
+        # Trace-time retrace accounting (obs.prof): a churning graph shape
+        # (edge-count drift between prepares) silently recompiles this
+        # kernel — the counter makes that visible in the run manifest.
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.agents.incremental")
         n = betas.shape[0]
         e = src.shape[0]
         dtype = betas.dtype
@@ -703,6 +709,9 @@ def _single_device_sim(config: AgentSimConfig):
 
     @jax.jit
     def run(betas, src, row_ptr, indeg, informed0, t_init, key, k0):
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.agents.gather")
         n = betas.shape[0]
         dtype = betas.dtype
         t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)

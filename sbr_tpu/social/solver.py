@@ -121,6 +121,11 @@ def _build_fixed_point(
 
     @jax.jit
     def run(beta, x0, u, p, kappa, lam, eta, grid):
+        # Trace-time retrace accounting (obs.prof): one count per jit cache
+        # miss of the fixed-point program (e.g. a churning grid dtype).
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.fixed_point")
         dtype = grid.dtype
         tol_ = jnp.asarray(tol, dtype=dtype)
         alpha = jnp.asarray(damping, dtype=dtype)

@@ -31,6 +31,7 @@ from sbr_tpu.baseline.learning import solve_learning
 from sbr_tpu.baseline.solver import solve_equilibrium_core
 from sbr_tpu.models.params import ModelParams, SolverConfig
 from sbr_tpu.models.results import LearningSolution
+from sbr_tpu.obs import prof
 
 
 @struct.dataclass
@@ -76,6 +77,10 @@ def _u_sweep_fn(config: SolverConfig, mesh=None, mesh_axis=None):
     materializing (n_u, n_grid) temporaries."""
 
     def fn(ls, u_values, p, kappa, lam, eta, tspan_end):
+        # Trace-time retrace accounting (obs.prof): this body runs once per
+        # jit cache miss, so the count is exactly the program's trace count.
+        prof.note_trace("sweeps.u_sweep")
+
         def cell(u):
             return _lean_cell(ls, u, p, kappa, lam, eta, tspan_end, config)
 
@@ -249,6 +254,9 @@ def _grid_fn(config: SolverConfig, dtype_name: str, mesh, mesh_axes):
     dtype = jnp.dtype(dtype_name)
 
     def cell(beta, u, p, kappa, lam, eta, t0, t1, x0):
+        # vmap² traces `cell` once per program trace — the retrace counter
+        # (obs.prof) sees exactly the grid program's jit cache misses.
+        prof.note_trace("sweeps.beta_u_grid")
         ls = solve_learning(
             # LearningParams is validated host-side; build the solution
             # directly from traced scalars via the closed form.

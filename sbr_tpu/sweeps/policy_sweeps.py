@@ -47,6 +47,11 @@ def _policy_fn(config: SolverConfig, dtype_name: str, mesh=None, mesh_axes=None)
     dtype = jnp.dtype(dtype_name)
 
     def cell(beta, u, r, p, kappa, lam, eta, delta, t0, t1, x0):
+        # Trace-time retrace accounting (obs.prof): vmap³ traces `cell`
+        # once per program trace = one count per jit cache miss.
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("sweeps.policy_interest")
         ls = solve_learning(_TracedLearning(beta=beta, tspan=(t0, t1), x0=x0), config, dtype=dtype)
         res = solve_equilibrium_interest_core(ls, u, p, kappa, lam, eta, r, delta, t1, config)
         return res.base.xi, res.base.aw_max, res.base.status, res.base.health

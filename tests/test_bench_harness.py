@@ -48,6 +48,14 @@ def test_bench_emits_contract_json():
     # the self-documenting history: forced platform + one ok measure phase
     phases = [h for h in extra["probe_history"] if h.get("phase") == "measure"]
     assert phases and phases[-1]["outcome"] == "ok"
+    # ISSUE 3 satellite: ONE uniform, versioned record shape for every
+    # probe/measure history entry (probe entries used to carry keys the
+    # measure entry lacked)
+    uniform = {"schema", "phase", "attempt", "outcome", "platform",
+               "duration_s", "timeout_s", "backoff_s"}
+    for h in extra["probe_history"]:
+        assert h["schema"] == 1
+        assert uniform <= set(h), f"non-uniform history entry: {h}"
 
 
 def test_stretch_emits_contract_json():

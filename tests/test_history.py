@@ -1,0 +1,271 @@
+"""Tests for the perf history + regression gate (`sbr_tpu.obs.history` and
+`report trend`, ISSUE 3 tentpole): append/load round-trip, polarity rules,
+rolling-median baselines, platform isolation, and the CLI exit-code
+contract — exit 1 on a synthetic ≥15% throughput regression, 0 on flat
+history, 3 on missing/short history (the acceptance criteria)."""
+
+import json
+
+import pytest
+
+from sbr_tpu.obs import history, report
+
+
+def _rec(ts, platform="cpu", **metrics):
+    return {
+        "schema": 1,
+        "ts": ts,
+        "label": "bench",
+        "platform": platform,
+        "metrics": metrics,
+    }
+
+
+def _write(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    return path
+
+
+# -- append / load -----------------------------------------------------------
+
+
+def test_append_load_round_trip(tmp_path):
+    p = tmp_path / "h.jsonl"
+    out = history.append(
+        {"eq_per_sec": 10.0, "nan_metric": float("nan"), "text": "no", "flag": True},
+        label="x",
+        platform="cpu",
+        path=p,
+        meta={"note": "fixture"},
+    )
+    assert out == p
+    (rec,) = history.load(p)
+    assert rec["schema"] == history.SCHEMA == 1
+    assert rec["label"] == "x" and rec["platform"] == "cpu"
+    # only finite numerics survive; bools coerce to gateable ints
+    assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}
+    assert rec["meta"] == {"note": "fixture"}
+    # a torn tail write must not poison the log
+    with open(p, "a") as fh:
+        fh.write('{"trunc')
+    assert len(history.load(p)) == 1
+
+
+def test_load_missing_file_is_empty(tmp_path):
+    assert history.load(tmp_path / "nope.jsonl") == []
+
+
+def test_history_path_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("SBR_OBS_HISTORY", str(tmp_path / "env.jsonl"))
+    assert history.history_path() == tmp_path / "env.jsonl"
+    assert history.history_path(tmp_path / "arg.jsonl") == tmp_path / "arg.jsonl"
+    monkeypatch.delenv("SBR_OBS_HISTORY")
+    assert str(history.history_path()).endswith("benchmarks/bench_history.jsonl")
+
+
+# -- polarity + check --------------------------------------------------------
+
+
+def test_polarity_rules():
+    assert history.polarity("beta_u_grid_equilibria_per_sec") == 1
+    assert history.polarity("agent_steps_per_sec") == 1
+    assert history.polarity("grid_dispatch_s") == -1
+    assert history.polarity("obs_compile_s") == -1
+    assert history.polarity("memory_peak_bytes") == -1
+    assert history.polarity("health_divergent") == -1
+    assert history.polarity("mystery_metric") == 1
+
+
+def test_check_flat_history_ok():
+    records = [_rec(f"t{i}", eq_per_sec=1000.0, grid_dispatch_s=0.5) for i in range(4)]
+    verdicts, status = history.check(records, tolerance=0.15)
+    assert status == "ok"
+    assert all(v["status"] == "ok" for v in verdicts.values())
+    assert verdicts["eq_per_sec"]["baseline"] == 1000.0
+
+
+def test_check_throughput_regression():
+    records = [_rec(f"t{i}", eq_per_sec=1000.0) for i in range(3)]
+    records.append(_rec("t3", eq_per_sec=700.0))  # -30%, higher-better
+    verdicts, status = history.check(records, tolerance=0.15)
+    assert status == "regression"
+    v = verdicts["eq_per_sec"]
+    assert v["status"] == "regression"
+    assert v["change"] == pytest.approx(-0.3)
+    assert v["direction"] == "higher_better"
+
+
+def test_check_duration_regression_lower_better():
+    records = [_rec(f"t{i}", obs_compile_s=1.0) for i in range(3)]
+    records.append(_rec("t3", obs_compile_s=1.5))  # +50% compile time
+    verdicts, status = history.check(records, tolerance=0.15)
+    assert status == "regression"
+    assert verdicts["obs_compile_s"]["direction"] == "lower_better"
+
+
+def test_check_improvement_is_not_regression():
+    records = [_rec(f"t{i}", eq_per_sec=1000.0, grid_dispatch_s=0.5) for i in range(3)]
+    records.append(_rec("t3", eq_per_sec=1500.0, grid_dispatch_s=0.3))
+    _, status = history.check(records, tolerance=0.15)
+    assert status == "ok"
+
+
+def test_check_within_tolerance_ok():
+    records = [_rec(f"t{i}", eq_per_sec=1000.0) for i in range(3)]
+    records.append(_rec("t3", eq_per_sec=900.0))  # -10% < 15% tolerance
+    _, status = history.check(records, tolerance=0.15)
+    assert status == "ok"
+
+
+def test_check_short_history():
+    records = [_rec("t0", eq_per_sec=1000.0), _rec("t1", eq_per_sec=500.0)]
+    verdicts, status = history.check(records, min_points=3)
+    assert status == "short"
+    assert verdicts["eq_per_sec"]["status"] == "short"
+
+
+def test_check_platform_isolation():
+    """A CPU-fallback latest record must gate against CPU history only —
+    never read as a collapse vs the TPU numbers."""
+    records = [_rec(f"t{i}", platform="tpu", eq_per_sec=100_000.0) for i in range(3)]
+    records += [_rec(f"c{i}", platform="cpu", eq_per_sec=1000.0) for i in range(3)]
+    _, status = history.check(records, tolerance=0.15)
+    assert status == "ok"
+    # and a genuine regression within the cpu series still fires
+    records.append(_rec("c3", platform="cpu", eq_per_sec=500.0))
+    _, status = history.check(records, tolerance=0.15)
+    assert status == "regression"
+
+
+def test_check_divergent_count_zero_baseline():
+    """lower-better count with a clean baseline: ANY increase regresses
+    (one divergent cell is a signal, not a percentage)."""
+    records = [_rec(f"t{i}", health_divergent=0) for i in range(3)]
+    records.append(_rec("t3", health_divergent=2))
+    verdicts, status = history.check(records)
+    assert status == "regression"
+    assert verdicts["health_divergent"]["change"] is None
+
+
+def test_check_rolling_median_window_ignores_ancient_baseline():
+    """The baseline is the rolling median of the WINDOW, not all history —
+    an old slow era must not mask a regression vs the recent plateau."""
+    records = [_rec(f"old{i}", eq_per_sec=100.0) for i in range(10)]
+    records += [_rec(f"new{i}", eq_per_sec=1000.0) for i in range(5)]
+    records.append(_rec("now", eq_per_sec=700.0))
+    verdicts, status = history.check(records, tolerance=0.15, window=5)
+    assert status == "regression"
+    assert verdicts["eq_per_sec"]["baseline"] == 1000.0
+
+
+def test_sparkline():
+    assert history.sparkline([1.0, 1.0, 1.0]) == "▄▄▄"
+    s = history.sparkline([0.0, 1.0, 2.0, 3.0])
+    assert s[0] == "▁" and s[-1] == "█"
+    assert history.sparkline(list(range(100)), width=24).__len__() == 24
+    assert history.sparkline([]) == ""
+
+
+# -- CLI (report trend) ------------------------------------------------------
+
+
+def test_trend_cli_exit_codes(tmp_path, capsys):
+    flat = _write(tmp_path / "flat.jsonl", [_rec(f"t{i}", eq_per_sec=1000.0) for i in range(4)])
+    reg = _write(
+        tmp_path / "reg.jsonl",
+        [_rec(f"t{i}", eq_per_sec=1000.0) for i in range(3)] + [_rec("t3", eq_per_sec=700.0)],
+    )
+    short = _write(tmp_path / "short.jsonl", [_rec("t0", eq_per_sec=1000.0)])
+
+    assert report.main(["trend", str(flat), "--check"]) == 0
+    assert report.main(["trend", str(reg), "--check", "--tolerance", "0.15"]) == 1
+    assert report.main(["trend", str(tmp_path / "missing.jsonl"), "--check"]) == 3
+    assert report.main(["trend", str(short), "--check"]) == 3
+    # render-only on a fresh checkout (no history yet) is not an error
+    assert report.main(["trend", str(tmp_path / "missing.jsonl")]) == 0
+    # a generous tolerance swallows the drop
+    assert report.main(["trend", str(reg), "--check", "--tolerance", "0.5"]) == 0
+    # without --check the CLI only renders (exit 0 regardless)
+    assert report.main(["trend", str(reg)]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "eq_per_sec" in out
+
+
+def test_trend_cli_json(tmp_path, capsys):
+    reg = _write(
+        tmp_path / "reg.jsonl",
+        [_rec(f"t{i}", eq_per_sec=1000.0) for i in range(3)] + [_rec("t3", eq_per_sec=700.0)],
+    )
+    assert report.main(["trend", str(reg), "--check", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "regression" and doc["exit"] == 1
+    assert doc["verdicts"]["eq_per_sec"]["status"] == "regression"
+    assert doc["n_records"] == 4
+
+    assert report.main(["trend", str(tmp_path / "missing.jsonl"), "--check", "--json"]) == 3
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "short" and doc["exit"] == 3
+
+
+def test_trend_cli_render_table(tmp_path, capsys):
+    p = _write(
+        tmp_path / "h.jsonl",
+        [_rec(f"t{i}", eq_per_sec=1000.0 + i, grid_dispatch_s=0.5) for i in range(5)],
+    )
+    assert report.main(["trend", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "PLATFORM cpu" in out
+    assert "eq_per_sec" in out and "grid_dispatch_s" in out
+    assert any(ch in out for ch in "▁▂▃▄▅▆▇█")
+
+
+def test_trend_cli_metric_filter(tmp_path, capsys):
+    p = _write(
+        tmp_path / "h.jsonl",
+        [_rec(f"t{i}", eq_per_sec=1000.0, obs_compile_s=1.0) for i in range(3)]
+        + [_rec("t3", eq_per_sec=1000.0, obs_compile_s=9.0)],
+    )
+    # compile time blew up, but the gate is restricted to the throughput metric
+    assert report.main(["trend", str(p), "--check", "--metric", "eq_per_sec"]) == 0
+    assert report.main(["trend", str(p), "--check"]) == 1
+    capsys.readouterr()
+
+
+# -- bench integration -------------------------------------------------------
+
+
+def test_bench_append_history_helper(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setenv("SBR_BENCH_SIZES", "tiny")
+    monkeypatch.setenv("SBR_OBS_HISTORY", str(tmp_path / "h.jsonl"))
+    result = {
+        "metric": "beta_u_grid_equilibria_per_sec",
+        "value": 100.0,
+        "unit": "equilibria/sec",
+        "extra": {
+            "platform": "cpu",
+            "agent_steps_per_sec": 5.0,
+            "grid_dispatch_s": 0.1,
+            "obs": {"compile_s": 1.0, "execute_s": 0.5},
+        },
+    }
+    bench._append_history(result)
+    (rec,) = history.load(tmp_path / "h.jsonl")
+    assert rec["platform"] == "cpu"
+    assert rec["metrics"]["beta_u_grid_equilibria_per_sec"] == 100.0
+    assert rec["metrics"]["agent_steps_per_sec"] == 5.0
+    assert rec["metrics"]["grid_dispatch_s"] == 0.1
+    assert rec["metrics"]["obs_compile_s"] == 1.0
+    # tiny smoke runs without SBR_OBS_HISTORY must NOT touch any history
+    monkeypatch.delenv("SBR_OBS_HISTORY")
+    bench._append_history(result)
+    assert len(history.load(tmp_path / "h.jsonl")) == 1
+
+
+def test_bench_metrics_extraction():
+    out = history.bench_metrics({"metric": "m_per_sec", "value": 2.0, "extra": {}})
+    assert out == {"m_per_sec": 2.0}
+    assert history.bench_metrics({}) == {}

@@ -1,0 +1,206 @@
+"""Tests for the performance observatory (`sbr_tpu.obs.prof`, ISSUE 3
+tentpole): the retrace detector (a jitted function called with churning
+shapes must produce `retrace` events with the correct counts), XLA compile
+attribution via the jax.monitoring listeners, opt-in profiler capture with
+the size bound, and the acceptance contract that enabling SBR_OBS_PROFILE
+and the listeners changes no solver output and causes zero additional
+retraces (the `tests/test_diag.py` no-retrace/no-value-change discipline).
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu import obs
+from sbr_tpu.obs import prof
+
+
+@pytest.fixture(autouse=True)
+def _no_active_run():
+    assert obs.current_run() is None
+    was_on = obs.metrics().enabled
+    yield
+    while obs.end_run() is not None:
+        pass
+    (obs.metrics().enable if was_on else obs.metrics().disable)()
+
+
+def _events(run_dir):
+    return [
+        json.loads(line)
+        for line in (Path(run_dir) / "events.jsonl").read_text().splitlines()
+    ]
+
+
+# -- retrace detector --------------------------------------------------------
+
+
+def test_retrace_detector_counts_shape_churn(tmp_path):
+    """A jitted function fed churning shapes retraces per call; once the
+    within-run count passes its budget, each further trace lands a
+    `retrace` event with the correct running count."""
+
+    @jax.jit
+    def f(x):
+        prof.note_trace("test_prof.churn", budget=2)
+        return (x * 2.0).sum()
+
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        vals = [float(f(jnp.arange(float(n)))) for n in (2, 3, 4, 5)]
+    # instrumentation changes no values
+    assert vals == [float(sum(2.0 * i for i in range(n))) for n in (2, 3, 4, 5)]
+
+    retraces = [e for e in _events(run.run_dir) if e["kind"] == "retrace"]
+    assert [e["count"] for e in retraces] == [3, 4]
+    assert all(e["name"] == "test_prof.churn" and e["budget"] == 2 for e in retraces)
+
+    manifest = json.loads((run.run_dir / "manifest.json").read_text())
+    entry = manifest["retraces"]["test_prof.churn"]
+    assert entry == {"traces": 4, "budget": 2, "over_budget": True}
+
+
+def test_retrace_detector_quiet_on_stable_shapes(tmp_path):
+    @jax.jit
+    def f(x):
+        prof.note_trace("test_prof.stable", budget=1)
+        return x + 1.0
+
+    x = jnp.arange(4.0)
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        for _ in range(5):
+            f(x)
+    assert not [e for e in _events(run.run_dir) if e["kind"] == "retrace"]
+    manifest = json.loads((run.run_dir / "manifest.json").read_text())
+    assert manifest["retraces"]["test_prof.stable"]["over_budget"] is False
+
+
+def test_note_trace_counts_without_run():
+    """The registry counts process-wide even with telemetry off — a later
+    run reports only its own delta."""
+    before = prof.trace_counts().get("test_prof.bare", 0)
+
+    @jax.jit
+    def f(x):
+        prof.note_trace("test_prof.bare")
+        return x * 2
+
+    f(jnp.arange(3.0))
+    assert prof.trace_counts()["test_prof.bare"] == before + 1
+
+
+# -- compile attribution (jax.monitoring) ------------------------------------
+
+
+def test_compile_attribution_to_active_span(tmp_path):
+    if not prof.install():
+        pytest.skip("jax.monitoring unavailable on this jax build")
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        with obs.span("compile_here"):
+            # a fresh lambda can never hit an existing jit cache
+            float(jax.jit(lambda x: (x * 1.5).sum())(jnp.arange(6.0)))
+    manifest = json.loads((run.run_dir / "manifest.json").read_text())
+    xla = manifest["xla"]
+    assert xla["monitoring"] is True
+    assert xla["compiles"] >= 1
+    assert xla["backend_compile_s"] > 0.0
+    assert "compile_here" in xla["by_span"]
+    assert xla["by_span"]["compile_here"]["compiles"] >= 1
+    compile_events = [e for e in _events(run.run_dir) if e["kind"] == "xla_compile"]
+    assert any(e["span"] == "compile_here" for e in compile_events)
+    assert any(e["phase"] == "backend_compile" for e in compile_events)
+
+
+# -- profiler capture --------------------------------------------------------
+
+
+def test_profile_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("SBR_OBS_PROFILE", raising=False)
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        with obs.profile("nope") as trace_dir:
+            assert trace_dir is None
+    assert not [e for e in _events(run.run_dir) if e["kind"] == "profile"]
+    manifest = json.loads((run.run_dir / "manifest.json").read_text())
+    assert manifest["profiles"] is None
+
+
+def test_profile_capture_records_summary(tmp_path, monkeypatch):
+    monkeypatch.setenv("SBR_OBS_PROFILE", "1")
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        with obs.profile("cap") as trace_dir:
+            assert trace_dir is not None
+            float(jax.jit(lambda x: (x * 2.0).sum())(jnp.arange(32.0)))
+    (ev,) = [e for e in _events(run.run_dir) if e["kind"] == "profile"]
+    assert ev["label"] == "cap"
+    assert ev["files"] > 0 and ev["bytes"] > 0 and ev["pruned"] is False
+    assert ev["window_s"] > 0.0
+    # the capture lives INSIDE the run dir, so run retention prunes it too
+    assert str(run.run_dir) in ev["trace_dir"]
+    assert Path(ev["trace_dir"]).is_dir()
+    manifest = json.loads((run.run_dir / "manifest.json").read_text())
+    assert manifest["profiles"][0]["label"] == "cap"
+
+
+def test_profile_size_bound_prunes_capture(tmp_path, monkeypatch):
+    monkeypatch.setenv("SBR_OBS_PROFILE", "1")
+    monkeypatch.setenv("SBR_OBS_PROFILE_MAX_MB", "0.0001")  # ~100 bytes
+    with obs.run_context(run_dir=str(tmp_path / "r")) as run:
+        with obs.profile("big") as trace_dir:
+            float(jax.jit(lambda x: (x * 2.0).sum())(jnp.arange(32.0)))
+    (ev,) = [e for e in _events(run.run_dir) if e["kind"] == "profile"]
+    assert ev["pruned"] is True
+    assert not Path(ev["trace_dir"]).exists()
+
+
+# -- acceptance: observatory toggles perturb nothing -------------------------
+
+
+def test_profiling_env_and_listeners_cause_no_retrace_no_value_change(tmp_path, monkeypatch):
+    """ISSUE 3 acceptance: with the monitoring listeners installed and
+    SBR_OBS_PROFILE=1 (annotations active on every span), a traced library
+    program is neither invalidated nor retraced and its outputs are
+    unchanged."""
+    prof.install()
+    traces = []
+
+    @jax.jit
+    def g(x):
+        traces.append(1)  # runs only at trace time
+        prof.note_trace("test_prof.accept")
+        with obs.span("inner"):  # trace guard → no-op under tracing
+            return (x * 3.0).sum()
+
+    x = jnp.arange(8.0)
+    y_off = float(g(x))
+    assert len(traces) == 1
+    monkeypatch.setenv("SBR_OBS_PROFILE", "1")
+    with obs.run_context(run_dir=str(tmp_path / "r")):
+        with obs.span("outer"), obs.step_annotation(0, "rep"):
+            y_on = float(g(x))
+    monkeypatch.delenv("SBR_OBS_PROFILE")
+    y_off2 = float(g(x))
+    assert len(traces) == 1, "observatory toggle retraced the program"
+    assert y_on == y_off == y_off2
+
+
+def test_solver_outputs_identical_under_profiling_env(tmp_path, monkeypatch):
+    """The sweep stack solved with SBR_OBS_PROFILE=1 (span annotations on)
+    must be bit-identical to the plain path."""
+    from sbr_tpu import make_model_params
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    m = make_model_params()
+    cfg = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+    betas, us = np.array([0.5, 1.0]), np.array([0.05, 0.5])
+    plain = beta_u_grid(betas, us, m, config=cfg)
+    monkeypatch.setenv("SBR_OBS_PROFILE", "1")
+    with obs.run_context(run_dir=str(tmp_path / "r")):
+        profiled = beta_u_grid(betas, us, m, config=cfg)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain), jax.tree_util.tree_leaves(profiled)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
