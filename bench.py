@@ -73,6 +73,15 @@ and `SBR_OBS_PROFILE=1` captures a size-bounded `jax.profiler` trace of
 one steady-state rep per workload into the run directory (summarized as a
 `profile` event; the old always-on SBR_BENCH_TRACE_DIR capture is
 superseded by this opt-in path).
+
+Resilience (PR 4): the probe ladder's attempts/backoff now come from the
+unified retry engine (`sbr_tpu.resilience.retry`, loaded standalone by
+file path so the parent stays jax-free) — SBR_BENCH_PROBE_ATTEMPTS /
+SBR_BENCH_PROBE_TIMEOUT_S keep working, joined by _BASE_DELAY_S /
+_MULTIPLIER / _MAX_DELAY_S; a seeded SBR_FAULT_PLAN can inject probe
+failures at the `bench.probe` fault point; and the measure child runs
+under a graceful-shutdown envelope (SIGTERM finalizes the obs manifest
+as "interrupted" instead of leaving a "running" corpse).
 """
 
 from __future__ import annotations
@@ -86,6 +95,31 @@ from pathlib import Path
 
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+_RESILIENCE_MODS: dict = {}
+
+
+def _resilience_mod(name: str):
+    """Load ``sbr_tpu/resilience/<name>.py`` STANDALONE by file path.
+
+    The parent's contract is to never import the sbr_tpu package (and with
+    it jax) — but the probe ladder's retry policy and the ``bench.probe``
+    fault point live in `sbr_tpu.resilience`, whose `retry`/`faults`
+    modules are deliberately stdlib-only. Loading them by path keeps the
+    parent jax-free while sharing the exact engine the tile loop uses."""
+    if name not in _RESILIENCE_MODS:
+        import importlib.util
+
+        path = Path(__file__).resolve().parent / "sbr_tpu" / "resilience" / f"{name}.py"
+        spec = importlib.util.spec_from_file_location(f"_sbr_resilience_{name}", path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses (and friends) resolve a class's module through
+        # sys.modules[__module__] — register before exec, like import does.
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        _RESILIENCE_MODS[name] = mod
+    return _RESILIENCE_MODS[name]
 
 
 # ---------------------------------------------------------------------------
@@ -312,7 +346,16 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
         )
         return cached["platform"], [entry]
 
-    attempts = int(os.environ.get("SBR_BENCH_PROBE_ATTEMPTS", "3"))
+    # Probe attempts/backoff ride the unified retry engine
+    # (sbr_tpu.resilience.retry, loaded standalone — see _resilience_mod):
+    # SBR_BENCH_PROBE_ATTEMPTS (alias of _MAX_ATTEMPTS), _BASE_DELAY_S,
+    # _MULTIPLIER, _MAX_DELAY_S replace the former hardcoded 3×300 s ladder
+    # (defaults keep its exact schedule: 3 attempts, 10 s·2^k backoff).
+    policy = _resilience_mod("retry").policy_from_env(
+        "SBR_BENCH_PROBE",
+        max_attempts=3, base_delay_s=10.0, multiplier=2.0, max_delay_s=600.0,
+    )
+    attempts = policy.max_attempts
     timeout_s = float(os.environ.get("SBR_BENCH_PROBE_TIMEOUT_S", "300"))
     history = []
     platform = ""
@@ -321,13 +364,13 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
         if eff_timeout <= 0.0:  # clamp's 0-means-skip contract (ADVICE r4)
             _log("probe budget exhausted before attempt — skipping")
             break
-        platform, outcome, dur = _probe_accelerator(eff_timeout)
+        platform, outcome, dur = _probe_attempt(attempt, eff_timeout)
         # ADVICE r4: count the upcoming backoff sleep against the budget
         # check, so backoffs cannot push the run past SBR_BENCH_BUDGET_S.
         # The backoff decision is made BEFORE the entry is recorded so the
         # JSON history and the mirrored obs `probe` event carry the same
         # backoff_s (the event used to fire before the field was set).
-        backoff = 10.0 * (2 ** (attempt - 1))
+        backoff = policy.delay_s(attempt)
         budget_left = budget is None or budget.remaining() >= 60.0 + backoff
         will_sleep = not platform and attempt < attempts and budget_left
         history.append(
@@ -349,12 +392,40 @@ def _probe_loop(budget: "_Budget" = None) -> tuple:
             break
         if will_sleep:
             _log(f"probe attempt {attempt}/{attempts} failed; backing off {backoff:.0f}s")
+            _obs_event(
+                "retry", scope="bench.probe", outcome="retrying",
+                attempt=attempt, max_attempts=attempts, backoff_s=backoff,
+            )
             time.sleep(backoff)
     if not platform:
         platform = "cpu"
         _log("accelerator unreachable after all probes — falling back to CPU")
+        # "fell_back", NOT "gave_up": the CPU fallback is this harness's
+        # DESIGNED degraded-success path (a measurement still lands), so it
+        # must not trip `report resilience`'s unrecovered-failure gate.
+        _obs_event(
+            "retry", scope="bench.probe", outcome="fell_back",
+            attempt=attempts, max_attempts=attempts, error="accelerator unreachable",
+        )
     _write_probe_cache(platform, history)
     return platform, history
+
+
+def _probe_attempt(attempt: int, timeout_s: float) -> tuple:
+    """One probe attempt, preceded by the ``bench.probe`` fault point.
+
+    The fault-plan check is env-guarded so the default path never loads
+    the standalone faults module; an injected transient reads as a failed
+    attempt (outcome ``"fault-injected"``) and flows through the ladder's
+    normal backoff/fallback — chaos runs exercise the real recovery."""
+    if os.environ.get("SBR_FAULT_PLAN", "").strip():
+        mod = _resilience_mod("faults")
+        try:
+            mod.fire("bench.probe", target=f"attempt{attempt}")
+        except mod.InjectedFault as err:
+            _log(f"probe fault injected: {err}")
+            return "", "fault-injected", 0.0
+    return _probe_accelerator(timeout_s)
 
 
 def _run_measurement(platform: str, timeout_s: float, script: str = None) -> tuple:
@@ -819,6 +890,17 @@ def bench_agents(platform: str) -> dict:
 
 
 def measure(platform: str) -> None:
+    """Measurement child entry: the real body runs inside a
+    graceful-shutdown envelope so a preemption (SIGTERM) mid-bench still
+    finalizes the obs manifest (status "interrupted") and removes partial
+    temp files instead of leaving a "running" corpse."""
+    from sbr_tpu.resilience.shutdown import graceful_shutdown
+
+    with graceful_shutdown(label="bench.measure"):
+        _measure_inner(platform)
+
+
+def _measure_inner(platform: str) -> None:
     devices = _init_child_backend(platform)
     platform = devices[0].platform
 

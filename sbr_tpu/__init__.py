@@ -36,6 +36,10 @@ Subpackage map (reference component in parens):
                  through every solver stack and sweep (new capability).
 - ``obs``      — run telemetry: event logs, stage spans, jit attribution,
                  metrics, the report/health/gc CLI (new capability).
+- ``resilience`` — deterministic fault injection, the unified retry
+                 engine, self-healing checkpoints (sidecars, quarantine,
+                 degrade ladder), graceful shutdown, chaos smoke (new
+                 capability).
 - ``parallel`` — mesh construction, sharding specs, collective helpers.
 - ``figures``  — matplotlib parity layer for the 13 reference figures
                  (``src/baseline/plotting.jl``, script-inline figures).

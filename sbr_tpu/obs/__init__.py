@@ -23,8 +23,10 @@ per-step ABM counters are the design references from PAPERS.md):
   gate (`report trend --check`).
 - ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
   a run directory or diffs two runs; the `health` subcommand renders and
-  gates on numerical health, `trend` renders/gates the perf history, `gc`
-  prunes old run directories. Every subcommand takes ``--json``.
+  gates on numerical health, `resilience` renders/gates the fault/retry/
+  repair story (`sbr_tpu.resilience`), `trend` renders/gates the perf
+  history, `gc` prunes old run directories. Every subcommand takes
+  ``--json``.
 
 Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
 land under ``SBR_OBS_DIR``, default ``obs_runs/``), or programmatically::
@@ -51,8 +53,12 @@ from sbr_tpu.obs.runlog import (
     end_run,
     event,
     gc_runs,
+    interrupt_all,
     jit_call,
+    log_fault,
     log_health,
+    log_repair,
+    log_retry,
     log_status,
     run_context,
     span,
@@ -75,8 +81,12 @@ __all__ = [
     "fence",
     "gc_runs",
     "history",
+    "interrupt_all",
     "jit_call",
+    "log_fault",
     "log_health",
+    "log_repair",
+    "log_retry",
     "log_status",
     "metrics",
     "note_trace",

@@ -9,6 +9,9 @@ Usage:
     python -m sbr_tpu.obs.report health RUN_DIR     # numerical-health report;
                                                     # exits 1 on divergence,
                                                     # 3 if no health data
+    python -m sbr_tpu.obs.report resilience RUN_DIR # fault/retry/repair report;
+                                                    # exits 1 on unrecovered
+                                                    # failures
     python -m sbr_tpu.obs.report trend [HISTORY]    # perf-history timelines
     python -m sbr_tpu.obs.report trend --check --tolerance 0.15
                                                     # regression gate: exit 1
@@ -389,6 +392,120 @@ def render_health(run: dict) -> tuple:
     return "\n".join(out), 1 if total_divergent else 0
 
 
+def _resilience_by_kind(events) -> dict:
+    """Fold fault/retry/repair events (the `sbr_tpu.resilience` emissions)
+    from the event log — the source of truth even when a kill -9 meant the
+    manifest roll-up was never finalized."""
+    faults: dict = {}
+    retries: dict = {}
+    repairs: dict = {}
+    failed_repairs = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "fault":
+            key = f"{ev.get('point', '?')}:{ev.get('fault', '?')}"
+            faults[key] = faults.get(key, 0) + 1
+        elif kind == "retry":
+            agg = retries.setdefault(
+                ev.get("scope", "?"), {"attempts": 0, "recovered": 0, "gave_up": 0}
+            )
+            agg["attempts"] = max(agg["attempts"], int(ev.get("attempt", 0)))
+            outcome = ev.get("outcome")
+            if outcome == "recovered":
+                agg["recovered"] += 1
+            elif outcome in ("gave_up", "budget_exhausted"):
+                agg["gave_up"] += 1
+        elif kind == "repair":
+            agg = repairs.setdefault(ev.get("action", "?"), {"count": 0, "failed": 0})
+            agg["count"] += 1
+            if not ev.get("ok", True):
+                agg["failed"] += 1
+                failed_repairs.append(ev.get("target", "?"))
+    return {
+        "faults": faults,
+        "retries": retries,
+        "repairs": repairs,
+        "failed_repairs": failed_repairs,
+    }
+
+
+def _resilience_gate(folded: dict) -> tuple:
+    """(unrecovered_count, exit_code): nonzero exit whenever a retry scope
+    gave up or a repair failed. A manifest status of "interrupted" is
+    reported but NOT gated — a graceful preemption is recorded evidence,
+    not an unrecovered failure (the resumed run completes elsewhere)."""
+    unrecovered = sum(v["gave_up"] for v in folded["retries"].values()) + sum(
+        v["failed"] for v in folded["repairs"].values()
+    )
+    return unrecovered, 1 if unrecovered else 0
+
+
+def render_resilience(run: dict) -> tuple:
+    """Fault/retry/repair report; returns (text, exit_code). Unlike
+    `health` (exit 3 when diagnostics never flowed), an empty resilience
+    log is a CLEAN run — nothing failed — and exits 0."""
+    folded = _resilience_by_kind(run["events"])
+    status = run["manifest"].get("status")
+    unrecovered, code = _resilience_gate(folded)
+    out = [f"run      {run['dir']}"]
+    out.append(f"status   {status}" + ("   (graceful preemption)" if status == "interrupted" else ""))
+    if not any((folded["faults"], folded["retries"], folded["repairs"])):
+        out.append("resilience  clean: no fault, retry, or repair events recorded")
+        return "\n".join(out), code
+    out.append(
+        f"resilience  {'UNRECOVERED FAILURES: ' + str(unrecovered) if unrecovered else 'recovered'}: "
+        f"{sum(folded['faults'].values())} fault(s) injected, "
+        f"{len(folded['retries'])} retried scope(s), "
+        f"{sum(v['count'] for v in folded['repairs'].values())} repair action(s)"
+    )
+    if folded["faults"]:
+        out += ["", "INJECTED FAULTS"]
+        out.append(
+            _table(
+                ["point:kind", "count"],
+                [[k, v] for k, v in sorted(folded["faults"].items())],
+            )
+        )
+    if folded["retries"]:
+        out += ["", "RETRIES"]
+        out.append(
+            _table(
+                ["scope", "max attempt", "recovered", "gave up"],
+                [
+                    [k, v["attempts"], v["recovered"], v["gave_up"] or "-"]
+                    for k, v in sorted(folded["retries"].items())
+                ],
+            )
+        )
+    if folded["repairs"]:
+        out += ["", "REPAIRS"]
+        out.append(
+            _table(
+                ["action", "count", "failed"],
+                [
+                    [k, v["count"], v["failed"] or "-"]
+                    for k, v in sorted(folded["repairs"].items())
+                ],
+            )
+        )
+        for target in folded["failed_repairs"]:
+            out.append(f"  FAILED: {target}")
+    return "\n".join(out), code
+
+
+def resilience_json(run: dict) -> tuple:
+    """Machine-readable equivalent of `render_resilience` (--json)."""
+    folded = _resilience_by_kind(run["events"])
+    unrecovered, code = _resilience_gate(folded)
+    return {
+        "dir": run["dir"],
+        "status": run["manifest"].get("status"),
+        **folded,
+        "unrecovered": unrecovered,
+        "exit": code,
+    }, code
+
+
 def render_json(run: dict) -> dict:
     """Machine-readable equivalent of `render` (--json): the manifest plus
     the per-name jit aggregation and per-stage status counts from events."""
@@ -495,6 +612,29 @@ def _main_health(argv) -> int:
     return code
 
 
+def _main_resilience(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report resilience",
+        description="Fault/retry/repair report for one run; nonzero exit on "
+        "unrecovered failures (a retry scope that gave up, a repair that failed)",
+    )
+    parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    try:
+        run = load_run(args.run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.json:
+        doc, code = resilience_json(run)
+        print(json.dumps(doc, default=str))
+        return code
+    text, code = render_resilience(run)
+    print(text)
+    return code
+
+
 def _main_gc(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report gc",
@@ -525,6 +665,8 @@ def main(argv=None) -> int:
     # interface (a directory named "health"/"gc" can be reached as ./health).
     if argv and argv[0] == "health":
         return _main_health(argv[1:])
+    if argv and argv[0] == "resilience":
+        return _main_resilience(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -536,7 +678,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
-        "'health' / 'trend' / 'gc' subcommands",
+        "'health' / 'resilience' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -171,6 +171,9 @@ class RunContext:
         self.mem_peak_device = 0  # peak allocator peak_bytes_in_use (if exposed)
         self.device: Optional[dict] = None
         self.health: dict = {}  # stage -> folded numerical-health roll-up
+        # Resilience roll-ups (sbr_tpu.resilience): injected-fault firings,
+        # retry-engine attempt outcomes, and self-healing repair actions.
+        self.resilience: dict = {"faults": {}, "retries": {}, "repairs": {}}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -481,6 +484,7 @@ class RunContext:
                 "peak_device_bytes": self.mem_peak_device,
             },
             "health": self.health or None,
+            "resilience": self._resilience_manifest(),
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -506,12 +510,49 @@ class RunContext:
         for name, n in (summary.get("flag_counts") or {}).items():
             agg["flag_counts"][name] = agg["flag_counts"].get(name, 0) + int(n)
 
-    def finalize(self) -> None:
-        """Write the final manifest and close the event log (idempotent)."""
+    def log_fault(self, point: str, kind: str = "?", **fields) -> None:
+        """Emit one injected-``fault`` event (`resilience.faults`) and count
+        it per (point, kind) in the manifest roll-up."""
+        self.event("fault", point=point, fault=kind, **fields)
+        key = f"{point}:{kind}"
+        agg = self.resilience["faults"]
+        agg[key] = agg.get(key, 0) + 1
+
+    def log_retry(self, scope: str, outcome: str, attempt: int = 0, **fields) -> None:
+        """Emit one ``retry`` attempt-outcome event (`resilience.retry`) and
+        fold it into the per-scope manifest roll-up. ``gave_up`` /
+        ``budget_exhausted`` scopes are what `report resilience` gates on."""
+        self.event("retry", scope=scope, outcome=outcome, attempt=attempt, **fields)
+        agg = self.resilience["retries"].setdefault(
+            scope, {"attempts": 0, "recovered": 0, "gave_up": 0}
+        )
+        agg["attempts"] = max(agg["attempts"], int(attempt))
+        if outcome == "recovered":
+            agg["recovered"] += 1
+        elif outcome in ("gave_up", "budget_exhausted"):
+            agg["gave_up"] += 1
+
+    def log_repair(self, action: str, target: str = "?", ok: bool = True, **fields) -> None:
+        """Emit one self-healing ``repair`` event (`resilience.heal`, the
+        multihost work-stealing adoption) and count it per action."""
+        self.event("repair", action=action, target=target, ok=bool(ok), **fields)
+        agg = self.resilience["repairs"].setdefault(action, {"count": 0, "failed": 0})
+        agg["count"] += 1
+        agg["failed"] += int(not ok)
+
+    def _resilience_manifest(self) -> Optional[dict]:
+        if not any(self.resilience.values()):
+            return None
+        return {k: v for k, v in self.resilience.items() if v}
+
+    def finalize(self, status: str = "complete") -> None:
+        """Write the final manifest and close the event log (idempotent).
+        ``status`` lets the graceful-shutdown handler land an
+        ``"interrupted"`` manifest instead of ``"complete"``."""
         if self._closed:
             return
-        self.event("run_end", n_events=self._n_events)
-        self._write_manifest(status="complete")
+        self.event("run_end", n_events=self._n_events, status=status)
+        self._write_manifest(status=status)
         self._closed = True
         self._fh.close()
         if not self._metrics_was_on:
@@ -678,6 +719,46 @@ def log_health(stage: str, health, status=None) -> None:
     from sbr_tpu.diag.health import summarize
 
     run.log_health(stage, summarize(health, status))
+
+
+def log_fault(point: str = "?", kind: str = "?", **fields) -> None:
+    """Injected-fault event + manifest roll-up (no-op when telemetry is
+    off or while tracing) — the `resilience.faults` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_fault(point, kind, **fields)
+
+
+def log_retry(scope: str = "?", outcome: str = "?", attempt: int = 0, **fields) -> None:
+    """Retry attempt-outcome event + manifest roll-up (no-op when telemetry
+    is off or while tracing) — the `resilience.retry` default observer."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_retry(scope, outcome, attempt, **fields)
+
+
+def log_repair(action: str = "?", target: str = "?", ok: bool = True, **fields) -> None:
+    """Self-healing repair event + manifest roll-up (no-op when telemetry
+    is off or while tracing) — the `resilience.heal` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_repair(action, target, ok, **fields)
+
+
+def interrupt_all() -> int:
+    """Finalize every active run with manifest status ``"interrupted"`` —
+    called by the graceful-shutdown handler (`resilience.shutdown`) on
+    SIGTERM/SIGINT so a preempted process still leaves honest artifacts.
+    Returns how many runs were finalized."""
+    n = 0
+    while _STACK:
+        run = _STACK.pop()
+        try:
+            run.finalize(status="interrupted")
+            n += 1
+        except Exception:
+            pass  # keep unwinding: one failing finalize must not strand the rest
+    return n
 
 
 def _run_mtime(d: Path) -> float:

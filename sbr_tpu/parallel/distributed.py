@@ -18,17 +18,34 @@ Two multi-host regimes, matching the framework's two parallel programs:
    rendezvous: every finished tile is an atomically-renamed npz, any
    process can adopt any tile from disk, and the assembly pass is a pure
    cache read. A lost host costs only its unfinished tiles, which the
-   survivors (or a retry) pick up — the failure-detection analogue of
-   SURVEY §5.3 at the cross-host level.
+   survivors pick up — the failure-detection analogue of SURVEY §5.3 at
+   the cross-host level.
+
+Work stealing (`sbr_tpu.resilience`): the filesystem barrier no longer
+just times out on a dead peer. After ``steal_grace_s`` (env
+``SBR_STEAL_GRACE_S``, default 300 s) with **no new tile landing** — the
+grace clock resets on every drop in the missing count, so healthy slow
+peers are never stolen from — a waiting process claims per-tile **lease
+files** (atomic ``O_EXCL`` create, JSON ``{pid, host, ts, ttl_s}``) for
+the stalled batch and computes the orphaned tiles itself in one pass; an
+expired lease (holder died mid-steal, TTL ``SBR_STEAL_LEASE_TTL_S``,
+default 900 s) is taken over. Two survivors racing an expired lease both
+compute the tile — benign by construction, since tile writes are atomic
+and deterministic for the same sweep. Every adoption is an obs ``repair``
+event (action ``"adopt"``), so `report resilience` shows which host
+picked up whose work.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import socket
 import time
 from typing import Optional
 
 from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.resilience import faults
 
 
 def initialize_distributed(
@@ -74,6 +91,62 @@ def tile_assignment(n_tiles: int, n_processes: int, process_id: int) -> range:
     return range(start, start + base + (1 if process_id < rem else 0))
 
 
+def _log_adopt(tile_id: str, ok: bool) -> None:
+    """Work-stealing adoption as an obs ``repair`` event (action "adopt")."""
+    try:
+        from sbr_tpu import obs
+
+        obs.log_repair(action="adopt", target=tile_id, ok=ok)
+    except Exception:
+        pass  # telemetry must never sink the barrier
+
+
+def _cleanup_leases(ckpt) -> None:
+    """Drop leases for tiles that now exist (a completed steal, or a slow
+    peer that finished after all): leases are scaffolding, not results."""
+    for lease in ckpt.glob("tile_*.lease"):
+        if lease.with_suffix(".npz").exists():
+            try:
+                lease.unlink()
+            except OSError:
+                pass
+
+
+def _try_lease(ckpt, bi: int, ui: int, ttl_s: float) -> bool:
+    """Claim the steal-lease for tile (bi, ui): atomic O_EXCL create, or
+    take over a lease whose holder's TTL has lapsed. False = a live lease
+    is held by another surviving process (let it work)."""
+    lease = ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease"
+    record = json.dumps(
+        {"pid": os.getpid(), "host": socket.gethostname(), "ts": time.time(), "ttl_s": ttl_s}
+    )
+    try:
+        fd = os.open(lease, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        try:
+            held = json.loads(lease.read_text())
+            # Honor the TTL the HOLDER wrote (it sized the lease to its own
+            # batch), falling back to ours for pre-TTL-field leases.
+            if time.time() - float(held.get("ts", 0.0)) < float(held.get("ttl_s", ttl_s)):
+                return False
+        except (OSError, ValueError):
+            pass  # unreadable lease = a torn write from a dead holder
+        # Expired: take over. The replace can race another stealer doing the
+        # same — both then compute the tile, which is benign (atomic,
+        # deterministic, identical writes).
+        tmp = ckpt / f"{lease.name}.{os.getpid()}.tmp"
+        try:
+            tmp.write_text(record)
+            os.replace(tmp, lease)
+        except OSError:
+            return False
+        return True
+    else:
+        with os.fdopen(fd, "w") as f:
+            f.write(record)
+        return True
+
+
 def run_tiled_grid_multihost(
     beta_values,
     u_values,
@@ -88,6 +161,9 @@ def run_tiled_grid_multihost(
     timeout_s: float = 24 * 3600.0,
     dtype=None,
     verbose: bool = False,
+    work_steal: bool = True,
+    steal_grace_s: Optional[float] = None,
+    lease_ttl_s: Optional[float] = None,
 ):
     """Farm a β×u grid across processes via the shared checkpoint dir.
 
@@ -101,6 +177,14 @@ def run_tiled_grid_multihost(
     until every tile exists, then assembles and returns the full grid.
     With ``wait=False`` it returns None right after its own share — the
     pattern for worker processes whose results are consumed elsewhere.
+
+    With ``work_steal`` (default), a process that has seen no barrier
+    progress (no drop in the missing-tile count) for ``steal_grace_s``
+    (env ``SBR_STEAL_GRACE_S``, default 300 s) adopts the stalled tiles
+    under per-tile lease files (TTL ``lease_ttl_s`` /
+    ``SBR_STEAL_LEASE_TTL_S``, default 900 s) instead of timing out — see
+    the module docstring. ``timeout_s`` still bounds the whole barrier as
+    the last line of defense.
     """
     from sbr_tpu.utils.checkpoint import _tile_path, run_tiled_grid, tile_origins
 
@@ -130,27 +214,78 @@ def run_tiled_grid_multihost(
     if not wait:
         return None
 
-    # Filesystem barrier: every tile must exist before assembly.
+    # Filesystem barrier: every tile must exist before assembly. After the
+    # steal grace period, missing tiles are adopted under leases instead of
+    # waited on forever (a dead peer's share must not require a human).
     from pathlib import Path
 
+    if steal_grace_s is None:
+        steal_grace_s = float(os.environ.get("SBR_STEAL_GRACE_S", "300"))
+    if lease_ttl_s is None:
+        lease_ttl_s = float(os.environ.get("SBR_STEAL_LEASE_TTL_S", "900"))
+
     ckpt = Path(checkpoint_dir)
-    deadline = time.monotonic() + timeout_s
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    # Stall detection: the grace clock measures time since the missing-tile
+    # count last DROPPED, not since the barrier started — a healthy-but-slow
+    # peer that keeps landing tiles is never stolen from; only a genuinely
+    # stalled remainder triggers adoption.
+    last_progress = t0
+    n_missing_prev: Optional[int] = None
     while True:
         missing = [t for t in tiles if not _tile_path(ckpt, *t).exists()]
         if not missing:
             break
+        if n_missing_prev is not None and len(missing) < n_missing_prev:
+            last_progress = time.monotonic()
+        n_missing_prev = len(missing)
+        faults.fire("barrier.poll", target=f"missing={len(missing)}")
+        if work_steal and time.monotonic() - last_progress >= steal_grace_s:
+            # Lease the whole stalled batch first, then compute it in ONE
+            # resilient pass (retry policy, verify-on-load, degrade ladder):
+            # cached tiles are read exactly once, not once per adoption.
+            leased = [
+                (bi, ui)
+                for bi, ui in missing
+                if not _tile_path(ckpt, bi, ui).exists()
+                and _try_lease(ckpt, bi, ui, lease_ttl_s)
+            ]
+            if leased:
+                leased_set = set(leased)
+                if verbose:
+                    print(f"  adopting {len(leased)} orphaned tile(s): {leased} …")
+                try:
+                    run_tiled_grid(
+                        beta_values, u_values, base, config=config,
+                        tile_shape=tile_shape, checkpoint_dir=checkpoint_dir,
+                        dtype=dtype, verbose=False,
+                        tile_owner=lambda b, u: (b, u) in leased_set,
+                    )
+                    for bi, ui in leased:
+                        _log_adopt(f"tile_b{bi:05d}_u{ui:05d}", ok=True)
+                finally:
+                    for bi, ui in leased:
+                        try:
+                            (ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease").unlink()
+                        except OSError:
+                            pass
+                last_progress = time.monotonic()  # we made the progress
+                continue  # re-check the barrier immediately
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"{len(missing)} tiles still missing after {timeout_s:.0f}s "
-                f"(first: {missing[0]}); a peer process likely died — rerun "
-                "with its process_id (or a smaller num_processes) to adopt "
-                "its tiles."
+                f"(first: {missing[0]}); a peer process likely died and its "
+                "tiles could not be adopted (work stealing "
+                f"{'on' if work_steal else 'off'}) — rerun with its "
+                "process_id (or a smaller num_processes) to adopt its tiles."
             )
         if verbose:
             print(f"  waiting on {len(missing)} peer tiles …")
         time.sleep(poll_s)
 
     # Assembly: all tiles cached on disk — a pure read, no recompute.
+    _cleanup_leases(ckpt)
     return run_tiled_grid(
         beta_values,
         u_values,

@@ -1,0 +1,50 @@
+"""Resilience layer: deterministic fault injection, unified retry/backoff,
+and self-healing execution.
+
+The robustness counterpart of the `sbr_tpu.obs` observability stack. Four
+modules:
+
+- ``faults``   — seeded, env-driven fault plans (``SBR_FAULT_PLAN``) fired
+  at named fault points planted in tile execution, checkpoint IO, the
+  bench probe, and the multihost barrier; every firing is an obs ``fault``
+  event, and the same seed always yields the same fault sequence.
+- ``retry``    — the one retry policy engine (exponential backoff with
+  jitter, deterministic-vs-transient classification, shared per-scope
+  budgets) behind the tile loop and the bench probe ladder; attempts land
+  as obs ``retry`` events with a manifest roll-up.
+- ``heal``     — sha256 integrity sidecars with verify-on-load and
+  quarantine-and-recompute for corrupt tiles, plus the per-cell degrade
+  ladder that re-runs health-divergent cells at float64 with tightened
+  tolerances (obs ``repair`` events + checkpoint ``repairs`` block).
+- ``shutdown`` — graceful SIGTERM/SIGINT: finalize obs manifests as
+  ``"interrupted"`` and remove partial temp files before exit.
+- ``chaos``    — the CI chaos smoke: a seeded fault plan (transient
+  errors, a corrupted tile, a preemption) must yield a final grid
+  bit-identical to the fault-free run (``python -m
+  sbr_tpu.resilience.chaos``).
+
+`faults` and `retry` are stdlib-only at import time: the bench harness
+parent (which must never load jax) imports them standalone by file path.
+
+Render what happened with ``python -m sbr_tpu.obs.report resilience
+RUN_DIR`` (exit 1 on unrecovered failures).
+"""
+
+from sbr_tpu.resilience import faults, heal, retry, shutdown
+from sbr_tpu.resilience.faults import FaultPlan, InjectedFault
+from sbr_tpu.resilience.retry import RetryBudget, RetryError, RetryPolicy, policy_from_env
+from sbr_tpu.resilience.shutdown import graceful_shutdown
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "RetryBudget",
+    "RetryError",
+    "RetryPolicy",
+    "faults",
+    "graceful_shutdown",
+    "heal",
+    "policy_from_env",
+    "retry",
+    "shutdown",
+]

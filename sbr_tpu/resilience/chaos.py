@@ -1,0 +1,196 @@
+"""Chaos smoke: prove the resilience layer recovers a sweep bit-for-bit.
+
+``python -m sbr_tpu.resilience.chaos [--out DIR] [--json]`` runs three
+worker subprocesses over one small β×u tiled sweep:
+
+1. **baseline** — fault-free run; its grid is the ground truth.
+2. **chaos** — the same sweep under a seeded fault plan that injects a
+   transient dispatch error (retried), a NaN-poisoned tile result
+   (degrade-ladder repaired in-run), a corrupted checkpoint file (a torn
+   write, discovered later), and a preemption (SIGTERM → graceful
+   shutdown: manifest status ``"interrupted"``, exit 143).
+3. **resume** — a clean rerun against the chaos checkpoint dir: the
+   corrupt tile is sha256-quarantined and recomputed, cached tiles are
+   served, preempted tiles are computed fresh.
+
+The smoke PASSES only if the resumed grid is **bit-identical** to the
+baseline (`max_aw`/`xi`/`status` byte equality — recovery must change
+nothing) and the recovery path is visible: fault events + an interrupted
+manifest in the chaos run, a quarantine repair in the resume run, and
+``report resilience`` exiting 0 on the resume run. CI runs this as the
+chaos-smoke job; it is equally useful locally after touching any
+resilience path.
+
+The driver itself never imports jax (workers are subprocesses), so it can
+run on a box whose accelerator stack is itself the thing being debugged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+# One plan, seeded: same sequence every run (see resilience.faults).
+# Tile order for the 4×4 grid under 2×2 tiles: (0,0) (0,2) (2,0) (2,2);
+# tile.compute hit order with the retried first attempt: 1=(0,0) attempt 1
+# (transient), 2=(0,0) attempt 2, 3=(0,2), 4=(2,0) → preempted.
+FAULT_PLAN = {
+    "seed": 0,
+    "rules": [
+        {"point": "tile.compute", "kind": "transient", "at_hits": [1]},
+        {"point": "tile.result", "kind": "nan", "match": "b00000_u00002",
+         "cells": 1, "max_fires": 1},
+        {"point": "checkpoint.save", "kind": "corrupt", "match": "b00000_u00000",
+         "max_fires": 1},
+        {"point": "tile.compute", "kind": "preempt", "at_hits": [4]},
+    ],
+}
+
+_FIELDS = ("max_aw", "xi", "status")
+
+
+def _worker(ckpt_dir: str, out_npz: str) -> int:
+    """One tiled sweep (fixed small shape), grids saved as npz."""
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.utils.checkpoint import run_tiled_grid
+
+    cfg = SolverConfig(n_grid=96, bisect_iters=40)
+    grid = run_tiled_grid(
+        np.linspace(0.5, 2.0, 4),
+        np.linspace(0.05, 0.5, 4),
+        make_model_params(),
+        config=cfg,
+        tile_shape=(2, 2),
+        checkpoint_dir=ckpt_dir,
+    )
+    arrays = {f: np.asarray(getattr(grid, f)) for f in _FIELDS}
+    with open(out_npz, "wb") as fh:
+        np.savez(fh, **arrays)
+    return 0
+
+
+def _run_phase(name: str, out: Path, ckpt: Path, npz, fault_plan=None, timeout_s=600.0):
+    """Run one worker subprocess; returns (rc, obs_run_dir_or_None)."""
+    obs_root = out / f"obs_{name}"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SBR_OBS": "1",
+        "SBR_OBS_LABEL": name,
+        "SBR_OBS_DIR": str(obs_root),
+        "SBR_RETRY_BASE_DELAY_S": "0.05",
+    }
+    env.pop("SBR_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["SBR_FAULT_PLAN"] = json.dumps(fault_plan)
+    argv = [
+        sys.executable, "-m", "sbr_tpu.resilience.chaos",
+        "--worker", str(ckpt), str(npz if npz else out / f"{name}.npz"),
+    ]
+    proc = subprocess.run(
+        argv, env=env, timeout=timeout_s, capture_output=True, text=True
+    )
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    runs = sorted(obs_root.iterdir()) if obs_root.is_dir() else []
+    return proc.returncode, (runs[0] if runs else None)
+
+
+def _manifest(run_dir) -> dict:
+    try:
+        return json.loads((Path(run_dir) / "manifest.json").read_text())
+    except (OSError, TypeError, json.JSONDecodeError):
+        return {}
+
+
+def _report_resilience(run_dir) -> tuple:
+    """(exit_code, json_doc) from the report CLI — the user-facing gate."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "sbr_tpu.obs.report", "resilience", str(run_dir), "--json"],
+        capture_output=True, text=True, timeout=120.0,
+    )
+    try:
+        return proc.returncode, json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return proc.returncode, {}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.resilience.chaos",
+        description="Seeded chaos smoke: faulted+resumed sweep must be "
+        "bit-identical to the fault-free run",
+    )
+    parser.add_argument("--out", default="/tmp/sbr_chaos", help="scratch/artifact dir")
+    parser.add_argument("--json", action="store_true", help="machine-readable verdict")
+    parser.add_argument("--worker", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    if args.worker:
+        return _worker(*args.worker)
+
+    out = Path(args.out)
+    if out.exists():
+        shutil.rmtree(out)
+    out.mkdir(parents=True)
+    checks: dict = {}
+
+    def log(msg):
+        if not args.json:
+            print(msg)
+
+    log("phase 1/3: fault-free baseline …")
+    rc, _ = _run_phase("baseline", out, out / "ckpt_baseline", out / "baseline.npz")
+    checks["baseline_rc0"] = rc == 0
+
+    log("phase 2/3: chaos (transient + nan poison + corrupt tile + preemption) …")
+    rc, chaos_run = _run_phase(
+        "chaos", out, out / "ckpt_chaos", out / "chaos.npz", fault_plan=FAULT_PLAN
+    )
+    checks["chaos_preempted_143"] = rc == 143
+    checks["chaos_manifest_interrupted"] = _manifest(chaos_run).get("status") == "interrupted"
+    chaos_res = (_manifest(chaos_run).get("resilience") or {})
+    faults_seen = chaos_res.get("faults") or {}
+    checks["chaos_faults_visible"] = {
+        "tile.compute:transient", "tile.result:nan", "checkpoint.save:corrupt",
+        "tile.compute:preempt",
+    } <= set(faults_seen)
+
+    log("phase 3/3: clean resume against the chaos checkpoint …")
+    rc, resume_run = _run_phase("resume", out, out / "ckpt_chaos", out / "resumed.npz")
+    checks["resume_rc0"] = rc == 0
+    checks["corrupt_tile_quarantined"] = any((out / "ckpt_chaos" / "quarantine").glob("*.npz"))
+    report_rc, report_doc = _report_resilience(resume_run) if resume_run else (2, {})
+    checks["report_resilience_rc0"] = report_rc == 0
+    checks["resume_repairs_visible"] = "quarantine" in (report_doc.get("repairs") or {})
+
+    if checks["baseline_rc0"] and checks["resume_rc0"]:
+        want = np.load(out / "baseline.npz")
+        got = np.load(out / "resumed.npz")
+        checks["grid_bit_identical"] = all(
+            want[f].tobytes() == got[f].tobytes() for f in _FIELDS
+        )
+    else:
+        checks["grid_bit_identical"] = False
+
+    ok = all(checks.values())
+    if args.json:
+        print(json.dumps({"ok": ok, "checks": checks, "out": str(out)}))
+    else:
+        for name, passed in checks.items():
+            print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+        print(f"chaos smoke: {'OK — recovery is bit-exact' if ok else 'FAILED'} ({out})")
+        if resume_run is not None:
+            print(f"recovery path: python -m sbr_tpu.obs.report resilience {resume_run}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""Deterministic fault injection: seeded fault plans fired at named points.
+
+Production-scale sweeps on real TPU pods meet preemptions, hung compiles,
+flaky hosts, and corrupted checkpoint files as routine events — and the
+only way to keep the recovery paths (retry, quarantine/recompute, work
+stealing, graceful shutdown) from rotting is to exercise them on demand.
+This module makes failure a *reproducible input*: a ``FaultPlan`` is a
+seeded list of rules, each bound to a named **fault point** planted in the
+execution paths that can really fail:
+
+=====================  ====================================================
+point                  planted in
+=====================  ====================================================
+``sweep.dispatch``     `sweeps.baseline_sweeps.beta_u_grid` /
+                       `sweeps.policy_sweeps.policy_sweep_interest`, just
+                       before the jitted grid program dispatches
+``tile.compute``       `utils.checkpoint.run_tiled_grid`, per tile attempt
+``tile.result``        same, after a tile computes (site poisons results)
+``checkpoint.save``    after a tile's atomic save (site corrupts the file)
+``checkpoint.load``    before a cached tile is read back
+``barrier.poll``       `parallel.distributed` filesystem barrier, per poll
+``bench.probe``        `bench.py`'s accelerator probe, per attempt
+=====================  ====================================================
+
+Fault kinds:
+
+- ``transient`` — raise :class:`InjectedFault` (a ``RuntimeError``): the
+  retry engine must classify and absorb it.
+- ``hang``      — sleep ``duration_s`` (default 30) then continue: models a
+  stalled tunnel/compile; timeouts and kill -9 recovery are tested with it.
+- ``preempt``   — send this process ``signal`` (default ``TERM``, ``KILL``
+  for un-catchable death): models pod preemption; the graceful-shutdown
+  handler (SIGTERM) or crash-resume path (SIGKILL) must recover.
+- ``nan``       — returned to the call site, which poisons ``cells`` result
+  cells with NaN and marks their health flags divergent: the degrade
+  ladder (`resilience.heal`) must repair them.
+- ``corrupt``   — returned to the call site, which truncates the
+  just-written checkpoint file: sha256 verify-on-load must quarantine it.
+
+Determinism contract: a plan with the same ``seed`` replayed against the
+same sequence of fault-point invocations fires the same faults (per-rule
+counters + a per-rule ``random.Random`` stream; nothing reads wall clock
+or global RNG state). Asserted by ``tests/test_resilience.py``.
+
+Configuration: ``SBR_FAULT_PLAN`` holds either inline JSON or a path to a
+JSON file. Shape::
+
+    {"seed": 0, "rules": [
+      {"point": "tile.compute", "kind": "transient", "at_hits": [1]},
+      {"point": "checkpoint.save", "kind": "corrupt", "match": "b00000",
+       "max_fires": 1},
+      {"point": "tile.compute", "kind": "preempt", "at_hits": [3]},
+      {"point": "tile.result", "kind": "nan", "p": 0.5, "cells": 2}
+    ]}
+
+A rule fires on a matching invocation when its hit index is in
+``at_hits``, or (without ``at_hits``) when its seeded stream draws below
+``p`` (default 1.0); ``max_fires`` caps total firings, ``match`` restricts
+to targets containing the substring. Every firing is emitted as an obs
+``fault`` event (when telemetry is on) and appended to the plan's
+``firings`` list.
+
+This module is deliberately stdlib-only at import time: the bench harness
+PARENT (which must never load jax) imports it standalone by file path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal as _signal
+import sys
+import time
+from typing import Optional
+
+KINDS = ("transient", "hang", "preempt", "nan", "corrupt")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected transient error (retryable by design)."""
+
+
+class Rule:
+    """One fault rule plus its mutable firing state (see module docstring)."""
+
+    __slots__ = (
+        "index", "point", "kind", "p", "max_fires", "at_hits", "match",
+        "duration_s", "signal", "cells", "hits", "fires", "_rng",
+    )
+
+    def __init__(self, index: int, seed: int, spec: dict) -> None:
+        point = spec.get("point")
+        kind = spec.get("kind")
+        if not point or kind not in KINDS:
+            raise ValueError(
+                f"fault rule #{index} needs a 'point' and a 'kind' in {KINDS}: {spec!r}"
+            )
+        self.index = index
+        self.point = point
+        self.kind = kind
+        self.p = float(spec.get("p", 1.0))
+        self.max_fires = spec.get("max_fires")
+        self.at_hits = [int(h) for h in spec["at_hits"]] if "at_hits" in spec else None
+        self.match = spec.get("match", "")
+        self.duration_s = float(spec.get("duration_s", 30.0))
+        self.signal = str(spec.get("signal", "TERM")).upper()
+        self.cells = int(spec.get("cells", 1))
+        self.hits = 0
+        self.fires = 0
+        # One independent deterministic stream per rule: decisions depend
+        # only on (seed, rule identity, hit order), never on other rules'
+        # draws, wall clock, or the global random module.
+        self._rng = random.Random(f"{seed}|{index}|{point}|{kind}")
+
+    def should_fire(self, target: str, consume: bool = True) -> bool:
+        """Advance this rule's hit counter and RNG stream for one matching
+        invocation and decide whether it would fire. With ``consume=False``
+        (the stream-alignment path when another rule already claimed the
+        invocation) the decision is computed identically but the rule's
+        ``fires`` budget is NOT charged — a planned fault must never be
+        silently spent by an invocation it didn't act on."""
+        if self.match and self.match not in target:
+            return False
+        self.hits += 1
+        if self.at_hits is not None:
+            fire = self.hits in self.at_hits
+        else:
+            # Draw unconditionally (even past max_fires) so the stream stays
+            # aligned with a replay under any other rule interleaving.
+            fire = self._rng.random() < self.p
+        if self.max_fires is not None and self.fires >= int(self.max_fires):
+            fire = False
+        if fire and consume:
+            self.fires += 1
+        return fire
+
+
+class FaultPlan:
+    """A seeded set of fault rules; `fire` is the single injection gate."""
+
+    def __init__(self, spec: dict) -> None:
+        self.seed = int(spec.get("seed", 0))
+        self.rules = [Rule(i, self.seed, r) for i, r in enumerate(spec.get("rules", []))]
+        self.firings: list = []  # chronological (point, kind, target) record
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """Parse inline JSON, or read a path to a JSON file."""
+        text = text.strip()
+        if not text.startswith("{"):
+            with open(text) as fh:
+                text = fh.read()
+        return cls(json.loads(text))
+
+    def fire(self, point: str, target: str = "") -> Optional[Rule]:
+        """Evaluate every rule bound to ``point`` against this invocation.
+
+        Side-effectful kinds act here (``transient`` raises,
+        ``hang`` sleeps, ``preempt`` signals this process); cooperation
+        kinds (``nan``, ``corrupt``) are returned for the site to apply.
+        At most one rule acts per invocation (first firing rule in plan
+        order wins); later rules still count the hit, keeping their
+        streams aligned with the fault-free replay.
+        """
+        acted = None
+        for rule in self.rules:
+            if rule.point != point:
+                continue
+            if acted is not None:
+                # keep hit/draw streams advancing identically whether or
+                # not an earlier rule already claimed this invocation —
+                # without spending the rule's own max_fires budget
+                rule.should_fire(target, consume=False)
+                continue
+            if rule.should_fire(target):
+                acted = rule
+        if acted is None:
+            return None
+        record = {
+            "point": point,
+            "kind": acted.kind,
+            "target": target,
+            "rule": acted.index,
+            "hit": acted.hits,
+            "fire": acted.fires,
+        }
+        self.firings.append(record)
+        _emit(**record)
+        if acted.kind == "transient":
+            raise InjectedFault(
+                f"injected transient fault at {point} (rule {acted.index}, target {target!r})"
+            )
+        if acted.kind == "hang":
+            time.sleep(acted.duration_s)
+            return None
+        if acted.kind == "preempt":
+            os.kill(os.getpid(), getattr(_signal, f"SIG{acted.signal}"))
+            # SIGTERM delivery is asynchronous: give the interpreter a
+            # moment to run the handler before the site continues.
+            time.sleep(0.5)
+            return None
+        return acted  # nan / corrupt: the site applies the damage
+
+
+# ---------------------------------------------------------------------------
+# Process-global plan: parsed once from SBR_FAULT_PLAN on first use.
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_PARSED = False
+
+
+def plan() -> Optional[FaultPlan]:
+    """The active plan (lazy-parsed from ``SBR_FAULT_PLAN``), or None."""
+    global _PLAN, _PARSED
+    if not _PARSED:
+        _PARSED = True
+        text = os.environ.get("SBR_FAULT_PLAN", "").strip()
+        if text:
+            _PLAN = FaultPlan.parse(text)
+    return _PLAN
+
+
+def install(p: Optional[FaultPlan]) -> None:
+    """Programmatically install (or clear, with None) the active plan."""
+    global _PLAN, _PARSED
+    _PLAN = p
+    _PARSED = True
+
+
+def reset() -> None:
+    """Forget the active plan so the next `fire` re-reads SBR_FAULT_PLAN."""
+    global _PLAN, _PARSED
+    _PLAN = None
+    _PARSED = False
+
+
+def fire(point: str, target: str = "") -> Optional[Rule]:
+    """Module-level fault point: near-zero cost (one None check) without a
+    plan; with one, delegates to :meth:`FaultPlan.fire`."""
+    p = plan()
+    if p is None:
+        return None
+    return p.fire(point, target)
+
+
+def corrupt_file(path, rule: Optional[Rule] = None) -> None:
+    """Apply a ``corrupt`` injection: truncate ``path`` to half its size
+    (a torn write — the checkpoint-corruption mode seen on real pods when
+    a host dies mid-flush on shared storage)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(size // 2, 1))
+
+
+def _emit(**fields) -> None:
+    """Emit one obs ``fault`` event, without ever being the reason jax
+    loads into a jax-free process: when `sbr_tpu` is not already imported
+    (the bench parent loads this file standalone by path), only an
+    explicit SBR_OBS opt-in justifies pulling the package in."""
+    if "sbr_tpu" not in sys.modules and os.environ.get("SBR_OBS", "").strip() in ("", "0"):
+        return
+    try:
+        from sbr_tpu import obs
+
+        obs.log_fault(**fields)
+    except Exception:
+        pass  # telemetry must never sink an injection (or its test)

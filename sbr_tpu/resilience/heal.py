@@ -1,0 +1,201 @@
+"""Self-healing primitives: checkpoint integrity sidecars, quarantine, and
+the per-cell degrade-ladder repair of divergent sweep cells.
+
+Three healing mechanisms, used by `utils.checkpoint.run_tiled_grid` and
+`parallel.distributed`:
+
+**Integrity sidecars.** Every saved tile gains a ``<tile>.sha256`` sidecar
+(hex digest of the file bytes, written after the tile's atomic rename).
+`verify_file` re-hashes on load: a mismatch means torn/bit-rotted storage
+and the tile is **quarantined** (moved into ``quarantine/`` next to the
+checkpoint, never silently deleted — it is evidence) and recomputed.
+Tiles written by pre-sidecar builds verify as ``"legacy"`` and are trusted,
+so old checkpoint dirs keep resuming.
+
+**Degrade ladder.** A cell whose `sbr_tpu.diag` health bitmask carries a
+divergent bit (NaN poison, non-finite residual, fixed-point failure)
+is re-run individually, climbing a ladder of increasingly conservative
+numerics:
+
+- rung 0 — identical config and dtype: repairs transient device garbage
+  (and injected NaN poison) where the mathematics is actually fine; being
+  deterministic, it cannot mask a genuine numerical failure — that
+  recomputes identically divergent and escalates;
+- rung 1 — float64 with tightened tolerances (doubled bisection
+  halvings): the "paranoid precision" rung for cells that are genuinely
+  marginal at sweep precision.
+
+A repaired cell replaces the original **only when its recompute is
+non-divergent** — the ladder strictly improves trust, never swaps one
+untrusted number for another. Each outcome is emitted as an obs ``repair``
+event and returned in a ``repairs`` report that call sites persist in the
+checkpoint manifest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+
+def sidecar_path(path) -> Path:
+    return Path(str(path) + ".sha256")
+
+
+def _digest(path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_sidecar(path) -> Path:
+    """Write (atomically) the sha256 sidecar for an already-saved file."""
+    side = sidecar_path(path)
+    tmp = Path(str(side) + ".tmp")
+    tmp.write_text(_digest(path) + "\n")
+    os.replace(tmp, side)
+    return side
+
+
+def verify_file(path) -> str:
+    """``"ok"`` (digest matches), ``"legacy"`` (no sidecar — a pre-sidecar
+    build wrote it; trusted), or ``"mismatch"`` (corrupt)."""
+    side = sidecar_path(path)
+    if not side.exists():
+        return "legacy"
+    try:
+        stored = side.read_text().strip()
+    except OSError:
+        return "mismatch"
+    return "ok" if stored and stored == _digest(path) else "mismatch"
+
+
+def quarantine(path, reason: str = "sha256-mismatch") -> Optional[Path]:
+    """Move a corrupt file (and its sidecar) into a ``quarantine/`` dir
+    beside it — evidence is preserved, the slot is freed for recompute.
+    Returns the quarantined path (None if the move itself failed)."""
+    path = Path(path)
+    qdir = path.parent / "quarantine"
+    qdir.mkdir(exist_ok=True)
+    dest, i = qdir / path.name, 0
+    while dest.exists():
+        i += 1
+        dest = qdir / f"{path.name}.{i}"
+    try:
+        os.replace(path, dest)
+    except OSError:
+        return None
+    side = sidecar_path(path)
+    if side.exists():
+        try:
+            os.replace(side, Path(str(dest) + ".sha256"))
+        except OSError:
+            pass
+    _log_repair(action="quarantine", target=path.name, ok=True, reason=reason)
+    return dest
+
+
+# ---------------------------------------------------------------------------
+# Degrade ladder
+# ---------------------------------------------------------------------------
+
+
+def _ladder(config, dtype) -> list:
+    """(config, dtype, needs_x64) rungs, mildest first (module docstring)."""
+    import jax.numpy as jnp
+
+    tight = dataclasses.replace(config, bisect_iters=max(config.bisect_iters * 2, 90))
+    return [(config, dtype, False), (tight, jnp.float64, True)]
+
+
+def _x64_context(needed: bool):
+    """Rung 1 must run at REAL float64: with x64 disabled (the production
+    default — tests force it on, so they can't catch this) a bare
+    jnp.float64 request silently canonicalizes to f32 and the 'paranoid
+    precision' rung would be a lie. jax.experimental.enable_x64 scopes
+    genuine f64 to just the per-cell repair call."""
+    import contextlib
+
+    import jax
+
+    if not needed or jax.config.jax_enable_x64:
+        return contextlib.nullcontext()
+    try:
+        from jax.experimental import enable_x64
+
+        return enable_x64()
+    except Exception:  # very old jax without the context manager
+        return contextlib.nullcontext()
+
+
+def repair_divergent(
+    beta_values,
+    u_values,
+    base,
+    config,
+    dtype,
+    arrays: dict,
+    flags,
+    scope: str = "tile",
+) -> list:
+    """Re-run every divergent cell of one tile up the degrade ladder,
+    patching ``arrays`` (the tile's field dict, modified in place) where a
+    rung produces a non-divergent replacement.
+
+    ``flags`` is the tile's health flag grid (host array, same shape as
+    the arrays). Returns the repairs report: one dict per divergent cell
+    with the cell index, the rung that fixed it (or None), and whether it
+    was repaired.
+    """
+    from sbr_tpu.diag.health import DIVERGENT_MASK
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid  # lazy: avoids cycle
+
+    flags = np.asarray(flags)
+    divergent = np.argwhere((flags & DIVERGENT_MASK) != 0)
+    if divergent.size == 0:
+        return []
+    beta_values = np.asarray(beta_values)
+    u_values = np.asarray(u_values)
+    report = []
+    for i, j in divergent:
+        i, j = int(i), int(j)
+        entry = {"cell": [i, j], "flags": int(flags[i, j]), "rung": None, "repaired": False}
+        for rung, (cfg, dt, needs_x64) in enumerate(_ladder(config, dtype)):
+            with _x64_context(needs_x64):
+                cell = beta_u_grid(
+                    beta_values[i : i + 1], u_values[j : j + 1], base, config=cfg, dtype=dt
+                )
+            new_flags = (
+                int(np.asarray(cell.health.flags).reshape(())) if cell.health is not None else 0
+            )
+            if new_flags & DIVERGENT_MASK:
+                continue  # still divergent — climb the ladder
+            for f in arrays:
+                arrays[f][i, j] = np.asarray(getattr(cell, f)).reshape(())
+            entry.update(rung=rung, repaired=True, new_flags=new_flags)
+            break
+        report.append(entry)
+        _log_repair(
+            action="degrade_ladder",
+            target=f"{scope}[{i},{j}]",
+            ok=entry["repaired"],
+            rung=entry["rung"],
+            flags=entry["flags"],
+        )
+    return report
+
+
+def _log_repair(**fields) -> None:
+    try:
+        from sbr_tpu import obs
+
+        obs.log_repair(**fields)
+    except Exception:
+        pass  # telemetry must never sink a repair

@@ -1,0 +1,194 @@
+"""Unified retry policy engine: exponential backoff with jitter, error
+classification, and per-scope retry budgets.
+
+Before this module the package had three ad-hoc failure defenses: one bare
+retry loop per sweep tile (`utils/checkpoint.py`), a hand-rolled 3×300 s
+probe ladder in `bench.py`, and nothing else. This engine is the single
+policy they all share:
+
+- **Classification.** Deterministic errors (shape/param/dtype bugs —
+  ``ValueError``/``TypeError`` by default) are re-raised immediately:
+  retrying the identical call just burns attempts. Everything else is
+  treated as transient (device resets, tunnel drops, injected faults).
+- **Backoff.** ``delay = min(max_delay_s, base_delay_s * multiplier**(k-1))``
+  after failed attempt k, optionally widened by up to ``jitter`` fraction
+  (drawn from a caller-supplied ``random.Random`` so chaos tests stay
+  deterministic; jitter exists to de-synchronize a fleet of workers
+  hammering shared storage after a common-mode failure).
+- **Budgets.** A :class:`RetryBudget` caps the *total* extra attempts
+  spent across every scope that shares it — a sweep of 400 tiles against
+  a dead backend must fail fast, not retry 400×3 times.
+- **Observability.** Every attempt outcome is reported to an ``observer``
+  callable (default: the obs ``retry`` event + manifest roll-up via
+  `obs.log_retry`, lazily imported); outcomes are ``retrying``,
+  ``recovered``, ``gave_up``, ``deterministic``, and ``budget_exhausted``.
+
+Like `resilience.faults`, this module is stdlib-only at import time so the
+bench harness parent (which must never load jax) can import it standalone
+by file path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import sys
+import time
+from typing import Callable, Optional, Tuple
+
+DETERMINISTIC_DEFAULT: Tuple[type, ...] = (ValueError, TypeError)
+
+
+class RetryError(RuntimeError):
+    """All attempts failed. ``__cause__`` is the last underlying error."""
+
+    def __init__(self, scope: str, attempts: int, reason: str = "") -> None:
+        msg = f"{scope} failed after {attempts} attempt{'s' if attempts != 1 else ''}"
+        if reason:
+            msg += f" ({reason})"
+        super().__init__(msg)
+        self.scope = scope
+        self.attempts = attempts
+
+
+class RetryBudget:
+    """Shared pool of extra attempts across scopes (see module docstring)."""
+
+    def __init__(self, total: int) -> None:
+        self.total = int(total)
+        self.used = 0
+
+    def take(self) -> bool:
+        """Consume one retry if any remain; False means the pool is dry."""
+        if self.used >= self.total:
+            return False
+        self.used += 1
+        return True
+
+    @property
+    def remaining(self) -> int:
+        return max(self.total - self.used, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry configuration; `call` runs a function under it."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 1.0
+    multiplier: float = 2.0
+    max_delay_s: float = 60.0
+    jitter: float = 0.0  # widen each delay by up to this fraction
+    deterministic: Tuple[type, ...] = DETERMINISTIC_DEFAULT
+
+    def delay_s(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        """Backoff after failed attempt ``attempt`` (1-based), widened by up
+        to ``jitter`` fraction (from ``rng`` when given — chaos tests pass a
+        seeded one — else the module RNG, so the knob works out of the box)."""
+        d = min(self.max_delay_s, self.base_delay_s * self.multiplier ** (attempt - 1))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (rng.random() if rng is not None else random.random())
+        return d
+
+    def call(
+        self,
+        fn: Callable,
+        *args,
+        scope: str = "call",
+        budget: Optional[RetryBudget] = None,
+        observer: Optional[Callable] = None,
+        sleep: Callable = time.sleep,
+        rng: Optional[random.Random] = None,
+        **kwargs,
+    ):
+        """Run ``fn(*args, **kwargs)``, retrying transient failures.
+
+        Raises deterministic errors unchanged on the first occurrence and
+        :class:`RetryError` (chained to the last error) when attempts or
+        the shared ``budget`` run out.
+        """
+        if observer is None:
+            observer = _default_observer
+        last_err = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                out = fn(*args, **kwargs)
+            except self.deterministic as err:
+                observer(
+                    scope=scope, outcome="deterministic", attempt=attempt,
+                    max_attempts=self.max_attempts, error=repr(err),
+                )
+                raise
+            except Exception as err:
+                last_err = err
+                if attempt >= self.max_attempts:
+                    break
+                if budget is not None and not budget.take():
+                    observer(
+                        scope=scope, outcome="budget_exhausted", attempt=attempt,
+                        max_attempts=self.max_attempts, error=repr(err),
+                    )
+                    raise RetryError(scope, attempt, "shared retry budget exhausted") from err
+                backoff = self.delay_s(attempt, rng)
+                observer(
+                    scope=scope, outcome="retrying", attempt=attempt,
+                    max_attempts=self.max_attempts, error=repr(err),
+                    backoff_s=round(backoff, 3),
+                )
+                if backoff > 0.0:
+                    sleep(backoff)
+            else:
+                if attempt > 1:
+                    observer(
+                        scope=scope, outcome="recovered", attempt=attempt,
+                        max_attempts=self.max_attempts,
+                    )
+                return out
+        observer(
+            scope=scope, outcome="gave_up", attempt=self.max_attempts,
+            max_attempts=self.max_attempts, error=repr(last_err),
+        )
+        raise RetryError(scope, self.max_attempts) from last_err
+
+
+def policy_from_env(prefix: str = "SBR_RETRY", **defaults) -> RetryPolicy:
+    """Build a policy from ``{prefix}_MAX_ATTEMPTS`` / ``_BASE_DELAY_S`` /
+    ``_MULTIPLIER`` / ``_MAX_DELAY_S`` / ``_JITTER`` env overrides layered
+    over ``defaults`` (which themselves override the dataclass defaults).
+
+    This is how each subsystem gets its own tunable scope with one shared
+    mechanism: the tile loop reads ``SBR_RETRY_*``, the bench probe ladder
+    ``SBR_BENCH_PROBE_*`` (where ``SBR_BENCH_PROBE_ATTEMPTS`` is accepted
+    as the historical alias of ``_MAX_ATTEMPTS``).
+    """
+    fields = {
+        "max_attempts": (int, ("MAX_ATTEMPTS", "ATTEMPTS")),
+        "base_delay_s": (float, ("BASE_DELAY_S",)),
+        "multiplier": (float, ("MULTIPLIER",)),
+        "max_delay_s": (float, ("MAX_DELAY_S",)),
+        "jitter": (float, ("JITTER",)),
+    }
+    kw = dict(defaults)
+    for name, (cast, suffixes) in fields.items():
+        for suffix in suffixes:
+            raw = os.environ.get(f"{prefix}_{suffix}", "").strip()
+            if raw:
+                kw[name] = cast(raw)
+                break
+    return RetryPolicy(**kw)
+
+
+def _default_observer(**record) -> None:
+    """Report one attempt outcome as an obs ``retry`` event + manifest
+    roll-up. Guarded like `faults._emit`: never the reason jax loads into
+    a process that hasn't imported sbr_tpu (the bench parent supplies its
+    own observer instead)."""
+    if "sbr_tpu" not in sys.modules and os.environ.get("SBR_OBS", "").strip() in ("", "0"):
+        return
+    try:
+        from sbr_tpu import obs
+
+        obs.log_retry(**record)
+    except Exception:
+        pass  # telemetry must never sink the retried call

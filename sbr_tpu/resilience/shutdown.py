@@ -1,0 +1,139 @@
+"""Graceful shutdown: SIGTERM/SIGINT during a sweep or bench run finalizes
+telemetry and removes partially-written files before exit.
+
+On a preempted TPU pod the runtime sends SIGTERM and gives the process a
+grace window. Without a handler, an interrupted sweep leaves an obs
+manifest stuck in status ``"running"`` (indistinguishable from a crash)
+and possibly a partially-written tile temp file. Inside a
+:func:`graceful_shutdown` block:
+
+- SIGTERM / SIGINT raise :class:`Interrupted` at the next bytecode, which
+  unwinds the sweep loop (atomic-save temp files are cleaned by their own
+  ``except BaseException`` paths on the way out);
+- every active obs run is finalized with manifest status
+  ``"interrupted"`` — a parseable artifact that says "preempted", not
+  "crashed";
+- any temp file still registered via :func:`track_tmp` (a save that never
+  reached its cleanup) is removed;
+- the process exits via ``SystemExit(128+signum)`` for SIGTERM, or
+  re-raises ``KeyboardInterrupt`` for SIGINT (the Python convention).
+
+Handler hygiene: handlers install only in the main thread, only over the
+*default* dispositions (a host application's custom handlers are
+respected), are restored on block exit, and nesting is reentrant (the
+outermost block owns the handlers) — so `run_tiled_grid` can install
+unconditionally even when called from `run_tiled_grid_multihost` or an
+embedding server.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+from typing import Optional
+
+
+class Interrupted(BaseException):
+    """Raised by the signal handler; derives BaseException so ordinary
+    ``except Exception`` recovery code (tile retry, telemetry guards)
+    cannot swallow a shutdown request."""
+
+    def __init__(self, signum: int) -> None:
+        super().__init__(f"interrupted by signal {signum}")
+        self.signum = signum
+
+
+# Temp files currently being written by atomic-save helpers; a shutdown
+# sweeps whatever is still registered (see utils.checkpoint._save_atomic).
+_TMP_REGISTRY: set = set()
+_DEPTH = 0  # reentrancy: only the outermost graceful_shutdown owns handlers
+
+
+@contextlib.contextmanager
+def track_tmp(path):
+    """Register ``path`` as an in-flight partial write for the duration."""
+    _TMP_REGISTRY.add(str(path))
+    try:
+        yield
+    finally:
+        _TMP_REGISTRY.discard(str(path))
+
+
+def _cleanup_tmp() -> list:
+    removed = []
+    for p in sorted(_TMP_REGISTRY):
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            pass
+    _TMP_REGISTRY.clear()
+    return removed
+
+
+def _finalize_obs_interrupted() -> None:
+    """Finalize every active obs run with status "interrupted" (lazy
+    import: shutdown must work in processes that never started telemetry)."""
+    try:
+        from sbr_tpu.obs import runlog
+
+        runlog.interrupt_all()
+    except Exception:
+        pass  # a failing finalize must not mask the exit itself
+
+
+@contextlib.contextmanager
+def graceful_shutdown(label: str = "run"):
+    """Convert SIGTERM/SIGINT into a clean, telemetry-finalizing exit.
+
+    See the module docstring for semantics. Yields None; safe (a plain
+    pass-through) off the main thread and under nested use.
+    """
+    global _DEPTH
+    if threading.current_thread() is not threading.main_thread():
+        yield  # handlers are main-thread-only in CPython
+        return
+    if _DEPTH > 0:  # nested: the outermost block already owns the handlers
+        _DEPTH += 1
+        try:
+            yield
+        finally:
+            _DEPTH -= 1
+        return
+
+    def handler(signum, frame):
+        raise Interrupted(signum)
+
+    previous = {}
+    for sig, default in (
+        (signal.SIGTERM, signal.SIG_DFL),
+        (signal.SIGINT, signal.default_int_handler),
+    ):
+        current = signal.getsignal(sig)
+        if current == default:  # respect an embedder's custom handlers
+            previous[sig] = current
+            signal.signal(sig, handler)
+
+    _DEPTH = 1
+    try:
+        yield
+    except Interrupted as itr:
+        _finalize_obs_interrupted()
+        _cleanup_tmp()
+        if itr.signum == signal.SIGINT:
+            raise KeyboardInterrupt from itr
+        raise SystemExit(128 + itr.signum) from itr
+    finally:
+        _DEPTH -= 1
+        for sig, prev in previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+
+
+def interrupted_status() -> Optional[str]:
+    """Hook for tests: the registry size (debug aid)."""
+    return f"tracked_tmp={len(_TMP_REGISTRY)} depth={_DEPTH}"

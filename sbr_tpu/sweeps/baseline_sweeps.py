@@ -32,6 +32,7 @@ from sbr_tpu.baseline.solver import solve_equilibrium_core
 from sbr_tpu.models.params import ModelParams, SolverConfig
 from sbr_tpu.models.results import LearningSolution
 from sbr_tpu.obs import prof
+from sbr_tpu.resilience import faults
 
 
 @struct.dataclass
@@ -149,6 +150,9 @@ def u_sweep(
         jnp.asarray(tspan_end, dtype),
     )
     n_u = int(u_values.shape[0])
+    # Chaos fault point (resilience.faults): a transient rule here models a
+    # device/tunnel failure at dispatch; one global None-check when unplanned.
+    faults.fire("sweep.dispatch", target=f"u_sweep[{n_u}]")
     with obs.span("sweeps.u_sweep", n_u=n_u, sharded=mesh is not None) as sp:
         xi, tau_in, aw_max, status, health = obs.jit_call("sweeps.u_sweep", fn, *args)
         sp.sync(status)
@@ -220,6 +224,10 @@ def beta_u_grid(
         jnp.asarray(v, dtype) for v in (econ.p, econ.kappa, econ.lam, econ.eta, tspan[0], tspan[1], x0)
     )
     n_b, n_u = int(beta_values.shape[0]), int(u_values.shape[0])
+    # Chaos fault point: the tile loop's retry policy (utils.checkpoint)
+    # wraps this whole call, so a transient injected here exercises the
+    # real recovery path, not a mock.
+    faults.fire("sweep.dispatch", target=f"beta_u_grid[{n_b}x{n_u}]")
     with obs.span(
         "sweeps.beta_u_grid", n_beta=n_b, n_u=n_u, dtype=dtype.name, sharded=mesh is not None
     ) as sp:

@@ -165,6 +165,10 @@ def policy_sweep_interest(
         config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None
     )
     n_b, n_u, n_r = (int(v.shape[0]) for v in (beta_values, u_values, r_values))
+    # Chaos fault point (resilience.faults), mirroring beta_u_grid's.
+    from sbr_tpu.resilience import faults
+
+    faults.fire("sweep.dispatch", target=f"policy_interest[{n_b}x{n_u}x{n_r}]")
     with obs.span(
         "sweeps.policy_interest",
         n_beta=n_b, n_u=n_u, n_r=n_r, dtype=dtype.name, sharded=mesh is not None,

@@ -1,4 +1,4 @@
-"""Tiled sweep execution with on-disk resume (SURVEY §5.4).
+"""Tiled sweep execution with on-disk resume and self-healing (SURVEY §5.3-5.4).
 
 The reference recomputes everything on every run — its only reuse is
 in-memory (`scripts/1_baseline.jl:44,169`). For paper-resolution grids
@@ -8,10 +8,29 @@ interrupted sweep resumes instead of restarting, and a failed tile is
 retried rather than aborting the grid (the multi-host sweep-driver
 failure-detection analogue, SURVEY §5.3).
 
-Format: one ``.npz`` per tile (atomic rename) holding the four result
-grids, keyed by tile indices; a resumed run recomputes nothing for tiles
-already on disk. Tiles are plain numpy — checkpoints are device- and
-dtype-portable.
+Format: one ``.npz`` per tile (atomic rename) holding the result grids,
+keyed by tile indices, plus a ``.sha256`` integrity sidecar; a resumed run
+recomputes nothing for tiles already on disk. Tiles are plain numpy —
+checkpoints are device- and dtype-portable.
+
+Resilience layer (`sbr_tpu.resilience`):
+
+- tile failures go through the unified retry engine (`resilience.retry`,
+  exponential backoff, deterministic-error fail-fast, a per-sweep shared
+  retry budget ``SBR_RETRY_BUDGET``) instead of a bare loop;
+- cached tiles are sha256-verified on load; a corrupt tile is quarantined
+  (``quarantine/`` beside the checkpoint) and recomputed, never trusted;
+- cells flagged divergent by the `sbr_tpu.diag` health bitmask are re-run
+  per cell up the degrade ladder (same precision, then float64 with
+  tightened tolerances — `resilience.heal`), and the checkpoint manifest
+  gains a ``repairs`` block (disable with ``SBR_HEAL=0`` or ``heal=False``);
+- SIGTERM/SIGINT inside the tile loop finalize obs manifests as
+  ``"interrupted"`` and clean partial temp files (`resilience.shutdown`);
+- named fault points (``tile.compute``, ``tile.result``,
+  ``checkpoint.save``, ``checkpoint.load``) let a seeded ``SBR_FAULT_PLAN``
+  inject transient errors, NaN-poisoned results, corrupted files, hangs,
+  and preemptions deterministically (`resilience.faults`) — the chaos
+  harness `python -m sbr_tpu.resilience.chaos` drives them in CI.
 """
 
 from __future__ import annotations
@@ -28,6 +47,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.resilience import faults, heal, retry, shutdown
 from sbr_tpu.sweeps.baseline_sweeps import GridSweepResult, beta_u_grid
 
 _FIELDS = ("max_aw", "xi", "status")
@@ -103,14 +123,85 @@ def _save_atomic(path: Path, arrays: dict) -> None:
     fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
     try:
         # Write via the open handle: np.savez appends ".npz" to bare paths,
-        # which would break the atomic rename.
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, path)
+        # which would break the atomic rename. track_tmp registers the
+        # partial file so a graceful shutdown sweeps it even if this
+        # frame's own cleanup never runs (e.g. SIGTERM mid-interpreter).
+        with shutdown.track_tmp(tmp):
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.remove(tmp)
         raise
+    # Integrity sidecar AFTER the rename: a crash between the two leaves a
+    # tile with no sidecar, which verifies as "legacy" (trusted) — never a
+    # tile whose sidecar describes different bytes.
+    heal.write_sidecar(path)
+
+
+def _load_tile_verified(path: Path, may_quarantine: bool = True) -> Optional[dict]:
+    """Load a cached tile, sha256-verifying first. Returns the field dict,
+    or None for a corrupt/unreadable tile — quarantined only when
+    ``may_quarantine`` (the caller will recompute the slot). A multihost
+    non-owner pass must NOT move a peer's corrupt tile away (it would skip
+    the recompute, orphaning the slot and stalling the barrier); it leaves
+    the evidence in place for the owner/stealer/assembly pass, all of which
+    do recompute. The ``checkpoint.load`` fault point injects read failures."""
+    tile_id = path.name
+    try:
+        faults.fire("checkpoint.load", target=tile_id)
+        if heal.verify_file(path) == "mismatch":
+            if may_quarantine:
+                heal.quarantine(path, reason="sha256-mismatch")
+            return None
+        data = np.load(path)
+        return {f: data[f] for f in _FIELDS}
+    except Exception as err:
+        # Unreadable beyond the hash check — torn zip (BadZipFile), rotted
+        # magic bytes on a sidecar-less legacy tile (np.load raises
+        # ValueError for those), missing fields (KeyError), or an injected
+        # load fault: all are corruption from the sweep's point of view, and
+        # quarantine+recompute is safe even for a genuine schema mismatch
+        # (the recompute writes a current-schema tile).
+        if may_quarantine and path.exists():
+            heal.quarantine(path, reason=f"unreadable: {err!r}")
+        return None
+
+
+def _poison_tile(rule, arrays: dict, flags: np.ndarray, tile_id: str) -> None:
+    """Apply a ``nan`` fault injection: poison the first ``rule.cells``
+    cells of every float field and mark them NAN_OUTPUT-divergent — the
+    simulated device-garbage the degrade ladder must catch and repair."""
+    from sbr_tpu.diag.health import NAN_OUTPUT
+
+    n = min(int(rule.cells), flags.size)
+    for k in range(n):
+        idx = np.unravel_index(k, flags.shape)
+        for f in arrays:
+            if np.issubdtype(arrays[f].dtype, np.floating):
+                arrays[f][idx] = np.nan
+        flags[idx] |= NAN_OUTPUT
+
+
+def _record_repairs(ckpt: Path, repairs: list) -> None:
+    """Fold this run's repairs into the checkpoint manifest's ``repairs``
+    block (atomic rewrite). Concurrent peers can race the read-modify-write
+    and drop each other's entries — tolerable: the obs event log is the
+    authoritative record; this block is the human-facing summary."""
+    manifest = ckpt / "manifest.json"
+    try:
+        doc = json.loads(manifest.read_text())
+    except (OSError, json.JSONDecodeError):
+        # Never rewrite the manifest from scratch: losing the stored
+        # fingerprint would brick the checkpoint dir for future resumes.
+        # The obs event log already carries every repair; skip the summary.
+        return
+    doc.setdefault("repairs", []).extend(repairs)
+    fd, tmp = tempfile.mkstemp(dir=ckpt, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(json.dumps(doc))
+    os.replace(tmp, manifest)
 
 
 def run_tiled_grid(
@@ -125,6 +216,8 @@ def run_tiled_grid(
     max_retries: int = 2,
     verbose: bool = False,
     tile_owner=None,
+    heal_divergent: Optional[bool] = None,
+    retry_budget: Optional[retry.RetryBudget] = None,
 ) -> GridSweepResult:
     """β×u grid in tiles with optional on-disk resume.
     NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
@@ -140,6 +233,16 @@ def run_tiled_grid(
     tiles (others stay at their NaN/-1 initial fill unless already on
     disk) — the hook the multi-host sweep farm uses to split a grid
     across processes (`parallel.distributed.run_tiled_grid_multihost`).
+
+    Failure handling: each tile runs under the unified retry policy
+    (``SBR_RETRY_*`` env overrides; ``max_retries`` keeps its historical
+    meaning of extra attempts, so attempts = ``max_retries + 1``), all
+    tiles share one retry budget (``SBR_RETRY_BUDGET``, default
+    ``max(16, n_tiles)``; or pass ``retry_budget`` to share across sweeps),
+    corrupt cached tiles are quarantined and recomputed, and divergent
+    cells are repaired up the degrade ladder unless ``heal_divergent``
+    (env ``SBR_HEAL``) disables it. A repaired-but-still-divergent cell
+    keeps its original values — the ladder only ever upgrades trust.
     """
     if config is None:  # sweep default: refinement off (see beta_u_grid)
         config = SolverConfig(refine_crossings=False)
@@ -147,6 +250,8 @@ def run_tiled_grid(
     u_values = np.asarray(u_values)
     nb, nu = len(beta_values), len(u_values)
     tb, tu = tile_shape
+    if heal_divergent is None:
+        heal_divergent = os.environ.get("SBR_HEAL", "").strip() != "0"
 
     if mesh is not None:
         # Every tile (including ragged edge tiles) must satisfy
@@ -173,57 +278,103 @@ def run_tiled_grid(
             ckpt, _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype)
         )
 
+    origins = tile_origins(nb, nu, tile_shape)
+    policy = retry.policy_from_env(
+        "SBR_RETRY",
+        max_attempts=max_retries + 1,
+        base_delay_s=1.0,
+        multiplier=2.0,
+        max_delay_s=60.0,
+    )
+    if retry_budget is None:
+        budget_env = os.environ.get("SBR_RETRY_BUDGET", "").strip()
+        retry_budget = retry.RetryBudget(
+            int(budget_env) if budget_env else max(16, len(origins))
+        )
+
     # Keyed off _FIELDS so the accumulator, tile save, and cache load stay in
     # lockstep: adding a field without an init entry fails loudly here.
     field_init = {"max_aw": (np.nan, np.float64), "xi": (np.nan, np.float64), "status": (-1, np.int32)}
     out = {f: np.full((nb, nu), *field_init[f]) for f in _FIELDS}
 
     n_cached = 0
-    for bi, ui in tile_origins(nb, nu, tile_shape):
+    repairs: list = []
+    with shutdown.graceful_shutdown(label="tiled_grid"):
+        for bi, ui in origins:
             bs = slice(bi, min(bi + tb, nb))
             us = slice(ui, min(ui + tu, nu))
             path = _tile_path(ckpt, bi, ui) if ckpt is not None else None
+            tile_id = f"tile_b{bi:05d}_u{ui:05d}"
 
+            owned = tile_owner is None or tile_owner(bi, ui)
             if path is not None and path.exists():
-                data = np.load(path)
-                for f in _FIELDS:
-                    out[f][bs, us] = data[f]
-                n_cached += 1
-                continue
+                cached = _load_tile_verified(path, may_quarantine=owned)
+                if cached is not None:
+                    for f in _FIELDS:
+                        out[f][bs, us] = cached[f]
+                    n_cached += 1
+                    continue
+                # corrupt tile: quarantined above (if owned) — recompute
 
-            if tile_owner is not None and not tile_owner(bi, ui):
+            if not owned:
                 continue  # another process's tile; it lands on disk, not here
 
-            last_err = None
-            for attempt in range(max_retries + 1):
-                try:
-                    tile = beta_u_grid(
-                        beta_values[bs], u_values[us], base, config=config, mesh=mesh, dtype=dtype
-                    )
-                    arrays = {f: np.asarray(getattr(tile, f)) for f in _FIELDS}
-                    break
-                except (ValueError, TypeError):
-                    # Deterministic shape/param/dtype bugs: retrying the
-                    # identical call just burns attempts — fail immediately.
-                    raise
-                except Exception as err:  # transient device/runtime failure
-                    last_err = err
+            def compute_tile():
+                faults.fire("tile.compute", target=tile_id)
+                tile = beta_u_grid(
+                    beta_values[bs], u_values[us], base, config=config, mesh=mesh, dtype=dtype
+                )
+                arrays = {f: np.asarray(getattr(tile, f)).copy() for f in _FIELDS}
+                tile_flags = (
+                    np.asarray(tile.health.flags).copy()
+                    if tile.health is not None
+                    else np.zeros(arrays["status"].shape, np.int32)
+                )
+                return arrays, tile_flags
+
+            def observer(**rec):
+                if rec.get("outcome") in ("retrying", "gave_up", "budget_exhausted"):
                     print(
-                        f"  tile ({bi},{ui}) attempt {attempt + 1}/{max_retries + 1} "
-                        f"failed: {err!r}",
+                        f"  tile ({bi},{ui}) attempt "
+                        f"{rec.get('attempt')}/{rec.get('max_attempts')} "
+                        f"{rec['outcome']}: {rec.get('error', '')}",
                         file=sys.stderr,
                     )
-                    if attempt < max_retries:
-                        time.sleep(1.0 * (attempt + 1))  # brief backoff
-            else:
-                raise RuntimeError(
-                    f"Tile ({bi},{ui}) failed after {max_retries + 1} attempts"
-                ) from last_err
+                retry._default_observer(**rec)
+
+            try:
+                arrays, tile_flags = policy.call(
+                    compute_tile, scope=f"Tile ({bi},{ui})",
+                    budget=retry_budget, observer=observer,
+                )
+            except retry.RetryError as err:
+                raise RuntimeError(str(err)) from err.__cause__
+
+            # Chaos hook: a ``nan`` rule on tile.result poisons the computed
+            # arrays + health flags, simulating device garbage downstream of
+            # a successful dispatch; the degrade ladder below must repair it.
+            inj = faults.fire("tile.result", target=tile_id)
+            if inj is not None and inj.kind == "nan":
+                _poison_tile(inj, arrays, tile_flags, tile_id)
+
+            if heal_divergent and (tile_flags != 0).any():
+                tile_report = heal.repair_divergent(
+                    beta_values[bs], u_values[us], base, config, dtype,
+                    arrays, tile_flags, scope=tile_id,
+                )
+                if tile_report:
+                    repairs.extend({"tile": [bi, ui], **r} for r in tile_report)
 
             for f in _FIELDS:
                 out[f][bs, us] = arrays[f]
             if path is not None:
                 _save_atomic(path, arrays)
+                # Chaos hook: a ``corrupt`` rule on checkpoint.save tears the
+                # file AFTER the save (and its sidecar) landed — exactly the
+                # torn-write mode verify-on-load must catch on the next read.
+                inj = faults.fire("checkpoint.save", target=tile_id)
+                if inj is not None and inj.kind == "corrupt":
+                    faults.corrupt_file(path)
             if verbose:
                 done = (bi // tb) * ((nu + tu - 1) // tu) + ui // tu + 1
                 total = ((nb + tb - 1) // tb) * ((nu + tu - 1) // tu)
@@ -231,6 +382,8 @@ def run_tiled_grid(
 
     if verbose and n_cached:
         print(f"  resumed {n_cached} tiles from {ckpt}")
+    if ckpt is not None and repairs:
+        _record_repairs(ckpt, repairs)
 
     import jax.numpy as jnp
 

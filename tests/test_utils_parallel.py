@@ -109,14 +109,20 @@ class TestTiledCheckpoint:
         tiles = sorted(tmp_path.glob("tile_*.npz"))
         assert len(tiles) == 2 * 2
 
-        # Corrupt-resistant resume: poison one stored tile, delete another;
-        # the poisoned one must be served from disk (proving no recompute),
-        # the deleted one recomputed.
+        # Resume semantics: alter one stored tile (refreshing its sha256
+        # sidecar so integrity verification still passes — a MISMATCHING
+        # sidecar would rightly trigger quarantine+recompute, covered by
+        # tests/test_resilience.py), delete another; the altered one must
+        # be served from disk (proving no recompute), the deleted one
+        # recomputed.
+        from sbr_tpu.resilience import heal
+
         poisoned = np.load(tiles[0])
         arrays = {k: poisoned[k].copy() for k in poisoned.files}
         arrays["xi"] = np.full_like(arrays["xi"], 123.0)
         with open(tiles[0], "wb") as f:
             np.savez(f, **arrays)
+        heal.write_sidecar(tiles[0])
         tiles[1].unlink()
 
         second = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(3, 4), checkpoint_dir=tmp_path)
