@@ -74,6 +74,15 @@ one steady-state rep per workload into the run directory (summarized as a
 `profile` event; the old always-on SBR_BENCH_TRACE_DIR capture is
 superseded by this opt-in path).
 
+Memory observatory (PR 5): each workload samples the allocator's
+high-water mark after every steady-state rep (`sbr_tpu.obs.mem` — zero
+reads on backends without `memory_stats()`), the JSON gains
+`extra.grid_mem_peak_bytes` / `extra.agents_mem_peak_bytes`, and the perf
+history records them (schema 2) so `report trend` gates memory regressions
+alongside throughput. The O(live arrays) live-buffer sum is disabled
+(`mem.live_disabled`, env `SBR_OBS_MEM_LIVE`) inside the timing loops on
+top of the existing `obs.suspended()` envelope.
+
 Resilience (PR 4): the probe ladder's attempts/backoff now come from the
 unified retry engine (`sbr_tpu.resilience.retry`, loaded standalone by
 file path so the parent stays jax-free) — SBR_BENCH_PROBE_ATTEMPTS /
@@ -697,6 +706,26 @@ def _profile_rep(label: str, step: int, rep_fn) -> None:
         _log(f"profiler capture failed (non-fatal): {err!r}")
 
 
+def _rep_peak_bytes(prev: int) -> int:
+    """Fold the allocator's CURRENT usage (`bytes_in_use`) into a running
+    per-rep peak (obs.mem). One `memory_stats()` read per rep, AFTER its
+    timing window closed — zero reads (and always 0) on backends without
+    the API, so CPU fallbacks simply omit the metric. Deliberately NOT
+    `peak_bytes_in_use`: that high-water mark never resets, so the agents
+    workload (which runs second) would just re-report the grid's peak and
+    the per-workload trend series would attribute regressions to the wrong
+    workload."""
+    try:
+        from sbr_tpu.obs import mem
+
+        stats = mem.allocator_stats()
+        if not stats:
+            return prev
+        return max(prev, int(stats.get("bytes_in_use", 0)))
+    except Exception:
+        return prev
+
+
 def pipelined_time(dispatch, start_rep: int, n_pipe: int | None = None):
     """Sustained per-dispatch seconds: K dispatches in flight, ONE fence.
 
@@ -779,7 +808,8 @@ def bench_grid(platform: str) -> dict:
     # per-dispatch output fence would serialize the pipelined launches and
     # per-event file IO would pad dispatch_s, so the measured numbers must
     # be identical to a telemetry-off process.
-    with obs.suspended():
+    mem_peak = 0
+    with obs.suspended(), obs.mem.live_disabled():
         # One untimed warm-up: rep 0 compiled via the AOT path, which does
         # not populate the plain jit cache — this retrace hits the
         # persistent compilation cache (a deserialize, not a recompile), so
@@ -794,9 +824,11 @@ def bench_grid(platform: str) -> dict:
             t0 = time.perf_counter()
             grid, _ = run(rep)
             times.append(time.perf_counter() - t0)
+            mem_peak = _rep_peak_bytes(mem_peak)  # after the clock stopped
         dispatch_s = min(times)
 
         pipelined_s, n_pipe = pipelined_time(dispatch, start_rep=5)
+        mem_peak = _rep_peak_bytes(mem_peak)
     elapsed = min(dispatch_s, pipelined_s)
 
     _profile_rep("bench.grid", 5, lambda: run(5))
@@ -817,6 +849,7 @@ def bench_grid(platform: str) -> dict:
         "dispatch_s": dispatch_s,
         "pipelined_s": pipelined_s,
         "n_pipe": n_pipe,
+        "mem_peak_bytes": mem_peak,
     }
 
 
@@ -859,11 +892,16 @@ def bench_agents(platform: str) -> dict:
     t0 = time.perf_counter()
     res0, frac0 = run(0)
     first_s = time.perf_counter() - t0
+    from sbr_tpu import obs
+
+    mem_peak = 0
     times = []
-    for seed in (1, 2):
-        t0 = time.perf_counter()
-        _, _ = run(seed)
-        times.append(time.perf_counter() - t0)
+    with obs.mem.live_disabled():  # O(live arrays) sum stays out of timed reps
+        for seed in (1, 2):
+            t0 = time.perf_counter()
+            _, _ = run(seed)
+            times.append(time.perf_counter() - t0)
+            mem_peak = _rep_peak_bytes(mem_peak)
     elapsed = min(times)
     _profile_rep("bench.agents", 3, lambda: run(3))
     # engine observability in the artifact: which steps were full recounts
@@ -886,6 +924,7 @@ def bench_agents(platform: str) -> dict:
         "prep_s": prep_s,
         "engine": pg.engine,
         "recount_steps": recounts,
+        "mem_peak_bytes": mem_peak,
     }
 
 
@@ -953,6 +992,8 @@ def _measure_inner(platform: str) -> None:
             "grid_pipeline_depth": grid["n_pipe"],
         },
     }
+    if grid.get("mem_peak_bytes"):
+        out["extra"]["grid_mem_peak_bytes"] = int(grid["mem_peak_bytes"])
     if agents is not None:
         out["extra"]["agent_steps_per_sec"] = round(agents["agent_steps_per_sec"], 1)
         out["extra"]["n_agents"] = agents["n_agents"]
@@ -962,6 +1003,8 @@ def _measure_inner(platform: str) -> None:
         out["extra"]["agents_prep_s"] = round(agents["prep_s"], 2)
         out["extra"]["agents_engine"] = agents["engine"]
         out["extra"]["agents_recount_steps"] = agents["recount_steps"]
+        if agents.get("mem_peak_bytes"):
+            out["extra"]["agents_mem_peak_bytes"] = int(agents["mem_peak_bytes"])
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

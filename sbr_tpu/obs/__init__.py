@@ -21,11 +21,19 @@ per-step ABM counters are the design references from PAPERS.md):
 - ``obs.history`` — append-only perf history (``bench_history.jsonl``):
   every bench run's headline metrics, trend rendering and the regression
   gate (`report trend --check`).
+- ``obs.mem``     — memory observatory: per-span/per-tile HBM attribution
+  (``mem`` events, ``SBR_OBS_MEM_LIVE`` live-buffer gate, manifest
+  ``memory`` roll-up with peak span / top programs by temp size), the
+  pre-dispatch OOM preflight (AOT analytical footprint vs
+  ``SBR_MEM_HEADROOM × capacity``, fail-closed `MemoryPreflightError`),
+  and the ``tile_shape="auto"`` capacity planner.
 - ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
   a run directory or diffs two runs; the `health` subcommand renders and
   gates on numerical health, `resilience` renders/gates the fault/retry/
   repair story (`sbr_tpu.resilience`), `trend` renders/gates the perf
-  history, `gc` prunes old run directories. Every subcommand takes
+  history, `memory` renders/gates per-span/per-tile peak-memory
+  attribution, `gc` prunes old run directories plus checkpoint debris
+  (``quarantine/``, stale ``tile_*.lease``). Every subcommand takes
   ``--json``.
 
 Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
@@ -41,7 +49,7 @@ Disabled (the default), every instrumentation site is a single global read
 jit caches (asserted by tests/test_obs.py).
 """
 
-from sbr_tpu.obs import history, prof
+from sbr_tpu.obs import history, mem, prof
 from sbr_tpu.obs.metrics import MetricsRegistry, metrics
 from sbr_tpu.obs.prof import annotate, note_trace, profile, step_annotation
 from sbr_tpu.obs.runlog import (
@@ -60,6 +68,7 @@ from sbr_tpu.obs.runlog import (
     log_repair,
     log_retry,
     log_status,
+    log_tile_mem,
     run_context,
     span,
     start_run,
@@ -88,6 +97,8 @@ __all__ = [
     "log_repair",
     "log_retry",
     "log_status",
+    "log_tile_mem",
+    "mem",
     "metrics",
     "note_trace",
     "prof",

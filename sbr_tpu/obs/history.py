@@ -39,7 +39,11 @@ import time
 from pathlib import Path
 from typing import Optional
 
-SCHEMA = 1
+# Record schema: 2 adds memory metrics (mem_peak_bytes and the per-workload
+# grid/agents peaks from the bench child — ISSUE 5). Readers accept 1 AND 2:
+# the key set only grew, and `load` stamps schema-less legacy lines as 1, so
+# a committed schema-1 history keeps gating new schema-2 appends.
+SCHEMA = 2
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -98,6 +102,9 @@ def load(path=None) -> list:
         except json.JSONDecodeError:
             continue
         if isinstance(rec, dict) and isinstance(rec.get("metrics"), dict):
+            # Schema-less lines predate versioning (= schema 1); schema 2
+            # is a pure superset, so every known version loads uniformly.
+            rec.setdefault("schema", 1)
             records.append(rec)
     return records
 
@@ -118,18 +125,32 @@ def bench_metrics(result: dict) -> dict:
         "grid_pipelined_s",
         "agents_steady_s",
         "agents_prep_s",
+        # schema 2: per-workload allocator peaks (absent on CPU backends
+        # without memory_stats — the gate simply has no memory series there)
+        "grid_mem_peak_bytes",
+        "agents_mem_peak_bytes",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
+            # A zero byte-peak means "no allocator stats", not "used zero
+            # bytes" — recording it would arm the zero-baseline regression
+            # rule on noise. Durations/throughputs keep their raw value.
+            if key.endswith("_bytes") and v <= 0:
+                continue
             out[key] = v
     obs_blk = extra.get("obs") or {}
     for src, dst in (
         ("compile_s", "obs_compile_s"),
         ("execute_s", "obs_execute_s"),
         ("xla_backend_compile_s", "xla_backend_compile_s"),
+        # schema 2: the run's overall peak (live-buffer based on CPU, so
+        # memory regressions gate even without allocator stats)
+        ("memory_peak_bytes", "mem_peak_bytes"),
     ):
         v = obs_blk.get(src)
         if isinstance(v, (int, float)):
+            if dst.endswith("_bytes") and v <= 0:
+                continue  # zero byte-peak = no data, not a clean baseline
             out[dst] = v
     return out
 

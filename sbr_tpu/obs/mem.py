@@ -1,0 +1,536 @@
+"""Memory observatory: HBM attribution, OOM preflight, and capacity-planned
+tiling (ISSUE 5 tentpole).
+
+The sweep grids that reproduce the paper's figures are the memory-bound hot
+path of this framework — batched-solver systems like torchode and ABMax
+(PAPERS.md) show that footprint, not FLOPs, governs achievable batch width
+for vmapped ODE/rootfind stacks. Before this module the telemetry stack
+recorded two one-shot allocator snapshots and a tile that OOMed on TPU was
+discovered by dying; ``tile_shape=(256, 256)`` was a hard-coded guess.
+
+Three layers, all host-side and zero-overhead when telemetry is off:
+
+- **Attribution** (`snapshot` + runlog wiring): every span end and jit call
+  emits a ``mem`` event carrying the live-buffer sum (gated by
+  ``SBR_OBS_MEM_LIVE`` — it is O(live arrays) per event), the allocator's
+  ``bytes_in_use`` / ``peak_bytes_in_use`` when the backend exposes
+  ``memory_stats()`` (TPU/GPU; None on CPU), and deltas vs the previous
+  snapshot. The run manifest's ``memory`` block rolls up the peak, the span
+  holding it, the top programs by XLA temp size, and per-tile peaks from
+  the tiled sweep loop. Render with
+  ``python -m sbr_tpu.obs.report memory RUN_DIR [--json]``.
+- **OOM preflight** (`aot_footprint` + `preflight`): before a sweep
+  dispatches, AOT-lower one tile (`jax.ShapeDtypeStruct` arguments — no
+  data, no execution), read the compiled program's analytical footprint
+  (argument + output + temp bytes from ``memory_analysis()``), and compare
+  it against ``memory_stats()`` capacity scaled by ``SBR_MEM_HEADROOM``
+  (default 0.8). Failure is CLOSED — a clear `MemoryPreflightError` before
+  any device work, instead of an XLA OOM mid-sweep. On CPU (or any backend
+  without ``memory_stats``) the check gracefully skips (verdict
+  ``"skipped"``) without paying the AOT compile.
+- **Capacity planner** (`plan_tile_shape` / `plan_from_probes`):
+  ``tile_shape="auto"`` in the tiled sweeps fits a linear footprint model
+  (fixed + per-cell bytes, from two small probe lowerings) and picks the
+  largest power-of-two square tile whose modeled footprint fits within
+  ``headroom × capacity``. The planner is deterministic: the same capacity
+  and model always produce the same shape, so multihost peers planning
+  independently agree on the tile grid.
+
+Nothing here imports jax at module scope, and `gc_debris` (the `report gc`
+helper that prunes ``quarantine/`` directories and stale ``tile_*.lease``
+files) is pure stdlib — so nothing in this module can wake an accelerator
+backend. (Note `python -m sbr_tpu.obs.report` still imports the jax module
+via the parent package ``__init__`` — as it always has; "accelerator-free"
+means no backend is ever initialized, not that jax is absent from
+sys.modules.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Callable, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Env knobs
+# ---------------------------------------------------------------------------
+
+DEFAULT_HEADROOM = 0.8
+
+
+def headroom() -> float:
+    """Fraction of device capacity the planner/preflight may budget
+    (``SBR_MEM_HEADROOM``, default 0.8 — the rest absorbs allocator
+    fragmentation, XLA scratch, and the framework's own persistent buffers)."""
+    env = os.environ.get("SBR_MEM_HEADROOM", "").strip()
+    try:
+        v = float(env) if env else DEFAULT_HEADROOM
+    except ValueError:
+        return DEFAULT_HEADROOM
+    return v if 0.0 < v <= 1.0 else DEFAULT_HEADROOM
+
+
+def live_enabled() -> bool:
+    """Whether snapshots sum `jax.live_arrays()` (``SBR_OBS_MEM_LIVE``,
+    default on). The sum is O(live arrays) per event — bench timing loops
+    turn it off (`live_disabled`) so instrumentation cannot pad measured
+    dispatch times."""
+    return os.environ.get("SBR_OBS_MEM_LIVE", "").strip() != "0"
+
+
+@contextlib.contextmanager
+def live_disabled():
+    """Temporarily disable the live-buffer sum (measurement-critical
+    sections; restores the previous setting on exit)."""
+    prev = os.environ.get("SBR_OBS_MEM_LIVE")
+    os.environ["SBR_OBS_MEM_LIVE"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("SBR_OBS_MEM_LIVE", None)
+        else:
+            os.environ["SBR_OBS_MEM_LIVE"] = prev
+
+
+def preflight_enabled() -> bool:
+    """``SBR_MEM_PREFLIGHT`` (default on) gates the pre-dispatch OOM check;
+    on capacity-less backends the check is free either way."""
+    return os.environ.get("SBR_MEM_PREFLIGHT", "").strip() != "0"
+
+
+# ---------------------------------------------------------------------------
+# Snapshots (attribution layer)
+# ---------------------------------------------------------------------------
+
+
+def live_bytes() -> Optional[int]:
+    """Sum of live jax buffer nbytes, or None when gated off / jax absent."""
+    if not live_enabled():
+        return None
+    try:
+        import jax
+
+        return int(sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def allocator_stats() -> Optional[dict]:
+    """The default device's ``memory_stats()`` dict, or None (CPU backends
+    and some tunneled runtimes return None / lack the API)."""
+    try:
+        import jax
+
+        return jax.devices()[0].memory_stats() or None
+    except Exception:
+        return None
+
+
+_CAPACITY_KEYS = ("bytes_limit", "bytes_reservable_limit", "pool_bytes")
+
+
+def device_capacity(stats: Optional[dict] = None) -> Optional[int]:
+    """Usable device memory in bytes, or None when the backend exposes no
+    allocator stats (the graceful-skip signal for preflight/planning)."""
+    if stats is None:
+        stats = allocator_stats()
+    if not stats:
+        return None
+    for key in _CAPACITY_KEYS:
+        v = stats.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            return int(v)
+    return None
+
+
+def tile_peak(snap: dict) -> int:
+    """The per-tile peak figure from one snapshot: ``bytes_in_use`` (the
+    tile's own live footprint) over the live-buffer sum, with the
+    process-lifetime ``peak_bytes_in_use`` high-water mark only as a last
+    resort — preferring the monotone counter would attribute the global
+    peak to every tile computed after it. Shared by the manifest roll-up
+    (`runlog.log_tile_mem`) and the events-only fold (`report._mem_fold`)
+    so the two data paths can never diverge."""
+    return int(
+        snap.get("bytes_in_use")
+        or snap.get("live_buffer_bytes")
+        or snap.get("peak_bytes_in_use")
+        or 0
+    )
+
+
+def snapshot(stats: Optional[dict] = None) -> dict:
+    """One attribution snapshot: whatever is observable right now. Keys are
+    present only when their source answered — consumers must treat every
+    field as optional (CPU runs carry only ``live_buffer_bytes``)."""
+    snap: dict = {}
+    live = live_bytes()
+    if live is not None:
+        snap["live_buffer_bytes"] = live
+    if stats is None:
+        stats = allocator_stats()
+    if stats:
+        for k in ("bytes_in_use", "peak_bytes_in_use"):
+            if k in stats:
+                snap[k] = int(stats[k])
+        cap = device_capacity(stats)
+        if cap is not None:
+            snap["bytes_limit"] = cap
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Analytical footprints (preflight layer)
+# ---------------------------------------------------------------------------
+
+
+def footprint_from_analysis(mem_analysis) -> dict:
+    """Normalize an XLA ``memory_analysis()`` object into the footprint dict
+    the preflight/planner consume (missing attributes read as 0)."""
+    fp = {}
+    for attr, key in (
+        ("argument_size_in_bytes", "arg_bytes"),
+        ("output_size_in_bytes", "out_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("generated_code_size_in_bytes", "code_bytes"),
+    ):
+        v = getattr(mem_analysis, attr, None)
+        fp[key] = int(v) if v is not None else 0
+    fp["total_bytes"] = fp["arg_bytes"] + fp["out_bytes"] + fp["temp_bytes"]
+    return fp
+
+
+def aot_footprint(fn, *args) -> dict:
+    """Analytical footprint of ``fn(*args)`` via the AOT path: lower +
+    compile (no execution, no data movement — ``args`` may be
+    `jax.ShapeDtypeStruct`s), then read ``memory_analysis()``. Raises on
+    un-lowerable functions; callers decide whether that is fatal."""
+    compiled = fn.lower(*args).compile()
+    return footprint_from_analysis(compiled.memory_analysis())
+
+
+class MemoryPreflightError(RuntimeError):
+    """A dispatch whose analytical footprint exceeds the memory budget —
+    raised BEFORE any device work (fail closed beats an XLA OOM mid-sweep)."""
+
+
+def preflight(
+    label: str,
+    footprint: Optional[dict],
+    capacity: Optional[int] = None,
+    headroom_frac: Optional[float] = None,
+    skip_reason: Optional[str] = None,
+) -> dict:
+    """Compare an analytical ``footprint`` against the device budget.
+
+    Returns a verdict record ``{label, verdict, footprint_bytes,
+    capacity_bytes, budget_bytes, headroom}`` with verdict ``"ok"``,
+    ``"exceeds"``, or ``"skipped"`` (no capacity or no footprint — CPU and
+    backends without ``memory_stats()``). The record is emitted as a
+    ``preflight`` obs event and folded into the run manifest's ``memory``
+    block when telemetry is on. Callers that must fail closed raise
+    `MemoryPreflightError` on ``"exceeds"`` (see `check_preflight`).
+    """
+    if headroom_frac is None:
+        headroom_frac = headroom()
+    if capacity is None:
+        capacity = device_capacity()
+    rec: dict = {"label": label, "headroom": round(float(headroom_frac), 4)}
+    if capacity is None or not footprint:
+        rec["verdict"] = "skipped"
+        rec["reason"] = skip_reason or ("no-capacity" if capacity is None else "no-footprint")
+    else:
+        budget = int(capacity * headroom_frac)
+        need = int(footprint.get("total_bytes", 0))
+        rec.update(
+            verdict="ok" if need <= budget else "exceeds",
+            footprint_bytes=need,
+            capacity_bytes=int(capacity),
+            budget_bytes=budget,
+            arg_bytes=int(footprint.get("arg_bytes", 0)),
+            out_bytes=int(footprint.get("out_bytes", 0)),
+            temp_bytes=int(footprint.get("temp_bytes", 0)),
+            # "aot" = exact XLA memory_analysis; "planner-model" = the
+            # fitted fixed+per-cell extrapolation (tile_shape="auto" path)
+            source=footprint.get("source", "aot"),
+        )
+    _log_preflight(rec)
+    return rec
+
+
+def check_preflight(rec: dict) -> dict:
+    """Raise `MemoryPreflightError` on an ``"exceeds"`` verdict (the
+    fail-closed wrapper); pass through ``ok``/``skipped`` records."""
+    if rec.get("verdict") == "exceeds":
+        raise MemoryPreflightError(
+            f"{rec.get('label', 'dispatch')}: analytical footprint "
+            f"{_fmt_bytes(rec.get('footprint_bytes'))} exceeds the memory budget "
+            f"{_fmt_bytes(rec.get('budget_bytes'))} "
+            f"({rec.get('headroom'):.0%} of {_fmt_bytes(rec.get('capacity_bytes'))} "
+            "device capacity). Shrink the tile (tile_shape=... or "
+            "tile_shape=\"auto\"), lower the grid resolution, or raise "
+            "SBR_MEM_HEADROOM if the budget is known-conservative."
+        )
+    return rec
+
+
+def _log_preflight(rec: dict) -> None:
+    """Emit the preflight record as an obs event + manifest roll-up entry
+    (no-op when telemetry is off; must never sink the caller)."""
+    try:
+        from sbr_tpu.obs import runlog
+
+        run = runlog.current_run()
+        if run is not None:
+            run.log_preflight(rec)
+    except Exception:
+        pass
+
+
+def fmt_bytes(v) -> str:
+    """Human byte formatter shared with `obs.report` (missing/zero → "-")."""
+    if not v or not isinstance(v, (int, float)):
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{v:.1f} {unit}"
+        v /= 1024
+    return f"{v:.1f} GiB"
+
+
+_fmt_bytes = fmt_bytes  # internal alias for the error-message paths above
+
+
+# ---------------------------------------------------------------------------
+# Capacity planner
+# ---------------------------------------------------------------------------
+
+
+def fit_linear_model(points) -> Tuple[float, float]:
+    """Fit ``bytes ≈ fixed + per_cell * n_cells`` through two (or more)
+    ``(n_cells, bytes)`` probe points (least-squares for >2). The linear
+    shape is exact for embarrassingly-parallel vmap grids: per-cell working
+    set × cells + program constants."""
+    pts = [(float(n), float(b)) for n, b in points]
+    if len(pts) == 1:
+        n, b = pts[0]
+        return 0.0, b / n if n else 0.0
+    n_mean = sum(n for n, _ in pts) / len(pts)
+    b_mean = sum(b for _, b in pts) / len(pts)
+    denom = sum((n - n_mean) ** 2 for n, _ in pts)
+    if denom == 0.0:
+        return max(0.0, b_mean), 0.0
+    per_cell = sum((n - n_mean) * (b - b_mean) for (n, b) in pts) / denom
+    per_cell = max(0.0, per_cell)
+    fixed = max(0.0, b_mean - per_cell * n_mean)
+    return fixed, per_cell
+
+
+def plan_tile_shape(
+    n_b: int,
+    n_u: int,
+    model: Tuple[float, float],
+    capacity: Optional[int],
+    headroom_frac: Optional[float] = None,
+    min_tile: int = 8,
+    max_tile: int = 8192,
+    fallback: Tuple[int, int] = (256, 256),
+    multiple_of: Tuple[int, int] = (1, 1),
+    per_device_divisor: int = 1,
+) -> Tuple[Tuple[int, int], dict]:
+    """Pick the largest power-of-two square tile whose modeled footprint
+    fits within ``headroom × capacity``.
+
+    ``model`` is ``(fixed_bytes, per_cell_bytes)`` from `fit_linear_model`.
+    Deterministic by construction: same (grid, model, capacity, headroom)
+    ⇒ same shape, so independently-planning multihost peers agree. With no
+    ``capacity`` (CPU) the ``fallback`` shape is returned with verdict
+    ``"skipped"``. Returns ``((tb, tu), plan_record)``; the record lands in
+    the run manifest's ``memory.plan`` block.
+
+    ``per_device_divisor`` (mesh size for sharded tiles) divides the
+    modeled CELL count: a tile sharded evenly over N devices puts ~1/N of
+    its cells — and hence per-cell working set — on each device, while the
+    fixed program overhead stays per-device. Without it, an unsharded
+    model vs single-device capacity would undersize sharded tiles by the
+    device count.
+
+    ``multiple_of`` carries mesh-axis divisibility (a sharded tile must
+    split evenly over the mesh): candidates not divisible by it are
+    rejected, and if NO candidate qualifies a `MemoryPreflightError` asks
+    for an explicit tile_shape — better than silently violating the mesh
+    contract.
+    """
+    if headroom_frac is None:
+        headroom_frac = headroom()
+    fixed, per_cell = (float(model[0]), float(model[1]))
+    divisor = max(1, int(per_device_divisor))
+    base_rec = {
+        "requested": "auto",
+        "grid": [int(n_b), int(n_u)],
+        "model_fixed_bytes": int(fixed),
+        "model_per_cell_bytes": round(per_cell, 3),
+        "headroom": round(float(headroom_frac), 4),
+    }
+    if divisor > 1:
+        base_rec["per_device_divisor"] = divisor
+    if capacity is None:
+        shape = (min(fallback[0], _pow2_ceil(n_b)), min(fallback[1], _pow2_ceil(n_u)))
+        shape = _round_to_multiple(shape, multiple_of)
+        return shape, {
+            **base_rec,
+            "tile_shape": list(shape),
+            "verdict": "skipped",
+            "reason": "no-capacity",
+        }
+    budget = int(capacity * headroom_frac)
+
+    def fits(t: int) -> bool:
+        cells = min(t, n_b) * min(t, n_u) / divisor
+        return fixed + per_cell * cells <= budget
+
+    candidates = []
+    t = min_tile
+    while t <= max_tile:
+        if t % multiple_of[0] == 0 and t % multiple_of[1] == 0:
+            candidates.append(t)
+        t *= 2
+    candidates = [t for t in candidates if fits(t)]
+    if not candidates:
+        raise MemoryPreflightError(
+            f"capacity planner: no power-of-two tile in [{min_tile}, {max_tile}] "
+            f"(divisible by mesh axes {multiple_of}) fits the memory budget "
+            f"{_fmt_bytes(budget)} ({headroom_frac:.0%} of {_fmt_bytes(capacity)}) "
+            f"with model fixed={_fmt_bytes(fixed)} per_cell={per_cell:.1f} B. "
+            "Lower the grid resolution, shrink n_grid, or pass an explicit "
+            "tile_shape."
+        )
+    best = candidates[-1]
+    # No point tiling beyond the grid itself: once one tile covers the grid,
+    # larger candidates change nothing (min() clamps the modeled cells), so
+    # the SMALLEST covering candidate is the canonical deterministic answer.
+    for t in candidates:
+        if t >= n_b and t >= n_u:
+            best = t
+            break
+    shape = (best, best)
+    cells = min(best, n_b) * min(best, n_u) / divisor
+    return shape, {
+        **base_rec,
+        "tile_shape": list(shape),
+        "verdict": "ok",
+        "capacity_bytes": int(capacity),
+        "budget_bytes": budget,
+        "modeled_bytes": int(fixed + per_cell * cells),
+    }
+
+
+def plan_from_probes(
+    n_b: int,
+    n_u: int,
+    probe_footprint: Callable[[int, int], dict],
+    capacity: Optional[int] = None,
+    headroom_frac: Optional[float] = None,
+    probe_shapes: Tuple[Tuple[int, int], ...] = ((8, 8), (16, 16)),
+    **plan_kwargs,
+) -> Tuple[Tuple[int, int], dict]:
+    """`plan_tile_shape` with the linear model fitted from small AOT probe
+    lowerings (``probe_footprint(tb, tu) -> footprint dict``). With no
+    capacity the probes are SKIPPED entirely — on CPU the planner must cost
+    nothing but a dict lookup."""
+    if capacity is None:
+        capacity = device_capacity()
+    if capacity is None:
+        return plan_tile_shape(
+            n_b, n_u, (0.0, 0.0), None, headroom_frac, **plan_kwargs
+        )
+    points = []
+    for tb, tu in probe_shapes:
+        fp = probe_footprint(tb, tu)
+        points.append((tb * tu, fp.get("total_bytes", 0)))
+    shape, rec = plan_tile_shape(
+        n_b, n_u, fit_linear_model(points), capacity, headroom_frac, **plan_kwargs
+    )
+    rec["probe_shapes"] = [list(s) for s in probe_shapes]
+    return shape, rec
+
+
+def _pow2_ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _round_to_multiple(shape, multiple_of) -> Tuple[int, int]:
+    """Clamp a shape onto the mesh-divisibility grid (round down to the
+    multiple; never below the multiple itself)."""
+    out = []
+    for dim, m in zip(shape, multiple_of):
+        if m <= 1:
+            out.append(dim)
+        else:
+            out.append(max(m, (dim // m) * m))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-debris retention (`report gc` satellite)
+# ---------------------------------------------------------------------------
+
+
+def gc_debris(root, lease_ttl_s: float = 900.0) -> list:
+    """Prune checkpoint debris left by aborted multihost runs under
+    ``root``: every ``quarantine/`` directory (corrupt-tile evidence that an
+    explicit gc invocation is entitled to clear) and every stale
+    ``tile_*.lease`` file — stale meaning its tile ``.npz`` already exists
+    (completed steal), its holder's TTL lapsed, or the lease is unreadable
+    (torn write from a dead holder). Live leases within TTL are preserved:
+    a running steal must not be yanked out from under its holder. Returns
+    the removed paths. Pure stdlib — safe from the jax-free report CLI."""
+    root = Path(root)
+    removed: list = []
+    if not root.is_dir():
+        return removed
+    now = time.time()
+    for q in sorted(root.rglob("quarantine")):
+        if not q.is_dir():
+            continue
+        try:
+            shutil.rmtree(q)
+            removed.append(q)
+        except OSError:
+            pass
+    for lease in sorted(root.rglob("tile_*.lease")):
+        stale = False
+        if lease.with_suffix(".npz").exists():
+            stale = True
+        else:
+            try:
+                held = json.loads(lease.read_text())
+                ttl = float(held.get("ttl_s", lease_ttl_s))
+                stale = (now - float(held.get("ts", 0.0))) >= ttl
+            except (OSError, ValueError):
+                stale = True  # torn write from a dead holder
+        if stale:
+            try:
+                lease.unlink()
+                removed.append(lease)
+            except OSError:
+                pass
+    # Lease-takeover temp files (`tile_*.lease.<pid>.tmp`, written by the
+    # work-stealing expired-lease path just before its os.replace): a
+    # surviving one means the stealer died mid-takeover — always debris.
+    for tmp in sorted(root.rglob("tile_*.lease.*.tmp")):
+        try:
+            tmp.unlink()
+            removed.append(tmp)
+        except OSError:
+            pass
+    return removed

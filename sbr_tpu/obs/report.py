@@ -17,7 +17,15 @@ Usage:
                                                     # regression gate: exit 1
                                                     # beyond tolerance, 3 on
                                                     # missing/short history
-    python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs
+    python -m sbr_tpu.obs.report memory RUN_DIR     # per-span/per-tile peak-
+                                                    # memory attribution; exit
+                                                    # 1 when a tile exceeds
+                                                    # the headroom threshold,
+                                                    # 3 on missing data
+    python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs +
+                                                    # checkpoint debris
+                                                    # (quarantine/, stale
+                                                    # tile_*.lease files)
 
 Every reporting subcommand (timing render, diff, health, trend) takes
 ``--json`` and then prints one machine-readable JSON document instead of
@@ -72,15 +80,10 @@ def _fmt_s(v) -> str:
     return f"{v * 1e3:.1f} ms" if v < 1.0 else f"{v:.3f} s"
 
 
-def _fmt_bytes(v) -> str:
-    if not v:
-        return "-"
-    v = float(v)
-    for unit in ("B", "KiB", "MiB", "GiB"):
-        if v < 1024 or unit == "GiB":
-            return f"{v:.1f} {unit}"
-        v /= 1024
-    return f"{v:.1f} GiB"
+# obs.mem is stdlib-only at module scope, so this import cannot initialize
+# an accelerator backend (running via `python -m` already imports the jax
+# MODULE through the parent package __init__ — that was true before too).
+from sbr_tpu.obs.mem import fmt_bytes as _fmt_bytes, tile_peak as _tile_peak  # noqa: E402
 
 
 def _table(headers, rows) -> str:
@@ -144,6 +147,8 @@ def render(run: dict) -> str:
             if mem.get("peak_device_bytes")
             else ""
         )
+        + (f"   peak span {mem['peak_span']}" if mem.get("peak_span") else "")
+        + ("   (details: report memory RUN_DIR)" if mem.get("tiles") or mem.get("plan") else "")
     )
     out.append(f"events   {m.get('n_events')}")
 
@@ -590,6 +595,227 @@ def diff(a: dict, b: dict) -> str:
     return "\n".join(out)
 
 
+# ---------------------------------------------------------------------------
+# Memory report (`memory` subcommand — the obs.mem attribution renderer/gate)
+# ---------------------------------------------------------------------------
+
+
+def _mem_fold(events) -> dict:
+    """Fold ``mem`` events: per-where maxima for span attribution, per-tile
+    peaks, and the last observed device capacity. The event log is the
+    source of truth even when a kill -9 meant the manifest roll-up was
+    never finalized (same contract as the resilience report)."""
+    spans: dict = {}
+    tiles: dict = {}
+    capacity = None
+    for ev in events:
+        if ev.get("kind") != "mem":
+            continue
+        if isinstance(ev.get("bytes_limit"), (int, float)) and ev["bytes_limit"] > 0:
+            capacity = int(ev["bytes_limit"])
+        tile = ev.get("tile")
+        if tile:
+            tiles[tile] = max(tiles.get(tile, 0), _tile_peak(ev))
+            continue
+        agg = spans.setdefault(
+            ev.get("where", "?"),
+            {"events": 0, "live_buffer_bytes": 0, "bytes_in_use": 0, "peak_bytes_in_use": 0},
+        )
+        agg["events"] += 1
+        for k in ("live_buffer_bytes", "bytes_in_use", "peak_bytes_in_use"):
+            if isinstance(ev.get(k), (int, float)):
+                agg[k] = max(agg[k], int(ev[k]))
+    return {"spans": spans, "tiles": tiles, "capacity_bytes": capacity}
+
+
+def memory_doc(run: dict, headroom_override=None) -> tuple:
+    """Machine-readable memory report; returns (doc, exit_code). Exit
+    codes: 0 within budget, 1 when any tile's peak exceeds the headroom
+    threshold (or a preflight verdict was "exceeds"), 3 when the run
+    carries no memory data at all (an instrumented run that was supposed
+    to attribute memory but emitted nothing must not pass a gate
+    silently)."""
+    m = run["manifest"].get("memory") or {}
+    folded = _mem_fold(run["events"])
+    tiles = {k: int(v) for k, v in (m.get("tiles") or {}).items()}
+    for t, p in folded["tiles"].items():
+        tiles[t] = max(tiles.get(t, 0), p)
+    capacity = m.get("capacity_bytes") or folded["capacity_bytes"]
+    headroom = (
+        float(headroom_override)
+        if headroom_override is not None
+        else float(m.get("headroom") or 0.8)
+    )
+    preflight = m.get("preflight") or [
+        {k: v for k, v in ev.items() if k not in ("mono", "ts", "kind")}
+        for ev in run["events"]
+        if ev.get("kind") == "preflight"
+    ]
+    plan = m.get("plan")
+    has_data = bool(
+        folded["spans"]
+        or tiles
+        or m.get("peak_live_buffer_bytes")
+        or m.get("peak_device_bytes")
+        or plan
+        or preflight
+    )
+    threshold = int(capacity * headroom) if capacity else None
+    over = sorted(t for t, p in tiles.items() if threshold is not None and p > threshold)
+    preflight_exceeded = any(p.get("verdict") == "exceeds" for p in preflight)
+    code = 3 if not has_data else (1 if (over or preflight_exceeded) else 0)
+    doc = {
+        "dir": run["dir"],
+        "memory": m,
+        "spans": folded["spans"],
+        "tiles": tiles,
+        "capacity_bytes": capacity,
+        "headroom": headroom,
+        "threshold_bytes": threshold,
+        "over_tiles": over,
+        "preflight": preflight,
+        "plan": plan,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_memory(run: dict, headroom_override=None) -> tuple:
+    """Human-readable memory report; same exit-code contract as
+    `memory_doc`."""
+    doc, code = memory_doc(run, headroom_override)
+    m = doc["memory"]
+    out = [f"run      {run['dir']}"]
+    if code == 3:
+        out.append(
+            "no memory data recorded — was the run produced by an "
+            "instrumented sweep/solve with telemetry on?"
+        )
+        return "\n".join(out), code
+    peak = m.get("peak_device_bytes") or m.get("peak_live_buffer_bytes") or 0
+    out.append(
+        f"memory   peak {_fmt_bytes(peak)}"
+        + (f"   in span {m['peak_span']}" if m.get("peak_span") else "")
+    )
+    if doc["capacity_bytes"]:
+        out.append(
+            f"capacity {_fmt_bytes(doc['capacity_bytes'])}   headroom "
+            f"{doc['headroom']:.0%} → threshold {_fmt_bytes(doc['threshold_bytes'])}"
+        )
+    else:
+        out.append("capacity unknown (no allocator stats — CPU backend?)")
+
+    if doc["plan"]:
+        p = doc["plan"]
+        out += ["", "CAPACITY PLAN"]
+        out.append(
+            f"tile_shape auto → {tuple(p.get('tile_shape', []))} "
+            f"(verdict {p.get('verdict')}"
+            + (
+                f", modeled {_fmt_bytes(p['modeled_bytes'])} of budget "
+                f"{_fmt_bytes(p['budget_bytes'])}"
+                if p.get("modeled_bytes") is not None
+                else ""
+            )
+            + ")"
+        )
+    if doc["preflight"]:
+        out += ["", "PREFLIGHT"]
+        out.append(
+            _table(
+                ["label", "verdict", "footprint", "budget"],
+                [
+                    [
+                        p.get("label", "-"),
+                        p.get("verdict", "-").upper()
+                        if p.get("verdict") == "exceeds"
+                        else p.get("verdict", "-"),
+                        _fmt_bytes(p.get("footprint_bytes")),
+                        _fmt_bytes(p.get("budget_bytes")),
+                    ]
+                    for p in doc["preflight"]
+                ],
+            )
+        )
+    if doc["spans"]:
+        out += ["", "SPANS (peak bytes observed at span/jit boundaries)"]
+        out.append(
+            _table(
+                ["where", "events", "live buffers", "in use", "device peak"],
+                [
+                    [
+                        k,
+                        v["events"],
+                        _fmt_bytes(v["live_buffer_bytes"]),
+                        _fmt_bytes(v["bytes_in_use"]),
+                        _fmt_bytes(v["peak_bytes_in_use"]),
+                    ]
+                    for k, v in sorted(doc["spans"].items())
+                ],
+            )
+        )
+    if doc["tiles"]:
+        out += ["", f"TILES{' (OVER THRESHOLD: ' + ', '.join(doc['over_tiles']) + ')' if doc['over_tiles'] else ''}"]
+        rows = []
+        for tile, peak_b in sorted(doc["tiles"].items(), key=lambda kv: -kv[1]):
+            share = (
+                f"{100 * peak_b / doc['capacity_bytes']:.1f}%"
+                if doc["capacity_bytes"]
+                else "-"
+            )
+            rows.append(
+                [tile, _fmt_bytes(peak_b), share, "OVER" if tile in doc["over_tiles"] else "-"]
+            )
+        out.append(_table(["tile", "peak", "of capacity", "flag"], rows))
+    top = m.get("top_programs") or []
+    if top:
+        out += ["", "TOP PROGRAMS (by XLA temp size)"]
+        out.append(
+            _table(
+                ["program", "temp", "output", "arguments"],
+                [
+                    [
+                        p.get("name", "-"),
+                        _fmt_bytes(p.get("temp_bytes")),
+                        _fmt_bytes(p.get("out_bytes")),
+                        _fmt_bytes(p.get("arg_bytes")),
+                    ]
+                    for p in top
+                ],
+            )
+        )
+    return "\n".join(out), code
+
+
+def _main_memory(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report memory",
+        description="Per-span/per-tile peak-memory attribution for one run; "
+        "exit 1 when any tile exceeds the headroom threshold (or a preflight "
+        "failed), 3 when no memory data was recorded",
+    )
+    parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    parser.add_argument(
+        "--headroom", type=float, default=None, metavar="FRAC",
+        help="override the flagging threshold as a fraction of device "
+        "capacity (default: the run's recorded SBR_MEM_HEADROOM, else 0.8)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    try:
+        run = load_run(args.run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.json:
+        doc, code = memory_doc(run, args.headroom)
+        print(json.dumps(doc, default=str))
+        return code
+    text, code = render_memory(run, args.headroom)
+    print(text)
+    return code
+
+
 def _main_health(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report health",
@@ -638,7 +864,9 @@ def _main_resilience(argv) -> int:
 def _main_gc(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report gc",
-        description="Prune old obs run directories, keeping the N most recent",
+        description="Prune old obs run directories (keeping the N most "
+        "recent) plus checkpoint debris left by aborted multihost runs: "
+        "quarantine/ directories and stale tile_*.lease files",
     )
     parser.add_argument(
         "root", nargs="?", default=None,
@@ -646,9 +874,20 @@ def _main_gc(argv) -> int:
     )
     parser.add_argument("--keep", type=int, required=True, metavar="N",
                         help="number of most-recent run directories to keep")
+    parser.add_argument(
+        "--checkpoints", action="append", default=[], metavar="DIR",
+        help="additional checkpoint root(s) to sweep for quarantine/ dirs "
+        "and stale tile_*.lease files (the run root is always swept)",
+    )
+    parser.add_argument(
+        "--lease-ttl", type=float, default=900.0, metavar="S",
+        help="age (s) past which a lease with no recorded TTL counts as "
+        "stale (default 900, matching SBR_STEAL_LEASE_TTL_S)",
+    )
     args = parser.parse_args(argv)
     import os
 
+    from sbr_tpu.obs import mem
     from sbr_tpu.obs.runlog import gc_runs
 
     root = args.root or os.environ.get("SBR_OBS_DIR", "obs_runs")
@@ -656,6 +895,13 @@ def _main_gc(argv) -> int:
     print(f"removed {len(removed)} run dir(s) under {root} (keep {args.keep})")
     for d in removed:
         print(f"  {d}")
+    debris = []
+    for r in [root, *args.checkpoints]:
+        debris.extend(mem.gc_debris(r, lease_ttl_s=args.lease_ttl))
+    print(f"removed {len(debris)} checkpoint-debris path(s) "
+          "(quarantine/, stale tile_*.lease)")
+    for p in debris:
+        print(f"  {p}")
     return 0
 
 
@@ -667,6 +913,8 @@ def main(argv=None) -> int:
         return _main_health(argv[1:])
     if argv and argv[0] == "resilience":
         return _main_resilience(argv[1:])
+    if argv and argv[0] == "memory":
+        return _main_memory(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -678,7 +926,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
-        "'health' / 'resilience' / 'trend' / 'gc' subcommands",
+        "'health' / 'resilience' / 'memory' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

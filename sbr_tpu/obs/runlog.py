@@ -169,6 +169,15 @@ class RunContext:
         self.jit: dict = {"calls": 0, "cache_hits": 0, "trace_s": 0.0, "compile_s": 0.0, "execute_s": 0.0}
         self.mem_peak_live = 0  # peak sum of live jax buffer nbytes
         self.mem_peak_device = 0  # peak allocator peak_bytes_in_use (if exposed)
+        # Memory observatory (obs.mem): per-span/per-tile attribution,
+        # preflight verdicts, and the capacity-planner decision.
+        self.mem_peak_span: Optional[str] = None  # span holding the peak snapshot
+        self.mem_capacity: Optional[int] = None  # allocator bytes_limit, if exposed
+        self.mem_programs: dict = {}  # program -> XLA footprint (arg/out/temp bytes)
+        self.mem_tiles: dict = {}  # tile_id -> peak bytes
+        self.mem_plan: Optional[dict] = None  # capacity-planner decision record
+        self.mem_preflights: list = []  # preflight verdict records
+        self._mem_last: dict = {}  # previous snapshot, for per-event deltas
         self.device: Optional[dict] = None
         self.health: dict = {}  # stage -> folded numerical-health roll-up
         # Resilience roll-ups (sbr_tpu.resilience): injected-fault firings,
@@ -287,6 +296,7 @@ class RunContext:
             trace_s = t1 - t0
             compile_s = t2 - t1
             info = _compiled_info(compiled)
+            self._note_program_mem(name, info)
             entry = (compiled, info)
             self._aot_cache[key] = entry
             cache = "miss"
@@ -345,29 +355,81 @@ class RunContext:
             pass
 
     def _memory_event(self, where: str) -> None:
-        """Live-buffer + allocator snapshot (guarded: `memory_stats` is
-        None on CPU and may be unsupported behind tunnels)."""
+        """Attribution snapshot (obs.mem): live-buffer sum (gated by
+        SBR_OBS_MEM_LIVE — O(live arrays) per event) plus allocator stats
+        when exposed (`memory_stats` is None on CPU and may be unsupported
+        behind tunnels), emitted as a ``mem`` event with deltas vs the
+        previous snapshot and folded into the peak/peak-span roll-up."""
         try:
-            import jax
-
             # Only span ends and jit calls land here, both of which imply
             # device work already happened — so recording the device info
             # cannot be the thing that forces backend init.
             self._device_event()
-            live = sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
-            snap = {"where": where, "live_buffer_bytes": int(live)}
-            stats = jax.devices()[0].memory_stats()
-            if stats:
-                for k in ("bytes_in_use", "peak_bytes_in_use"):
-                    if k in stats:
-                        snap[k] = int(stats[k])
-                self.mem_peak_device = max(
-                    self.mem_peak_device, int(stats.get("peak_bytes_in_use", 0))
-                )
-            self.mem_peak_live = max(self.mem_peak_live, int(live))
-            self.event("memory", **snap)
+            from sbr_tpu.obs import mem
+
+            snap = mem.snapshot()
+            if not snap:
+                return
+            ev = {"where": where, "span": active_span(), **snap}
+            for k in ("live_buffer_bytes", "bytes_in_use"):
+                if k in snap and k in self._mem_last:
+                    ev["d_" + k] = snap[k] - self._mem_last[k]
+            self._mem_last.update(snap)
+            if "bytes_limit" in snap:
+                self.mem_capacity = snap["bytes_limit"]
+            live = snap.get("live_buffer_bytes")
+            device_now = max(snap.get("peak_bytes_in_use", 0), snap.get("bytes_in_use", 0))
+            if live is not None and live > self.mem_peak_live:
+                self.mem_peak_live = live
+                if not device_now:  # live sum is the only signal (CPU)
+                    self.mem_peak_span = where
+            if device_now > self.mem_peak_device:
+                self.mem_peak_device = device_now
+                self.mem_peak_span = where
+            self.event("mem", **ev)
         except Exception:
             pass
+
+    def _note_program_mem(self, name: str, info: dict) -> None:
+        """Fold one compiled program's XLA footprint (jit_call's
+        memory_analysis) into the per-program registry — the manifest's
+        top-programs-by-temp-size table reads from here."""
+        keys = ("arg_bytes", "out_bytes", "temp_bytes", "code_bytes")
+        fp = {k: int(info[k]) for k in keys if k in info}
+        if not fp:
+            return
+        prev = self.mem_programs.get(name)
+        if prev is None or fp.get("temp_bytes", 0) >= prev.get("temp_bytes", 0):
+            self.mem_programs[name] = fp
+
+    def log_tile_mem(self, tile: str, **snap) -> None:
+        """Per-tile peak attribution (the tiled sweep loop calls this after
+        each computed tile): one ``mem`` event with a ``tile`` field, folded
+        into the manifest's per-tile peak table. The tile's figure is
+        ``bytes_in_use`` at snapshot time (taken while the tile's buffers
+        are live) — NOT ``peak_bytes_in_use``, which is a process-lifetime
+        high-water mark: after one big tile (or a compile spike) it would
+        attribute the global peak to every later tile and `report memory`
+        would flag them all. The monotone counter is still recorded in the
+        event and handled at run level (``peak_span``)."""
+        from sbr_tpu.obs import mem
+
+        self.event("mem", where="tile", tile=tile, span=active_span(), **snap)
+        self.mem_tiles[tile] = max(mem.tile_peak(snap), self.mem_tiles.get(tile, 0))
+        if "bytes_limit" in snap:
+            self.mem_capacity = int(snap["bytes_limit"])
+
+    def log_preflight(self, rec: dict) -> None:
+        """OOM-preflight verdict (obs.mem.preflight): one ``preflight``
+        event + an entry in the manifest's ``memory.preflight`` list."""
+        self.event("preflight", **rec)
+        self.mem_preflights.append(dict(rec))
+
+    def log_plan(self, rec: dict) -> None:
+        """Capacity-planner decision (tile_shape="auto"): one ``plan``
+        event; the manifest's ``memory.plan`` block records the last one."""
+        self.event("plan", **rec)
+        self.mem_plan = dict(rec)
 
     # -- performance observatory hooks (obs.prof) -----------------------------
     def _note_xla(self, key: str, duration_s: float, span: Optional[str]) -> None:
@@ -461,6 +523,41 @@ class RunContext:
             },
         }
 
+    _MANIFEST_TILE_CAP = 512  # largest per-tile table the manifest carries
+
+    def _memory_manifest(self) -> dict:
+        """The manifest ``memory`` roll-up: peaks (+ the span holding the
+        peak), device capacity, the top-5 programs by XLA temp size, the
+        per-tile peak table (size-capped — the event log keeps every tile),
+        and the planner/preflight records."""
+        from sbr_tpu.obs import mem
+
+        top = sorted(
+            self.mem_programs.items(), key=lambda kv: -kv[1].get("temp_bytes", 0)
+        )[:5]
+        tiles = self.mem_tiles
+        truncated = 0
+        if len(tiles) > self._MANIFEST_TILE_CAP:
+            truncated = len(tiles) - self._MANIFEST_TILE_CAP
+            tiles = dict(
+                sorted(tiles.items(), key=lambda kv: -kv[1])[: self._MANIFEST_TILE_CAP]
+            )
+        block = {
+            "peak_live_buffer_bytes": self.mem_peak_live,
+            "peak_device_bytes": self.mem_peak_device,
+            "peak_bytes": self.mem_peak_device or self.mem_peak_live,
+            "peak_span": self.mem_peak_span,
+            "capacity_bytes": self.mem_capacity,
+            "headroom": mem.headroom(),
+            "top_programs": [{"name": k, **v} for k, v in top] or None,
+            "tiles": tiles or None,
+            "plan": self.mem_plan,
+            "preflight": self.mem_preflights or None,
+        }
+        if truncated:
+            block["tiles_truncated"] = truncated
+        return block
+
     def _write_manifest(self, status: str) -> None:
         manifest = {
             "schema": SCHEMA,
@@ -479,10 +576,7 @@ class RunContext:
                 **{k: self.jit[k] for k in ("calls", "cache_hits")},
                 **{k: round(self.jit[k], 6) for k in ("trace_s", "compile_s", "execute_s")},
             },
-            "memory": {
-                "peak_live_buffer_bytes": self.mem_peak_live,
-                "peak_device_bytes": self.mem_peak_device,
-            },
+            "memory": self._memory_manifest(),
             "health": self.health or None,
             "resilience": self._resilience_manifest(),
             "metrics": metrics().summary() if metrics().enabled else None,
@@ -735,6 +829,22 @@ def log_retry(scope: str = "?", outcome: str = "?", attempt: int = 0, **fields) 
     run = current_run()
     if run is not None and _trace_clean():
         run.log_retry(scope, outcome, attempt, **fields)
+
+
+def log_tile_mem(tile: str = "?", **snap) -> None:
+    """Per-tile peak-memory event + manifest roll-up (no-op when telemetry
+    is off or while tracing) — the tiled sweep loop's emission hook. With
+    no explicit ``snap`` fields, takes a fresh `obs.mem` snapshot."""
+    run = current_run()
+    if run is None or not _trace_clean():
+        return
+    if not snap:
+        from sbr_tpu.obs import mem
+
+        snap = mem.snapshot()
+        if not snap:
+            return
+    run.log_tile_mem(tile, **snap)
 
 
 def log_repair(action: str = "?", target: str = "?", ok: bool = True, **fields) -> None:

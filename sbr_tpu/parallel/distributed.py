@@ -185,8 +185,24 @@ def run_tiled_grid_multihost(
     ``SBR_STEAL_LEASE_TTL_S``, default 900 s) instead of timing out — see
     the module docstring. ``timeout_s`` still bounds the whole barrier as
     the last line of defense.
+
+    ``tile_shape="auto"`` resolves here, before the ownership split, via
+    the obs.mem capacity planner (see `utils.checkpoint.run_tiled_grid`):
+    the planner is deterministic in (grid, capacity, headroom), so
+    same-capacity peers independently agree on the tile grid — and the
+    checkpoint fingerprint, which hashes the resolved shape, fails loudly
+    if a heterogeneous pool somehow disagrees. The ORIGINAL ``tile_shape``
+    is what gets passed down to the `run_tiled_grid` calls (own share,
+    steals, assembly): re-resolving "auto" there is free (probe footprints
+    are cached) and hands each call its own plan record, which the OOM
+    preflight consumes instead of paying a full worst-case-tile compile.
     """
-    from sbr_tpu.utils.checkpoint import _tile_path, run_tiled_grid, tile_origins
+    from sbr_tpu.utils.checkpoint import (
+        _tile_path,
+        resolve_tile_shape,
+        run_tiled_grid,
+        tile_origins,
+    )
 
     if process_id is None or num_processes is None:
         import jax
@@ -197,7 +213,10 @@ def run_tiled_grid_multihost(
     import numpy as np
 
     nb, nu = len(np.asarray(beta_values)), len(np.asarray(u_values))
-    tiles = tile_origins(nb, nu, tile_shape)
+    # Resolve for OUR view of the tile grid (ownership split + barrier);
+    # the original tile_shape still flows to run_tiled_grid (see docstring).
+    resolved_shape, _plan = resolve_tile_shape(nb, nu, tile_shape, config, dtype)
+    tiles = tile_origins(nb, nu, resolved_shape)
     owned = {tiles[i] for i in tile_assignment(len(tiles), num_processes, process_id)}
 
     run_tiled_grid(

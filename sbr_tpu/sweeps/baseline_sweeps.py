@@ -253,6 +253,59 @@ class _TracedLearning:
         self.x0 = x0
 
 
+def _sweep_footprint(cache: dict, axes, config, dtype, build_fn, n_scalars) -> dict:
+    """Shared footprint machinery for the sweep modules: normalize the
+    (config, dtype) defaults exactly as the sweep entry points do, then AOT
+    lower + compile the UNSHARDED program on abstract `jax.ShapeDtypeStruct`
+    arguments (no data, no execution, no device buffers) and read XLA's
+    ``memory_analysis()`` — cached per (axes, config, dtype), since the OOM
+    preflight and the tile_shape="auto" planner hit the same shapes
+    repeatedly. A mesh changes the per-device footprint and is handled by
+    the callers' graceful-skip."""
+    if config is None:
+        config = SolverConfig(refine_crossings=False)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+    axes = tuple(int(n) for n in axes)
+    key = (axes, config, dtype.name)
+    fp = cache.get(key)
+    if fp is None:
+        from sbr_tpu.obs import mem
+
+        scalar = jax.ShapeDtypeStruct((), dtype)
+        args = tuple(jax.ShapeDtypeStruct((n,), dtype) for n in axes)
+        args += (scalar,) * n_scalars
+        fp = mem.aot_footprint(build_fn(config, dtype.name), *args)
+        cache[key] = fp
+    return dict(fp)
+
+
+_FOOTPRINT_CACHE: dict = {}
+
+
+def grid_tile_footprint(
+    n_b: int,
+    n_u: int,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> dict:
+    """Analytical memory footprint of ONE (n_b × n_u) β×u grid dispatch
+    (argument/output/temp bytes, summed as ``total_bytes``) — the model
+    the OOM preflight compares against device capacity and the
+    ``tile_shape="auto"`` planner probes (`sbr_tpu.obs.mem`).
+    ``config=None`` selects the sweep default (refinement OFF), matching
+    `beta_u_grid`. See `_sweep_footprint` for the AOT mechanics."""
+    return _sweep_footprint(
+        _FOOTPRINT_CACHE,
+        (n_b, n_u),
+        config,
+        dtype,
+        lambda cfg, dt: _grid_fn(cfg, dt, None, None),
+        n_scalars=7,
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _grid_fn(config: SolverConfig, dtype_name: str, mesh, mesh_axes):
     """Jitted β×u grid program, cached by (config, dtype, mesh) so repeated

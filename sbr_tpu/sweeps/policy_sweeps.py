@@ -95,6 +95,33 @@ def _policy_fn(config: SolverConfig, dtype_name: str, mesh=None, mesh_axes=None)
     return jax.jit(fn)
 
 
+# AOT footprint cache, mirroring baseline_sweeps._FOOTPRINT_CACHE.
+_FOOTPRINT_CACHE: dict = {}
+
+
+def policy_tile_footprint(
+    n_b: int,
+    n_u: int,
+    n_r: int,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> dict:
+    """Analytical memory footprint of ONE (n_b × n_u × n_r) policy-grid
+    dispatch — the (β, u, r) analogue of
+    `baseline_sweeps.grid_tile_footprint`, feeding the pre-dispatch OOM
+    preflight in `policy_sweep_interest` (`sbr_tpu.obs.mem`)."""
+    from sbr_tpu.sweeps.baseline_sweeps import _sweep_footprint
+
+    return _sweep_footprint(
+        _FOOTPRINT_CACHE,
+        (n_b, n_u, n_r),
+        config,
+        dtype,
+        lambda cfg, dt: _policy_fn(cfg, dt, None, None),
+        n_scalars=8,
+    )
+
+
 def policy_sweep_interest(
     beta_values,
     u_values,
@@ -165,6 +192,27 @@ def policy_sweep_interest(
         config, dtype.name, mesh, tuple(mesh_axes) if mesh is not None else None
     )
     n_b, n_u, n_r = (int(v.shape[0]) for v in (beta_values, u_values, r_values))
+    # OOM preflight (obs.mem): unlike the baseline grid, the policy sweep
+    # has no tile loop in front of it, so this is its only pre-dispatch
+    # memory check — fail closed on an analytically-oversized grid instead
+    # of an XLA OOM. Graceful skip on CPU (no capacity: the footprint
+    # compile is skipped too) and under a mesh (the unsharded lowering
+    # would overestimate the per-device footprint).
+    from sbr_tpu.obs import mem as obs_mem
+
+    if obs_mem.preflight_enabled():
+        label = f"policy[{n_b}x{n_u}x{n_r}]"
+        if obs_mem.device_capacity() is None or mesh is not None:
+            obs_mem.preflight(
+                label, None, capacity=None,
+                skip_reason="sharded" if mesh is not None else None,
+            )
+        else:
+            obs_mem.check_preflight(
+                obs_mem.preflight(
+                    label, policy_tile_footprint(n_b, n_u, n_r, config, dtype)
+                )
+            )
     # Chaos fault point (resilience.faults), mirroring beta_u_grid's.
     from sbr_tpu.resilience import faults
 

@@ -46,11 +46,111 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from sbr_tpu import obs
 from sbr_tpu.models.params import ModelParams, SolverConfig
 from sbr_tpu.resilience import faults, heal, retry, shutdown
 from sbr_tpu.sweeps.baseline_sweeps import GridSweepResult, beta_u_grid
 
 _FIELDS = ("max_aw", "xi", "status")
+
+
+def resolve_tile_shape(
+    nb: int,
+    nu: int,
+    tile_shape,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+    mesh=None,
+) -> Tuple[Tuple[int, int], Optional[dict]]:
+    """Resolve ``tile_shape="auto"`` via the obs.mem capacity planner.
+
+    An explicit ``(tb, tu)`` passes through untouched (plan record None).
+    For ``"auto"``, the planner fits a linear footprint model from two
+    small abstract AOT lowerings (`grid_tile_footprint`) and picks the
+    largest power-of-two square tile fitting ``SBR_MEM_HEADROOM`` × device
+    capacity; with no capacity (CPU / absent ``memory_stats``) it falls
+    back to the historical (256, 256) default clamped to the grid, verdict
+    ``"skipped"``. Deterministic: same capacity + same grid ⇒ same shape,
+    so multihost peers planning independently agree on the tile grid (and
+    the checkpoint fingerprint, which hashes the RESOLVED shape, fails
+    loudly if they somehow don't). The decision is recorded as a ``plan``
+    event + ``memory.plan`` manifest block when telemetry is on.
+    """
+    if tile_shape != "auto":
+        tb, tu = tile_shape
+        return (int(tb), int(tu)), None
+    if config is None:  # the sweep default, matching run_tiled_grid
+        config = SolverConfig(refine_crossings=False)
+    from sbr_tpu.obs import mem as obs_mem
+    from sbr_tpu.sweeps.baseline_sweeps import grid_tile_footprint
+
+    multiple = (1, 1)
+    if mesh is not None:
+        multiple = (int(mesh.shape["b"]), int(mesh.shape["u"]))
+    shape, rec = obs_mem.plan_from_probes(
+        int(nb),
+        int(nu),
+        lambda tb, tu: grid_tile_footprint(tb, tu, config=config, dtype=dtype),
+        multiple_of=multiple,
+        # A sharded tile spreads its cells evenly over the mesh: per-device
+        # footprint is ~cells/mesh-size, so budget the model per device or
+        # the planner would undersize sharded tiles by the device count.
+        per_device_divisor=multiple[0] * multiple[1],
+    )
+    try:
+        from sbr_tpu import obs
+
+        run = obs.current_run()
+        if run is not None:
+            run.log_plan(rec)
+    except Exception:
+        pass  # telemetry must never sink the planner
+    return shape, rec
+
+
+def _preflight_tile(nb, nu, tb, tu, config, dtype, mesh, plan=None) -> Optional[dict]:
+    """OOM preflight for the tiled sweep: AOT-lower one worst-case (full)
+    tile, read its analytical footprint, and fail CLOSED
+    (`MemoryPreflightError`) when it exceeds headroom × capacity — a clear
+    error before dispatch instead of an XLA OOM mid-sweep. Graceful skips
+    (recorded, never fatal): ``SBR_MEM_PREFLIGHT=0``, no device capacity
+    (CPU/absent API — the footprint compile is skipped too, so CPU runs
+    pay nothing), or a mesh (the unsharded lowering would overestimate the
+    per-device footprint by the device count; never fail a dispatch that
+    actually fits). When the capacity planner already fitted this grid
+    (``plan`` from tile_shape="auto"), the verdict comes from its model —
+    the planner just proved the budget from two probe lowerings, and
+    re-compiling the full tile only to discard the executable would double
+    the first-dispatch XLA compile. An EXPLICIT tile_shape does pay that
+    extra AOT compile, deliberately: the exact analytical footprint is the
+    trustworthy fail-closed signal for a shape no model has vetted, the
+    result is cached (`_FOOTPRINT_CACHE`), and rigs with a persistent XLA
+    compile cache dedupe the dispatch-time recompile to a deserialize."""
+    from sbr_tpu.obs import mem as obs_mem
+
+    if not obs_mem.preflight_enabled():
+        return None
+    tb_eff, tu_eff = min(tb, nb), min(tu, nu)
+    label = f"tile[{tb_eff}x{tu_eff}]"
+    capacity = obs_mem.device_capacity()
+    if capacity is None or mesh is not None:
+        return obs_mem.preflight(
+            label, None, capacity=None,
+            skip_reason="sharded" if mesh is not None else None,
+        )
+    if plan is not None and plan.get("verdict") == "ok":
+        fp = {
+            "total_bytes": int(
+                plan["model_fixed_bytes"]
+                + plan["model_per_cell_bytes"] * (tb_eff * tu_eff)
+            ),
+            "source": "planner-model",
+        }
+    else:
+        from sbr_tpu.sweeps.baseline_sweeps import grid_tile_footprint
+
+        fp = grid_tile_footprint(tb_eff, tu_eff, config=config, dtype=dtype)
+    return obs_mem.check_preflight(obs_mem.preflight(label, fp, capacity=capacity))
 
 
 def _tile_path(ckpt_dir: Path, bi: int, ui: int) -> Path:
@@ -209,7 +309,7 @@ def run_tiled_grid(
     u_values,
     base: ModelParams,
     config: Optional[SolverConfig] = None,
-    tile_shape: Tuple[int, int] = (256, 256),
+    tile_shape=(256, 256),
     checkpoint_dir: Optional[str] = None,
     mesh=None,
     dtype=None,
@@ -224,6 +324,19 @@ def run_tiled_grid(
     default (crossing refinement OFF, like `beta_u_grid`), and the config is
     part of the sweep fingerprint — switching between the two invalidates an
     existing checkpoint dir (by design: tile numerics would differ).
+
+    ``tile_shape`` may be ``"auto"``: the obs.mem capacity planner picks the
+    largest power-of-two square tile whose modeled footprint (linear fit of
+    two abstract AOT probe lowerings) fits ``SBR_MEM_HEADROOM`` (default
+    0.8) × device capacity; on CPU (no ``memory_stats``) it falls back to
+    (256, 256) clamped to the grid. The resolved shape enters the sweep
+    fingerprint, and the decision is recorded in the obs manifest's
+    ``memory.plan`` block. Before the tile loop dispatches, an OOM
+    preflight AOT-lowers one worst-case tile and FAILS CLOSED
+    (`obs.mem.MemoryPreflightError`) when its analytical footprint exceeds
+    the headroom budget — disable with ``SBR_MEM_PREFLIGHT=0``. Each
+    computed tile's peak memory lands as a ``mem`` event
+    (``report memory RUN_DIR`` renders the per-tile table).
 
     Semantically identical to one `beta_u_grid` call over the full grid
     (cells are independent); tiling bounds device-memory footprint at
@@ -249,6 +362,7 @@ def run_tiled_grid(
     beta_values = np.asarray(beta_values)
     u_values = np.asarray(u_values)
     nb, nu = len(beta_values), len(u_values)
+    tile_shape, _plan = resolve_tile_shape(nb, nu, tile_shape, config, dtype, mesh)
     tb, tu = tile_shape
     if heal_divergent is None:
         heal_divergent = os.environ.get("SBR_HEAL", "").strip() != "0"
@@ -277,6 +391,10 @@ def run_tiled_grid(
         _check_fingerprint(
             ckpt, _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype)
         )
+
+    # OOM preflight: fail closed on an analytically-oversized tile BEFORE
+    # any device work (graceful skip on CPU/sharded — see _preflight_tile).
+    _preflight_tile(nb, nu, tb, tu, config, dtype, mesh, plan=_plan)
 
     origins = tile_origins(nb, nu, tile_shape)
     policy = retry.policy_from_env(
@@ -319,6 +437,8 @@ def run_tiled_grid(
             if not owned:
                 continue  # another process's tile; it lands on disk, not here
 
+            tile_snap: dict = {}
+
             def compute_tile():
                 faults.fire("tile.compute", target=tile_id)
                 tile = beta_u_grid(
@@ -330,6 +450,12 @@ def run_tiled_grid(
                     if tile.health is not None
                     else np.zeros(arrays["status"].shape, np.int32)
                 )
+                if obs.current_run() is not None:
+                    # Snapshot while the tile's device buffers are still
+                    # live — after the host copies land, the live-buffer
+                    # sum would read an empty device.
+                    tile_snap.clear()
+                    tile_snap.update(obs.mem.snapshot())
                 return arrays, tile_flags
 
             def observer(**rec):
@@ -367,6 +493,10 @@ def run_tiled_grid(
 
             for f in _FIELDS:
                 out[f][bs, us] = arrays[f]
+            # Per-tile peak-memory attribution (obs.mem): one `mem` event
+            # with a `tile` field, folded into the manifest's tile table —
+            # `report memory` renders it and flags near-capacity tiles.
+            obs.log_tile_mem(tile_id, **tile_snap)
             if path is not None:
                 _save_atomic(path, arrays)
                 # Chaos hook: a ``corrupt`` rule on checkpoint.save tears the

@@ -1,0 +1,507 @@
+"""Tests for the memory observatory (`sbr_tpu.obs.mem`, ISSUE 5).
+
+Covers the acceptance criteria: the ``mem`` event schema and manifest
+``memory`` roll-up (per-span/per-tile attribution), OOM-preflight graceful
+skip on CPU (``memory_stats()`` returning None) and fail-closed behavior
+with a synthetic capacity, capacity-planner determinism (same capacity ⇒
+same tile shape), the schema-1→2 ``bench_history.jsonl`` back-compat read,
+the ``report memory`` exit-code contract (0 within budget / 1 over the
+headroom threshold / 3 missing data), and the ``report gc`` checkpoint-
+debris satellite (quarantine/ + stale tile_*.lease pruning).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sbr_tpu import obs
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.obs import mem, report
+
+
+@pytest.fixture(autouse=True)
+def _no_active_run():
+    """Telemetry must never leak between tests (mirrors test_obs.py)."""
+    assert obs.current_run() is None
+    was_on = obs.metrics().enabled
+    yield
+    while obs.end_run() is not None:
+        pass
+    (obs.metrics().enable if was_on else obs.metrics().disable)()
+
+
+_TINY = SolverConfig(n_grid=64, bisect_iters=20, refine_crossings=False)
+
+
+# -- snapshots & the SBR_OBS_MEM_LIVE gate -----------------------------------
+
+
+def test_snapshot_on_cpu_carries_live_bytes_only():
+    import jax.numpy as jnp
+
+    keep = jnp.arange(1024.0)  # ensure at least one live buffer
+    snap = mem.snapshot()
+    assert snap.get("live_buffer_bytes", 0) >= keep.nbytes
+    # CPU backends expose no allocator stats — the keys must be absent,
+    # not zero (consumers treat every field as optional).
+    assert "bytes_in_use" not in snap
+    assert "bytes_limit" not in snap
+
+
+def test_live_gate_env_and_context(monkeypatch):
+    assert mem.live_enabled()
+    monkeypatch.setenv("SBR_OBS_MEM_LIVE", "0")
+    assert not mem.live_enabled()
+    assert mem.live_bytes() is None
+    monkeypatch.setenv("SBR_OBS_MEM_LIVE", "1")
+    with mem.live_disabled():
+        assert not mem.live_enabled()
+        assert mem.snapshot() == {}  # nothing observable on CPU with the gate off
+    assert mem.live_enabled()  # restored
+
+
+def test_headroom_env(monkeypatch):
+    assert mem.headroom() == pytest.approx(0.8)
+    monkeypatch.setenv("SBR_MEM_HEADROOM", "0.5")
+    assert mem.headroom() == pytest.approx(0.5)
+    monkeypatch.setenv("SBR_MEM_HEADROOM", "nonsense")
+    assert mem.headroom() == pytest.approx(0.8)  # garbage falls back, never raises
+
+
+# -- mem event schema --------------------------------------------------------
+
+
+def test_mem_event_schema_and_manifest_rollup(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    run_dir = tmp_path / "run"
+    fn = jax.jit(lambda x: (x * 2.0).sum())
+    with obs.run_context(run_dir=str(run_dir)):
+        with obs.span("stage_m") as sp:
+            y = obs.jit_call("prog_m", fn, jnp.arange(256.0))
+            sp.sync(y)
+        obs.log_tile_mem("tile_b00000_u00000")
+
+    events = [
+        json.loads(line) for line in (run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    mem_events = [e for e in events if e["kind"] == "mem"]
+    assert mem_events, "span end + jit call must land mem events"
+    for ev in mem_events:
+        assert "where" in ev and "span" in ev
+        assert isinstance(ev.get("live_buffer_bytes"), int)
+    tile_events = [e for e in mem_events if e.get("tile")]
+    assert tile_events and tile_events[0]["where"] == "tile"
+
+    manifest = json.loads((run_dir / "manifest.json").read_text())
+    block = manifest["memory"]
+    assert block["peak_bytes"] == block["peak_live_buffer_bytes"] > 0
+    assert block["peak_span"] is not None
+    assert "tile_b00000_u00000" in (block["tiles"] or {})
+    top = block["top_programs"]
+    assert top and top[0]["name"] == "prog_m"
+    assert {"arg_bytes", "out_bytes", "temp_bytes"} <= set(top[0])
+
+
+# -- analytical footprints & preflight ---------------------------------------
+
+
+def test_grid_tile_footprint_scales_with_cells():
+    from sbr_tpu.sweeps.baseline_sweeps import grid_tile_footprint
+
+    fp8 = grid_tile_footprint(8, 8, _TINY)
+    fp16 = grid_tile_footprint(16, 16, _TINY)
+    assert fp8["total_bytes"] > 0
+    assert fp16["total_bytes"] > fp8["total_bytes"]
+    assert fp16["out_bytes"] > fp8["out_bytes"]
+
+
+def test_policy_tile_footprint():
+    from sbr_tpu.sweeps.policy_sweeps import policy_tile_footprint
+
+    fp = policy_tile_footprint(2, 2, 2, _TINY)
+    assert fp["total_bytes"] > 0
+
+
+def test_preflight_graceful_skip_on_cpu(tmp_path):
+    """CPU: memory_stats() is None ⇒ no capacity ⇒ verdict "skipped" — and
+    check_preflight passes it through (never fail-closed without evidence)."""
+    run_dir = tmp_path / "run"
+    with obs.run_context(run_dir=str(run_dir)):
+        rec = mem.preflight("tile[8x8]", {"total_bytes": 10**18})
+        assert rec["verdict"] == "skipped"
+        assert rec["reason"] == "no-capacity"
+        mem.check_preflight(rec)  # must not raise
+    events = [
+        json.loads(line) for line in (run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    (pf,) = [e for e in events if e["kind"] == "preflight"]
+    assert pf["verdict"] == "skipped"
+    manifest = json.loads((run_dir / "manifest.json").read_text())
+    assert manifest["memory"]["preflight"][0]["verdict"] == "skipped"
+
+
+def test_preflight_fails_closed_on_exceeds():
+    fp = {"total_bytes": 2 * 2**30, "arg_bytes": 0, "out_bytes": 0, "temp_bytes": 2 * 2**30}
+    rec = mem.preflight("tile[big]", fp, capacity=2**30, headroom_frac=0.8)
+    assert rec["verdict"] == "exceeds"
+    with pytest.raises(mem.MemoryPreflightError, match="exceeds the memory budget"):
+        mem.check_preflight(rec)
+    ok = mem.preflight("tile[ok]", {"total_bytes": 100}, capacity=2**30)
+    assert ok["verdict"] == "ok"
+    mem.check_preflight(ok)
+
+
+def test_policy_sweep_preflight_fails_closed_with_synthetic_capacity(monkeypatch):
+    """The policy sweep has no tile loop in front of it — its direct
+    preflight must refuse an analytically-oversized grid pre-dispatch."""
+    from sbr_tpu.models.params import make_interest_params
+    from sbr_tpu.sweeps.policy_sweeps import policy_sweep_interest
+
+    monkeypatch.setattr(mem, "device_capacity", lambda stats=None: 4096)
+    with pytest.raises(mem.MemoryPreflightError):
+        policy_sweep_interest(
+            np.array([0.5, 1.0]), np.array([0.05, 0.1]), np.array([0.0, 0.01]),
+            make_interest_params(u=0.1, delta=0.1), config=_TINY,
+        )
+
+
+def test_auto_preflight_uses_planner_model_not_a_second_compile(monkeypatch, tmp_path):
+    """On the tile_shape="auto" path the preflight verdict must come from
+    the planner's fitted model (source "planner-model") — not a full-tile
+    AOT compile whose executable would be discarded."""
+    from sbr_tpu.utils.checkpoint import run_tiled_grid
+
+    monkeypatch.setattr(mem, "allocator_stats", lambda: {"bytes_limit": 64 * 2**20})
+    run_dir = tmp_path / "run"
+    with obs.run_context(run_dir=str(run_dir)):
+        run_tiled_grid(
+            np.linspace(0.5, 1.0, 4), np.linspace(0.05, 0.5, 4),
+            make_model_params(), config=_TINY, tile_shape="auto",
+        )
+    block = json.loads((run_dir / "manifest.json").read_text())["memory"]
+    assert block["plan"]["verdict"] == "ok"
+    (pf,) = block["preflight"]
+    assert pf["verdict"] == "ok"
+    assert pf["source"] == "planner-model"
+
+
+def test_tiled_sweep_preflight_fails_closed_with_synthetic_capacity(monkeypatch):
+    """With a (mocked) tiny device capacity, run_tiled_grid must refuse the
+    dispatch BEFORE any device work — the anti-XLA-OOM contract."""
+    from sbr_tpu.utils import checkpoint
+
+    monkeypatch.setattr(mem, "device_capacity", lambda stats=None: 4096)
+    with pytest.raises(mem.MemoryPreflightError):
+        checkpoint.run_tiled_grid(
+            np.linspace(0.5, 1.0, 4),
+            np.linspace(0.05, 0.5, 4),
+            make_model_params(),
+            config=_TINY,
+            tile_shape=(4, 4),
+        )
+
+
+# -- capacity planner --------------------------------------------------------
+
+
+def test_fit_linear_model_two_points():
+    fixed, per_cell = mem.fit_linear_model([(64, 10_000 + 64 * 100), (256, 10_000 + 256 * 100)])
+    assert per_cell == pytest.approx(100.0)
+    assert fixed == pytest.approx(10_000.0)
+
+
+def test_planner_determinism_same_capacity_same_shape():
+    model = (10_000.0, 400.0)
+    shapes = {
+        mem.plan_tile_shape(5000, 5000, model, capacity=16 * 2**30)[0] for _ in range(5)
+    }
+    assert len(shapes) == 1  # same capacity ⇒ same tile shape, every time
+
+
+def test_planner_picks_largest_power_of_two_within_budget():
+    model = (0.0, 1024.0)  # 1 KiB per cell
+    # budget = 0.8 * 128 MiB = 102.4 MiB → 256² cells = 64 MiB fits,
+    # 512² = 256 MiB does not.
+    (tb, tu), rec = mem.plan_tile_shape(5000, 5000, model, capacity=128 * 2**20)
+    assert (tb, tu) == (256, 256)
+    assert rec["verdict"] == "ok"
+    assert rec["modeled_bytes"] <= rec["budget_bytes"]
+    # More capacity ⇒ a no-smaller tile (monotone in capacity).
+    (tb2, _), _ = mem.plan_tile_shape(5000, 5000, model, capacity=512 * 2**20)
+    assert tb2 >= tb
+
+
+def test_planner_no_capacity_falls_back():
+    shape, rec = mem.plan_tile_shape(5000, 5000, (0.0, 0.0), capacity=None)
+    assert shape == (256, 256)
+    assert rec["verdict"] == "skipped" and rec["reason"] == "no-capacity"
+    # Small grids clamp the fallback to the covering power of two.
+    shape_small, _ = mem.plan_tile_shape(100, 100, (0.0, 0.0), capacity=None)
+    assert shape_small == (128, 128)
+
+
+def test_planner_raises_when_nothing_fits():
+    with pytest.raises(mem.MemoryPreflightError, match="no power-of-two tile"):
+        mem.plan_tile_shape(100, 100, (10**12, 10**9), capacity=2**20)
+
+
+def test_planner_respects_mesh_divisibility():
+    model = (0.0, 1024.0)
+    (tb, tu), _ = mem.plan_tile_shape(
+        5000, 5000, model, capacity=128 * 2**20, multiple_of=(4, 4)
+    )
+    assert tb % 4 == 0 and tu % 4 == 0
+
+
+def test_planner_per_device_divisor_scales_sharded_tiles():
+    """A tile sharded over N devices puts ~1/N of its cells on each: the
+    planner must budget per device, not undersize by the device count."""
+    model = (0.0, 1024.0)
+    (t1, _), _ = mem.plan_tile_shape(5000, 5000, model, capacity=128 * 2**20)
+    (t4, _), rec = mem.plan_tile_shape(
+        5000, 5000, model, capacity=128 * 2**20, per_device_divisor=4
+    )
+    assert t1 == 256 and t4 == 512  # 4× the cells fit when split over 4 devices
+    assert rec["per_device_divisor"] == 4
+
+
+def test_auto_tile_shape_records_plan_and_preflight_in_manifest(tmp_path):
+    """Acceptance: a sweep launched with tile_shape="auto" records its
+    planned shape + preflight verdict in manifest.json (CPU: both land as
+    graceful skips with the fallback shape)."""
+    from sbr_tpu.utils.checkpoint import run_tiled_grid
+
+    run_dir = tmp_path / "run"
+    with obs.run_context(run_dir=str(run_dir)):
+        grid = run_tiled_grid(
+            np.linspace(0.5, 1.0, 4),
+            np.linspace(0.05, 0.5, 4),
+            make_model_params(),
+            config=_TINY,
+            tile_shape="auto",
+        )
+    assert grid.xi.shape == (4, 4)
+    block = json.loads((run_dir / "manifest.json").read_text())["memory"]
+    assert block["plan"]["requested"] == "auto"
+    assert tuple(block["plan"]["tile_shape"]) == (4, 4)  # pow2 cover of the grid
+    assert block["plan"]["verdict"] == "skipped"  # no capacity on CPU
+    assert block["preflight"][0]["verdict"] == "skipped"
+    assert block["tiles"]  # per-tile peaks attributed
+
+
+def test_resolve_tile_shape_passthrough_and_determinism():
+    from sbr_tpu.utils.checkpoint import resolve_tile_shape
+
+    shape, rec = resolve_tile_shape(100, 100, (32, 16), _TINY, None)
+    assert shape == (32, 16) and rec is None
+    a, _ = resolve_tile_shape(100, 100, "auto", _TINY, None)
+    b, _ = resolve_tile_shape(100, 100, "auto", _TINY, None)
+    assert a == b  # deterministic — multihost peers must agree
+
+
+# -- bench history: schema 1 → 2 back-compat ---------------------------------
+
+
+def test_history_schema2_appends_and_reads_schema1(tmp_path):
+    from sbr_tpu.obs import history
+
+    path = tmp_path / "hist.jsonl"
+    # A committed schema-1 line (pre-memory) and a legacy schema-less line.
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"schema": 1, "ts": "2026-01-01T00:00:00", "label": "bench",
+                             "platform": "tpu", "metrics": {"eq_per_sec": 1000.0}}) + "\n")
+        fh.write(json.dumps({"ts": "2026-01-02T00:00:00", "label": "bench",
+                             "platform": "tpu", "metrics": {"eq_per_sec": 1010.0}}) + "\n")
+    history.append({"eq_per_sec": 990.0, "mem_peak_bytes": 2**30},
+                   platform="tpu", path=path)
+    records = history.load(path)
+    assert [r["schema"] for r in records] == [1, 1, 2]
+    # The schema-2 record gates against the schema-1 baseline (same metric).
+    verdicts, status = history.check(records, tolerance=0.15, min_points=3)
+    assert status == "ok"
+    assert verdicts["eq_per_sec"]["n"] == 3
+    # The new memory metric is present but still short — never a false gate.
+    assert verdicts["mem_peak_bytes"]["status"] == "short"
+
+
+def test_bench_metrics_schema2_memory_keys():
+    from sbr_tpu.obs import history
+
+    result = {
+        "metric": "eq_per_sec",
+        "value": 5.0,
+        "extra": {
+            "grid_mem_peak_bytes": 123456,
+            "agents_mem_peak_bytes": 0,  # zero = no allocator stats: dropped
+            "obs": {"memory_peak_bytes": 777},
+        },
+    }
+    m = history.bench_metrics(result)
+    assert m["grid_mem_peak_bytes"] == 123456
+    assert m["mem_peak_bytes"] == 777
+    assert "agents_mem_peak_bytes" not in m
+    assert history.polarity("grid_mem_peak_bytes") == -1  # lower is better
+
+
+# -- report memory -----------------------------------------------------------
+
+
+def _write_run(tmp_path, manifest_memory=None, events=()):
+    run_dir = tmp_path / "synth_run"
+    run_dir.mkdir()
+    manifest = {"schema": "sbr-obs/1", "label": "t", "status": "complete"}
+    if manifest_memory is not None:
+        manifest["memory"] = manifest_memory
+    (run_dir / "manifest.json").write_text(json.dumps(manifest))
+    with open(run_dir / "events.jsonl", "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return run_dir
+
+
+def test_report_memory_exit_0_within_budget(tmp_path, capsys):
+    run_dir = _write_run(
+        tmp_path,
+        manifest_memory={
+            "peak_live_buffer_bytes": 100,
+            "peak_device_bytes": 1000,
+            "peak_span": "sweeps.beta_u_grid",
+            "capacity_bytes": 10_000,
+            "headroom": 0.8,
+            "tiles": {"tile_b00000_u00000": 1000},
+        },
+    )
+    code = report.main(["memory", str(run_dir)])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert "tile_b00000_u00000" in out and "OVER" not in out
+
+
+def test_report_memory_exit_1_on_tile_over_threshold(tmp_path, capsys):
+    run_dir = _write_run(
+        tmp_path,
+        manifest_memory={
+            "peak_device_bytes": 9_500,
+            "capacity_bytes": 10_000,
+            "headroom": 0.8,
+            "tiles": {"tile_b00000_u00000": 9_500, "tile_b00000_u00004": 100},
+        },
+    )
+    code = report.main(["memory", str(run_dir)])
+    out = capsys.readouterr().out
+    assert code == 1
+    assert "OVER THRESHOLD" in out
+    # A looser --headroom clears the flag: the threshold is configurable.
+    assert report.main(["memory", str(run_dir), "--headroom", "0.99"]) == 0
+
+
+def test_report_memory_exit_1_from_events_only(tmp_path):
+    """The event log is authoritative when the manifest roll-up never
+    landed (kill -9 mid-run)."""
+    run_dir = _write_run(
+        tmp_path,
+        events=[
+            {"mono": 0.1, "ts": 1.0, "kind": "mem", "where": "tile",
+             "tile": "tile_b00000_u00000", "peak_bytes_in_use": 9_900,
+             "bytes_limit": 10_000},
+        ],
+    )
+    doc, code = report.memory_doc(report.load_run(run_dir))
+    assert code == 1
+    assert doc["over_tiles"] == ["tile_b00000_u00000"]
+
+
+def test_report_memory_exit_3_on_missing_data(tmp_path, capsys):
+    run_dir = _write_run(tmp_path)
+    assert report.main(["memory", str(run_dir)]) == 3
+    assert "no memory data" in capsys.readouterr().out
+    run_dir2 = tmp_path / "synth_run"
+    (run_dir2 / "manifest.json").unlink()
+    assert report.main(["memory", str(run_dir2)]) == 2  # not a run dir
+
+
+def test_report_memory_json_contract(tmp_path, capsys):
+    run_dir = _write_run(
+        tmp_path,
+        manifest_memory={
+            "peak_device_bytes": 500,
+            "capacity_bytes": 10_000,
+            "headroom": 0.8,
+            "tiles": {"tile_b00000_u00000": 500},
+            "plan": {"requested": "auto", "tile_shape": [256, 256], "verdict": "ok"},
+            "preflight": [{"label": "tile[256x256]", "verdict": "ok"}],
+        },
+    )
+    code = report.main(["memory", str(run_dir), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert code == 0 and doc["exit"] == 0
+    assert doc["memory"]["plan"]["verdict"] == "ok"
+    assert doc["tiles"] == {"tile_b00000_u00000": 500}
+    assert doc["threshold_bytes"] == 8_000
+
+
+def test_report_memory_exit_1_on_preflight_exceeds(tmp_path):
+    run_dir = _write_run(
+        tmp_path,
+        manifest_memory={
+            "peak_device_bytes": 1,
+            "preflight": [{"label": "tile[512x512]", "verdict": "exceeds"}],
+        },
+    )
+    assert report.main(["memory", str(run_dir)]) == 1
+
+
+# -- report gc: checkpoint debris (satellite) --------------------------------
+
+
+def test_gc_debris_prunes_quarantine_and_stale_leases(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    (ckpt / "quarantine").mkdir(parents=True)
+    (ckpt / "quarantine" / "tile_b00000_u00000.npz").write_bytes(b"corrupt")
+    # Completed steal: tile exists → lease is scaffolding.
+    (ckpt / "tile_b00000_u00000.npz").write_bytes(b"x")
+    (ckpt / "tile_b00000_u00000.lease").write_text(
+        json.dumps({"pid": 1, "ts": time.time(), "ttl_s": 900})
+    )
+    # Expired lease (dead holder), torn lease, and a LIVE lease.
+    (ckpt / "tile_b00000_u00004.lease").write_text(
+        json.dumps({"pid": 2, "ts": time.time() - 10_000, "ttl_s": 900})
+    )
+    (ckpt / "tile_b00004_u00000.lease").write_text("{torn")
+    live = ckpt / "tile_b00004_u00004.lease"
+    live.write_text(json.dumps({"pid": 3, "ts": time.time(), "ttl_s": 900}))
+    # A stealer that died between writing its takeover temp file and the
+    # os.replace (parallel.distributed._try_lease) — always debris.
+    (ckpt / "tile_b00008_u00000.lease.4242.tmp").write_text("{half")
+
+    removed = mem.gc_debris(tmp_path)
+    names = {p.name for p in removed}
+    assert "quarantine" in names
+    assert "tile_b00000_u00000.lease" in names
+    assert "tile_b00000_u00004.lease" in names
+    assert "tile_b00004_u00000.lease" in names
+    assert "tile_b00008_u00000.lease.4242.tmp" in names
+    assert live.exists(), "a live lease must never be yanked from its holder"
+    assert not (ckpt / "quarantine").exists()
+    assert (ckpt / "tile_b00000_u00000.npz").exists()  # results are never touched
+
+
+def test_report_gc_cli_sweeps_debris(tmp_path, capsys):
+    root = tmp_path / "obs_root"
+    root.mkdir()
+    ckpt = tmp_path / "ckpt"
+    (ckpt / "quarantine").mkdir(parents=True)
+    (ckpt / "tile_b00000_u00000.lease").write_text("{torn")
+    code = report.main(
+        ["gc", str(root), "--keep", "4", "--checkpoints", str(ckpt)]
+    )
+    out = capsys.readouterr().out
+    assert code == 0
+    assert "2 checkpoint-debris path(s)" in out
+    assert not (ckpt / "quarantine").exists()
+    assert not (ckpt / "tile_b00000_u00000.lease").exists()
