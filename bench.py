@@ -83,6 +83,14 @@ alongside throughput. The O(live arrays) live-buffer sum is disabled
 (`mem.live_disabled`, env `SBR_OBS_MEM_LIVE`) inside the timing loops on
 top of the existing `obs.suspended()` envelope.
 
+Serving observatory (ISSUE 7): a third workload drives the seeded loadgen
+mix through an in-process `sbr_tpu.serve.Engine` (warmup over the
+parameter pool, then the measured repeated mix) and reports
+`extra.serve_p50_ms` / `extra.serve_p99_ms` / `extra.serve_cache_hit_rate`
+(+ qps), appended to the perf history as schema 3 so `report trend
+--check` catches serving-latency regressions; schema-1/2 lines still load
+and gate.
+
 Resilience (PR 4): the probe ladder's attempts/backoff now come from the
 unified retry engine (`sbr_tpu.resilience.retry`, loaded standalone by
 file path so the parent stays jax-free) — SBR_BENCH_PROBE_ATTEMPTS /
@@ -928,6 +936,72 @@ def bench_agents(platform: str) -> dict:
     }
 
 
+def bench_serve(platform: str) -> dict:
+    """Serving latency/cache workload (ISSUE 7): drive the seeded loadgen
+    mix through an in-process `sbr_tpu.serve.Engine` — warmup pass over the
+    parameter pool (compiles the bucket executables, fills the result
+    cache), then the measured repeated-mix phase. Headline numbers are the
+    measured-phase latency quantiles from the live log-bucket histogram and
+    the cache hit rate; `report trend` gates them as schema-3 history
+    metrics (serve_p50_ms / serve_p99_ms lower-better,
+    serve_cache_hit_rate higher-better)."""
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+    from sbr_tpu.serve.loadgen import build_pool, query_mix
+
+    if _tiny():
+        pool_n, n_queries, n_grid = 6, 48, 96
+    elif platform == "cpu":
+        pool_n, n_queries, n_grid = 32, 512, 512
+    else:
+        pool_n, n_queries, n_grid = 64, 2048, 1024
+    config = SolverConfig(n_grid=n_grid, bisect_iters=60, refine_crossings=False)
+    pool = build_pool(0, pool_n)
+    mix = query_mix(0, pool_n, n_queries)
+
+    engine = Engine(config=config, serve=ServeConfig(buckets=(1, 8, 64)))
+    engine.start()
+    try:
+        t0 = time.perf_counter()
+        for i in range(0, len(pool), 16):
+            engine.query_many(pool[i : i + 16], scenario="warmup")
+        warmup_s = time.perf_counter() - t0
+        warm = engine.live.snapshot()
+        # Measured-phase latency histogram = lifetime histogram delta across
+        # the phase (LogHistogram.delta): the 60 s rolling window would fold
+        # the warmup's compile-heavy latencies into the quantiles.
+        hist_before = engine.live.total_hist.copy()
+
+        t0 = time.perf_counter()
+        for i in range(0, len(mix), 16):
+            engine.query_many([pool[j] for j in mix[i : i + 16]], scenario="mix")
+        measured_s = time.perf_counter() - t0
+
+        snap = engine.live.snapshot()
+        diff = engine.live.total_hist.delta(hist_before)
+        totals, wt = snap["totals"], warm["totals"]
+        measured_q = totals["queries"] - wt["queries"]
+        measured_hits = totals["cache_hits"] - wt["cache_hits"]
+    finally:
+        engine.close()
+    p50, p99 = diff.quantile(0.5), diff.quantile(0.99)
+    hit_rate = measured_hits / measured_q if measured_q else 0.0
+    _log(
+        f"serve: {measured_q} queries in {measured_s:.3f}s "
+        f"(warmup {len(pool)} in {warmup_s:.1f}s); p50 {p50} ms, "
+        f"p99 {p99} ms, cache hit rate {hit_rate:.2f}"
+    )
+    return {
+        "serve_queries": int(measured_q),
+        "serve_pool": pool_n,
+        "serve_p50_ms": p50,
+        "serve_p99_ms": p99,
+        "serve_cache_hit_rate": round(hit_rate, 4),
+        "serve_qps": round(measured_q / measured_s, 1) if measured_s else 0.0,
+        "serve_warmup_s": round(warmup_s, 3),
+    }
+
+
 def measure(platform: str) -> None:
     """Measurement child entry: the real body runs inside a
     graceful-shutdown envelope so a preemption (SIGTERM) mid-bench still
@@ -975,6 +1049,19 @@ def _measure_inner(platform: str) -> None:
             "bench_agents",
             **{k: round(v, 6) if isinstance(v, float) else v for k, v in agents.items()},
         )
+    try:
+        with obs.span("bench.serve"):
+            serve = bench_serve(platform)
+    except Exception as err:
+        # Same graceful degradation as the agents workload: the primary
+        # metric must land even when the serving workload fails.
+        _log(f"serve bench failed: {err!r}")
+        serve = None
+    if serve is not None:
+        obs.event(
+            "bench_serve",
+            **{k: round(v, 6) if isinstance(v, float) else v for k, v in serve.items()},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -1005,6 +1092,18 @@ def _measure_inner(platform: str) -> None:
         out["extra"]["agents_recount_steps"] = agents["recount_steps"]
         if agents.get("mem_peak_bytes"):
             out["extra"]["agents_mem_peak_bytes"] = int(agents["mem_peak_bytes"])
+    if serve is not None:
+        # Schema-3 history metrics: bench_metrics picks the serve_* keys up
+        # so `report trend` gates serving-latency regressions.
+        for k in (
+            "serve_p50_ms",
+            "serve_p99_ms",
+            "serve_cache_hit_rate",
+            "serve_qps",
+            "serve_queries",
+        ):
+            if serve.get(k) is not None:
+                out["extra"][k] = serve[k]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

@@ -40,6 +40,12 @@ Subpackage map (reference component in parens):
                  engine, self-healing checkpoints (sidecars, quarantine,
                  degrade ladder), graceful shutdown, chaos smoke (new
                  capability).
+- ``serve``    — long-lived equilibrium query engine: micro-batched
+                 queries padded into the vmapped solver, LRU + on-disk
+                 result cache keyed by canonical params fingerprints,
+                 serialized AOT executables reloaded across restarts, and
+                 live windowed telemetry (`/metrics`, `/healthz`,
+                 rolling ``live.json``) (new capability).
 - ``parallel`` — mesh construction, sharding specs, collective helpers.
 - ``figures``  — matplotlib parity layer for the 13 reference figures
                  (``src/baseline/plotting.jl``, script-inline figures).

@@ -32,9 +32,12 @@ per-step ABM counters are the design references from PAPERS.md):
   gates on numerical health, `resilience` renders/gates the fault/retry/
   repair story (`sbr_tpu.resilience`), `trend` renders/gates the perf
   history, `memory` renders/gates per-span/per-tile peak-memory
-  attribution, `gc` prunes old run directories plus checkpoint debris
+  attribution, `serve` renders/gates a serving run's rolling live
+  telemetry (``live.json`` from `sbr_tpu.serve`; SLO breach = exit 1),
+  `gc` prunes old run directories plus checkpoint debris
   (``quarantine/``, stale ``tile_*.lease``). Every subcommand takes
-  ``--json``.
+  ``--json``. Reports tolerate torn ``events.jsonl`` lines (counted and
+  surfaced as ``bad_event_lines``).
 
 Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
 land under ``SBR_OBS_DIR``, default ``obs_runs/``), or programmatically::

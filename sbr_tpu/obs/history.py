@@ -39,11 +39,13 @@ import time
 from pathlib import Path
 from typing import Optional
 
-# Record schema: 2 adds memory metrics (mem_peak_bytes and the per-workload
-# grid/agents peaks from the bench child — ISSUE 5). Readers accept 1 AND 2:
-# the key set only grew, and `load` stamps schema-less legacy lines as 1, so
-# a committed schema-1 history keeps gating new schema-2 appends.
-SCHEMA = 2
+# Record schema: 2 added memory metrics (mem_peak_bytes and the per-workload
+# grid/agents peaks from the bench child — ISSUE 5); 3 adds the serving
+# workload's latency/cache metrics (serve_p50_ms / serve_p99_ms /
+# serve_cache_hit_rate — ISSUE 7). Readers accept every version: the key set
+# only grows, and `load` stamps schema-less legacy lines as 1, so a committed
+# schema-1/2 history keeps gating new schema-3 appends.
+SCHEMA = 3
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -102,8 +104,9 @@ def load(path=None) -> list:
         except json.JSONDecodeError:
             continue
         if isinstance(rec, dict) and isinstance(rec.get("metrics"), dict):
-            # Schema-less lines predate versioning (= schema 1); schema 2
-            # is a pure superset, so every known version loads uniformly.
+            # Schema-less lines predate versioning (= schema 1); schemas 2
+            # and 3 are pure supersets, so every known version loads
+            # uniformly and older lines keep gating newer appends.
             rec.setdefault("schema", 1)
             records.append(rec)
     return records
@@ -129,6 +132,11 @@ def bench_metrics(result: dict) -> dict:
         # without memory_stats — the gate simply has no memory series there)
         "grid_mem_peak_bytes",
         "agents_mem_peak_bytes",
+        # schema 3: the serving workload (bench.py bench_serve / loadgen):
+        # latency quantiles are lower-better (_ms polarity), hit rate higher
+        "serve_p50_ms",
+        "serve_p99_ms",
+        "serve_cache_hit_rate",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
@@ -161,12 +169,19 @@ def bench_metrics(result: dict) -> dict:
 
 
 def polarity(metric: str) -> int:
-    """+1 when higher is better (throughput), -1 when lower is better
-    (durations, byte counts, divergence counts)."""
+    """+1 when higher is better (throughput, cache hit rates), -1 when lower
+    is better (durations, latencies, byte counts, divergence counts)."""
     m = metric.lower()
-    if m.endswith("_per_sec") or "per_sec" in m or "throughput" in m:
+    if m.endswith("_per_sec") or "per_sec" in m or "throughput" in m or "hit_rate" in m:
         return 1
-    if m.endswith("_s") or m.endswith("_bytes") or "divergent" in m or "retrace" in m:
+    if (
+        m.endswith("_s")
+        or m.endswith("_ms")
+        or m.endswith("_bytes")
+        or "latency" in m
+        or "divergent" in m
+        or "retrace" in m
+    ):
         return -1
     return 1
 

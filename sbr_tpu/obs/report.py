@@ -22,6 +22,15 @@ Usage:
                                                     # 1 when a tile exceeds
                                                     # the headroom threshold,
                                                     # 3 on missing data
+    python -m sbr_tpu.obs.report serve RUN_DIR      # live serving telemetry
+                                                    # (rolling live.json of a
+                                                    # running or finished
+                                                    # sbr_tpu.serve engine);
+                                                    # exit 1 on SLO breach
+                                                    # (p99 over
+                                                    # SBR_SERVE_SLO_MS, cache
+                                                    # hit rate under floor),
+                                                    # 3 on missing data
     python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs +
                                                     # checkpoint debris
                                                     # (quarantine/, stale
@@ -49,28 +58,57 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
 
 def load_run(run_dir) -> dict:
-    """Load a run directory: manifest (required) + parsed events (optional)."""
+    """Load a run directory: manifest (required) + parsed events (optional).
+
+    Tolerates torn event lines (ISSUE 7 satellite): a process killed
+    mid-write leaves a truncated final line — possibly cut inside a UTF-8
+    multibyte sequence, so even ``read_text()`` can raise — or a line that
+    parses but is not an event object. Every such line is counted in
+    ``bad_event_lines`` (surfaced in report headers) instead of crashing
+    the report, and folding continues over the intact events.
+    """
     run_dir = Path(run_dir)
     manifest_path = run_dir / "manifest.json"
     if not manifest_path.exists():
         raise FileNotFoundError(f"{manifest_path} not found — not an obs run directory")
     manifest = json.loads(manifest_path.read_text())
     events = []
+    bad_lines = 0
     events_path = run_dir / "events.jsonl"
     if events_path.exists():
-        for line in events_path.read_text().splitlines():
+        # bytes + replace: a torn multibyte character must not take down
+        # the whole log (strict read_text raises UnicodeDecodeError).
+        text = events_path.read_bytes().decode("utf-8", errors="replace")
+        for line in text.splitlines():
             line = line.strip()
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                ev = json.loads(line)
             except json.JSONDecodeError:
-                events.append({"kind": "_unparseable", "raw": line[:120]})
-    return {"dir": str(run_dir), "manifest": manifest, "events": events}
+                bad_lines += 1
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+            else:
+                bad_lines += 1  # parseable but not an event object
+    return {
+        "dir": str(run_dir),
+        "manifest": manifest,
+        "events": events,
+        "bad_event_lines": bad_lines,
+    }
+
+
+def _bad_lines_note(run: dict) -> str:
+    """Header suffix surfacing tolerated torn event lines (empty if none)."""
+    n = run.get("bad_event_lines", 0)
+    return f"   ({n} unparseable event line(s) skipped — torn write?)" if n else ""
 
 
 def _fmt_s(v) -> str:
@@ -150,7 +188,7 @@ def render(run: dict) -> str:
         + (f"   peak span {mem['peak_span']}" if mem.get("peak_span") else "")
         + ("   (details: report memory RUN_DIR)" if mem.get("tiles") or mem.get("plan") else "")
     )
-    out.append(f"events   {m.get('n_events')}")
+    out.append(f"events   {m.get('n_events')}{_bad_lines_note(run)}")
 
     stages = m.get("stages") or {}
     if stages:
@@ -340,7 +378,7 @@ def render_health(run: dict) -> tuple:
     a CI gate silently)."""
     events = run["events"]
     stages = _health_by_stage(events)
-    out = [f"run      {run['dir']}"]
+    out = [f"run      {run['dir']}{_bad_lines_note(run)}"]
     if not stages:
         out.append("no health events recorded — was the run produced by an "
                     "instrumented solver/sweep with telemetry on?")
@@ -452,7 +490,7 @@ def render_resilience(run: dict) -> tuple:
     folded = _resilience_by_kind(run["events"])
     status = run["manifest"].get("status")
     unrecovered, code = _resilience_gate(folded)
-    out = [f"run      {run['dir']}"]
+    out = [f"run      {run['dir']}{_bad_lines_note(run)}"]
     out.append(f"status   {status}" + ("   (graceful preemption)" if status == "interrupted" else ""))
     if not any((folded["faults"], folded["retries"], folded["repairs"])):
         out.append("resilience  clean: no fault, retry, or repair events recorded")
@@ -507,6 +545,7 @@ def resilience_json(run: dict) -> tuple:
         "status": run["manifest"].get("status"),
         **folded,
         "unrecovered": unrecovered,
+        "bad_event_lines": run.get("bad_event_lines", 0),
         "exit": code,
     }, code
 
@@ -519,6 +558,7 @@ def render_json(run: dict) -> dict:
         "manifest": run["manifest"],
         "jit_by_name": _jit_by_name(run["events"]),
         "status_by_stage": _status_by_stage(run["events"]),
+        "bad_event_lines": run.get("bad_event_lines", 0),
     }
 
 
@@ -526,8 +566,9 @@ def health_json(run: dict) -> tuple:
     """Machine-readable equivalent of `render_health` (--json); returns
     (doc, exit_code) with the same exit-code contract."""
     stages = _health_by_stage(run["events"])
+    bad = run.get("bad_event_lines", 0)
     if not stages:
-        return {"dir": run["dir"], "stages": {}, "exit": 3}, 3
+        return {"dir": run["dir"], "stages": {}, "bad_event_lines": bad, "exit": 3}, 3
     total_divergent = sum(v["divergent"] for v in stages.values())
     code = 1 if total_divergent else 0
     return {
@@ -535,6 +576,7 @@ def health_json(run: dict) -> tuple:
         "stages": stages,
         "total_cells": sum(v["cells"] for v in stages.values()),
         "total_divergent": total_divergent,
+        "bad_event_lines": bad,
         "exit": code,
     }, code
 
@@ -675,6 +717,7 @@ def memory_doc(run: dict, headroom_override=None) -> tuple:
         "over_tiles": over,
         "preflight": preflight,
         "plan": plan,
+        "bad_event_lines": run.get("bad_event_lines", 0),
         "exit": code,
     }
     return doc, code
@@ -685,7 +728,7 @@ def render_memory(run: dict, headroom_override=None) -> tuple:
     `memory_doc`."""
     doc, code = memory_doc(run, headroom_override)
     m = doc["memory"]
-    out = [f"run      {run['dir']}"]
+    out = [f"run      {run['dir']}{_bad_lines_note(run)}"]
     if code == 3:
         out.append(
             "no memory data recorded — was the run produced by an "
@@ -785,6 +828,205 @@ def render_memory(run: dict, headroom_override=None) -> tuple:
             )
         )
     return "\n".join(out), code
+
+
+# ---------------------------------------------------------------------------
+# Serve report (`serve` subcommand — the live serving-telemetry renderer/gate)
+# ---------------------------------------------------------------------------
+
+
+def _env_float(name: str, default) -> float:
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else default
+
+
+def serve_doc(run_dir, slo_ms=None, cache_floor=None, warmup=None) -> tuple:
+    """Machine-readable serve report from a run dir's rolling ``live.json``
+    (written by `sbr_tpu.serve.engine` — atomic rename, so a RUNNING server
+    can be read mid-flight); returns (doc, exit_code).
+
+    Exit codes: 0 within SLO, 1 on a breach — window p99 over
+    ``SBR_SERVE_SLO_MS`` (when set), or cache hit rate under the floor
+    (``SBR_SERVE_CACHE_FLOOR``, default 0 = disabled) after warmup
+    (``SBR_SERVE_WARMUP`` lifetime queries, default 50) — 2 when
+    ``run_dir`` does not exist, 3 when no live serving data was recorded
+    (a serve gate with nothing to read must not pass silently).
+    """
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    live_path = run_dir / "live.json"
+    try:
+        live = json.loads(live_path.read_text())
+    except (OSError, json.JSONDecodeError) as err:
+        return {
+            "dir": str(run_dir),
+            "error": f"no readable live.json ({err})",
+            "exit": 3,
+        }, 3
+
+    slo_ms = _env_float("SBR_SERVE_SLO_MS", None) if slo_ms is None else slo_ms
+    cache_floor = (
+        _env_float("SBR_SERVE_CACHE_FLOOR", 0.0) if cache_floor is None else cache_floor
+    )
+    warmup = int(_env_float("SBR_SERVE_WARMUP", 50)) if warmup is None else int(warmup)
+
+    totals = live.get("totals") or {}
+    window = live.get("window") or {}
+    # The rolling window is the live view; when it has drained (a finished
+    # server read post-hoc after >window_s), fall back to lifetime numbers.
+    in_window = bool(window.get("queries"))
+    scope = window if in_window else totals
+    scope_name = "window" if in_window else "lifetime"
+    p99 = (scope.get("latency_ms") or {}).get("p99")
+    hit_rate = scope.get("hit_rate")
+    scope_queries = scope.get("queries", 0)
+
+    breaches = []
+    if slo_ms is not None and p99 is not None and p99 > slo_ms:
+        breaches.append(f"p99 {p99:.3f} ms over SLO {slo_ms:g} ms ({scope_name})")
+    # The rate and the arming count come from the SAME scope: a quiet
+    # window holding two fresh queries on a long-warm server must not read
+    # as a cold cache (the lifetime count would arm the gate while the
+    # window rate tanks on two samples).
+    if cache_floor > 0 and scope_queries >= warmup and (hit_rate or 0.0) < cache_floor:
+        breaches.append(
+            f"cache hit rate {0.0 if hit_rate is None else hit_rate:.3f} "
+            f"under floor {cache_floor:g} after warmup "
+            f"({int(scope_queries)} {scope_name} queries)"
+        )
+    code = 1 if breaches else 0
+    doc = {
+        "dir": str(run_dir),
+        "live": live,
+        "scope": "window" if in_window else "lifetime",
+        "slo_ms": slo_ms,
+        "cache_floor": cache_floor,
+        "warmup": warmup,
+        "p99_ms": p99,
+        "hit_rate": hit_rate,
+        "breaches": breaches,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_serve(doc: dict) -> str:
+    """Human-readable serve report; same exit contract as `serve_doc`."""
+    live = doc.get("live") or {}
+    out = [f"run      {doc['dir']}"]
+    if doc["exit"] in (2, 3):
+        out.append(doc.get("error", "no serving data"))
+        if doc["exit"] == 3:
+            out.append(
+                "was the run produced by sbr_tpu.serve (the engine writes a "
+                "rolling live.json)?"
+            )
+        return "\n".join(out)
+    out.append(
+        f"serving  started {live.get('started_at')}   uptime "
+        f"{_fmt_s(live.get('uptime_s'))}   snapshot age "
+        f"{_fmt_s(max(0.0, time.time() - live.get('ts', 0)))}"
+    )
+    healthz = live.get("healthz") or {}
+    out.append(
+        f"health   {healthz.get('status', '?')}"
+        + (f"   ({'; '.join(healthz.get('reasons', []))})" if healthz.get("reasons") else "")
+    )
+    engine = live.get("engine") or {}
+    if engine:
+        out.append(
+            f"engine   buckets {engine.get('buckets')}   dtype {engine.get('dtype')}   "
+            f"execs {engine.get('compiled', 0)} compiled / {engine.get('loaded', 0)} reloaded   "
+            f"lru {engine.get('lru_entries', 0)}/{engine.get('lru_max', '?')}"
+        )
+    rows = []
+    for label, scope in (("window", live.get("window") or {}), ("lifetime", live.get("totals") or {})):
+        lat = scope.get("latency_ms") or {}
+        rows.append(
+            [
+                label,
+                int(scope.get("queries", 0)),
+                "-" if scope.get("hit_rate") is None else f"{scope['hit_rate']:.1%}",
+                "-" if scope.get("occupancy") is None else f"{scope['occupancy']:.1%}",
+                int(scope.get("divergent_cells", 0)),
+                *(
+                    "-" if lat.get(q) is None else f"{lat[q]:.2f}"
+                    for q in ("p50", "p95", "p99")
+                ),
+            ]
+        )
+    out += ["", "TRAFFIC"]
+    out.append(
+        _table(
+            ["scope", "queries", "hit rate", "occupancy", "divergent",
+             "p50 ms", "p95 ms", "p99 ms"],
+            rows,
+        )
+    )
+    compile_blk = live.get("compile") or {}
+    out.append(
+        f"\ncompiles {int(compile_blk.get('compiles', 0))} XLA backend compile(s), "
+        f"traces " + (
+            ", ".join(f"{k}={v}" for k, v in (compile_blk.get("traces") or {}).items())
+            or "-"
+        )
+    )
+    hist = ((live.get("window") or {}).get("latency_hist_ms")) or {}
+    bounds, counts = hist.get("bounds") or [], hist.get("counts") or []
+    if bounds and counts and sum(counts):
+        buckets = {}
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            label = f"<={bounds[i]:g}ms" if i < len(bounds) else f">{bounds[-1]:g}ms"
+            buckets[label] = c
+        out += ["", "WINDOW LATENCY HISTOGRAM"]
+        out += _ascii_hist(buckets)
+    gate_bits = []
+    if doc.get("slo_ms") is not None:
+        gate_bits.append(f"SLO p99 <= {doc['slo_ms']:g} ms")
+    if doc.get("cache_floor"):
+        gate_bits.append(f"hit rate >= {doc['cache_floor']:g} after {doc['warmup']} queries")
+    out.append("")
+    if doc["breaches"]:
+        out.append("GATE: SLO BREACH")
+        for b in doc["breaches"]:
+            out.append(f"  {b}")
+    else:
+        out.append(
+            "GATE: ok" + (f" ({'; '.join(gate_bits)})" if gate_bits else " (no SLO configured)")
+        )
+    return "\n".join(out)
+
+
+def _main_serve(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report serve",
+        description="Render a serving run's rolling live telemetry "
+        "(live.json); exit 1 on SLO breach (p99 over SBR_SERVE_SLO_MS or "
+        "cache hit rate under the floor after warmup), 3 when no live "
+        "serving data was recorded",
+    )
+    parser.add_argument("run_dir", help="run directory (contains live.json)")
+    parser.add_argument("--slo-ms", type=float, default=None, dest="slo_ms",
+                        help="p99 latency SLO in ms (default: $SBR_SERVE_SLO_MS)")
+    parser.add_argument("--cache-floor", type=float, default=None, dest="cache_floor",
+                        help="minimum cache hit rate after warmup "
+                        "(default: $SBR_SERVE_CACHE_FLOOR, else 0 = disabled)")
+    parser.add_argument("--warmup", type=int, default=None,
+                        help="lifetime queries before the cache floor applies "
+                        "(default: $SBR_SERVE_WARMUP, else 50)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = serve_doc(args.run_dir, args.slo_ms, args.cache_floor, args.warmup)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_serve(doc))
+    return code
 
 
 def _main_memory(argv) -> int:
@@ -915,6 +1157,8 @@ def main(argv=None) -> int:
         return _main_resilience(argv[1:])
     if argv and argv[0] == "memory":
         return _main_memory(argv[1:])
+    if argv and argv[0] == "serve":
+        return _main_serve(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -926,7 +1170,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
-        "'health' / 'resilience' / 'memory' / 'trend' / 'gc' subcommands",
+        "'health' / 'resilience' / 'memory' / 'serve' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -588,6 +588,18 @@ class RunContext:
         tmp.write_text(json.dumps(manifest, indent=1, default=_json_default) + "\n")
         os.replace(tmp, self.run_dir / "manifest.json")
 
+    def live_snapshot(self, doc: dict, name: str = "live.json") -> Path:
+        """Atomically rewrite a rolling snapshot file inside the run dir
+        (tmp + ``os.replace``, the manifest discipline) — how a LONG-LIVED
+        process (the serving engine's ``live.json``) exposes queryable
+        state to `report` while still running. Readers always see a
+        complete document; writers may call this at any cadence."""
+        tmp = self.run_dir / (name + ".tmp")
+        tmp.write_text(json.dumps(doc, default=_json_default) + "\n")
+        dest = self.run_dir / name
+        os.replace(tmp, dest)
+        return dest
+
     def log_health(self, stage: str, summary: dict) -> None:
         """Emit one ``health`` event and fold it into the per-stage manifest
         roll-up (sum cells/divergent, max residual, summed flag counts)."""

@@ -1,0 +1,27 @@
+"""Equilibrium serving layer: a long-lived query engine with live,
+queryable-while-alive telemetry (ISSUE 7; the ROADMAP's "bank-run weather
+service" serving item).
+
+- ``serve.engine``   — `Engine`: micro-batched (`ModelParams`, scenario)
+  queries padded to a fixed bucket ladder into one vmapped dispatch of
+  the sweeps' `solve_param_cell`; LRU + on-disk result cache keyed by
+  `utils.checkpoint.params_fingerprint`; serialized AOT executables
+  reloaded across restarts.
+- ``serve.live``     — `LiveMetrics`: lock-free windowed aggregation
+  (log-bucket latency histograms with derivable p50/p95/p99, cache and
+  batch-occupancy counters, per-window divergent cells) snapshotted to a
+  rolling ``live.json`` in the run dir.
+- ``serve.endpoint`` — `ServeEndpoint`: stdlib HTTP ``/metrics``
+  (Prometheus), ``/healthz`` (ready/degraded/unhealthy), ``/statz``.
+- ``serve.loadgen``  — ``python -m sbr_tpu.serve.loadgen``: seeded
+  deterministic query mix for CI and bench.
+
+Gate a (running or finished) server with
+``python -m sbr_tpu.obs.report serve RUN_DIR [--json]``.
+"""
+
+from sbr_tpu.serve.endpoint import ServeEndpoint
+from sbr_tpu.serve.engine import Engine, QueryResult, ServeConfig
+from sbr_tpu.serve.live import LiveMetrics
+
+__all__ = ["Engine", "LiveMetrics", "QueryResult", "ServeConfig", "ServeEndpoint"]

@@ -1,0 +1,110 @@
+"""HTTP exposition for the serving engine (ISSUE 7 tentpole, part 3).
+
+A stdlib-only (``http.server``) thread serving three read-only routes off
+an `Engine`:
+
+- ``/metrics``  — Prometheus text exposition 0.0.4: lifetime counters,
+  rolling-window gauges (p50/p95/p99, hit rate, occupancy, divergent
+  cells), the cumulative log-bucket latency histogram, and the XLA
+  compile/trace counters (the acceptance gate scrapes THESE to prove zero
+  post-warmup compiles — counters, not logs).
+- ``/healthz``  — JSON ready/degraded/unhealthy with reasons, wired to the
+  resilience retry budget and the per-window `Health` divergence state
+  (`Engine.healthz`). HTTP 200 for ready/degraded, 503 for unhealthy, so
+  a dumb load-balancer probe needs no JSON parsing.
+- ``/statz``    — the full JSON live snapshot (same document as the
+  rolling ``live.json``).
+
+No jax import, no engine mutation: handlers only read. ``port=0`` binds
+an ephemeral port (tests, parallel CI); the bound port is `.port`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class ServeEndpoint:
+    """Expose ``engine`` over HTTP on a daemon thread."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.engine = engine
+        endpoint = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route access logs off stdout
+                print(f"[serve.endpoint] {fmt % args}", file=sys.stderr)
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            endpoint.engine.prometheus().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        doc = endpoint.engine.healthz()
+                        code = 503 if doc.get("status") == "unhealthy" else 200
+                        self._send(code, json.dumps(doc).encode(), "application/json")
+                    elif path == "/statz":
+                        self._send(
+                            200,
+                            json.dumps(endpoint.engine.statz(), default=str).encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b'{"error": "not found"}', "application/json")
+                except BrokenPipeError:
+                    pass  # client went away mid-write; nothing to salvage
+                except Exception as err:  # exposition must never kill serving
+                    try:
+                        self._send(
+                            500, json.dumps({"error": repr(err)}).encode(),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self.httpd.server_address[1])
+        self._started = False
+        self._thread: threading.Thread = threading.Thread(
+            target=self.httpd.serve_forever, name="sbr-serve-http", daemon=True
+        )
+
+    def start(self) -> "ServeEndpoint":
+        self._started = True
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        try:
+            if self._started:
+                # shutdown() handshakes with a RUNNING serve_forever loop;
+                # calling it on a never-started server deadlocks forever
+                # (socketserver's own documented trap) — so only the bound
+                # socket is released on that path.
+                self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "ServeEndpoint":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

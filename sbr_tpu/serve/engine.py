@@ -1,0 +1,810 @@
+"""Long-lived equilibrium query engine (ISSUE 7 tentpole, part 1).
+
+The paper's pipeline solves one equilibrium per invocation; the ROADMAP's
+north star is a "bank-run weather service" answering equilibrium queries
+for millions of users. This engine is that serving layer, with
+observability as its load-bearing spine (`serve.live`, `serve.endpoint`):
+
+- **Queries** are (`ModelParams`, scenario tag) pairs. `query` /
+  `query_many` are synchronous; `submit` returns a ticket. A background
+  micro-batcher thread drains the queue and batches concurrent queries
+  into ONE vmapped dispatch of the same `solve_param_cell` the β×u grid
+  sweeps run — a served query and a sweep cell can never drift.
+- **Pad-to-bucket batching**: batch shapes are padded up to a fixed bucket
+  ladder (``SBR_SERVE_BUCKETS``, default 1,8,64,512) so the engine
+  compiles at most one executable per bucket and NEVER retraces on
+  arbitrary batch sizes (`prof.note_trace("serve.batch")` counts the
+  traces; `/metrics` exposes them). Padded lanes repeat the first query
+  and are discarded — vmap lanes are independent, so per-query results
+  are bitwise identical across bucket sizes (asserted by
+  tests/test_serve.py).
+- **Result cache**: an in-memory LRU plus an optional on-disk layer
+  (``SBR_SERVE_CACHE_DIR``), keyed by the canonical params fingerprint
+  (`sbr_tpu.utils.checkpoint.params_fingerprint`) combined with the
+  solver config + dtype — the same keying a future cross-run sweep cache
+  uses.
+- **AOT executable cache**: compiled bucket executables are serialized
+  (`jax.experimental.serialize_executable`) into the cache dir and
+  RELOADED on restart, skipping the ~2 s first-call compile visible in
+  every BENCH_r*.json. Reload is a deserialize, not a compile: a warm
+  restart shows zero ``serve.batch`` traces and zero backend compiles on
+  ``/metrics``. Every step degrades gracefully when the backend cannot
+  serialize (the engine just compiles).
+- **Resilience**: dispatches run under the unified retry engine
+  (``SBR_SERVE_RETRY_*``) with a shared `RetryBudget`
+  (``SBR_SERVE_RETRY_BUDGET``); `/healthz` folds the budget state and the
+  per-window divergent-cell counts (from the solver's `Health` pytree)
+  into ready/degraded/unhealthy.
+
+The pickle-based executable cache trusts its cache directory (same trust
+model as the tile checkpoints beside it) — point ``SBR_SERVE_CACHE_DIR``
+at storage you own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import os
+import pickle
+import queue
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from sbr_tpu.diag.health import DIVERGENT_MASK
+from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.resilience import retry
+from sbr_tpu.serve.live import LiveMetrics
+from sbr_tpu.utils.checkpoint import canonicalize, params_fingerprint
+
+# Bump when the batch program's semantics change: invalidates serialized
+# executables AND cached results (both encode solver behavior).
+_PROGRAM_VERSION = 1
+
+_SHUTDOWN = object()
+
+
+def default_buckets() -> Tuple[int, ...]:
+    """Batch-size bucket ladder from ``SBR_SERVE_BUCKETS`` (comma-separated,
+    ascending; default 1,8,64,512). Queries are padded up to the smallest
+    bucket that fits, so at most ``len(buckets)`` executables ever compile.
+    A malformed env value falls back to the default ladder WITH a stderr
+    warning — an operator typo must neither crash engine construction nor
+    silently serve a different compile/memory profile."""
+    import sys
+
+    env = os.environ.get("SBR_SERVE_BUCKETS", "").strip()
+    if env:
+        try:
+            vals = sorted({int(v) for v in env.split(",") if v.strip()})
+            if vals and all(v > 0 for v in vals):
+                return tuple(vals)
+            raise ValueError("buckets must be positive integers")
+        except ValueError as err:
+            print(
+                f"[sbr_tpu.serve] ignoring invalid SBR_SERVE_BUCKETS={env!r} "
+                f"({err}); using default ladder",
+                file=sys.stderr,
+            )
+    return (1, 8, 64, 512)
+
+
+def slo_ms() -> Optional[float]:
+    """The p99 latency SLO (``SBR_SERVE_SLO_MS``); None when unset."""
+    env = os.environ.get("SBR_SERVE_SLO_MS", "").strip()
+    return float(env) if env else None
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (env defaults resolved at construction)."""
+
+    buckets: Tuple[int, ...] = dataclasses.field(default_factory=default_buckets)
+    max_wait_ms: float = 2.0  # micro-batch assembly window
+    lru_max: int = 4096
+    cache_dir: Optional[str] = None  # results + serialized executables
+    # Upper bound on on-disk result-cache entries (the traffic model is
+    # millions of arbitrary queries — without a cap the results/ tree
+    # grows one file per distinct fingerprint forever). Checked every 512
+    # disk writes; oldest entries (mtime) pruned first. 0 disables.
+    disk_cap: int = 100_000
+
+    def __post_init__(self):
+        # _bucket_for assumes an ascending ladder (first bucket >= n wins);
+        # normalize here so a hand-built ServeConfig((64, 8, 1)) cannot
+        # silently pad singleton traffic to 64 lanes.
+        buckets = tuple(sorted({int(b) for b in self.buckets}))
+        if not buckets or buckets[0] <= 0:
+            raise ValueError(f"buckets must be positive integers, got {self.buckets!r}")
+        object.__setattr__(self, "buckets", buckets)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        kw = dict(
+            buckets=default_buckets(),
+            cache_dir=os.environ.get("SBR_SERVE_CACHE_DIR", "").strip() or None,
+        )
+        env_lru = os.environ.get("SBR_SERVE_LRU", "").strip()
+        if env_lru:
+            kw["lru_max"] = int(env_lru)
+        env_cap = os.environ.get("SBR_SERVE_DISK_CAP", "").strip()
+        if env_cap:
+            kw["disk_cap"] = int(env_cap)
+        kw.update(overrides)
+        return cls(**kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryResult:
+    """One served equilibrium: the lean per-cell outputs plus provenance."""
+
+    xi: float
+    tau_bar_in: float
+    aw_max: float
+    status: int
+    flags: int
+    residual: float
+    source: str  # "lru" | "disk" | "coalesced" | "computed"
+    scenario: str
+    latency_s: float
+
+    @property
+    def divergent(self) -> bool:
+        return bool(self.flags & DIVERGENT_MASK)
+
+
+class _Ticket:
+    __slots__ = ("params", "scenario", "key", "t0", "event", "result", "error")
+
+    def __init__(self, params: ModelParams, scenario: str, key: str) -> None:
+        self.params = params
+        self.scenario = scenario
+        self.key = key
+        self.t0 = time.monotonic()
+        self.event = threading.Event()
+        self.result: Optional[QueryResult] = None
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> QueryResult:
+        if not self.event.wait(timeout):
+            raise TimeoutError(f"query not fulfilled within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+@functools.lru_cache(maxsize=None)
+def _batch_fn(config: SolverConfig, dtype_name: str):
+    """Jitted 1-D micro-batch program: `solve_param_cell` vmapped with
+    EVERY parameter per-lane (the grid program broadcasts economics; a
+    served batch mixes arbitrary params). Cached per (config, dtype) so
+    buckets share one traced definition — each bucket shape still
+    compiles its own executable, counted by ``serve.batch`` traces."""
+    import jax
+    import jax.numpy as jnp
+
+    from sbr_tpu.obs import prof
+    from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+    dtype = jnp.dtype(dtype_name)
+
+    def fn(beta, u, p, kappa, lam, eta, t0, t1, x0):
+        prof.note_trace("serve.batch")
+
+        def cell(*cols):
+            return solve_param_cell(*cols, config, dtype)
+
+        return jax.vmap(cell)(beta, u, p, kappa, lam, eta, t0, t1, x0)
+
+    return jax.jit(fn)
+
+
+def _query_columns(params_list: List[ModelParams], dtype) -> list:
+    """The 9 per-lane parameter vectors in `solve_param_cell` order."""
+    rows = [
+        (
+            p.learning.beta,
+            p.economic.u,
+            p.economic.p,
+            p.economic.kappa,
+            p.economic.lam,
+            p.economic.eta,
+            p.learning.tspan[0],
+            p.learning.tspan[1],
+            p.learning.x0,
+        )
+        for p in params_list
+    ]
+    cols = np.asarray(rows, dtype=dtype).T
+    return [np.ascontiguousarray(c) for c in cols]
+
+
+class Engine:
+    """The long-lived serving engine (see module docstring).
+
+    Construction is cheap (no compile, no dispatch); executables compile
+    lazily per bucket on first use — or load from the serialized cache.
+    Use as a context manager, or call `start()` / `close()` explicitly.
+    Without `start()` the engine still serves `query_many` synchronously
+    in the calling thread (the deterministic path tests exercise)."""
+
+    def __init__(
+        self,
+        config: Optional[SolverConfig] = None,
+        dtype=None,
+        serve: Optional[ServeConfig] = None,
+        run=None,
+        run_dir: Optional[str] = None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        # Sweep-default numerics (refinement OFF), matching beta_u_grid.
+        self.config = config if config is not None else SolverConfig(refine_crossings=False)
+        if dtype is None:
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        self.dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+        self.serve = serve or ServeConfig.from_env()
+        self.live = LiveMetrics()
+
+        from sbr_tpu import obs
+        from sbr_tpu.obs import prof
+
+        # The compile listeners normally install when a RunContext starts;
+        # an engine without a run still promises live XLA compile counters
+        # on /metrics (the zero-post-warmup-compile gate reads them), so
+        # install unconditionally — idempotent, no-op on jax builds
+        # without jax.monitoring.
+        prof.install()
+
+        self._owned_run = None
+        if run is None and run_dir is not None:
+            run = self._owned_run = obs.start_run(label="serve", run_dir=run_dir)
+        self._run = run if run is not None else obs.active_run()
+
+        self._lru: "OrderedDict[str, dict]" = OrderedDict()
+        self._lru_lock = threading.Lock()
+        self._disk_writes = 0
+        self._execs: dict = {}
+        self._exec_meta = {"loaded": 0, "compiled": 0, "serialized": 0, "aot": "enabled"}
+        self._cfg_tag = canonicalize((self.config, self.dtype.name, _PROGRAM_VERSION))
+
+        self._retry = retry.policy_from_env(
+            "SBR_SERVE_RETRY", max_attempts=2, base_delay_s=0.05,
+            multiplier=2.0, max_delay_s=2.0,
+        )
+        budget_env = os.environ.get("SBR_SERVE_RETRY_BUDGET", "").strip()
+        self._budget_total = int(budget_env) if budget_env else 8
+        # Unlike a sweep (one bounded run), a server lives for days: a
+        # LIFETIME budget would let 8 unrelated recovered hiccups spread
+        # over a week permanently latch /healthz unhealthy. The budget
+        # refreshes every SBR_SERVE_RETRY_REFILL_S (default 900 s), so it
+        # still fail-fasts a genuinely dead backend (many failures within
+        # one refill window) without ratcheting.
+        refill_env = os.environ.get("SBR_SERVE_RETRY_REFILL_S", "").strip()
+        self._budget_refill_s = float(refill_env) if refill_env else 900.0
+        self.retry_budget = retry.RetryBudget(self._budget_total)
+        self._budget_epoch = time.monotonic()
+
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # Serializes the closed-check+enqueue in submit against close()'s
+        # closed-flag flip: without it a submit racing close could land a
+        # ticket after the batcher's final drain and strand its waiter.
+        self._close_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Engine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="sbr-serve-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._close_lock:
+            if self._closed:
+                return
+            # Under the lock: any submit that saw _closed == False has
+            # already enqueued, so the batcher's shutdown drain (or the
+            # sweep below) is guaranteed to see its ticket.
+            self._closed = True
+        if self._thread is not None:
+            self._stop.set()
+            self._queue.put(_SHUTDOWN)
+            self._thread.join(timeout=30.0)
+            # A submit that raced close() may have slipped a ticket in after
+            # the batcher drained; fail it rather than strand its waiter.
+            while True:
+                try:
+                    t = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if t is not _SHUTDOWN:
+                    t.error = RuntimeError("engine closed before the query was served")
+                    t.event.set()
+        self.live.maybe_write(self._run, self._live_extra(), force=True)
+        if self._run is not None:
+            try:
+                self._run.event("serve_summary", **self.live.snapshot())
+            except Exception:
+                pass
+        if self._owned_run is not None:
+            from sbr_tpu.obs import runlog
+
+            runlog._finalize_if_active(self._owned_run)
+
+    def __enter__(self) -> "Engine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- public query API ---------------------------------------------------
+    def submit(self, params: ModelParams, scenario: str = "default") -> _Ticket:
+        """Enqueue one query for the micro-batcher (requires `start()`).
+        Raises once the engine is closed — a ticket enqueued after the
+        batcher drained would block its waiter forever."""
+        ticket = _Ticket(params, scenario, self._result_key(params))
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._queue.put(ticket)
+        self.live.queue_depth = self._queue.qsize()
+        return ticket
+
+    def query(
+        self, params: ModelParams, scenario: str = "default",
+        timeout: Optional[float] = None,
+    ) -> QueryResult:
+        """Synchronous single query. Batched with concurrent submitters
+        when the engine is started; solved inline otherwise."""
+        if self._thread is None:
+            return self.query_many([params], scenario=scenario)[0]
+        return self.submit(params, scenario).wait(timeout)
+
+    def query_many(
+        self, params_list: List[ModelParams], scenario: str = "default",
+        timeout: Optional[float] = None,
+    ) -> List[QueryResult]:
+        """Solve a list of queries. Started engine: all enqueue at once (the
+        natural micro-batch). Unstarted: processed inline in this thread —
+        the deterministic, thread-free path."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        tickets = [
+            _Ticket(p, scenario, self._result_key(p)) for p in params_list
+        ]
+        if self._thread is None:
+            self._process(tickets)
+        else:
+            with self._close_lock:
+                if self._closed:
+                    raise RuntimeError("engine is closed")
+                for t in tickets:
+                    self._queue.put(t)
+            self.live.queue_depth = self._queue.qsize()
+        return [t.wait(timeout) for t in tickets]
+
+    # -- health / exposition -------------------------------------------------
+    def healthz(self) -> dict:
+        """Ready/degraded/unhealthy verdict with reasons — `/healthz` body.
+
+        unhealthy: the batcher thread died, or the shared retry budget is
+        exhausted (every future transient failure will fail fast until the
+        next refill — see ``SBR_SERVE_RETRY_REFILL_S``).
+        degraded: divergent cells or dispatch errors in the current window,
+        a partially consumed retry budget (since the last refill), or a
+        p99 over ``SBR_SERVE_SLO_MS``.
+        """
+        self._maybe_refill_budget()
+        reasons = []
+        status = "ready"
+        if self._thread is not None and not self._thread.is_alive() and not self._closed:
+            status = "unhealthy"
+            reasons.append("batcher thread dead")
+        if self.retry_budget.total > 0 and self.retry_budget.remaining == 0:
+            status = "unhealthy"
+            reasons.append("retry budget exhausted")
+        if status != "unhealthy":
+            window = self.live.window()
+            if window.get("divergent_cells", 0):
+                status = "degraded"
+                reasons.append(f"{int(window['divergent_cells'])} divergent cell(s) in window")
+            if window.get("errors", 0):
+                status = "degraded"
+                reasons.append(f"{int(window['errors'])} dispatch error(s) in window")
+            if self.retry_budget.used > 0:
+                status = "degraded"
+                reasons.append(
+                    f"retry budget {self.retry_budget.used}/{self.retry_budget.total} consumed"
+                )
+            slo = slo_ms()
+            p99 = (window.get("latency_ms") or {}).get("p99")
+            if slo is not None and p99 is not None and p99 > slo:
+                status = "degraded"
+                reasons.append(f"window p99 {p99:.3f} ms over SLO {slo:g} ms")
+        return {"status": status, "reasons": reasons}
+
+    def _maybe_refill_budget(self) -> None:
+        """Swap in a fresh retry budget once the refill period has lapsed
+        (reference swap — in-flight dispatches keep drawing on the old
+        object, which is fine: the pool bounds failures per window)."""
+        if self._budget_refill_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._budget_epoch >= self._budget_refill_s:
+            self._budget_epoch = now
+            self.retry_budget = retry.RetryBudget(self._budget_total)
+
+    def statz(self) -> dict:
+        """Full live snapshot — `/statz` body and the `live.json` document."""
+        return self.live.snapshot(self._live_extra())
+
+    def prometheus(self) -> str:
+        extra = {
+            "sbr_serve_execs_loaded": ("counter", self._exec_meta["loaded"]),
+            "sbr_serve_execs_compiled": ("counter", self._exec_meta["compiled"]),
+            "sbr_serve_lru_entries": ("gauge", len(self._lru)),
+            "sbr_serve_retry_budget_remaining": ("gauge", self.retry_budget.remaining),
+        }
+        return self.live.to_prometheus(extra)
+
+    def _live_extra(self) -> dict:
+        return {
+            "healthz": self.healthz(),
+            "retry_budget": {
+                "total": self.retry_budget.total,
+                "used": self.retry_budget.used,
+                "remaining": self.retry_budget.remaining,
+            },
+            "slo": {"slo_ms": slo_ms()},
+            "engine": {
+                "buckets": list(self.serve.buckets),
+                "dtype": self.dtype.name,
+                "lru_entries": len(self._lru),
+                "lru_max": self.serve.lru_max,
+                "cache_dir": self.serve.cache_dir,
+                **self._exec_meta,
+            },
+        }
+
+    # -- batcher loop --------------------------------------------------------
+    def _loop(self) -> None:
+        max_bucket = max(self.serve.buckets)
+        while True:
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
+                # write_due first: building the extras (healthz + window
+                # fold) 20×/s on an idle server just to hit the write
+                # throttle would be pure waste.
+                if self._run is not None and self.live.write_due():
+                    self.live.maybe_write(self._run, self._live_extra())
+                continue
+            batch, shutdown = [], item is _SHUTDOWN
+            if not shutdown:
+                batch.append(item)
+                deadline = time.monotonic() + self.serve.max_wait_ms / 1e3
+                while len(batch) < max_bucket:
+                    budget = deadline - time.monotonic()
+                    try:
+                        nxt = self._queue.get(timeout=max(budget, 0.0))
+                    except queue.Empty:
+                        break
+                    if nxt is _SHUTDOWN:
+                        shutdown = True
+                        break
+                    batch.append(nxt)
+            else:
+                # Drain everything still queued so no ticket hangs forever.
+                while True:
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is not _SHUTDOWN:
+                        batch.append(nxt)
+            self.live.queue_depth = self._queue.qsize()
+            if batch:
+                self.live.inflight = len(batch)
+                try:
+                    self._process(batch)
+                finally:
+                    self.live.inflight = 0
+                if self._run is not None and self.live.write_due():
+                    self.live.maybe_write(self._run, self._live_extra())
+            if shutdown:
+                break
+
+    # -- processing ----------------------------------------------------------
+    def _process(self, tickets: List[_Ticket]) -> None:
+        """Serve a batch of tickets: cache lookups first, then the misses in
+        bucket-padded dispatches. Identical queries inside one batch are
+        COALESCED into a single lane — a million users asking the same
+        question is this service's expected traffic shape, so duplicates
+        must cost one solve, not N. Never raises — failures land on
+        tickets."""
+        groups: "OrderedDict[str, List[_Ticket]]" = OrderedDict()
+        for t in tickets:
+            rec, source = self._lookup(t.key)
+            if rec is not None:
+                self._fulfill(t, rec, source)
+            else:
+                groups.setdefault(t.key, []).append(t)
+        unique = [g[0] for g in groups.values()]
+        max_bucket = max(self.serve.buckets)
+        for i in range(0, len(unique), max_bucket):
+            chunk = unique[i : i + max_bucket]
+            try:
+                records = self._dispatch([t.params for t in chunk])
+            except BaseException as err:
+                for t in chunk:
+                    for dup in groups[t.key]:
+                        self.live.record_error()
+                        dup.error = err
+                        dup.event.set()
+                continue
+            for t, rec in zip(chunk, records):
+                # A divergent result (DIVERGENT_MASK flag) is served — the
+                # caller sees the flags and decides — but never CACHED: a
+                # cached hit would replay the poisoned numbers forever
+                # (surviving restarts via the disk layer) while /healthz
+                # recovered as the window rolled past the original solve.
+                # Recomputing each time keeps the divergence visible to the
+                # live window and leaves the door open for a heal-ladder
+                # retry to succeed on transient poison.
+                if not (rec["flags"] & DIVERGENT_MASK):
+                    self._store(t.key, rec)
+                for j, dup in enumerate(groups[t.key]):
+                    self._fulfill(dup, rec, "computed" if j == 0 else "coalesced")
+
+    def _fulfill(self, t: _Ticket, rec: dict, source: str) -> None:
+        latency = time.monotonic() - t.t0
+        t.result = QueryResult(
+            source=source, scenario=t.scenario, latency_s=latency, **rec
+        )
+        self.live.record_query(
+            latency, source, scenario=t.scenario, divergent=t.result.divergent
+        )
+        t.event.set()
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.serve.buckets:
+            if b >= n:
+                return b
+        return max(self.serve.buckets)
+
+    def _dispatch(self, params_list: List[ModelParams]) -> List[dict]:
+        """One padded vmapped dispatch under the retry policy; returns one
+        plain-float record per query (the cacheable form)."""
+        import jax.numpy as jnp
+
+        self._maybe_refill_budget()
+        n = len(params_list)
+        bucket = self._bucket_for(n)
+        cols = _query_columns(params_list, self.dtype)
+        if bucket > n:
+            pad = bucket - n
+            cols = [np.concatenate([c, np.repeat(c[:1], pad)]) for c in cols]
+        exec_ = self._exec(bucket)
+        args = [jnp.asarray(c) for c in cols]
+
+        def run():
+            xi, tau_in, aw_max, status, health = exec_(*args)
+            # Device→host fetch inside the retried scope: a transient that
+            # surfaces at fetch time must count against THIS dispatch.
+            return (
+                np.asarray(xi),
+                np.asarray(tau_in),
+                np.asarray(aw_max),
+                np.asarray(status),
+                np.asarray(health.flags),
+                np.asarray(health.residual),
+            )
+
+        xi, tau_in, aw_max, status, flags, residual = self._retry.call(
+            run, scope=f"serve.dispatch[{bucket}]", budget=self.retry_budget
+        )
+        self.live.record_batch(n, bucket)
+        if self._run is not None:
+            try:
+                self._run.event(
+                    "serve_batch", n=n, bucket=bucket,
+                    divergent=int(((flags[:n] & DIVERGENT_MASK) != 0).sum()),
+                )
+            except Exception:
+                pass
+        return [
+            {
+                "xi": float(xi[i]),
+                "tau_bar_in": float(tau_in[i]),
+                "aw_max": float(aw_max[i]),
+                "status": int(status[i]),
+                "flags": int(flags[i]),
+                "residual": float(residual[i]),
+            }
+            for i in range(n)
+        ]
+
+    # -- result cache --------------------------------------------------------
+    def _result_key(self, params: ModelParams) -> str:
+        return params_fingerprint((params, self._cfg_tag))
+
+    def _result_path(self, key: str) -> Optional[Path]:
+        if not self.serve.cache_dir:
+            return None
+        return Path(self.serve.cache_dir) / "results" / key[:2] / f"{key}.json"
+
+    def _lookup(self, key: str) -> tuple:
+        with self._lru_lock:
+            rec = self._lru.get(key)
+            if rec is not None:
+                self._lru.move_to_end(key)
+                return dict(rec), "lru"
+        path = self._result_path(key)
+        if path is not None and path.exists():
+            import json
+
+            try:
+                rec = json.loads(path.read_text())
+                rec = {
+                    "xi": float(rec["xi"]),
+                    "tau_bar_in": float(rec["tau_bar_in"]),
+                    "aw_max": float(rec["aw_max"]),
+                    "status": int(rec["status"]),
+                    "flags": int(rec["flags"]),
+                    "residual": float(rec["residual"]),
+                }
+            except (OSError, ValueError, KeyError, TypeError):
+                # Unreadable OR parseable-but-wrong-shape (a torn write can
+                # leave valid non-dict JSON; rec["xi"] then raises TypeError,
+                # which must not kill the batcher thread): recompute.
+                return None, None
+            self._store(key, rec, write_disk=False)
+            return dict(rec), "disk"
+        return None, None
+
+    def _store(self, key: str, rec: dict, write_disk: bool = True) -> None:
+        with self._lru_lock:
+            self._lru[key] = dict(rec)
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.serve.lru_max:
+                self._lru.popitem(last=False)
+        path = self._result_path(key)
+        if write_disk and path is not None:
+            import json
+
+            try:
+                path.parent.mkdir(parents=True, exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+                with os.fdopen(fd, "w") as f:
+                    f.write(json.dumps(rec))
+                os.replace(tmp, path)
+                self._disk_writes += 1
+                if self._disk_writes % 512 == 0:
+                    self._prune_disk_cache()
+            except OSError:
+                pass  # the disk layer is best-effort; the LRU already has it
+
+    def _prune_disk_cache(self) -> None:
+        """Bound the results/ tree at ``disk_cap`` entries, evicting oldest
+        by mtime (the serve-cache analogue of `report gc`'s run retention).
+        Best-effort and rare (every 512 writes) — a concurrent reader of a
+        pruned entry just recomputes."""
+        cap = self.serve.disk_cap
+        if cap <= 0 or not self.serve.cache_dir:
+            return
+        try:
+            root = Path(self.serve.cache_dir) / "results"
+            entries = [(p.stat().st_mtime, p) for p in root.rglob("*.json")]
+            if len(entries) <= cap:
+                return
+            entries.sort()
+            for _, p in entries[: len(entries) - cap]:
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+            if self._run is not None:
+                self._run.event(
+                    "serve_cache_prune", removed=len(entries) - cap, cap=cap
+                )
+        except OSError:
+            pass
+
+    # -- executable cache -----------------------------------------------------
+    def _exec_path(self, bucket: int) -> Optional[Path]:
+        if not self.serve.cache_dir:
+            return None
+        import jax
+
+        d = jax.devices()[0]
+        key = hashlib.sha256(
+            canonicalize(
+                (
+                    self._cfg_tag,
+                    int(bucket),
+                    jax.__version__,
+                    d.platform,
+                    d.device_kind,
+                )
+            ).encode()
+        ).hexdigest()[:24]
+        return Path(self.serve.cache_dir) / "execs" / f"serve_batch_{bucket}_{key}.pkl"
+
+    def _abstract_args(self, bucket: int) -> tuple:
+        import jax
+
+        return tuple(jax.ShapeDtypeStruct((bucket,), self.dtype) for _ in range(9))
+
+    def _exec(self, bucket: int):
+        """The compiled executable for one bucket shape: in-memory, else
+        deserialized from the cache dir (restart warm path), else freshly
+        lowered + compiled (and serialized back, best-effort)."""
+        exec_ = self._execs.get(bucket)
+        if exec_ is not None:
+            return exec_
+        path = self._exec_path(bucket)
+        if path is not None and path.exists():
+            try:
+                from jax.experimental.serialize_executable import deserialize_and_load
+
+                payload, in_tree, out_tree = pickle.loads(path.read_bytes())
+                exec_ = deserialize_and_load(payload, in_tree, out_tree)
+                self._execs[bucket] = exec_
+                self._exec_meta["loaded"] += 1
+                if self._run is not None:
+                    self._run.event("serve_exec", bucket=bucket, source="deserialized",
+                                    path=str(path))
+                return exec_
+            except Exception as err:
+                # A stale/foreign blob must never sink serving: recompile.
+                self._exec_meta["aot"] = f"reload failed ({type(err).__name__})"
+        from sbr_tpu import obs
+
+        t0 = time.monotonic()
+        with obs.span(f"serve.compile[{bucket}]"):
+            fn = _batch_fn(self.config, self.dtype.name)
+            compiled = fn.lower(*self._abstract_args(bucket)).compile()
+        self._execs[bucket] = compiled
+        self._exec_meta["compiled"] += 1
+        if self._run is not None:
+            try:
+                self._run.event(
+                    "serve_exec", bucket=bucket, source="compiled",
+                    compile_s=round(time.monotonic() - t0, 3),
+                )
+            except Exception:
+                pass
+        if path is not None:
+            self._serialize_exec(compiled, path, bucket)
+        return compiled
+
+    def _serialize_exec(self, compiled, path: Path, bucket: int) -> None:
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            blob = pickle.dumps(serialize(compiled))
+            path.parent.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+            self._exec_meta["serialized"] += 1
+        except Exception as err:
+            # Backends without executable serialization (or read-only cache
+            # dirs) just pay the compile on each restart — record why.
+            self._exec_meta["aot"] = f"disabled ({type(err).__name__}: {err})"

@@ -1,0 +1,309 @@
+"""Live windowed metrics for the serving engine (ISSUE 7 tentpole, part 2).
+
+Everything PRs 1-5 built is per-run, post-hoc: a JSONL directory inspected
+after the process exits. A long-lived query server needs the opposite —
+streaming, windowed, queryable-while-alive telemetry, the way torchode
+(PAPERS.md) exposes per-solve step/accept statistics as first-class outputs
+rather than logs. This module is that layer:
+
+- **Windowed aggregation** via a ring of time slots: the window (default
+  60 s, ``SBR_SERVE_WINDOW_S``) is divided into `_N_SLOTS` slots, each
+  holding a log-bucketed latency histogram (`obs.metrics.LogHistogram`)
+  plus plain counters. Recording touches only the current slot; snapshots
+  fold the live slots. Slots are replaced by single reference assignment
+  and counters are int increments, so the hot path needs NO lock under
+  CPython — the worst cross-thread race drops one count from a rolling
+  window, never corrupts state (same contract as `LogHistogram`).
+- **Lifetime totals** next to the window: Prometheus counters must be
+  monotone, and `report serve` wants both views.
+- **Rolling ``live.json``**: `maybe_write` snapshots the document into the
+  run directory via `RunContext.live_snapshot` (atomic rename, like the
+  manifest) at a bounded cadence, so ``python -m sbr_tpu.obs.report serve
+  RUN_DIR`` can read a RUNNING server — and the final write at engine
+  close leaves the post-hoc artifact.
+
+Latency histograms are log-bucketed (bounded relative error), so p50/p95/
+p99 are derivable from the buckets — both here and by any Prometheus
+backend scraping ``/metrics``.
+
+No jax import anywhere: live metrics are pure host accounting.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from sbr_tpu.obs.metrics import DEFAULT_LATENCY_BOUNDS_MS as LATENCY_BOUNDS_MS
+from sbr_tpu.obs.metrics import LogHistogram
+
+_N_SLOTS = 12
+
+SCHEMA = "sbr-serve-live/1"
+
+
+def window_seconds() -> float:
+    env = os.environ.get("SBR_SERVE_WINDOW_S", "").strip()
+    return float(env) if env else 60.0
+
+
+class _Slot:
+    """One time slot of the rolling window."""
+
+    __slots__ = ("epoch", "hist", "counters")
+
+    def __init__(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.hist = LogHistogram(LATENCY_BOUNDS_MS)
+        self.counters: Dict[str, float] = {}
+
+    def inc(self, name: str, n: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+
+# Counter keys shared by slots and totals. "queries" counts fulfilled
+# queries; "cache_hits" = LRU or disk hits, split out as "disk_hits";
+# "computed" = queries that went through a device dispatch.
+_COUNTERS = (
+    "queries",
+    "cache_hits",
+    "disk_hits",
+    "cache_misses",
+    "computed",
+    "errors",
+    "divergent_cells",
+    "batches",
+    "batch_queries",
+    "padded_lanes",
+)
+
+
+class LiveMetrics:
+    """Windowed + lifetime serving metrics (see module docstring).
+
+    ``time_fn`` is injectable so tests can drive window expiry without
+    sleeping."""
+
+    _MAX_SCENARIOS = 64  # distinct tags tracked; overflow folds into _other
+
+    def __init__(self, window_s: Optional[float] = None, time_fn=time.monotonic) -> None:
+        self.window_s = float(window_s) if window_s else window_seconds()
+        self._slot_s = self.window_s / _N_SLOTS
+        self._time = time_fn
+        self._slots = [_Slot(-1) for _ in range(_N_SLOTS)]
+        self.totals: Dict[str, float] = {k: 0 for k in _COUNTERS}
+        self.total_hist = LogHistogram(LATENCY_BOUNDS_MS)
+        self.scenarios: Dict[str, int] = {}
+        self.queue_depth = 0
+        self.inflight = 0
+        self.started_at = time.time()
+        self._t0 = self._time()
+        self._last_write = 0.0
+
+    # -- recording (engine threads) -----------------------------------------
+    def _slot(self) -> _Slot:
+        epoch = int(self._time() / self._slot_s)
+        pos = epoch % _N_SLOTS
+        slot = self._slots[pos]
+        if slot.epoch != epoch:
+            # Replace stale slot wholesale: one reference assignment, so a
+            # concurrent reader folds either the old or the new slot.
+            slot = _Slot(epoch)
+            self._slots[pos] = slot
+        return slot
+
+    def record_query(
+        self,
+        latency_s: float,
+        source: str,
+        scenario: str = "default",
+        divergent: bool = False,
+    ) -> None:
+        """One fulfilled query: ``source`` is "lru", "disk", "coalesced"
+        (deduplicated against an identical query in the same batch — no
+        device work, so it counts as a cache hit), or "computed"."""
+        ms = latency_s * 1e3
+        slot = self._slot()
+        slot.hist.record(ms)
+        self.total_hist.record(ms)
+        keys = ["queries"]
+        if source in ("lru", "disk", "coalesced"):
+            keys.append("cache_hits")
+            if source == "disk":
+                keys.append("disk_hits")
+        else:
+            keys += ["cache_misses", "computed"]
+        if divergent:
+            keys.append("divergent_cells")
+        for k in keys:
+            slot.inc(k)
+            self.totals[k] += 1
+        # Scenario tags are caller-chosen strings: cap the table so a
+        # long-lived server with per-request-derived tags cannot grow the
+        # snapshot (and its 0.5 s rewrites) without bound — the same
+        # bounded-memory contract as the histograms and the disk cap.
+        if scenario in self.scenarios or len(self.scenarios) < self._MAX_SCENARIOS:
+            self.scenarios[scenario] = self.scenarios.get(scenario, 0) + 1
+        else:
+            self.scenarios["_other"] = self.scenarios.get("_other", 0) + 1
+
+    def record_error(self, n: int = 1) -> None:
+        self._slot().inc("errors", n)
+        self.totals["errors"] += n
+
+    def record_batch(self, n_queries: int, bucket: int) -> None:
+        """One device dispatch: ``bucket`` lanes launched for ``n_queries``
+        real queries (occupancy = batch_queries / padded capacity)."""
+        slot = self._slot()
+        slot.inc("batches")
+        slot.inc("batch_queries", n_queries)
+        slot.inc("padded_lanes", bucket - n_queries)
+        self.totals["batches"] += 1
+        self.totals["batch_queries"] += n_queries
+        self.totals["padded_lanes"] += bucket - n_queries
+
+    # -- reading (endpoint / snapshot threads) ------------------------------
+    def _window_fold(self) -> tuple:
+        """(hist, counters) folded over the slots still inside the window."""
+        min_epoch = int(self._time() / self._slot_s) - _N_SLOTS + 1
+        hist = LogHistogram(LATENCY_BOUNDS_MS)
+        counters: Dict[str, float] = {k: 0 for k in _COUNTERS}
+        for slot in list(self._slots):
+            if slot.epoch < min_epoch:
+                continue
+            hist.add(slot.hist)
+            # list() snapshot: the recording thread may insert a new counter
+            # key mid-iteration (lock-free contract — a torn read drops one
+            # count from a rolling window, never raises).
+            for k, v in list(slot.counters.items()):
+                counters[k] = counters.get(k, 0) + v
+        return hist, counters
+
+    @staticmethod
+    def _derived(counters: Dict[str, float]) -> dict:
+        q = counters.get("queries", 0)
+        hits = counters.get("cache_hits", 0)
+        launched = counters.get("batch_queries", 0) + counters.get("padded_lanes", 0)
+        return {
+            "hit_rate": round(hits / q, 4) if q else None,
+            "occupancy": (
+                round(counters.get("batch_queries", 0) / launched, 4) if launched else None
+            ),
+        }
+
+    def window(self) -> dict:
+        hist, counters = self._window_fold()
+        return {
+            "window_s": self.window_s,
+            **{k: counters.get(k, 0) for k in _COUNTERS},
+            **self._derived(counters),
+            "latency_ms": hist.summary(),
+            "latency_hist_ms": hist.to_dict(),
+        }
+
+    def snapshot(self, extra: Optional[dict] = None) -> dict:
+        """The full live document — `live.json` body and `/statz` payload."""
+        from sbr_tpu.obs import prof
+
+        doc = {
+            "schema": SCHEMA,
+            "ts": round(time.time(), 3),
+            "started_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(self.started_at)
+            ),
+            "uptime_s": round(self._time() - self._t0, 3),
+            "queue_depth": self.queue_depth,
+            "inflight": self.inflight,
+            "totals": {
+                **{k: self.totals.get(k, 0) for k in _COUNTERS},
+                **self._derived(self.totals),
+                "latency_ms": self.total_hist.summary(),
+            },
+            "window": self.window(),
+            "scenarios": dict(sorted(list(self.scenarios.items()))),
+            # Compile/retrace counters ride along so a scrape — not a log
+            # grep — proves "zero post-warmup compiles" (acceptance gate).
+            "compile": {
+                "traces": {
+                    k: v for k, v in sorted(prof.trace_counts().items())
+                    if k.startswith("serve.")
+                },
+                **{
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in prof.compile_totals().items()
+                },
+            },
+        }
+        if extra:
+            doc.update(extra)
+        return doc
+
+    def write_due(self, min_interval_s: float = 0.5) -> bool:
+        """Whether `maybe_write` would actually write — callers on hot/idle
+        loops check this FIRST so the snapshot document (healthz + window
+        fold) is only built when a write will land, not 20×/s only for the
+        throttle to discard it."""
+        return self._time() - self._last_write >= min_interval_s
+
+    def maybe_write(self, run, extra: Optional[dict] = None,
+                    min_interval_s: float = 0.5, force: bool = False) -> bool:
+        """Write the rolling ``live.json`` through ``run.live_snapshot``
+        at a bounded cadence (``force`` for the final write at close).
+        Returns whether a write happened; never raises — live telemetry
+        must not sink the serving path."""
+        if run is None:
+            return False
+        now = self._time()
+        if not force and now - self._last_write < min_interval_s:
+            return False
+        self._last_write = now
+        try:
+            run.live_snapshot(self.snapshot(extra))
+            return True
+        except Exception:
+            return False
+
+    # -- prometheus exposition ----------------------------------------------
+    def to_prometheus(self, extra: Optional[dict] = None) -> str:
+        """Prometheus text exposition (0.0.4): lifetime counters, window
+        gauges, the cumulative latency histogram, and compile counters.
+        ``extra`` maps name -> (type, value) for engine-owned series."""
+        from sbr_tpu.obs import prof
+
+        lines = []
+        for k in _COUNTERS:
+            name = f"sbr_serve_{k}_total"
+            lines += [f"# TYPE {name} counter", f"{name} {int(self.totals.get(k, 0))}"]
+        hist, counters = self._window_fold()
+        derived = self._derived(counters)
+        window_gauges = {
+            "sbr_serve_queue_depth": self.queue_depth,
+            "sbr_serve_inflight": self.inflight,
+            "sbr_serve_window_queries": counters.get("queries", 0),
+            "sbr_serve_window_hit_rate": derived["hit_rate"],
+            "sbr_serve_window_occupancy": derived["occupancy"],
+            "sbr_serve_window_divergent_cells": counters.get("divergent_cells", 0),
+        }
+        for q in (0.5, 0.95, 0.99):
+            v = hist.quantile(q)
+            window_gauges[f"sbr_serve_window_latency_ms_p{int(q * 100)}"] = v
+        for name, v in window_gauges.items():
+            lines += [f"# TYPE {name} gauge", f"{name} {'NaN' if v is None else f'{v:g}'}"]
+        lines += self.total_hist.to_prometheus("sbr_serve_latency_ms")
+        totals = prof.compile_totals()
+        lines += [
+            "# TYPE sbr_serve_xla_compiles_total counter",
+            f"sbr_serve_xla_compiles_total {int(totals.get('compiles', 0))}",
+            "# TYPE sbr_serve_backend_compile_seconds_total counter",
+            f"sbr_serve_backend_compile_seconds_total {totals.get('backend_compile_s', 0.0):g}",
+        ]
+        traces = {k: v for k, v in sorted(prof.trace_counts().items()) if k.startswith("serve.")}
+        lines.append("# TYPE sbr_serve_traces_total counter")
+        for k, v in traces.items():
+            lines.append(f'sbr_serve_traces_total{{program="{k}"}} {int(v)}')
+        if not traces:
+            lines.append('sbr_serve_traces_total{program="serve.batch"} 0')
+        for name, (typ, value) in (extra or {}).items():
+            lines += [f"# TYPE {name} {typ}", f"{name} {value:g}"]
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,224 @@
+"""Seeded deterministic load generator for the serving engine (ISSUE 7).
+
+``python -m sbr_tpu.serve.loadgen`` drives a reproducible query mix
+against an in-process `Engine` + `ServeEndpoint`, scrapes its own
+``/metrics`` and ``/healthz`` over HTTP (counters, not logs — the
+acceptance contract), and prints ONE JSON summary line. CI and the bench
+harness both ride this:
+
+- the query stream is a seeded sample over a fixed parameter pool, so the
+  same ``--seed``/``--pool``/``--queries`` always produces the same mix
+  (and therefore the same cache-hit trajectory);
+- a **warmup phase** queries every pool member once (each miss compiles/
+  computes), then the **measured phase** replays the seeded mix — with
+  ``--assert-warm`` the run exits 1 unless the measured phase shows a
+  cache hit rate >= the floor AND zero new XLA compiles on the scraped
+  counters (the serve-smoke CI gate);
+- ``--run-dir`` lands the engine's rolling ``live.json`` in an obs run
+  directory that ``python -m sbr_tpu.obs.report serve`` renders and gates.
+
+Exit codes: 0 ok, 1 failed assertion (--assert-warm), 2 setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import urllib.request
+from typing import List
+
+from sbr_tpu.models.params import ModelParams, SolverConfig, make_model_params
+
+
+def build_pool(seed: int, pool: int) -> List[ModelParams]:
+    """``pool`` distinct parameter points, deterministically derived from
+    ``seed``: β and u swept over their Figure-4/5 ranges, everything else
+    at the reference defaults (η stays pinned like the sweeps)."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(pool):
+        out.append(
+            make_model_params(
+                beta=round(rng.uniform(0.5, 4.0), 6),
+                u=round(rng.uniform(0.02, 0.9), 6),
+            )
+        )
+    return out
+
+
+def query_mix(seed: int, pool_size: int, n: int) -> List[int]:
+    """Seeded stream of pool indices: repeated-mix traffic (each index
+    drawn uniformly), the shape a warm cache should mostly absorb."""
+    rng = random.Random(seed + 1)
+    return [rng.randrange(pool_size) for _ in range(n)]
+
+
+def _scrape(port: int, path: str) -> tuple:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _metric_value(text: str, name: str) -> float:
+    """Parse one un-labeled sample from Prometheus exposition text."""
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return float("nan")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.serve.loadgen",
+        description="Drive a seeded deterministic query mix against an "
+        "in-process serving engine; scrape /metrics + /healthz; print one "
+        "JSON summary line",
+    )
+    parser.add_argument("--queries", type=int, default=200, help="measured-phase queries")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--pool", type=int, default=24, help="distinct parameter points")
+    parser.add_argument("--group", type=int, default=16,
+                        help="queries submitted per query_many group")
+    parser.add_argument("--n-grid", type=int, default=192, dest="n_grid")
+    parser.add_argument("--bisect-iters", type=int, default=40, dest="bisect_iters")
+    parser.add_argument("--buckets", default=None,
+                        help="comma-separated batch buckets (default: SBR_SERVE_BUCKETS or 1,8,64)")
+    parser.add_argument("--run-dir", default=None,
+                        help="obs run dir for the rolling live.json (report serve reads it)")
+    parser.add_argument("--cache-dir", default=None,
+                        help="on-disk result + executable cache (default: SBR_SERVE_CACHE_DIR)")
+    parser.add_argument("--platform", default=None,
+                        help="pin a jax platform before backend init (e.g. cpu)")
+    parser.add_argument("--assert-warm", action="store_true",
+                        help="exit 1 unless measured-phase hit rate >= floor and "
+                        "zero new XLA compiles after warmup (scraped from /metrics)")
+    parser.add_argument("--hit-floor", type=float, default=0.5,
+                        help="cache-hit-rate floor for --assert-warm (default 0.5)")
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        if args.platform.lower() == "cpu":
+            from sbr_tpu.utils.platform import pin_cpu_platform
+
+            pin_cpu_platform()
+        else:
+            # The only supported pin is cpu (the axon sitecustomize ignores
+            # JAX_PLATFORMS; other backends are jax's default selection) —
+            # silently ignoring a typo would misattribute the numbers.
+            print(
+                f"[loadgen] unsupported --platform {args.platform!r} "
+                "(only 'cpu' can be pinned; omit the flag for the default backend)",
+                file=sys.stderr,
+            )
+            return 2
+
+    from sbr_tpu.serve.endpoint import ServeEndpoint
+    from sbr_tpu.serve.engine import Engine, ServeConfig, default_buckets
+
+    if args.buckets:
+        try:
+            buckets = tuple(sorted({int(v) for v in args.buckets.split(",") if v.strip()}))
+            if not buckets or any(b <= 0 for b in buckets):
+                raise ValueError(f"buckets must be positive ints, got {args.buckets!r}")
+        except ValueError as err:
+            print(f"[loadgen] bad --buckets: {err}", file=sys.stderr)
+            return 2
+    else:
+        import os
+
+        buckets = default_buckets() if os.environ.get("SBR_SERVE_BUCKETS") else (1, 8, 64)
+    serve_cfg = ServeConfig.from_env(buckets=buckets, **(
+        {"cache_dir": args.cache_dir} if args.cache_dir else {}
+    ))
+    config = SolverConfig(
+        n_grid=args.n_grid, bisect_iters=args.bisect_iters, refine_crossings=False
+    )
+
+    pool = build_pool(args.seed, args.pool)
+    mix = query_mix(args.seed, args.pool, args.queries)
+
+    engine = Engine(config=config, serve=serve_cfg, run_dir=args.run_dir)
+    engine.start()
+    endpoint = ServeEndpoint(engine).start()
+    print(f"[loadgen] endpoint on 127.0.0.1:{endpoint.port}", file=sys.stderr)
+    try:
+        # Warmup: every pool member once — compiles the bucket executables
+        # and fills the result cache. Its counters are the baseline the
+        # measured phase is compared against.
+        for i in range(0, len(pool), args.group):
+            engine.query_many(pool[i : i + args.group], scenario="warmup")
+        _, warm_metrics = _scrape(endpoint.port, "/metrics")
+        warm_compiles = _metric_value(warm_metrics, "sbr_serve_xla_compiles_total")
+        warm_queries = _metric_value(warm_metrics, "sbr_serve_queries_total")
+        warm_hits = _metric_value(warm_metrics, "sbr_serve_cache_hits_total")
+        # Measured-phase quantiles via histogram delta (LogHistogram.delta):
+        # lifetime quantiles would be dominated by the warmup's multi-second
+        # compile latencies (the same isolation bench_serve uses).
+        hist_before = engine.live.total_hist.copy()
+
+        for i in range(0, len(mix), args.group):
+            group = [pool[j] for j in mix[i : i + args.group]]
+            engine.query_many(group, scenario="mix")
+
+        _, metrics_text = _scrape(endpoint.port, "/metrics")
+        health_code, health_body = _scrape(endpoint.port, "/healthz")
+        try:  # /statz must serve a coherent document; a bad body is a
+            statz = json.loads(_scrape(endpoint.port, "/statz")[1])  # finding,
+            statz_ok = isinstance((statz.get("totals") or {}).get("queries"), (int, float))
+        except (OSError, ValueError):  # not a loadgen traceback
+            statz, statz_ok = {}, False
+
+        post_compiles = _metric_value(metrics_text, "sbr_serve_xla_compiles_total")
+        queries_total = _metric_value(metrics_text, "sbr_serve_queries_total")
+        hits_total = _metric_value(metrics_text, "sbr_serve_cache_hits_total")
+        measured_queries = queries_total - warm_queries
+        measured_hits = hits_total - warm_hits
+        hit_rate = measured_hits / measured_queries if measured_queries else 0.0
+        compile_delta = post_compiles - warm_compiles
+
+        lat = engine.live.total_hist.delta(hist_before).summary()
+        summary = {
+            "queries": int(measured_queries),
+            "warmup_queries": int(warm_queries),
+            "pool": args.pool,
+            "seed": args.seed,
+            "buckets": list(buckets),
+            "cache_hit_rate": round(hit_rate, 4),
+            "post_warmup_xla_compiles": int(compile_delta),
+            "p50_ms": lat.get("p50"),
+            "p99_ms": lat.get("p99"),
+            "healthz": json.loads(health_body),
+            "healthz_http": health_code,
+            "statz_ok": statz_ok,
+            "occupancy": (statz.get("totals") or {}).get("occupancy"),
+            "endpoint_port": endpoint.port,
+            "run_dir": args.run_dir,
+        }
+    finally:
+        endpoint.close()
+        engine.close()
+
+    failures = []
+    if args.assert_warm:
+        if hit_rate < args.hit_floor:
+            failures.append(
+                f"measured cache hit rate {hit_rate:.3f} < floor {args.hit_floor}"
+            )
+        if compile_delta != 0:
+            failures.append(
+                f"{int(compile_delta)} XLA compile(s) after warmup (expected 0)"
+            )
+        if health_code != 200:
+            failures.append(f"/healthz returned {health_code}")
+        if not statz_ok:
+            failures.append("/statz did not serve a coherent snapshot")
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    for f in failures:
+        print(f"[loadgen] ASSERTION FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -253,6 +253,19 @@ class _TracedLearning:
         self.x0 = x0
 
 
+def solve_param_cell(beta, u, p, kappa, lam, eta, t0, t1, x0, config: SolverConfig, dtype):
+    """One fully-parameterized equilibrium cell from traced scalars:
+    closed-form Stage 1 rebuilt per cell, then the lean Stage 2-3 solve.
+
+    The shared unit under BOTH the β×u grid program (`_grid_fn` vmaps it
+    over two axes with broadcast economics) and the serving engine's
+    micro-batch program (`sbr_tpu.serve.engine` vmaps it over one axis
+    with every parameter per-lane) — one definition means a served query
+    and a sweep cell can never drift numerically."""
+    ls = solve_learning(_TracedLearning(beta=beta, tspan=(t0, t1), x0=x0), config, dtype=dtype)
+    return _lean_cell(ls, u, p, kappa, lam, eta, t1, config)
+
+
 def _sweep_footprint(cache: dict, axes, config, dtype, build_fn, n_scalars) -> dict:
     """Shared footprint machinery for the sweep modules: normalize the
     (config, dtype) defaults exactly as the sweep entry points do, then AOT
@@ -318,14 +331,7 @@ def _grid_fn(config: SolverConfig, dtype_name: str, mesh, mesh_axes):
         # vmap² traces `cell` once per program trace — the retrace counter
         # (obs.prof) sees exactly the grid program's jit cache misses.
         prof.note_trace("sweeps.beta_u_grid")
-        ls = solve_learning(
-            # LearningParams is validated host-side; build the solution
-            # directly from traced scalars via the closed form.
-            _TracedLearning(beta=beta, tspan=(t0, t1), x0=x0),
-            config,
-            dtype=dtype,
-        )
-        return _lean_cell(ls, u, p, kappa, lam, eta, t1, config)
+        return solve_param_cell(beta, u, p, kappa, lam, eta, t0, t1, x0, config, dtype)
 
     bcast = (None,) * 7
     fn = jax.vmap(jax.vmap(cell, in_axes=(None, 0) + bcast), in_axes=(0, None) + bcast)

@@ -54,6 +54,69 @@ from sbr_tpu.sweeps.baseline_sweeps import GridSweepResult, beta_u_grid
 _FIELDS = ("max_aw", "xi", "status")
 
 
+# ---------------------------------------------------------------------------
+# Canonical parameter fingerprints (shared keying machinery)
+# ---------------------------------------------------------------------------
+
+
+def canonicalize(obj) -> str:
+    """Deterministic textual form of a parameter pytree — the canonical
+    input to `params_fingerprint` and `_sweep_fingerprint`.
+
+    Stability contract: the same logical structure produces the same
+    string across processes, interpreter restarts, and dict insertion
+    orders. Dataclasses render as ``TypeName(field=..., ...)`` with fields
+    sorted by name (so ModelParams vs ModelParamsInterest with identical
+    numbers can never collide); dicts sort by key; floats use Python's
+    shortest round-trip ``repr`` (exact for every binary64); numpy scalars
+    and arrays hash dtype + raw bytes. Unknown object types raise
+    ``TypeError`` — a silently unstable ``repr`` (memory addresses) must
+    never leak into a cache key.
+    """
+    import dataclasses as _dc
+
+    if _dc.is_dataclass(obj) and not isinstance(obj, type):
+        inner = ",".join(
+            f"{name}={canonicalize(getattr(obj, name))}"
+            for name in sorted(f.name for f in _dc.fields(obj))
+        )
+        return f"{type(obj).__name__}({inner})"
+    if isinstance(obj, dict):
+        items = sorted(obj.items(), key=lambda kv: canonicalize(kv[0]))
+        return "{" + ",".join(f"{canonicalize(k)}:{canonicalize(v)}" for k, v in items) + "}"
+    if isinstance(obj, (list, tuple)):
+        return "[" + ",".join(canonicalize(v) for v in obj) + "]"
+    if obj is None or isinstance(obj, (bool, int, str, bytes)):
+        return repr(obj)
+    if isinstance(obj, float):
+        return repr(obj)
+    if isinstance(obj, np.generic):
+        return f"{obj.dtype.name}:{obj.item()!r}"
+    if isinstance(obj, np.ndarray):
+        return (
+            f"ndarray{tuple(obj.shape)}:{obj.dtype.name}:"
+            f"{np.ascontiguousarray(obj).tobytes().hex()}"
+        )
+    raise TypeError(
+        f"canonicalize: unsupported type {type(obj).__name__} — extend the "
+        "canonical form rather than falling back to repr (addresses would "
+        "make fingerprints process-local)"
+    )
+
+
+def params_fingerprint(params) -> str:
+    """Stable sha256 hex of a parameter pytree (ModelParams and friends,
+    SolverConfig, or any nesting of dataclasses/dicts/sequences/scalars).
+
+    The public keying helper extracted from the tile-checkpoint fingerprint
+    (ISSUE 7 satellite): the same params pytree yields the same hex across
+    processes and dict orderings, so the serving engine's result cache
+    (`sbr_tpu.serve.engine`) and any future cross-run sweep cache can both
+    key on it. See `canonicalize` for the stability contract.
+    """
+    return hashlib.sha256(canonicalize(params).encode()).hexdigest()
+
+
 def resolve_tile_shape(
     nb: int,
     nu: int,
@@ -167,11 +230,15 @@ def tile_origins(n_b: int, n_u: int, tile_shape: Tuple[int, int]) -> list:
 
 def _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype) -> str:
     """Hash of everything that determines tile contents, so a checkpoint dir
-    can never silently serve results for different parameters."""
+    can never silently serve results for different parameters. Built on the
+    shared `canonicalize` form (not raw ``repr``, whose dataclass field
+    ORDER — rather than name — used to define the hash); checkpoint dirs
+    written by older builds therefore fail the fingerprint check loudly and
+    must be recomputed, never silently adopted."""
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(np.asarray(beta_values, dtype=np.float64)).tobytes())
     h.update(np.ascontiguousarray(np.asarray(u_values, dtype=np.float64)).tobytes())
-    h.update(repr((base, config, tuple(tile_shape), str(dtype))).encode())
+    h.update(canonicalize((base, config, tuple(int(t) for t in tile_shape), str(dtype))).encode())
     return h.hexdigest()
 
 

@@ -42,9 +42,10 @@ def test_append_load_round_trip(tmp_path):
     )
     assert out == p
     (rec,) = history.load(p)
-    # schema 2 (ISSUE 5): memory metrics joined the record; the key set
-    # only grew, and schema-1/-less lines still load (tests/test_mem.py).
-    assert rec["schema"] == history.SCHEMA == 2
+    # schema 3 (ISSUE 7): serving metrics joined the record (2 added
+    # memory); the key set only grew, and schema-1/2/-less lines still
+    # load (tests/test_mem.py, tests/test_serve.py).
+    assert rec["schema"] == history.SCHEMA == 3
     assert rec["label"] == "x" and rec["platform"] == "cpu"
     # only finite numerics survive; bools coerce to gateable ints
     assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}

@@ -320,8 +320,8 @@ def test_history_schema2_appends_and_reads_schema1(tmp_path):
     history.append({"eq_per_sec": 990.0, "mem_peak_bytes": 2**30},
                    platform="tpu", path=path)
     records = history.load(path)
-    assert [r["schema"] for r in records] == [1, 1, 2]
-    # The schema-2 record gates against the schema-1 baseline (same metric).
+    assert [r["schema"] for r in records] == [1, 1, history.SCHEMA]
+    # The current-schema record gates against the schema-1 baseline (same metric).
     verdicts, status = history.check(records, tolerance=0.15, min_points=3)
     assert status == "ok"
     assert verdicts["eq_per_sec"]["n"] == 3

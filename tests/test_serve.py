@@ -1,0 +1,683 @@
+"""Serving observatory tests (ISSUE 7): micro-batcher determinism, cache
+warm-path compile accounting, AOT executable reload, live metrics windows,
+the HTTP exposition endpoints, `report serve` gating, and the
+params-fingerprint satellite.
+
+The engine solves tiny SolverConfig programs so each bucket compiles in a
+couple of seconds on CPU; everything here is tier-1."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.obs import prof
+from sbr_tpu.obs.metrics import LogHistogram, log_bounds
+from sbr_tpu.serve.engine import Engine, ServeConfig
+from sbr_tpu.serve.live import LiveMetrics
+from sbr_tpu.serve.loadgen import build_pool, query_mix
+from sbr_tpu.utils.checkpoint import canonicalize, params_fingerprint
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Small program: compiles fast, still exercises the full three-stage solve.
+CFG = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+
+
+def _bits(results):
+    """Bitwise signature of per-query float outputs (NaN-safe)."""
+    return [
+        (
+            np.float64(r.xi).tobytes(),
+            np.float64(r.tau_bar_in).tobytes(),
+            np.float64(r.aw_max).tobytes(),
+            r.status,
+            r.flags,
+        )
+        for r in results
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Satellite: public params fingerprint
+# ---------------------------------------------------------------------------
+
+
+class TestParamsFingerprint:
+    def test_same_params_same_hex(self):
+        a = make_model_params(beta=1.5, u=0.2)
+        b = make_model_params(beta=1.5, u=0.2)
+        assert params_fingerprint(a) == params_fingerprint(b)
+
+    def test_dict_ordering_invariant(self):
+        a = {"beta": 1.5, "u": 0.2, "nested": {"x": 1, "y": 2}}
+        b = {"nested": {"y": 2, "x": 1}, "u": 0.2, "beta": 1.5}
+        assert params_fingerprint(a) == params_fingerprint(b)
+
+    def test_distinguishes_params(self):
+        a = make_model_params(beta=1.5, u=0.2)
+        b = make_model_params(beta=1.5, u=0.2000001)
+        assert params_fingerprint(a) != params_fingerprint(b)
+
+    def test_type_name_enters_hash(self):
+        # Same numbers under a different dataclass type must not collide.
+        assert "ModelParams(" in canonicalize(make_model_params())
+
+    def test_unknown_type_fails_loudly(self):
+        class Opaque:
+            pass
+
+        with pytest.raises(TypeError):
+            params_fingerprint(Opaque())
+
+    def test_stable_across_processes(self):
+        params = make_model_params(beta=2.5, u=0.33)
+        expected = params_fingerprint(params)
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from sbr_tpu.models.params import make_model_params\n"
+            "from sbr_tpu.utils.checkpoint import params_fingerprint\n"
+            "print(params_fingerprint(make_model_params(beta=2.5, u=0.33)))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=240,
+            env={**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"},
+            cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        assert out.stdout.strip() == expected
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: micro-batcher determinism + cache/compile accounting
+# ---------------------------------------------------------------------------
+
+
+class TestMicroBatcher:
+    def test_determinism_across_bucket_sizes(self):
+        """The same seeded query stream through batch buckets 1, 8, and 64
+        yields bitwise-identical per-query results (padded vmap lanes are
+        independent)."""
+        pool = build_pool(3, 10)
+        stream = [pool[i] for i in query_mix(3, len(pool), 24)]
+        signatures = []
+        for bucket in (1, 8, 64):
+            eng = Engine(config=CFG, serve=ServeConfig(buckets=(bucket,)))
+            try:
+                results = eng.query_many(stream)
+            finally:
+                eng.close()
+            signatures.append(_bits(results))
+        assert signatures[0] == signatures[1] == signatures[2]
+
+    def test_cache_warm_replay_zero_compiles(self):
+        """A cache-warm replay of the same stream issues ZERO new traces and
+        zero new XLA compiles (asserted via the prof registries, which is
+        what /metrics exposes) and serves everything from the LRU."""
+        pool = build_pool(4, 8)
+        stream = [pool[i] for i in query_mix(4, len(pool), 32)]
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(8,)))
+        try:
+            first = eng.query_many(stream)
+            traces_before = dict(prof.trace_counts())
+            compiles_before = prof.compile_totals()["compiles"]
+            replay = eng.query_many(stream)
+            assert prof.trace_counts() == traces_before
+            assert prof.compile_totals()["compiles"] == compiles_before
+        finally:
+            eng.close()
+        assert all(r.source == "lru" for r in replay)
+        assert _bits(first) == _bits(replay)
+        # repeated-mix stream over an 8-point pool: hit rate well over 0.5
+        totals = eng.live.snapshot()["totals"]
+        assert totals["queries"] == 64
+        assert totals["cache_hits"] / totals["queries"] >= 0.5
+
+    def test_threaded_path_matches_direct(self):
+        pool = build_pool(5, 6)
+        direct = Engine(config=CFG, serve=ServeConfig(buckets=(8,)))
+        try:
+            want = direct.query_many(pool)
+        finally:
+            direct.close()
+        threaded = Engine(config=CFG, serve=ServeConfig(buckets=(8,)))
+        threaded.start()
+        try:
+            got = threaded.query_many(pool, timeout=120)
+        finally:
+            threaded.close()
+        assert _bits(want) == _bits(got)
+
+    def test_scalar_query_and_scenario_accounting(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        try:
+            r = eng.query(make_model_params(beta=1.0, u=0.1), scenario="fig4")
+            assert r.source == "computed" and r.scenario == "fig4"
+            r2 = eng.query(make_model_params(beta=1.0, u=0.1), scenario="fig4")
+            assert r2.source == "lru"
+            assert _bits([r]) == _bits([r2])
+            assert eng.live.scenarios == {"fig4": 2}
+        finally:
+            eng.close()
+
+
+class TestCaches:
+    def test_disk_result_cache_survives_restart(self, tmp_path):
+        pool = build_pool(6, 4)
+        cfg = ServeConfig(buckets=(8,), cache_dir=str(tmp_path))
+        a = Engine(config=CFG, serve=cfg)
+        try:
+            want = a.query_many(pool)
+        finally:
+            a.close()
+        assert list((tmp_path / "results").rglob("*.json"))
+        b = Engine(config=CFG, serve=cfg)
+        try:
+            got = b.query_many(pool)
+        finally:
+            b.close()
+        assert all(r.source == "disk" for r in got)
+        assert _bits(want) == _bits(got)
+
+    def test_aot_executable_reload_skips_compile(self, tmp_path):
+        """A restarted engine with the same cache dir reloads the serialized
+        bucket executable: fresh params compute WITHOUT a new serve.batch
+        trace or XLA compile (the ~2 s first-call compile is skipped)."""
+        cfg = ServeConfig(buckets=(8,), cache_dir=str(tmp_path))
+        a = Engine(config=CFG, serve=cfg)
+        try:
+            a.query_many(build_pool(7, 4))
+        finally:
+            a.close()
+        if a._exec_meta["serialized"] == 0:
+            pytest.skip(f"backend cannot serialize executables: {a._exec_meta['aot']}")
+        assert list((tmp_path / "execs").glob("*.pkl"))
+
+        b = Engine(config=CFG, serve=cfg)
+        traces_before = dict(prof.trace_counts())
+        compiles_before = prof.compile_totals()["compiles"]
+        try:
+            fresh = build_pool(8, 4)  # different params: result cache misses
+            got = b.query_many(fresh)
+        finally:
+            b.close()
+        assert all(r.source == "computed" for r in got)
+        assert b._exec_meta["loaded"] == 1 and b._exec_meta["compiled"] == 0
+        assert prof.trace_counts() == traces_before
+        assert prof.compile_totals()["compiles"] == compiles_before
+
+    def test_non_dict_disk_entry_recomputes(self, tmp_path):
+        """A torn disk-cache write can leave valid NON-DICT JSON; the lookup
+        must treat it as a miss (recompute), not kill the batcher thread."""
+        cfg = ServeConfig(buckets=(8,), cache_dir=str(tmp_path))
+        a = Engine(config=CFG, serve=cfg)
+        try:
+            want = a.query_many(build_pool(13, 2))
+        finally:
+            a.close()
+        for f in (tmp_path / "results").rglob("*.json"):
+            f.write_text("[1, 2, 3]")
+        b = Engine(config=CFG, serve=cfg)
+        b.start()
+        try:
+            got = b.query_many(build_pool(13, 2), timeout=120)
+        finally:
+            b.close()
+        assert all(r.source == "computed" for r in got)
+        assert _bits(want) == _bits(got)
+
+    def test_submit_after_close_raises(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        eng.start()
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit(make_model_params())
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.query_many([make_model_params()])
+
+    def test_divergent_results_served_but_never_cached(self, tmp_path, monkeypatch):
+        """A DIVERGENT_MASK result reaches the caller (flags visible) but
+        must not enter the LRU or disk cache — a cached hit would replay
+        the poison forever while /healthz recovered."""
+        from sbr_tpu.diag.health import NAN_OUTPUT
+
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,), cache_dir=str(tmp_path)))
+        rec = {"xi": float("nan"), "tau_bar_in": 0.0, "aw_max": float("nan"),
+               "status": 0, "flags": int(NAN_OUTPUT), "residual": float("nan")}
+        monkeypatch.setattr(eng, "_dispatch", lambda params: [dict(rec) for _ in params])
+        try:
+            r1 = eng.query(make_model_params())
+            assert r1.divergent and r1.source == "computed"
+            r2 = eng.query(make_model_params())
+            assert r2.source == "computed"  # recomputed, not a cache hit
+            assert len(eng._lru) == 0
+            assert not list((tmp_path / "results").rglob("*.json"))
+            assert eng.live.totals["divergent_cells"] == 2  # stays visible
+        finally:
+            eng.close()
+
+    def test_serveconfig_normalizes_buckets(self):
+        assert ServeConfig(buckets=(64, 8, 1)).buckets == (1, 8, 64)
+        with pytest.raises(ValueError):
+            ServeConfig(buckets=(0, 8))
+
+    def test_lru_eviction_bounded(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(8,), lru_max=3))
+        try:
+            eng.query_many(build_pool(9, 6))
+            assert len(eng._lru) == 3
+        finally:
+            eng.close()
+
+    def test_disk_cache_prune_bounded(self, tmp_path):
+        cfg = ServeConfig(buckets=(8,), cache_dir=str(tmp_path), disk_cap=3)
+        eng = Engine(config=CFG, serve=cfg)
+        try:
+            eng.query_many(build_pool(14, 6))
+            eng._prune_disk_cache()  # cadence in prod is every 512 writes
+            left = list((tmp_path / "results").rglob("*.json"))
+            assert len(left) == 3
+        finally:
+            eng.close()
+
+    def test_retry_budget_refills_over_time(self, monkeypatch):
+        """A long-lived server must not latch unhealthy forever after the
+        budget drains: the pool refreshes every SBR_SERVE_RETRY_REFILL_S."""
+        import time as _time
+
+        monkeypatch.setenv("SBR_SERVE_RETRY_REFILL_S", "0.05")
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        try:
+            while eng.retry_budget.take():
+                pass
+            assert eng.healthz()["status"] == "unhealthy"
+            _time.sleep(0.08)
+            assert eng.healthz()["status"] == "ready"
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Live metrics: windowing, histograms, prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+class TestLiveMetrics:
+    def test_log_histogram_quantiles(self):
+        h = LogHistogram(log_bounds(0.1, 1000.0, per_decade=4))
+        for v in (1.0,) * 50 + (10.0,) * 45 + (500.0,) * 5:
+            h.record(v)
+        assert h.count == 100
+        assert h.quantile(0.5) <= 2.0
+        assert 5.0 <= h.quantile(0.95) <= 20.0
+        assert h.quantile(0.99) >= 100.0
+        s = h.summary()
+        assert s["count"] == 100 and s["max"] == 500.0
+
+    def test_histogram_delta_isolates_phase(self):
+        h = LogHistogram(log_bounds(0.1, 1000.0, per_decade=4))
+        for v in (500.0,) * 10:  # "warmup": slow samples
+            h.record(v)
+        before = h.copy()
+        for v in (1.0,) * 30:  # "measured": fast samples
+            h.record(v)
+        d = h.delta(before)
+        assert d.count == 30
+        assert d.quantile(0.99) <= 2.0  # warmup's 500 ms never leaks in
+        with pytest.raises(ValueError):
+            h.delta(LogHistogram((1.0, 2.0)))
+
+    def test_histogram_overflow_bucket(self):
+        h = LogHistogram((1.0, 10.0))
+        h.record(99999.0)
+        assert h.counts[-1] == 1
+        assert h.quantile(0.99) == 99999.0
+
+    def test_window_expiry(self):
+        clock = [0.0]
+        live = LiveMetrics(window_s=12.0, time_fn=lambda: clock[0])
+        live.record_query(0.001, "computed")
+        assert live.window()["queries"] == 1
+        clock[0] += 100.0  # all slots age out; lifetime totals stay
+        assert live.window()["queries"] == 0
+        assert live.totals["queries"] == 1
+
+    def test_scenario_table_bounded(self):
+        live = LiveMetrics(window_s=60.0)
+        for i in range(200):
+            live.record_query(0.001, "computed", scenario=f"tag{i}")
+        assert len(live.scenarios) <= LiveMetrics._MAX_SCENARIOS + 1
+        assert live.scenarios["_other"] == 200 - LiveMetrics._MAX_SCENARIOS
+
+    def test_prometheus_exposition_shape(self):
+        live = LiveMetrics(window_s=60.0)
+        live.record_query(0.002, "computed")
+        live.record_query(0.001, "lru")
+        live.record_batch(3, 8)
+        text = live.to_prometheus()
+        assert "# TYPE sbr_serve_queries_total counter" in text
+        assert "sbr_serve_queries_total 2" in text
+        assert "sbr_serve_cache_hits_total 1" in text
+        assert 'le="+Inf"' in text and "sbr_serve_latency_ms_count 2" in text
+        assert "sbr_serve_xla_compiles_total" in text
+
+
+# ---------------------------------------------------------------------------
+# Endpoint + healthz + report serve gate
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:  # 404/503 still carry a body
+        return err.code, err.read().decode()
+
+
+class TestEndpointAndGate:
+    def test_endpoint_routes_and_report_gate(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs.report import main as report_main
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+
+        run_dir = tmp_path / "run"
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(8,)), run_dir=str(run_dir))
+        eng.start()
+        ep = ServeEndpoint(eng).start()
+        try:
+            pool = build_pool(11, 6)
+            eng.query_many(pool + pool, timeout=180)  # repeats ⇒ cache hits
+            code, metrics_text = _get(ep.port, "/metrics")
+            assert code == 200 and "sbr_serve_queries_total 12" in metrics_text
+            code, health = _get(ep.port, "/healthz")
+            assert code == 200 and json.loads(health)["status"] == "ready"
+            code, statz = _get(ep.port, "/statz")
+            doc = json.loads(statz)
+            assert doc["totals"]["queries"] == 12
+            assert doc["window"]["hit_rate"] >= 0.5
+            code, _ = _get(ep.port, "/nope")
+            assert code == 404
+        finally:
+            ep.close()
+            eng.close()
+
+        # live.json landed in the run dir; gate passes with no SLO...
+        assert (run_dir / "live.json").exists()
+        for var in ("SBR_SERVE_SLO_MS", "SBR_SERVE_CACHE_FLOOR", "SBR_SERVE_WARMUP"):
+            monkeypatch.delenv(var, raising=False)
+        assert report_main(["serve", str(run_dir)]) == 0
+        assert report_main(["serve", str(run_dir), "--json"]) == 0
+        # ...exits 1 when the SLO is artificially low...
+        monkeypatch.setenv("SBR_SERVE_SLO_MS", "0.000001")
+        assert report_main(["serve", str(run_dir)]) == 1
+        monkeypatch.delenv("SBR_SERVE_SLO_MS")
+        # ...and 1 again when the cache floor is unreachable after warmup.
+        assert report_main(
+            ["serve", str(run_dir), "--cache-floor", "1.1", "--warmup", "1"]
+        ) == 1
+        # missing data → 3; missing dir → 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert report_main(["serve", str(empty)]) == 3
+        assert report_main(["serve", str(tmp_path / "nothing")]) == 2
+
+    def test_endpoint_close_without_start_returns(self):
+        """socketserver's shutdown() deadlocks when serve_forever never ran;
+        close() must special-case the never-started endpoint."""
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        try:
+            ep = ServeEndpoint(eng)  # constructed, never started
+            ep.close()  # must return, not deadlock
+        finally:
+            eng.close()
+
+    def test_cache_floor_gate_scopes_consistently(self, tmp_path):
+        """A quiet window holding two fresh queries on a long-warm server
+        must NOT trip the floor gate: the rate and the arming count come
+        from the same scope."""
+        from sbr_tpu.obs.report import serve_doc
+
+        run_dir = tmp_path / "run"
+        run_dir.mkdir()
+        live = {
+            "schema": "sbr-serve-live/1", "ts": 0, "uptime_s": 9999,
+            "totals": {"queries": 10000, "cache_hits": 9500, "hit_rate": 0.95,
+                       "latency_ms": {"p99": 1.0}},
+            "window": {"queries": 2, "cache_hits": 0, "hit_rate": 0.0,
+                       "latency_ms": {"p99": 1.0}},
+        }
+        (run_dir / "live.json").write_text(json.dumps(live))
+        doc, code = serve_doc(run_dir, cache_floor=0.5, warmup=50)
+        assert code == 0, doc["breaches"]
+        # but a genuinely cold warmed-up window still breaches
+        live["window"] = {"queries": 200, "cache_hits": 10, "hit_rate": 0.05,
+                         "latency_ms": {"p99": 1.0}}
+        (run_dir / "live.json").write_text(json.dumps(live))
+        doc, code = serve_doc(run_dir, cache_floor=0.5, warmup=50)
+        assert code == 1 and "hit rate" in doc["breaches"][0]
+
+    def test_loadgen_rejects_bad_buckets(self, capsys):
+        # a bad token is a setup error (exit 2, stderr message), never a
+        # traceback; empty tokens from trailing commas are filtered
+        from sbr_tpu.serve.loadgen import main as loadgen_main
+
+        assert loadgen_main(["--buckets", "-4"]) == 2
+        assert loadgen_main(["--buckets", "x"]) == 2
+        assert loadgen_main(["--buckets", ",,"]) == 2
+        capsys.readouterr()
+
+    def test_healthz_degraded_and_unhealthy(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        try:
+            assert eng.healthz()["status"] == "ready"
+            eng.live.record_query(0.001, "computed", divergent=True)
+            assert eng.healthz()["status"] == "degraded"
+            while eng.retry_budget.take():
+                pass
+            doc = eng.healthz()
+            assert doc["status"] == "unhealthy"
+            assert any("budget" in r for r in doc["reasons"])
+        finally:
+            eng.close()
+
+    def test_dispatch_failure_marks_tickets_and_errors(self, monkeypatch):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        try:
+            monkeypatch.setattr(
+                eng, "_dispatch",
+                lambda params: (_ for _ in ()).throw(RuntimeError("boom")),
+            )
+            with pytest.raises(RuntimeError, match="boom"):
+                eng.query(make_model_params())
+            assert eng.live.totals["errors"] == 1
+            assert eng.healthz()["status"] == "degraded"
+        finally:
+            eng.close()
+
+
+class TestLoadgen:
+    def test_loadgen_assert_warm_via_metrics_scrape(self, tmp_path, capsys):
+        """The acceptance contract end to end: after warmup, the seeded
+        repeated-mix stream shows cache hit rate >= 0.5 and ZERO
+        post-warmup XLA compiles — verified from the scraped /metrics
+        counters (--assert-warm), not logs — and `report serve --json`
+        exits 0 on the run dir the engine wrote."""
+        from sbr_tpu.obs.report import main as report_main
+        from sbr_tpu.serve.loadgen import main as loadgen_main
+
+        run_dir = tmp_path / "run"
+        rc = loadgen_main([
+            "--queries", "60", "--pool", "8", "--seed", "0",
+            "--n-grid", "96", "--bisect-iters", "30", "--buckets", "1,8",
+            "--run-dir", str(run_dir), "--assert-warm",
+        ])
+        out = capsys.readouterr().out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert rc == 0, summary
+        assert summary["cache_hit_rate"] >= 0.5
+        assert summary["post_warmup_xla_compiles"] == 0
+        assert summary["healthz"]["status"] == "ready"
+        assert report_main(["serve", str(run_dir), "--json"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Satellite: torn events.jsonl tolerance in obs.report
+# ---------------------------------------------------------------------------
+
+
+class TestTornEventLog:
+    def _run_dir(self, tmp_path) -> Path:
+        d = tmp_path / "run"
+        d.mkdir()
+        (d / "manifest.json").write_text(json.dumps(
+            {"schema": "sbr-obs/1", "label": "t", "status": "running",
+             "n_events": 3, "stages": {}, "jit": {}}
+        ))
+        good = [
+            {"mono": 0.1, "ts": 1.0, "kind": "stage_start", "stage": "s"},
+            {"mono": 0.2, "ts": 1.1, "kind": "health", "stage": "s",
+             "cells": 4, "divergent": 0},
+        ]
+        lines = [json.dumps(ev).encode() for ev in good]
+        # Torn final line from a killed process: cut mid-record, mid-UTF-8
+        # multibyte sequence (b"\xe2\x82" is a truncated €).
+        lines.append(b'{"mono": 0.3, "ts": 1.2, "kind": "mem", "note": "\xe2\x82')
+        (d / "events.jsonl").write_bytes(b"\n".join(lines))
+        return d
+
+    def test_load_run_tolerates_and_counts(self, tmp_path):
+        from sbr_tpu.obs.report import load_run
+
+        run = load_run(self._run_dir(tmp_path))
+        assert run["bad_event_lines"] == 1
+        assert [ev["kind"] for ev in run["events"]] == ["stage_start", "health"]
+
+    def test_non_dict_line_counts_as_bad(self, tmp_path):
+        from sbr_tpu.obs.report import load_run
+
+        d = self._run_dir(tmp_path)
+        with open(d / "events.jsonl", "ab") as fh:
+            fh.write(b"\n42\n")
+        assert load_run(d)["bad_event_lines"] == 2
+
+    def test_report_subcommands_survive_torn_line(self, tmp_path, capsys):
+        from sbr_tpu.obs.report import main as report_main
+
+        d = str(self._run_dir(tmp_path))
+        assert report_main([d]) == 0
+        out = capsys.readouterr().out
+        assert "1 unparseable event line(s) skipped" in out
+        assert report_main(["health", d]) == 0  # intact health events gate
+        assert report_main(["resilience", d]) == 0
+        assert report_main([d, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert doc["bad_event_lines"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite: bench serve workload + schema-3 history
+# ---------------------------------------------------------------------------
+
+
+class TestBenchServe:
+    def test_bench_serve_tiny(self, monkeypatch):
+        monkeypatch.setenv("SBR_BENCH_SIZES", "tiny")
+        sys.path.insert(0, str(REPO))
+        try:
+            import bench
+        finally:
+            sys.path.pop(0)
+        out = bench.bench_serve("cpu")
+        assert out["serve_queries"] == 48
+        assert out["serve_p50_ms"] > 0
+        assert out["serve_p99_ms"] >= out["serve_p50_ms"]
+        assert out["serve_cache_hit_rate"] >= 0.5
+
+    def test_history_schema3_backcompat(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        rows = [
+            # schema-less (=1), schema 2, then schema-3 lines with serve metrics
+            {"ts": "t0", "label": "bench", "platform": "cpu",
+             "metrics": {"beta_u_grid_equilibria_per_sec": 1000.0}},
+            {"schema": 2, "ts": "t1", "label": "bench", "platform": "cpu",
+             "metrics": {"beta_u_grid_equilibria_per_sec": 1010.0,
+                         "mem_peak_bytes": 5000}},
+            {"schema": 3, "ts": "t2", "label": "bench", "platform": "cpu",
+             "metrics": {"beta_u_grid_equilibria_per_sec": 1005.0,
+                         "serve_p99_ms": 4.0, "serve_cache_hit_rate": 0.9}},
+            {"schema": 3, "ts": "t3", "label": "bench", "platform": "cpu",
+             "metrics": {"beta_u_grid_equilibria_per_sec": 1002.0,
+                         "serve_p99_ms": 4.1, "serve_cache_hit_rate": 0.88}},
+        ]
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        records = history.load(path)
+        assert [r["schema"] for r in records] == [1, 2, 3, 3]
+        verdicts, status = history.check(records, min_points=3)
+        assert status == "ok"
+        assert verdicts["beta_u_grid_equilibria_per_sec"]["status"] == "ok"
+
+    def test_serve_latency_regression_gates(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        assert history.polarity("serve_p99_ms") == -1
+        assert history.polarity("serve_cache_hit_rate") == 1
+        rows = [
+            {"schema": 3, "ts": f"t{i}", "label": "bench", "platform": "cpu",
+             "metrics": {"serve_p99_ms": 4.0}}
+            for i in range(3)
+        ] + [
+            {"schema": 3, "ts": "t9", "label": "bench", "platform": "cpu",
+             "metrics": {"serve_p99_ms": 40.0}}
+        ]
+        path = tmp_path / "hist.jsonl"
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        verdicts, status = history.check(history.load(path), min_points=3)
+        assert status == "regression"
+        assert verdicts["serve_p99_ms"]["status"] == "regression"
+
+    def test_bench_metrics_picks_up_serve_keys(self):
+        from sbr_tpu.obs.history import bench_metrics
+
+        result = {
+            "metric": "beta_u_grid_equilibria_per_sec", "value": 1.0,
+            "extra": {"serve_p50_ms": 0.4, "serve_p99_ms": 4.0,
+                      "serve_cache_hit_rate": 0.9},
+        }
+        got = bench_metrics(result)
+        assert got["serve_p50_ms"] == 0.4
+        assert got["serve_p99_ms"] == 4.0
+        assert got["serve_cache_hit_rate"] == 0.9
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint fingerprint integration (the extraction must keep protecting
+# the tile checkpoints)
+# ---------------------------------------------------------------------------
+
+
+class TestSweepFingerprintIntegration:
+    def test_sweep_fingerprint_uses_canonical_form(self):
+        from sbr_tpu.utils.checkpoint import _sweep_fingerprint
+
+        base = make_model_params()
+        cfg = SolverConfig(refine_crossings=False)
+        a = _sweep_fingerprint([0.5, 1.0], [0.1, 0.2], base, cfg, (2, 2), "float32")
+        b = _sweep_fingerprint([0.5, 1.0], [0.1, 0.2], base, cfg, (2, 2), "float32")
+        assert a == b
+        c = _sweep_fingerprint([0.5, 1.0], [0.1, 0.2], base, cfg, (2, 2), "float64")
+        assert a != c
