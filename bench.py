@@ -91,6 +91,13 @@ parameter pool, then the measured repeated mix) and reports
 --check` catches serving-latency regressions; schema-1/2 lines still load
 and gate.
 
+Elastic sweeps (ISSUE 8): a fourth workload runs one cold elastic tiled
+sweep (`parallel.run_tiled_grid_multihost` — heartbeats, claim plan,
+leases) and a warm re-sweep against the cross-run global tile cache,
+reporting `extra.sweep_cold_cells_per_sec` / `sweep_warm_cells_per_sec` /
+`sweep_warm_hit_rate` (history schema 4) so `report trend` gates both the
+scheduler's compute path and the cache's hit path.
+
 Resilience (PR 4): the probe ladder's attempts/backoff now come from the
 unified retry engine (`sbr_tpu.resilience.retry`, loaded standalone by
 file path so the parent stays jax-free) — SBR_BENCH_PROBE_ATTEMPTS /
@@ -1002,6 +1009,86 @@ def bench_serve(platform: str) -> dict:
     }
 
 
+def bench_sweep(platform: str) -> dict:
+    """Tiled-sweep workload (ISSUE 8): one cold elastic tiled sweep through
+    `run_tiled_grid_multihost` (heartbeats, claim plan, leases), then a
+    WARM re-sweep of the same grid into a fresh checkpoint dir with the
+    cross-run global tile cache hot — the serving-fleet traffic shape where
+    repeated sweeps re-request mostly-warm parameter regions. Headline
+    numbers: cold compute throughput, warm cache-served throughput, and
+    the warm hit rate (actual `cache` hit events over the tile count);
+    `report trend` gates them as schema-4 history metrics (all
+    higher-better)."""
+    import shutil
+    import tempfile
+
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.parallel import run_tiled_grid_multihost
+
+    import numpy as np
+
+    if _tiny():
+        n_beta, n_u, tile, n_grid = 8, 8, (4, 4), 96
+    elif platform == "cpu":
+        n_beta, n_u, tile, n_grid = 32, 32, (16, 16), 256
+    else:
+        n_beta, n_u, tile, n_grid = 128, 128, (64, 64), 1024
+    config = SolverConfig(n_grid=n_grid, bisect_iters=60, refine_crossings=False)
+    base = make_model_params()
+    betas = np.linspace(0.5, 2.0, n_beta)
+    us = np.linspace(0.02, 0.5, n_u)
+    n_cells = n_beta * n_u
+
+    from sbr_tpu import obs
+
+    # Warm hits are counted from the obs `cache` event roll-up, NOT from a
+    # cache-entry count delta: a warm recompute stores back under the
+    # IDENTICAL deterministic key (os.replace), so the entry count cannot
+    # distinguish "all hits" from "cache broken, all recomputed".
+    run = obs.active_run()
+
+    def _cache_counts() -> dict:
+        return dict(run.elastic["cache"]) if run is not None else {}
+
+    scratch = Path(tempfile.mkdtemp(prefix="sbr_bench_sweep_"))
+    try:
+        cache = scratch / "tile_cache"
+        kwargs = dict(
+            config=config, tile_shape=tile, poll_s=0.1, timeout_s=1800.0,
+            elastic=True, tile_cache_dir=str(cache),
+        )
+        t0 = time.perf_counter()
+        run_tiled_grid_multihost(betas, us, base, str(scratch / "ckpt_cold"), **kwargs)
+        cold_s = time.perf_counter() - t0
+        entries_cold = len(list(cache.rglob("*.npz")))
+
+        before_warm = _cache_counts()
+        t0 = time.perf_counter()
+        run_tiled_grid_multihost(betas, us, base, str(scratch / "ckpt_warm"), **kwargs)
+        warm_s = time.perf_counter() - t0
+        after_warm = _cache_counts()
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    n_tiles = max(entries_cold, 1)
+    warm_hits = after_warm.get("hit", 0) - before_warm.get("hit", 0)
+    hit_rate = min(1.0, max(0.0, warm_hits / n_tiles)) if run is not None else 0.0
+    _log(
+        f"sweep: {n_cells} cells cold in {cold_s:.3f}s, warm in {warm_s:.3f}s "
+        f"({entries_cold} tile(s) cached, {warm_hits} warm hit(s), "
+        f"hit rate {hit_rate:.2f})"
+    )
+    return {
+        "sweep_cells": n_cells,
+        "sweep_tiles": entries_cold,
+        "sweep_cold_s": round(cold_s, 3),
+        "sweep_warm_s": round(warm_s, 3),
+        "sweep_cold_cells_per_sec": round(n_cells / cold_s, 1) if cold_s else 0.0,
+        "sweep_warm_cells_per_sec": round(n_cells / warm_s, 1) if warm_s else 0.0,
+        "sweep_warm_hit_rate": round(hit_rate, 4),
+    }
+
+
 def measure(platform: str) -> None:
     """Measurement child entry: the real body runs inside a
     graceful-shutdown envelope so a preemption (SIGTERM) mid-bench still
@@ -1062,6 +1149,19 @@ def _measure_inner(platform: str) -> None:
             "bench_serve",
             **{k: round(v, 6) if isinstance(v, float) else v for k, v in serve.items()},
         )
+    try:
+        with obs.span("bench.sweep"):
+            sweep = bench_sweep(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the elastic-sweep workload fails.
+        _log(f"sweep bench failed: {err!r}")
+        sweep = None
+    if sweep is not None:
+        obs.event(
+            "bench_sweep",
+            **{k: round(v, 6) if isinstance(v, float) else v for k, v in sweep.items()},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -1104,6 +1204,17 @@ def _measure_inner(platform: str) -> None:
         ):
             if serve.get(k) is not None:
                 out["extra"][k] = serve[k]
+    if sweep is not None:
+        # Schema-4 history metrics: cold/warm tiled-sweep throughput + the
+        # warm cross-run-cache hit rate (`report trend` gates all three).
+        for k in (
+            "sweep_cold_cells_per_sec",
+            "sweep_warm_cells_per_sec",
+            "sweep_warm_hit_rate",
+            "sweep_tiles",
+        ):
+            if sweep.get(k) is not None:
+                out["extra"][k] = sweep[k]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

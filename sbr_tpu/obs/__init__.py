@@ -34,10 +34,13 @@ per-step ABM counters are the design references from PAPERS.md):
   history, `memory` renders/gates per-span/per-tile peak-memory
   attribution, `serve` renders/gates a serving run's rolling live
   telemetry (``live.json`` from `sbr_tpu.serve`; SLO breach = exit 1),
-  `gc` prunes old run directories plus checkpoint debris
-  (``quarantine/``, stale ``tile_*.lease``). Every subcommand takes
-  ``--json``. Reports tolerate torn ``events.jsonl`` lines (counted and
-  surfaced as ``bad_event_lines``).
+  `elastic` renders the elastic-scheduler census (hosts joined/left,
+  claims, tile sources, global-cache outcomes — exit 3 when a churn gate
+  has nothing to read), `gc` prunes old run directories plus checkpoint
+  debris (``quarantine/``, stale ``tile_*.lease``, expired ``host_*.hb``
+  heartbeats) and, with ``--tile-cache``, cold cross-run tile-cache
+  entries. Every subcommand takes ``--json``. Reports tolerate torn
+  ``events.jsonl`` lines (counted and surfaced as ``bad_event_lines``).
 
 Enabling telemetry: set ``SBR_OBS=1`` in the environment (run directories
 land under ``SBR_OBS_DIR``, default ``obs_runs/``), or programmatically::
@@ -66,10 +69,12 @@ from sbr_tpu.obs.runlog import (
     gc_runs,
     interrupt_all,
     jit_call,
+    log_cache,
     log_fault,
     log_health,
     log_repair,
     log_retry,
+    log_scheduler,
     log_status,
     log_tile_mem,
     run_context,
@@ -95,10 +100,12 @@ __all__ = [
     "history",
     "interrupt_all",
     "jit_call",
+    "log_cache",
     "log_fault",
     "log_health",
     "log_repair",
     "log_retry",
+    "log_scheduler",
     "log_status",
     "log_tile_mem",
     "mem",

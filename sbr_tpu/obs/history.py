@@ -42,10 +42,17 @@ from typing import Optional
 # Record schema: 2 added memory metrics (mem_peak_bytes and the per-workload
 # grid/agents peaks from the bench child — ISSUE 5); 3 adds the serving
 # workload's latency/cache metrics (serve_p50_ms / serve_p99_ms /
-# serve_cache_hit_rate — ISSUE 7). Readers accept every version: the key set
-# only grows, and `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1/2 history keeps gating new schema-3 appends.
-SCHEMA = 3
+# serve_cache_hit_rate — ISSUE 7); 4 adds the tiled-sweep workload's
+# cold/warm throughput + warm-cache hit rate (sweep_cold_cells_per_sec /
+# sweep_warm_cells_per_sec / sweep_warm_hit_rate); the elastic scheduler's
+# per-host ``elastic_cells_per_sec`` records live in a SIDECAR file
+# (``<history>.elastic.jsonl`` — the trend gate evaluates only the latest
+# main-history record, so cost-model records must not displace bench
+# lines) and seed `resilience.elastic.seed_rate_from_history` (ISSUE 8).
+# Readers accept every version: the key set only grows, and
+# `load` stamps schema-less legacy lines as 1, so a committed schema-1/2/3
+# history keeps gating new schema-4 appends.
+SCHEMA = 4
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -137,6 +144,12 @@ def bench_metrics(result: dict) -> dict:
         "serve_p50_ms",
         "serve_p99_ms",
         "serve_cache_hit_rate",
+        # schema 4: the tiled-sweep workload (bench.py bench_sweep): cold
+        # compute throughput, warm cross-run-cache re-sweep throughput, and
+        # the warm hit rate (all higher-better by polarity)
+        "sweep_cold_cells_per_sec",
+        "sweep_warm_cells_per_sec",
+        "sweep_warm_hit_rate",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
@@ -196,6 +209,26 @@ def _same_platform(records: list, platform) -> list:
     """Records comparable to ``platform``: exact matches, plus records
     that never recorded one (legacy lines gate against everything)."""
     return [r for r in records if r.get("platform") in (platform, None)]
+
+
+def recent_median(metric: str, path=None, platform=None, window: int = 8):
+    """Median of the most recent ``window`` values of one metric in the
+    history (optionally restricted to ``platform``), or None when the
+    metric has never been recorded — the deterministic seed the elastic
+    scheduler's cost model reads (`resilience.elastic`). jax-free, like
+    everything in this module."""
+    records = load(path)
+    if platform is not None:
+        records = _same_platform(records, platform)
+    vals = [
+        r["metrics"][metric]
+        for r in records
+        if isinstance(r.get("metrics", {}).get(metric), (int, float))
+        and math.isfinite(r["metrics"][metric])
+    ]
+    if not vals:
+        return None
+    return _median(vals[-window:])
 
 
 def check(records: list, tolerance: float = 0.15, min_points: int = 3,

@@ -488,12 +488,15 @@ def _round_to_multiple(shape, multiple_of) -> Tuple[int, int]:
 def gc_debris(root, lease_ttl_s: float = 900.0) -> list:
     """Prune checkpoint debris left by aborted multihost runs under
     ``root``: every ``quarantine/`` directory (corrupt-tile evidence that an
-    explicit gc invocation is entitled to clear) and every stale
+    explicit gc invocation is entitled to clear), every stale
     ``tile_*.lease`` file — stale meaning its tile ``.npz`` already exists
     (completed steal), its holder's TTL lapsed, or the lease is unreadable
-    (torn write from a dead holder). Live leases within TTL are preserved:
-    a running steal must not be yanked out from under its holder. Returns
-    the removed paths. Pure stdlib — safe from the jax-free report CLI."""
+    (torn write from a dead holder) — and every EXPIRED elastic-scheduler
+    heartbeat (``host_*.hb`` whose own TTL lapsed, or unreadable). Live
+    leases within TTL and live heartbeats are preserved: a running steal
+    or a breathing host must not be yanked out from under its holder.
+    Returns the removed paths. Pure stdlib — safe from the jax-free report
+    CLI."""
     root = Path(root)
     removed: list = []
     if not root.is_dir():
@@ -524,13 +527,36 @@ def gc_debris(root, lease_ttl_s: float = 900.0) -> list:
                 removed.append(lease)
             except OSError:
                 pass
-    # Lease-takeover temp files (`tile_*.lease.<pid>.tmp`, written by the
-    # work-stealing expired-lease path just before its os.replace): a
-    # surviving one means the stealer died mid-takeover — always debris.
-    for tmp in sorted(root.rglob("tile_*.lease.*.tmp")):
+    # Expired heartbeats (resilience.elastic): a host that died without a
+    # graceful release ages out via its own recorded TTL; an unreadable
+    # heartbeat is a torn write from a dying host — debris either way.
+    for hb in sorted(root.rglob("host_*.hb")):
+        stale = False
         try:
-            tmp.unlink()
-            removed.append(tmp)
-        except OSError:
-            pass
+            rec = json.loads(hb.read_text())
+            # Fallback mirrors elastic.DEFAULT_HEARTBEAT_TTL_S (kept as a
+            # literal: this module stays stdlib-only for the report CLI;
+            # update BOTH if that constant ever changes) — gc must never
+            # use a SHORTER ttl than live_hosts(), or it would delete a
+            # heartbeat whose host liveness still counts as breathing.
+            ttl = float(rec.get("ttl_s", 300.0))
+            stale = (now - float(rec.get("ts", 0.0))) >= ttl
+        except (OSError, ValueError):
+            stale = True
+        if stale:
+            try:
+                hb.unlink()
+                removed.append(hb)
+            except OSError:
+                pass
+    # Lease-takeover / heartbeat temp files (`*.lease.<pid>.tmp`,
+    # `*.hb.<pid>.tmp`, written just before their os.replace): a surviving
+    # one means the writer died mid-rename — always debris.
+    for pattern in ("tile_*.lease.*.tmp", "host_*.hb.*.tmp"):
+        for tmp in sorted(root.rglob(pattern)):
+            try:
+                tmp.unlink()
+                removed.append(tmp)
+            except OSError:
+                pass
     return removed

@@ -31,10 +31,22 @@ Usage:
                                                     # SBR_SERVE_SLO_MS, cache
                                                     # hit rate under floor),
                                                     # 3 on missing data
+    python -m sbr_tpu.obs.report elastic RUN_DIR    # elastic-scheduler census
+                                                    # (hosts joined/left, tile
+                                                    # claims by source, global
+                                                    # tile-cache outcomes);
+                                                    # exit 3 when no scheduler
+                                                    # events were recorded
     python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs +
                                                     # checkpoint debris
                                                     # (quarantine/, stale
-                                                    # tile_*.lease files)
+                                                    # tile_*.lease files,
+                                                    # expired host_*.hb
+                                                    # heartbeats); with
+                                                    # --tile-cache DIR
+                                                    # --keep-days N also
+                                                    # prunes cold global-
+                                                    # cache entries
 
 Every reporting subcommand (timing render, diff, health, trend) takes
 ``--json`` and then prints one machine-readable JSON document instead of
@@ -638,6 +650,165 @@ def diff(a: dict, b: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Elastic report (`elastic` subcommand — the scheduler/cache renderer/gate)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_fold(events) -> dict:
+    """Fold ``scheduler`` + ``cache`` events (the `resilience.elastic`
+    emissions): per-host membership/throughput, scheduler action counts,
+    tile counts by source, and cache outcome counts. The event log is the
+    source of truth even when a kill -9 meant the manifest roll-up was
+    never finalized (same contract as the resilience report)."""
+    hosts: dict = {}
+    scheduler: dict = {}
+    cache: dict = {}
+    tiles: dict = {}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "scheduler":
+            action = ev.get("action", "?")
+            scheduler[action] = scheduler.get(action, 0) + 1
+            host = ev.get("host")
+            if host:
+                h = hosts.setdefault(
+                    host,
+                    {"tiles_done": 0, "computed": 0, "cached": 0,
+                     "compute_s": 0.0, "compute_cells": 0,
+                     "joined": False, "left": False, "reclaims": 0},
+                )
+                if action == "join":
+                    h["joined"] = True
+                elif action == "leave":
+                    h["left"] = True
+                elif action == "reclaim":
+                    h["reclaims"] += 1
+                elif action == "done":
+                    h["tiles_done"] += 1
+                    source = str(ev.get("source", "?"))
+                    tiles[source] = tiles.get(source, 0) + 1
+                    if source == "computed":
+                        h["computed"] += 1
+                        h["compute_s"] += float(ev.get("dur_s", 0.0))
+                        h["compute_cells"] += int(ev.get("cells", 0))
+                    else:
+                        h["cached"] += 1
+        elif kind == "cache":
+            action = ev.get("action", "?")
+            cache[action] = cache.get(action, 0) + 1
+    for h in hosts.values():
+        h["cells_per_sec"] = (
+            round(h["compute_cells"] / h["compute_s"], 2) if h["compute_s"] > 0 else None
+        )
+    return {"hosts": hosts, "scheduler": scheduler, "cache": cache, "tiles": tiles}
+
+
+def elastic_doc(run: dict) -> tuple:
+    """Machine-readable elastic-scheduler report; returns (doc, exit_code).
+    Exit 0 when scheduler events were recorded, 3 when the run carries no
+    elastic data at all (a churn gate with nothing to read must not pass
+    silently) — there is no failure exit here: unrecovered failures gate
+    via ``report resilience``; this report is the membership/cache census
+    CI asserts counts against (e.g. warm re-sweep ⇒ tiles.computed == 0)."""
+    folded = _elastic_fold(run["events"])
+    manifest_blk = run["manifest"].get("elastic") or {}
+    # Scheduler events (or their manifest roll-up) are the signal that the
+    # run WAS elastic — a cache-only block (plain run_tiled_grid with
+    # SBR_TILE_CACHE_DIR) must not satisfy a churn gate's exit-0 check.
+    code = 3 if not folded["scheduler"] and not manifest_blk.get("scheduler") else 0
+    doc = {
+        "dir": run["dir"],
+        **folded,
+        "manifest": manifest_blk or None,
+        "tiles_computed": folded["tiles"].get("computed", 0),
+        "tiles_from_cache": folded["tiles"].get("cache", 0)
+        + folded["tiles"].get("local", 0),
+        "bad_event_lines": run.get("bad_event_lines", 0),
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_elastic(run: dict) -> tuple:
+    """Human-readable elastic report; same exit contract as `elastic_doc`."""
+    doc, code = elastic_doc(run)
+    out = [f"run      {run['dir']}{_bad_lines_note(run)}"]
+    if code == 3:
+        out.append(
+            "no scheduler events recorded — was the sweep run through the "
+            "elastic scheduler (run_tiled_grid_multihost, SBR_ELASTIC unset/1)?"
+        )
+        return "\n".join(out), code
+    tiles = doc["tiles"]
+    out.append(
+        "elastic  "
+        + ", ".join(f"{tiles.get(k, 0)} {k}" for k in ("computed", "cache", "local"))
+        + f" tile(s) across {len(doc['hosts'])} host(s)"
+    )
+    if doc["hosts"]:
+        out += ["", "HOSTS"]
+        out.append(
+            _table(
+                ["host", "tiles", "computed", "cached", "cells/s", "reclaims", "join", "leave"],
+                [
+                    [
+                        h,
+                        v["tiles_done"],
+                        v["computed"],
+                        v["cached"],
+                        v["cells_per_sec"] if v["cells_per_sec"] is not None else "-",
+                        v["reclaims"] or "-",
+                        "yes" if v["joined"] else "-",
+                        "yes" if v["left"] else "-",
+                    ]
+                    for h, v in sorted(doc["hosts"].items())
+                ],
+            )
+        )
+    if doc["scheduler"]:
+        out += ["", "SCHEDULER EVENTS"]
+        out.append(
+            _table(
+                ["action", "count"],
+                [[k, v] for k, v in sorted(doc["scheduler"].items())],
+            )
+        )
+    if doc["cache"]:
+        out += ["", "GLOBAL TILE CACHE"]
+        out.append(
+            _table(
+                ["outcome", "count"],
+                [[k, v] for k, v in sorted(doc["cache"].items())],
+            )
+        )
+    return "\n".join(out), code
+
+
+def _main_elastic(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report elastic",
+        description="Elastic-scheduler report for one run (hosts, claims, "
+        "tile sources, global-cache outcomes); exit 3 when no scheduler "
+        "events were recorded",
+    )
+    parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    try:
+        run = load_run(args.run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.json:
+        doc, code = elastic_doc(run)
+        print(json.dumps(doc, default=str))
+        return code
+    text, code = render_elastic(run)
+    print(text)
+    return code
+
+
+# ---------------------------------------------------------------------------
 # Memory report (`memory` subcommand — the obs.mem attribution renderer/gate)
 # ---------------------------------------------------------------------------
 
@@ -1126,6 +1297,17 @@ def _main_gc(argv) -> int:
         help="age (s) past which a lease with no recorded TTL counts as "
         "stale (default 900, matching SBR_STEAL_LEASE_TTL_S)",
     )
+    parser.add_argument(
+        "--tile-cache", action="append", default=[], metavar="DIR",
+        help="cross-run global tile cache root(s) (SBR_TILE_CACHE_DIR) to "
+        "prune of COLD entries — not read/written for --keep-days (cache "
+        "hits refresh an entry's mtime, so warm regions are never evicted)",
+    )
+    parser.add_argument(
+        "--keep-days", type=float, default=30.0, metavar="N",
+        help="age (days) past which an unused tile-cache entry is pruned "
+        "(default 30; only with --tile-cache)",
+    )
     args = parser.parse_args(argv)
     import os
 
@@ -1141,9 +1323,19 @@ def _main_gc(argv) -> int:
     for r in [root, *args.checkpoints]:
         debris.extend(mem.gc_debris(r, lease_ttl_s=args.lease_ttl))
     print(f"removed {len(debris)} checkpoint-debris path(s) "
-          "(quarantine/, stale tile_*.lease)")
+          "(quarantine/, stale tile_*.lease, expired host_*.hb)")
     for p in debris:
         print(f"  {p}")
+    if args.tile_cache:
+        from sbr_tpu.resilience.elastic import gc_tile_cache
+
+        pruned = []
+        for c in args.tile_cache:
+            pruned.extend(gc_tile_cache(c, keep_days=args.keep_days))
+        print(f"removed {len(pruned)} cold tile-cache entr(ies) "
+              f"(unused for {args.keep_days:g} days)")
+        for p in pruned:
+            print(f"  {p}")
     return 0
 
 
@@ -1157,6 +1349,8 @@ def main(argv=None) -> int:
         return _main_resilience(argv[1:])
     if argv and argv[0] == "memory":
         return _main_memory(argv[1:])
+    if argv and argv[0] == "elastic":
+        return _main_elastic(argv[1:])
     if argv and argv[0] == "serve":
         return _main_serve(argv[1:])
     if argv and argv[0] == "gc":
@@ -1170,7 +1364,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
-        "'health' / 'resilience' / 'memory' / 'serve' / 'trend' / 'gc' subcommands",
+        "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'trend' / "
+        "'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -183,6 +183,10 @@ class RunContext:
         # Resilience roll-ups (sbr_tpu.resilience): injected-fault firings,
         # retry-engine attempt outcomes, and self-healing repair actions.
         self.resilience: dict = {"faults": {}, "retries": {}, "repairs": {}}
+        # Elastic-scheduler roll-ups (resilience.elastic): scheduler
+        # actions (join/claim/reclaim/done/leave/plan), cross-run tile
+        # cache outcomes (hit/miss/store/quarantine), and tiles by source.
+        self.elastic: dict = {"scheduler": {}, "cache": {}, "tiles": {}}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -579,6 +583,7 @@ class RunContext:
             "memory": self._memory_manifest(),
             "health": self.health or None,
             "resilience": self._resilience_manifest(),
+            "elastic": self._elastic_manifest(),
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -646,10 +651,35 @@ class RunContext:
         agg["count"] += 1
         agg["failed"] += int(not ok)
 
+    def log_scheduler(self, action: str = "?", **fields) -> None:
+        """Emit one elastic-scheduler ``scheduler`` event
+        (`resilience.elastic`) and count it per action in the manifest
+        roll-up; ``done`` events also count their tile ``source``
+        (computed / cache / local) — what `report elastic` gates on."""
+        self.event("scheduler", action=action, **fields)
+        agg = self.elastic["scheduler"]
+        agg[action] = agg.get(action, 0) + 1
+        if action == "done":
+            source = str(fields.get("source", "?"))
+            tiles = self.elastic["tiles"]
+            tiles[source] = tiles.get(source, 0) + 1
+
+    def log_cache(self, action: str = "?", **fields) -> None:
+        """Emit one cross-run tile-cache ``cache`` event
+        (`resilience.elastic.TileCache`) and count it per action."""
+        self.event("cache", action=action, **fields)
+        agg = self.elastic["cache"]
+        agg[action] = agg.get(action, 0) + 1
+
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
             return None
         return {k: v for k, v in self.resilience.items() if v}
+
+    def _elastic_manifest(self) -> Optional[dict]:
+        if not any(self.elastic.values()):
+            return None
+        return {k: v for k, v in self.elastic.items() if v}
 
     def finalize(self, status: str = "complete") -> None:
         """Write the final manifest and close the event log (idempotent).
@@ -865,6 +895,22 @@ def log_repair(action: str = "?", target: str = "?", ok: bool = True, **fields) 
     run = current_run()
     if run is not None and _trace_clean():
         run.log_repair(action, target, ok, **fields)
+
+
+def log_scheduler(action: str = "?", **fields) -> None:
+    """Elastic-scheduler event + manifest roll-up (no-op when telemetry is
+    off or while tracing) — the `resilience.elastic` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_scheduler(action, **fields)
+
+
+def log_cache(action: str = "?", **fields) -> None:
+    """Cross-run tile-cache event + manifest roll-up (no-op when telemetry
+    is off or while tracing) — the `resilience.elastic.TileCache` hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_cache(action, **fields)
 
 
 def interrupt_all() -> int:

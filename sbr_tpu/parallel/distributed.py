@@ -21,6 +21,16 @@ Two multi-host regimes, matching the framework's two parallel programs:
    survivors pick up — the failure-detection analogue of SURVEY §5.3 at
    the cross-host level.
 
+Elastic scheduling (ISSUE 8, `sbr_tpu.resilience.elastic`): by default
+(``SBR_ELASTIC`` unset/1) the sweep farm no longer freezes ownership at
+launch at all — hosts announce heartbeats in the checkpoint dir, claim
+tiles from the remaining queue under a deterministic throughput-weighted
+plan with per-tile lease arbitration, and read/write a cross-run global
+tile cache (``SBR_TILE_CACHE_DIR``). Hosts can join a live sweep and leave
+it (gracefully or by dying) at any point; the assembled grid is
+byte-identical regardless. ``SBR_ELASTIC=0`` (or ``elastic=False``)
+selects the legacy static split + work stealing described below.
+
 Work stealing (`sbr_tpu.resilience`): the filesystem barrier no longer
 just times out on a dead peer. After ``steal_grace_s`` (env
 ``SBR_STEAL_GRACE_S``, default 300 s) with **no new tile landing** — the
@@ -115,10 +125,26 @@ def _cleanup_leases(ckpt) -> None:
 def _try_lease(ckpt, bi: int, ui: int, ttl_s: float) -> bool:
     """Claim the steal-lease for tile (bi, ui): atomic O_EXCL create, or
     take over a lease whose holder's TTL has lapsed. False = a live lease
-    is held by another surviving process (let it work)."""
+    is held by another surviving process (let it work).
+
+    The takeover path writes a per-claim NONCE and re-reads the lease
+    after its ``os.replace``: two survivors racing the same expired lease
+    both replace, but only the one whose nonce survives proceeds — the
+    loser backs off instead of double-computing (ISSUE 8 satellite; the
+    residual replace-after-read window is benign as before: tile writes
+    are atomic and deterministic)."""
+    import uuid
+
     lease = ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease"
+    nonce = uuid.uuid4().hex
     record = json.dumps(
-        {"pid": os.getpid(), "host": socket.gethostname(), "ts": time.time(), "ttl_s": ttl_s}
+        {
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "nonce": nonce,
+            "ts": time.time(),
+            "ttl_s": ttl_s,
+        }
     )
     try:
         fd = os.open(lease, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -126,21 +152,22 @@ def _try_lease(ckpt, bi: int, ui: int, ttl_s: float) -> bool:
         try:
             held = json.loads(lease.read_text())
             # Honor the TTL the HOLDER wrote (it sized the lease to its own
-            # batch), falling back to ours for pre-TTL-field leases.
+            # batch), falling back to ours for pre-TTL-field leases. Strict
+            # `<`: a lease EXACTLY at its TTL boundary is expired.
             if time.time() - float(held.get("ts", 0.0)) < float(held.get("ttl_s", ttl_s)):
                 return False
         except (OSError, ValueError):
             pass  # unreadable lease = a torn write from a dead holder
-        # Expired: take over. The replace can race another stealer doing the
-        # same — both then compute the tile, which is benign (atomic,
-        # deterministic, identical writes).
         tmp = ckpt / f"{lease.name}.{os.getpid()}.tmp"
         try:
             tmp.write_text(record)
             os.replace(tmp, lease)
-        except OSError:
+            # Verify WE won: re-read and check our nonce survived. A racer
+            # replacing after us makes its nonce the survivor; we yield.
+            now_held = json.loads(lease.read_text())
+        except (OSError, ValueError):
             return False
-        return True
+        return now_held.get("nonce") == nonce
     else:
         with os.fdopen(fd, "w") as f:
             f.write(record)
@@ -164,14 +191,34 @@ def run_tiled_grid_multihost(
     work_steal: bool = True,
     steal_grace_s: Optional[float] = None,
     lease_ttl_s: Optional[float] = None,
+    elastic: Optional[bool] = None,
+    heartbeat_ttl_s: Optional[float] = None,
+    tile_cache_dir=None,
 ):
     """Farm a β×u grid across processes via the shared checkpoint dir.
 
-    Each process computes only its `tile_assignment` share (plus anything
-    already on disk); coordination is purely filesystem-level, so this
-    works across hosts that share nothing but storage — no collectives, no
-    jax.distributed requirement (use it when a mesh-spanning program is
-    also running; not needed here).
+    **Elastic mode (the default — ISSUE 8).** Ownership is not a
+    launch-time split at all: every host runs the elastic scheduler
+    (`resilience.elastic.run_elastic_grid`) against the shared dir —
+    heartbeat membership, a deterministic throughput-weighted claim plan
+    over the remaining tile queue, per-tile lease arbitration, and the
+    cross-run global tile cache (``tile_cache_dir`` /
+    ``SBR_TILE_CACHE_DIR``). Hosts may join a running sweep at any time
+    and adopt unowned/expired tiles immediately; a host that leaves
+    (SIGTERM, or silence past the TTLs) has its tiles reclaimed without
+    waiting for the end-of-sweep barrier. ``process_id``/``num_processes``
+    are accepted but unused for ownership (any number of peers, fixed
+    nowhere). Opt out per call (``elastic=False``), process-wide
+    (``SBR_ELASTIC=0``), or by passing ``work_steal=False`` — whose
+    own-share-only / dead-peer-times-out contract the always-adopting
+    elastic scheduler cannot honor — to get the legacy static split below.
+
+    **Legacy static split (``elastic=False``).** Each process computes only
+    its `tile_assignment` share (plus anything already on disk);
+    coordination is purely filesystem-level, so this works across hosts
+    that share nothing but storage — no collectives, no jax.distributed
+    requirement (use it when a mesh-spanning program is also running; not
+    needed here).
 
     With ``wait`` (default), after finishing its share the process polls
     until every tile exists, then assembles and returns the full grid.
@@ -197,12 +244,25 @@ def run_tiled_grid_multihost(
     are cached) and hands each call its own plan record, which the OOM
     preflight consumes instead of paying a full worst-case-tile compile.
     """
+    from sbr_tpu.resilience import elastic as elastic_mod
     from sbr_tpu.utils.checkpoint import (
         _tile_path,
         resolve_tile_shape,
         run_tiled_grid,
         tile_origins,
     )
+
+    # work_steal=False promises "this process computes ONLY its own share
+    # and a dead peer surfaces as TimeoutError" — a contract the elastic
+    # scheduler (which always adopts unleased tiles) cannot honor, so that
+    # flag selects the legacy static split even when elastic is on.
+    if elastic_mod.elastic_enabled(elastic) and work_steal:
+        return elastic_mod.run_elastic_grid(
+            beta_values, u_values, base, checkpoint_dir, config=config,
+            tile_shape=tile_shape, dtype=dtype, wait=wait, poll_s=poll_s,
+            timeout_s=timeout_s, verbose=verbose, lease_ttl_s=lease_ttl_s,
+            heartbeat_ttl_s=heartbeat_ttl_s, tile_cache_dir=tile_cache_dir,
+        )
 
     if process_id is None or num_processes is None:
         import jax
@@ -235,8 +295,13 @@ def run_tiled_grid_multihost(
 
     # Filesystem barrier: every tile must exist before assembly. After the
     # steal grace period, missing tiles are adopted under leases instead of
-    # waited on forever (a dead peer's share must not require a human).
+    # waited on forever (a dead peer's share must not require a human). The
+    # whole barrier runs under the graceful-shutdown envelope so a SIGTERM
+    # during a poll sleep still releases any held leases (the registry in
+    # `resilience.shutdown`) instead of stranding them until TTL.
     from pathlib import Path
+
+    from sbr_tpu.resilience import shutdown
 
     if steal_grace_s is None:
         steal_grace_s = float(os.environ.get("SBR_STEAL_GRACE_S", "300"))
@@ -252,56 +317,63 @@ def run_tiled_grid_multihost(
     # stalled remainder triggers adoption.
     last_progress = t0
     n_missing_prev: Optional[int] = None
-    while True:
-        missing = [t for t in tiles if not _tile_path(ckpt, *t).exists()]
-        if not missing:
-            break
-        if n_missing_prev is not None and len(missing) < n_missing_prev:
-            last_progress = time.monotonic()
-        n_missing_prev = len(missing)
-        faults.fire("barrier.poll", target=f"missing={len(missing)}")
-        if work_steal and time.monotonic() - last_progress >= steal_grace_s:
-            # Lease the whole stalled batch first, then compute it in ONE
-            # resilient pass (retry policy, verify-on-load, degrade ladder):
-            # cached tiles are read exactly once, not once per adoption.
-            leased = [
-                (bi, ui)
-                for bi, ui in missing
-                if not _tile_path(ckpt, bi, ui).exists()
-                and _try_lease(ckpt, bi, ui, lease_ttl_s)
-            ]
-            if leased:
-                leased_set = set(leased)
-                if verbose:
-                    print(f"  adopting {len(leased)} orphaned tile(s): {leased} …")
-                try:
-                    run_tiled_grid(
-                        beta_values, u_values, base, config=config,
-                        tile_shape=tile_shape, checkpoint_dir=checkpoint_dir,
-                        dtype=dtype, verbose=False,
-                        tile_owner=lambda b, u: (b, u) in leased_set,
-                    )
-                    for bi, ui in leased:
-                        _log_adopt(f"tile_b{bi:05d}_u{ui:05d}", ok=True)
-                finally:
-                    for bi, ui in leased:
-                        try:
-                            (ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease").unlink()
-                        except OSError:
-                            pass
-                last_progress = time.monotonic()  # we made the progress
-                continue  # re-check the barrier immediately
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"{len(missing)} tiles still missing after {timeout_s:.0f}s "
-                f"(first: {missing[0]}); a peer process likely died and its "
-                "tiles could not be adopted (work stealing "
-                f"{'on' if work_steal else 'off'}) — rerun with its "
-                "process_id (or a smaller num_processes) to adopt its tiles."
-            )
-        if verbose:
-            print(f"  waiting on {len(missing)} peer tiles …")
-        time.sleep(poll_s)
+    with shutdown.graceful_shutdown(label="multihost_barrier"):
+        while True:
+            missing = [t for t in tiles if not _tile_path(ckpt, *t).exists()]
+            if not missing:
+                break
+            if n_missing_prev is not None and len(missing) < n_missing_prev:
+                last_progress = time.monotonic()
+            n_missing_prev = len(missing)
+            faults.fire("barrier.poll", target=f"missing={len(missing)}")
+            if work_steal and time.monotonic() - last_progress >= steal_grace_s:
+                # Lease the whole stalled batch first, then compute it in ONE
+                # resilient pass (retry policy, verify-on-load, degrade ladder):
+                # cached tiles are read exactly once, not once per adoption.
+                leased = []
+                for bi, ui in missing:
+                    if _tile_path(ckpt, bi, ui).exists():
+                        continue
+                    if _try_lease(ckpt, bi, ui, lease_ttl_s):
+                        # Register IMMEDIATELY: a SIGTERM between acquiring
+                        # this lease and finishing the batch must still
+                        # release it, not strand it until the TTL.
+                        shutdown.release_on_exit(ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease")
+                        leased.append((bi, ui))
+                if leased:
+                    leased_set = set(leased)
+                    if verbose:
+                        print(f"  adopting {len(leased)} orphaned tile(s): {leased} …")
+                    try:
+                        run_tiled_grid(
+                            beta_values, u_values, base, config=config,
+                            tile_shape=tile_shape, checkpoint_dir=checkpoint_dir,
+                            dtype=dtype, verbose=False,
+                            tile_owner=lambda b, u: (b, u) in leased_set,
+                        )
+                        for bi, ui in leased:
+                            _log_adopt(f"tile_b{bi:05d}_u{ui:05d}", ok=True)
+                    finally:
+                        for bi, ui in leased:
+                            lease = ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease"
+                            try:
+                                lease.unlink()
+                            except OSError:
+                                pass
+                            shutdown.unregister_release(lease)
+                    last_progress = time.monotonic()  # we made the progress
+                    continue  # re-check the barrier immediately
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(missing)} tiles still missing after {timeout_s:.0f}s "
+                    f"(first: {missing[0]}); a peer process likely died and its "
+                    "tiles could not be adopted (work stealing "
+                    f"{'on' if work_steal else 'off'}) — rerun with its "
+                    "process_id (or a smaller num_processes) to adopt its tiles."
+                )
+            if verbose:
+                print(f"  waiting on {len(missing)} peer tiles …")
+            time.sleep(poll_s)
 
     # Assembly: all tiles cached on disk — a pure read, no recompute.
     _cleanup_leases(ckpt)

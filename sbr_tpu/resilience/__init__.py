@@ -17,7 +17,13 @@ modules:
   ladder that re-runs health-divergent cells at float64 with tightened
   tolerances (obs ``repair`` events + checkpoint ``repairs`` block).
 - ``shutdown`` — graceful SIGTERM/SIGINT: finalize obs manifests as
-  ``"interrupted"`` and remove partial temp files before exit.
+  ``"interrupted"``, remove partial temp files, and release held
+  coordination files (tile leases, the elastic heartbeat) before exit.
+- ``elastic``  — the elastic sweep scheduler (ISSUE 8): heartbeat
+  membership in the checkpoint dir, deterministic throughput-weighted
+  claim plans over the remaining tile queue, and the cross-run global
+  tile cache (``SBR_TILE_CACHE_DIR``) behind
+  `parallel.run_tiled_grid_multihost`.
 - ``chaos``    — the CI chaos smoke: a seeded fault plan (transient
   errors, a corrupted tile, a preemption) must yield a final grid
   bit-identical to the fault-free run (``python -m
@@ -30,7 +36,8 @@ Render what happened with ``python -m sbr_tpu.obs.report resilience
 RUN_DIR`` (exit 1 on unrecovered failures).
 """
 
-from sbr_tpu.resilience import faults, heal, retry, shutdown
+from sbr_tpu.resilience import elastic, faults, heal, retry, shutdown
+from sbr_tpu.resilience.elastic import TileCache
 from sbr_tpu.resilience.faults import FaultPlan, InjectedFault
 from sbr_tpu.resilience.retry import RetryBudget, RetryError, RetryPolicy, policy_from_env
 from sbr_tpu.resilience.shutdown import graceful_shutdown
@@ -41,6 +48,8 @@ __all__ = [
     "RetryBudget",
     "RetryError",
     "RetryPolicy",
+    "TileCache",
+    "elastic",
     "faults",
     "graceful_shutdown",
     "heal",

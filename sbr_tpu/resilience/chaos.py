@@ -21,6 +21,18 @@ manifest in the chaos run, a quarantine repair in the resume run, and
 chaos-smoke job; it is equally useful locally after touching any
 resilience path.
 
+``python -m sbr_tpu.resilience.chaos --churn`` runs the ELASTIC churn
+smoke (ISSUE 8) instead: a fault-free single-host baseline, then an
+elastic sweep whose first host is preempted mid-run (SIGTERM after two of
+four tiles — graceful shutdown must release its leases and heartbeat
+immediately), a late-joining replacement host that adopts the remaining
+tiles and assembles, and finally a warm re-sweep of the SAME parameter
+grid into a FRESH checkpoint dir with the cross-run global tile cache
+(``SBR_TILE_CACHE_DIR``) now hot. It passes only if both the churned and
+the warm grids are bit-identical to the baseline, the preempted host left
+no leases or heartbeats behind, and ``report elastic`` proves the warm
+sweep computed ZERO tiles (every tile a cache hit).
+
 The driver itself never imports jax (workers are subprocesses), so it can
 run on a box whose accelerator stack is itself the thing being debugged.
 """
@@ -55,19 +67,27 @@ FAULT_PLAN = {
 
 _FIELDS = ("max_aw", "xi", "status")
 
+# One fixed small sweep shared by every worker mode so grids are
+# byte-comparable across phases: 4×4 β×u grid under 2×2 tiles.
+_SWEEP = dict(n_grid=96, bisect_iters=40, tile_shape=(2, 2))
+
+
+def _sweep_values():
+    return np.linspace(0.5, 2.0, 4), np.linspace(0.05, 0.5, 4)
+
 
 def _worker(ckpt_dir: str, out_npz: str) -> int:
     """One tiled sweep (fixed small shape), grids saved as npz."""
     from sbr_tpu.models.params import SolverConfig, make_model_params
     from sbr_tpu.utils.checkpoint import run_tiled_grid
 
-    cfg = SolverConfig(n_grid=96, bisect_iters=40)
+    betas, us = _sweep_values()
     grid = run_tiled_grid(
-        np.linspace(0.5, 2.0, 4),
-        np.linspace(0.05, 0.5, 4),
+        betas,
+        us,
         make_model_params(),
-        config=cfg,
-        tile_shape=(2, 2),
+        config=SolverConfig(n_grid=_SWEEP["n_grid"], bisect_iters=_SWEEP["bisect_iters"]),
+        tile_shape=_SWEEP["tile_shape"],
         checkpoint_dir=ckpt_dir,
     )
     arrays = {f: np.asarray(getattr(grid, f)) for f in _FIELDS}
@@ -76,7 +96,32 @@ def _worker(ckpt_dir: str, out_npz: str) -> int:
     return 0
 
 
-def _run_phase(name: str, out: Path, ckpt: Path, npz, fault_plan=None, timeout_s=600.0):
+def _worker_elastic(ckpt_dir: str, out_npz: str) -> int:
+    """The same sweep through the ELASTIC scheduler (heartbeats, claim
+    plan, leases, global tile cache from SBR_TILE_CACHE_DIR)."""
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.parallel import run_tiled_grid_multihost
+
+    betas, us = _sweep_values()
+    grid = run_tiled_grid_multihost(
+        betas,
+        us,
+        make_model_params(),
+        ckpt_dir,
+        config=SolverConfig(n_grid=_SWEEP["n_grid"], bisect_iters=_SWEEP["bisect_iters"]),
+        tile_shape=_SWEEP["tile_shape"],
+        poll_s=0.2,
+        timeout_s=300.0,
+        elastic=True,
+    )
+    arrays = {f: np.asarray(getattr(grid, f)) for f in _FIELDS}
+    with open(out_npz, "wb") as fh:
+        np.savez(fh, **arrays)
+    return 0
+
+
+def _run_phase(name: str, out: Path, ckpt: Path, npz, fault_plan=None,
+               timeout_s=600.0, mode: str = "--worker", extra_env=None):
     """Run one worker subprocess; returns (rc, obs_run_dir_or_None)."""
     obs_root = out / f"obs_{name}"
     env = {
@@ -88,11 +133,14 @@ def _run_phase(name: str, out: Path, ckpt: Path, npz, fault_plan=None, timeout_s
         "SBR_RETRY_BASE_DELAY_S": "0.05",
     }
     env.pop("SBR_FAULT_PLAN", None)
+    env.pop("SBR_TILE_CACHE_DIR", None)
     if fault_plan is not None:
         env["SBR_FAULT_PLAN"] = json.dumps(fault_plan)
+    if extra_env:
+        env.update(extra_env)
     argv = [
         sys.executable, "-m", "sbr_tpu.resilience.chaos",
-        "--worker", str(ckpt), str(npz if npz else out / f"{name}.npz"),
+        mode, str(ckpt), str(npz if npz else out / f"{name}.npz"),
     ]
     proc = subprocess.run(
         argv, env=env, timeout=timeout_s, capture_output=True, text=True
@@ -110,16 +158,130 @@ def _manifest(run_dir) -> dict:
         return {}
 
 
-def _report_resilience(run_dir) -> tuple:
-    """(exit_code, json_doc) from the report CLI — the user-facing gate."""
+def _report(subcommand: str, run_dir) -> tuple:
+    """(exit_code, json_doc) from a report subcommand — user-facing gates."""
     proc = subprocess.run(
-        [sys.executable, "-m", "sbr_tpu.obs.report", "resilience", str(run_dir), "--json"],
+        [sys.executable, "-m", "sbr_tpu.obs.report", subcommand, str(run_dir), "--json"],
         capture_output=True, text=True, timeout=120.0,
     )
     try:
         return proc.returncode, json.loads(proc.stdout)
     except json.JSONDecodeError:
         return proc.returncode, {}
+
+
+def _report_resilience(run_dir) -> tuple:
+    return _report("resilience", run_dir)
+
+
+def _bit_identical(a_npz, b_npz) -> bool:
+    try:
+        want, got = np.load(a_npz), np.load(b_npz)
+    except OSError:
+        return False
+    return all(want[f].tobytes() == got[f].tobytes() for f in _FIELDS)
+
+
+# Churn plan: preempt host A at its THIRD tile compute — two tiles land,
+# the third is interrupted mid-flight; graceful shutdown must release A's
+# lease + heartbeat so the late-joining replacement claims immediately.
+CHURN_FAULT_PLAN = {
+    "seed": 0,
+    "rules": [
+        {"point": "tile.compute", "kind": "preempt", "at_hits": [3]},
+    ],
+}
+
+
+def main_churn(out: Path, as_json: bool) -> int:
+    """The elastic churn smoke: kill one host mid-sweep, late-join a
+    replacement, then warm-cache re-sweep — all three grids bit-identical,
+    zero tiles computed warm. See the module docstring."""
+    checks: dict = {}
+    cache = out / "tile_cache"
+    elastic_env = {
+        "SBR_TILE_CACHE_DIR": str(cache),
+        "SBR_ELASTIC": "1",
+        "SBR_HEARTBEAT_TTL_S": "10",
+    }
+
+    def log(msg):
+        if not as_json:
+            print(msg)
+
+    log("phase 1/4: fault-free single-host baseline (no cache) …")
+    rc, _ = _run_phase("baseline", out, out / "ckpt_baseline", out / "baseline.npz")
+    checks["baseline_rc0"] = rc == 0
+
+    log("phase 2/4: elastic host A — preempted (SIGTERM) after 2 of 4 tiles …")
+    ckpt_churn = out / "ckpt_churn"
+    rc, run_a = _run_phase(
+        "churn_a", out, ckpt_churn, out / "churn_a.npz",
+        fault_plan=CHURN_FAULT_PLAN, mode="--worker-elastic", extra_env=elastic_env,
+    )
+    checks["host_a_preempted_143"] = rc == 143
+    checks["host_a_manifest_interrupted"] = _manifest(run_a).get("status") == "interrupted"
+    # Even a preempted host's departure is in the census: its "leave"
+    # event lands before the obs run finalizes as interrupted.
+    _, doc_a = _report("elastic", run_a) if run_a else (2, {})
+    checks["host_a_leave_visible"] = (doc_a.get("scheduler") or {}).get("leave", 0) >= 1
+    # The graceful-shutdown satellite: A's leases AND heartbeat are
+    # RELEASED at SIGTERM, so the replacement never waits out a TTL.
+    checks["host_a_released_leases"] = not list(ckpt_churn.glob("*.lease"))
+    checks["host_a_released_heartbeat"] = not list(ckpt_churn.glob("host_*.hb"))
+    checks["host_a_partial_tiles"] = len(list(ckpt_churn.glob("tile_*.npz"))) == 2
+
+    log("phase 3/4: elastic host B late-joins, adopts the rest, assembles …")
+    rc, run_b = _run_phase(
+        "churn_b", out, ckpt_churn, out / "churn.npz",
+        mode="--worker-elastic", extra_env=elastic_env,
+    )
+    checks["host_b_rc0"] = rc == 0
+    rc_el, doc_b = _report("elastic", run_b) if run_b else (2, {})
+    checks["host_b_report_elastic_rc0"] = rc_el == 0
+    sched_b = doc_b.get("scheduler") or {}
+    checks["host_b_join_leave_visible"] = (
+        sched_b.get("join", 0) >= 1 and sched_b.get("leave", 0) >= 1
+    )
+    checks["host_b_adopted_tiles"] = doc_b.get("tiles_computed", 0) == 2
+    checks["churn_grid_bit_identical"] = _bit_identical(
+        out / "baseline.npz", out / "churn.npz"
+    )
+
+    log("phase 4/4: warm re-sweep — fresh checkpoint dir, hot global cache …")
+    rc, run_w = _run_phase(
+        "warm", out, out / "ckpt_warm", out / "warm.npz",
+        mode="--worker-elastic", extra_env=elastic_env,
+    )
+    checks["warm_rc0"] = rc == 0
+    rc_el, doc_w = _report("elastic", run_w) if run_w else (2, {})
+    checks["warm_report_elastic_rc0"] = rc_el == 0
+    # The bridge from "one sweep survives faults" to "a fleet serves
+    # sweeps incrementally": a repeated sweep recomputes NOTHING.
+    checks["warm_zero_tiles_computed"] = (
+        doc_w.get("tiles_computed", 1) == 0
+        and doc_w.get("tiles_from_cache", 0) >= 4
+    )
+    checks["warm_all_tiles_cache_hits"] = (doc_w.get("cache") or {}).get("hit", 0) >= 4
+    checks["warm_grid_bit_identical"] = _bit_identical(
+        out / "baseline.npz", out / "warm.npz"
+    )
+
+    ok = all(checks.values())
+    if as_json:
+        print(json.dumps({"ok": ok, "checks": checks, "out": str(out)}))
+    else:
+        for name, passed in checks.items():
+            print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+        print(
+            "churn smoke: "
+            + ("OK — churn is bit-exact and the warm sweep computed 0 tiles"
+               if ok else "FAILED")
+            + f" ({out})"
+        )
+        if run_b is not None:
+            print(f"scheduler story: python -m sbr_tpu.obs.report elastic {run_b}")
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -130,16 +292,29 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--out", default="/tmp/sbr_chaos", help="scratch/artifact dir")
     parser.add_argument("--json", action="store_true", help="machine-readable verdict")
+    parser.add_argument(
+        "--churn", action="store_true",
+        help="run the ELASTIC churn smoke instead: preempt one host "
+        "mid-sweep, late-join a replacement, warm-cache re-sweep — "
+        "bit-identical grids, zero warm recomputes (ISSUE 8)",
+    )
     parser.add_argument("--worker", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
+    parser.add_argument("--worker-elastic", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
 
     if args.worker:
         return _worker(*args.worker)
+    if args.worker_elastic:
+        return _worker_elastic(*args.worker_elastic)
 
     out = Path(args.out)
     if out.exists():
         shutil.rmtree(out)
     out.mkdir(parents=True)
+
+    if args.churn:
+        return main_churn(out, args.json)
+
     checks: dict = {}
 
     def log(msg):

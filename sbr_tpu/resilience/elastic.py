@@ -1,0 +1,777 @@
+"""Elastic sweep scheduler: hosts join/leave a live tiled sweep, tile
+ownership rebalances by measured throughput, and a cross-run global tile
+cache makes repeated/overlapping sweeps incremental (ISSUE 8).
+
+PR 4's lease-based work stealing proved a faulted+resumed sweep stays
+byte-identical; this module promotes that substrate into a real elastic
+scheduler — the preemptible-TPU-pod model where the roster is never fixed:
+
+**Membership.** Every participating host announces itself with a heartbeat
+file (``host_<id>.hb``, JSON ``{host, pid, ts, ttl_s, tiles_done,
+cells_per_sec}``) in the shared checkpoint dir, refreshed between tiles —
+the same filesystem-rendezvous discipline as the tile files themselves, so
+membership needs no coordinator. A host that JOINS a running sweep simply
+starts claiming unowned tiles from the remaining queue; a host that LEAVES
+gracefully (SIGTERM/SIGINT → `resilience.shutdown`) releases its held
+leases and heartbeat so peers reclaim its work at their next poll, and a
+host that dies silently ages out via the lease/heartbeat TTLs
+(``SBR_STEAL_LEASE_TTL_S`` / ``SBR_HEARTBEAT_TTL_S``).
+
+**Throughput-aware rebalancing.** There is no launch-time modulo split:
+each poll, every host derives the SAME deterministic claim plan
+(`plan_claims`) — greedy longest-processing-time assignment of the
+remaining tiles over the live hosts, weighted by each host's published
+cells/sec (measured in-run as an EWMA, seeded from the PR 3 perf history's
+``elastic_cells_per_sec`` records) — and tries to lease its own share
+first, falling back to any unleased tile so the queue is always
+work-conserving. Fast hosts therefore claim proportionally more of the
+remaining queue, and the per-tile lease files (atomic ``O_EXCL`` create,
+TTL takeover — `parallel.distributed._try_lease`) stay the single
+arbiter, so a plan disagreement can only ever cost a benign duplicate
+compute, never a wrong grid.
+
+**Cross-run global tile cache.** `TileCache` (root ``SBR_TILE_CACHE_DIR``)
+is a content-addressed store keyed by the sha256 of the canonicalized
+(params, config, dtype, x64 flag, grid-program version, tile β values,
+tile u values) — built on `utils.checkpoint.canonicalize`, the same
+machinery as `params_fingerprint` — so a tile computed by ANY sweep is
+reusable by every later sweep whose cell numerics match, including
+overlapping grids. Entries carry sha256 sidecars (`resilience.heal`) and
+are verified on read: a mismatch is quarantined beside the cache and the
+tile recomputed, never trusted. Hits refresh the entry mtime, which is
+what ``report gc --tile-cache DIR --keep-days N`` uses to prune cold
+entries.
+
+Every membership change, claim, completion, and cache outcome is an obs
+``scheduler`` / ``cache`` event (``python -m sbr_tpu.obs.report elastic
+RUN_DIR`` renders and gates them), and the PR 4 invariant is preserved:
+any churn schedule yields a grid byte-identical to the fault-free
+single-host run (asserted in CI by ``python -m sbr_tpu.resilience.chaos
+--churn``).
+
+Module import stays jax-free (stdlib + numpy): the report CLI imports it
+for cache gc, and all sbr_tpu machinery is imported lazily inside the
+functions that need a live solver.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import socket
+import tempfile
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_FIELDS = ("max_aw", "xi", "status")  # mirrors utils.checkpoint._FIELDS
+
+# Heartbeats refresh at tile boundaries (never mid-compute), so the TTL
+# must comfortably exceed the worst-case tile wall-clock or a working host
+# reads as dead between beats. 300 s covers paper-resolution tiles with
+# margin; size SBR_HEARTBEAT_TTL_S to your tile duration, not your
+# failure-detection appetite — the lease TTL protects claimed tiles.
+DEFAULT_HEARTBEAT_TTL_S = 300.0
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+
+def elastic_enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the elastic opt-out: an explicit ``flag`` wins, else
+    ``SBR_ELASTIC`` (default ON — set ``SBR_ELASTIC=0`` for the legacy
+    launch-time static split)."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("SBR_ELASTIC", "").strip() != "0"
+
+
+def heartbeat_ttl_s(value: Optional[float] = None) -> float:
+    if value is not None:
+        return float(value)
+    raw = os.environ.get("SBR_HEARTBEAT_TTL_S", "").strip()
+    return float(raw) if raw else DEFAULT_HEARTBEAT_TTL_S
+
+
+def default_tile_cache(cache_dir=None) -> Optional["TileCache"]:
+    """The cross-run cache from ``SBR_TILE_CACHE_DIR`` (None = disabled)."""
+    root = cache_dir or os.environ.get("SBR_TILE_CACHE_DIR", "").strip()
+    return TileCache(root) if root else None
+
+
+_HOST_ID: Optional[str] = None
+
+
+def host_identity() -> str:
+    """Stable per-process host id: hostname + pid + a random suffix so two
+    workers on one box (or a fast pid reuse) can never share an identity."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        name = re.sub(r"[^A-Za-z0-9_.-]", "-", socket.gethostname())[:48]
+        _HOST_ID = f"{name}-p{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    return _HOST_ID
+
+
+# ---------------------------------------------------------------------------
+# Telemetry hooks (guarded: telemetry must never sink the scheduler)
+# ---------------------------------------------------------------------------
+
+
+def _log_sched(action: str, **fields) -> None:
+    try:
+        from sbr_tpu import obs
+
+        obs.log_scheduler(action=action, **fields)
+    except Exception:
+        pass
+
+
+def _log_cache(action: str, **fields) -> None:
+    try:
+        from sbr_tpu import obs
+
+        obs.log_cache(action=action, **fields)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Membership: heartbeat files beside the tiles
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_path(ckpt_dir, host: str) -> Path:
+    return Path(ckpt_dir) / f"host_{host}.hb"
+
+
+class Heartbeat:
+    """One host's liveness record in the checkpoint dir (atomic rewrite,
+    TTL like leases). Registered with `resilience.shutdown` so a graceful
+    preemption hands the slot back immediately instead of aging out."""
+
+    def __init__(self, ckpt_dir, host: Optional[str] = None, ttl_s: Optional[float] = None):
+        self.host = host or host_identity()
+        self.ttl_s = heartbeat_ttl_s(ttl_s)
+        self.path = heartbeat_path(ckpt_dir, self.host)
+        self.started_at = time.time()
+
+    def beat(self, **stats) -> None:
+        rec = {
+            "host": self.host,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "ts": time.time(),
+            "ttl_s": self.ttl_s,
+            "started_at": self.started_at,
+            **stats,
+        }
+        # A beat is pure liveness telemetry: a transient hiccup on the
+        # shared volume (EIO/ESTALE/ENOSPC) must not sink the sweep host —
+        # the next beat retries, and worst case peers briefly replan around
+        # us (benign: leases still protect claimed tiles).
+        try:
+            tmp = Path(f"{self.path}.{os.getpid()}.tmp")
+            tmp.write_text(json.dumps(rec))
+            os.replace(tmp, self.path)
+        except OSError:
+            return
+        try:
+            from sbr_tpu.resilience import shutdown
+
+            shutdown.release_on_exit(self.path)
+        except Exception:
+            pass
+
+    def withdraw(self) -> None:
+        try:
+            from sbr_tpu.resilience import shutdown
+
+            shutdown.unregister_release(self.path)
+        except Exception:
+            pass
+        try:
+            self.path.unlink()
+        except OSError:
+            pass
+
+
+def live_hosts(ckpt_dir, now: Optional[float] = None) -> Dict[str, dict]:
+    """Parse every heartbeat in the dir; returns {host_id: record} for
+    hosts whose TTL has not lapsed. Unreadable heartbeats (torn write from
+    a dying host) count as dead."""
+    now = time.time() if now is None else now
+    out: Dict[str, dict] = {}
+    for hb in sorted(Path(ckpt_dir).glob("host_*.hb")):
+        try:
+            rec = json.loads(hb.read_text())
+            ts = float(rec.get("ts", 0.0))
+            ttl = float(rec.get("ttl_s", DEFAULT_HEARTBEAT_TTL_S))
+        except (OSError, ValueError):
+            continue
+        if now - ts < ttl:
+            out[str(rec.get("host", hb.stem[len("host_"):]))] = rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Deterministic cost model + rebalancing plan
+# ---------------------------------------------------------------------------
+
+
+def recorded_tile_shape(checkpoint_dir) -> Optional[Tuple[int, int]]:
+    """The RESOLVED tile shape the sweep's creating host recorded in the
+    checkpoint manifest (`utils.checkpoint._check_fingerprint`) — what a
+    late joiner with ``tile_shape="auto"`` must adopt: re-planning from its
+    OWN device capacity would fingerprint-mismatch on a heterogeneous
+    fleet instead of joining. None for a fresh dir or a pre-ISSUE-8
+    manifest (the joiner then resolves locally, the historical behavior)."""
+    try:
+        doc = json.loads((Path(checkpoint_dir) / "manifest.json").read_text())
+        shape = doc.get("tile_shape")
+        if isinstance(shape, list) and len(shape) == 2:
+            return int(shape[0]), int(shape[1])
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def tile_cells(origin: Tuple[int, int], nb: int, nu: int, tile_shape: Tuple[int, int]) -> int:
+    bi, ui = origin
+    tb, tu = tile_shape
+    return max(0, min(tb, nb - bi)) * max(0, min(tu, nu - ui))
+
+
+def plan_claims(
+    tiles: List[Tuple[Tuple[int, int], float]],
+    rates: Dict[str, float],
+) -> Dict[str, List[Tuple[int, int]]]:
+    """Deterministic throughput-weighted LPT assignment of the remaining
+    tile queue over the live hosts.
+
+    ``tiles`` is ``[(origin, cost), ...]`` (cost in cells, or seconds —
+    any consistent unit); ``rates`` maps host id → published throughput
+    (cells/sec; non-positive/missing treated as 1.0). Tiles are placed
+    largest-cost-first onto the host with the smallest projected finish
+    time ``(load + cost) / rate`` (ties broken by host id, then plan
+    order), so every host computes the IDENTICAL plan from the same
+    heartbeat snapshot — coordination-free rebalancing, with the per-tile
+    leases as the actual arbiter when snapshots momentarily differ.
+    """
+    hosts = sorted(rates)
+    plan: Dict[str, List[Tuple[int, int]]] = {h: [] for h in hosts}
+    if not hosts:
+        return plan
+    eff = {h: (float(rates[h]) if float(rates.get(h) or 0.0) > 0 else 1.0) for h in hosts}
+    loads = {h: 0.0 for h in hosts}
+    # Largest cost first (LPT); origin tie-break keeps the order total.
+    for origin, cost in sorted(tiles, key=lambda tc: (-tc[1], tc[0])):
+        best = min(hosts, key=lambda h: ((loads[h] + cost) / eff[h], h))
+        plan[best].append(origin)
+        loads[best] += float(cost)
+    return plan
+
+
+class ThroughputTracker:
+    """EWMA cells/sec for THIS host, seeded from the perf history so a
+    rejoining host starts from its fleet-typical rate instead of 1.0."""
+
+    def __init__(self, seed_rate: Optional[float] = None, alpha: float = 0.5):
+        self.rate = seed_rate
+        self.alpha = alpha
+
+    def update(self, cells: int, dur_s: float) -> None:
+        if dur_s <= 0 or cells <= 0:
+            return
+        r = cells / dur_s
+        self.rate = r if self.rate is None else self.alpha * r + (1 - self.alpha) * self.rate
+
+
+def _platform() -> Optional[str]:
+    """Backend platform, best-effort (the sweep just ran, so a backend is
+    already live; never the reason jax initializes)."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return None
+
+
+def _rate_history_path():
+    """SIDECAR history for elastic throughput records
+    (``<SBR_OBS_HISTORY>.elastic.jsonl``): `report trend --check` gates
+    only the LATEST record of the main history, so an elastic_sweep line
+    landing after a bench line would mask (or short-circuit) the bench
+    gate — the cost-model records therefore live beside, not inside, the
+    gated file."""
+    from sbr_tpu.obs import history
+    from pathlib import Path as _P
+
+    return _P(str(history.history_path()) + ".elastic.jsonl")
+
+
+def seed_rate_from_history(path=None, window: int = 8) -> Optional[float]:
+    """Median of this platform's most recent ``elastic_cells_per_sec``
+    records in the elastic sidecar history — the deterministic cost-model
+    seed (CPU smoke rates must not seed a TPU host, hence the platform
+    filter). None when no such metric was ever recorded."""
+    try:
+        from sbr_tpu.obs import history
+
+        return history.recent_median(
+            "elastic_cells_per_sec",
+            path=path or _rate_history_path(),
+            platform=_platform(),
+            window=window,
+        )
+    except Exception:
+        return None
+
+
+def _append_rate_history(rate: Optional[float], tiles_computed: int) -> None:
+    """Record this sweep's MEASURED throughput for future cost-model seeds
+    (an all-cache-hit sweep measured nothing and must not echo its seed
+    back). Gated on an explicit SBR_OBS_HISTORY (like bench tiny runs):
+    tests and ad-hoc sweeps must not grow a committed history."""
+    if not rate or tiles_computed <= 0 or not os.environ.get("SBR_OBS_HISTORY", "").strip():
+        return
+    try:
+        from sbr_tpu.obs import history
+
+        history.append(
+            {"elastic_cells_per_sec": float(rate)},
+            label="elastic_sweep",
+            platform=_platform(),
+            path=_rate_history_path(),
+            meta={"tiles": tiles_computed, "host": host_identity()},
+        )
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Cross-run global tile cache
+# ---------------------------------------------------------------------------
+
+
+class TileCache:
+    """Content-addressed cross-run tile store (see module docstring).
+
+    Layout: ``<root>/<key[:2]>/<key>.npz`` + ``.sha256`` sidecar; writes
+    are atomic (tmp + rename, losing a race to a peer writing the SAME key
+    is fine — identical content by construction); reads verify the sidecar
+    and QUARANTINE mismatches (``<root>/<key[:2]>/quarantine/``) rather
+    than trusting or deleting them. Hits `os.utime`-refresh the entry so
+    cold-entry gc (`gc_tile_cache`) never evicts a warm region."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+
+    def key(self, base, config, dtype, tile_betas, tile_us) -> str:
+        """sha256 over everything that determines the tile's bytes: the
+        canonicalized params/config (the `params_fingerprint` machinery),
+        dtype, the x64 flag (a dtype=None sweep canonicalizes differently
+        under it), the grid program version (bumped when cell numerics
+        change — `sweeps.baseline_sweeps.GRID_PROGRAM_VERSION`), and the
+        tile's ACTUAL β/u values — so overlapping grids share entries
+        exactly when their cells are mathematically identical."""
+        from sbr_tpu.utils.checkpoint import canonicalize
+
+        x64 = None
+        try:
+            import jax
+
+            x64 = bool(jax.config.jax_enable_x64)
+        except Exception:
+            pass
+        version = 0
+        try:
+            from sbr_tpu.sweeps.baseline_sweeps import GRID_PROGRAM_VERSION
+
+            version = int(GRID_PROGRAM_VERSION)
+        except Exception:
+            pass
+        payload = canonicalize(
+            (
+                base,
+                config,
+                str(dtype),
+                x64,
+                version,
+                np.ascontiguousarray(np.asarray(tile_betas, dtype=np.float64)),
+                np.ascontiguousarray(np.asarray(tile_us, dtype=np.float64)),
+            )
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()
+
+    def path(self, key: str) -> Path:
+        return self.root / key[:2] / f"{key}.npz"
+
+    def load(self, key: str, tile: str = "?") -> Optional[dict]:
+        """Verified read; None on miss or corruption (corrupt entries are
+        quarantined + logged, and the caller recomputes)."""
+        path = self.path(key)
+        if not path.exists():
+            _log_cache("miss", tile=tile, key=key[:12])
+            return None
+        from sbr_tpu.resilience import faults, heal
+        from sbr_tpu.resilience.faults import InjectedFault
+
+        # The fault point fires OUTSIDE the quarantine handler: an injected
+        # transient read failure means "fall back to compute" (a miss),
+        # never "destroy a healthy entry".
+        try:
+            faults.fire("tilecache.load", target=tile)
+        except InjectedFault:
+            _log_cache("miss", tile=tile, key=key[:12], injected=True)
+            return None
+        try:
+            # Unlike local checkpoints, the cache has NO legitimate
+            # pre-sidecar "legacy" entries: a sidecar-less entry means
+            # `store` died between the rename and the sidecar write, and
+            # later rot in it would be unverifiable — quarantine anything
+            # that is not a verified "ok", never trust it.
+            if heal.verify_file(path) != "ok":
+                heal.quarantine(path, reason="tilecache-unverifiable")
+                _log_cache("quarantine", tile=tile, key=key[:12])
+                return None
+            data = np.load(path)
+            arrays = {f: data[f] for f in _FIELDS}
+        except Exception as err:
+            if path.exists():
+                heal.quarantine(path, reason=f"tilecache-unreadable: {err!r}")
+            _log_cache("quarantine", tile=tile, key=key[:12])
+            return None
+        try:  # a hit is a "use": keep the entry warm for keep-days gc
+            os.utime(path)
+        except OSError:
+            pass
+        _log_cache("hit", tile=tile, key=key[:12])
+        return arrays
+
+    def store(self, key: str, arrays: dict, tile: str = "?") -> Optional[Path]:
+        from sbr_tpu.resilience import heal, shutdown
+
+        path = self.path(key)
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+            try:
+                # track_tmp: a graceful shutdown sweeps the partial write
+                # even if this frame's cleanup never runs; a hard kill
+                # leaves it for gc_tile_cache's *.tmp debris sweep.
+                with shutdown.track_tmp(tmp):
+                    with os.fdopen(fd, "wb") as fh:
+                        np.savez(fh, **{f: np.asarray(arrays[f]) for f in _FIELDS})
+                    # Sidecar BEFORE the rename (hashed from the staged
+                    # tmp): a concurrent reader sees either nothing or a
+                    # fully verifiable entry — never the rename-then-
+                    # sidecar window that load() would have to quarantine.
+                    # A crash here leaves an orphan sidecar, swept by
+                    # gc_tile_cache; a racer writing the same key writes
+                    # identical bytes (deterministic), so overwrites agree.
+                    heal.write_sidecar(path, source=tmp)
+                    os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
+        except OSError:
+            return None  # a read-only/full cache volume must not sink the sweep
+        _log_cache("store", tile=tile, key=key[:12])
+        return path
+
+
+def gc_tile_cache(root, keep_days: float = 30.0, now: Optional[float] = None) -> list:
+    """Prune COLD global-cache entries: any ``.npz`` (plus its sidecar)
+    not read or written for ``keep_days`` (hits refresh mtime). Entries
+    under a ``quarantine/`` dir are evidence and are removed too (an
+    explicit gc is entitled to clear evidence, matching `mem.gc_debris`).
+    Orphaned ``*.tmp`` store files (a writer hard-killed between mkstemp
+    and rename) older than an hour are always debris. Never touches other
+    files; returns the removed paths."""
+    import shutil
+
+    root = Path(root)
+    removed: list = []
+    if not root.is_dir():
+        return removed
+    now = time.time() if now is None else now
+    horizon = now - keep_days * 86400.0
+    # Quarantine dirs first, unconditionally (matching mem.gc_debris):
+    # quarantined entries keep a fresh mtime from their os.replace, so the
+    # keep-days horizon below would wrongly preserve the evidence.
+    for q in sorted(root.rglob("quarantine")):
+        if not q.is_dir():
+            continue
+        try:
+            shutil.rmtree(q)
+            removed.append(q)
+        except OSError:
+            pass
+    for entry in sorted(root.rglob("*.npz")):
+        try:
+            if entry.stat().st_mtime > horizon:
+                continue
+            entry.unlink()
+            removed.append(entry)
+        except OSError:
+            continue
+        side = Path(str(entry) + ".sha256")
+        try:
+            side.unlink()
+            removed.append(side)
+        except OSError:
+            pass
+    for tmp in sorted(root.rglob("*.tmp")):
+        try:
+            # An hour of grace covers any live writer (stores take <1 s);
+            # anything older is a dead writer's orphan.
+            if now - tmp.stat().st_mtime >= 3600.0:
+                tmp.unlink()
+                removed.append(tmp)
+        except OSError:
+            continue
+    # Orphan sidecars (a writer died between publishing the sidecar and
+    # renaming the entry): same hour of grace.
+    for side in sorted(root.rglob("*.npz.sha256")):
+        try:
+            if (
+                not Path(str(side)[: -len(".sha256")]).exists()
+                and now - side.stat().st_mtime >= 3600.0
+            ):
+                side.unlink()
+                removed.append(side)
+        except OSError:
+            continue
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# The elastic sweep driver
+# ---------------------------------------------------------------------------
+
+
+def run_elastic_grid(
+    beta_values,
+    u_values,
+    base,
+    checkpoint_dir,
+    config=None,
+    tile_shape=(256, 256),
+    dtype=None,
+    wait: bool = True,
+    poll_s: float = 5.0,
+    timeout_s: float = 24 * 3600.0,
+    verbose: bool = False,
+    lease_ttl_s: Optional[float] = None,
+    heartbeat_ttl_s: Optional[float] = None,
+    tile_cache_dir=None,
+    max_retries: int = 2,
+):
+    """Elastic β×u sweep over a shared checkpoint dir (the scheduler behind
+    `parallel.run_tiled_grid_multihost` when elastic mode is on).
+
+    Any number of hosts may run this concurrently against one
+    ``checkpoint_dir`` — including hosts started long after the sweep began
+    (they announce a heartbeat and start claiming) and hosts that vanish
+    mid-run (their leases/heartbeats expire, or are released immediately on
+    a graceful SIGTERM, and peers reclaim the tiles). Tile ownership is
+    decided per-claim by `plan_claims` + the lease files; the final grid is
+    byte-identical to a single-host `run_tiled_grid` of the same sweep
+    regardless of the churn schedule.
+
+    ``wait=False`` returns None as soon as nothing is claimable (every
+    tile done or leased to a live holder) — the worker-process pattern.
+    ``wait=True`` polls until all tiles exist, then assembles the full
+    grid from disk (pure read).
+    """
+    from sbr_tpu.parallel.distributed import _cleanup_leases, _try_lease
+    from sbr_tpu.resilience import faults, shutdown
+    from sbr_tpu.utils import checkpoint as ckpt_mod
+
+    if checkpoint_dir is None:
+        raise ValueError("elastic sweeps need a shared checkpoint_dir (the rendezvous)")
+    if lease_ttl_s is None:
+        lease_ttl_s = float(os.environ.get("SBR_STEAL_LEASE_TTL_S", "900"))
+    if tile_shape == "auto":
+        # Late-join on a heterogeneous fleet: adopt the sweep's recorded
+        # geometry instead of re-planning from this host's capacity (see
+        # `recorded_tile_shape`). First host in: resolves locally and its
+        # shape becomes the record.
+        adopted = recorded_tile_shape(checkpoint_dir)
+        if adopted is not None:
+            tile_shape = adopted
+
+    cache = default_tile_cache(tile_cache_dir)
+    runner = ckpt_mod.tile_runner(
+        beta_values, u_values, base, checkpoint_dir, config=config,
+        tile_shape=tile_shape, dtype=dtype, max_retries=max_retries,
+        tile_cache=cache, verbose=verbose,
+    )
+    ckpt = runner.ckpt
+    tiles = ckpt_mod.tile_origins(runner.nb, runner.nu, (runner.tb, runner.tu))
+    costs = {
+        t: float(tile_cells(t, runner.nb, runner.nu, (runner.tb, runner.tu)))
+        for t in tiles
+    }
+
+    hid = host_identity()
+    hb = Heartbeat(ckpt, hid, ttl_s=heartbeat_ttl_s)
+    tracker = ThroughputTracker(seed_rate=seed_rate_from_history())
+    hb.beat(tiles_done=0, cells_per_sec=tracker.rate)
+    _log_sched("join", host=hid, tiles=len(tiles), seed_rate=tracker.rate)
+
+    done = 0
+    deadline = time.monotonic() + timeout_s
+    last_plan_sig = None
+    # Incremental remaining-set bookkeeping: ONE full disk scan at join,
+    # then tiles leave the set as we produce them or observe them landed
+    # (the single pre-claim stat below). A full re-scan happens only when
+    # nothing was claimable (the poll path) — so the claim loop costs
+    # O(1) stats per claimed tile, not O(n_tiles) per iteration, which
+    # matters on the shared network storage every host depends on.
+    remaining = {t for t in tiles if not runner.path(*t).exists()}
+    # Re-planning is amortized: heartbeats are re-read and the LPT plan
+    # recomputed only every REPLAN_EVERY claims (or when the cached claim
+    # order drains / nothing was claimable) — a per-claim re-plan would be
+    # O(tiles² · hosts) scheduling work plus a heartbeat read per host per
+    # tile against the shared storage. Staleness is safe: leases arbitrate
+    # every claim, and produce() rechecks the local slot.
+    REPLAN_EVERY = 16
+    order: list = []
+    next_in_order = 0
+    claims_since_plan = 0
+    # The leave/withdraw finally sits INSIDE the shutdown envelope: on a
+    # SIGTERM it runs while unwinding toward graceful_shutdown's handler,
+    # i.e. BEFORE the obs run is finalized — so a preempted host's "leave"
+    # event still lands in the log and the census shows it departed.
+    with shutdown.graceful_shutdown(label="elastic_grid"):
+        try:
+            while remaining:
+                faults.fire("barrier.poll", target=f"missing={len(remaining)}")
+                if next_in_order >= len(order) or claims_since_plan >= REPLAN_EVERY:
+                    hosts = live_hosts(ckpt)
+                    rates = {
+                        h: float(rec.get("cells_per_sec") or 0.0) or 1.0
+                        for h, rec in hosts.items()
+                    }
+                    rates[hid] = float(tracker.rate or 0.0) or rates.get(hid, 1.0)
+                    missing = sorted(remaining)
+                    plan = plan_claims([(t, costs[t]) for t in missing], rates)
+                    plan_sig = json.dumps({h: len(v) for h, v in sorted(plan.items())})
+                    if plan_sig != last_plan_sig:
+                        last_plan_sig = plan_sig
+                        _log_sched(
+                            "plan", host=hid, missing=len(missing),
+                            shares={h: len(v) for h, v in sorted(plan.items())},
+                        )
+                    mine = plan.get(hid, [])
+                    mine_set = set(mine)
+                    order = mine + [t for t in missing if t not in mine_set]
+                    next_in_order = 0
+                    claims_since_plan = 0
+
+                claimed = None
+                while next_in_order < len(order):
+                    bi, ui = order[next_in_order]
+                    next_in_order += 1
+                    if (bi, ui) not in remaining:
+                        continue
+                    if runner.path(bi, ui).exists():
+                        remaining.discard((bi, ui))  # a peer landed it
+                        continue
+                    lease = ckpt / f"tile_b{bi:05d}_u{ui:05d}.lease"
+                    takeover = lease.exists()
+                    if _try_lease(ckpt, bi, ui, lease_ttl_s):
+                        claimed = (bi, ui, lease, takeover)
+                        break
+                    # Leased to a live holder: revisit it on the NEXT plan,
+                    # not in this pass — it is being worked on.
+                if claimed is None:
+                    # Nothing claimable right now: re-scan what is truly
+                    # still missing (peers may have landed tiles since the
+                    # join-time scan), then exit (worker mode) or poll.
+                    remaining = {
+                        t for t in remaining if not runner.path(*t).exists()
+                    }
+                    if not remaining or not wait:
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"{len(remaining)} tiles still missing after "
+                            f"{timeout_s:.0f}s with nothing claimable — live "
+                            f"holders: {sorted(live_hosts(ckpt))}; first "
+                            f"missing: {sorted(remaining)[0]}"
+                        )
+                    hb.beat(tiles_done=done, cells_per_sec=tracker.rate)
+                    if verbose:
+                        print(f"  elastic: waiting on {len(remaining)} leased tile(s) …")
+                    time.sleep(poll_s)
+                    continue
+
+                bi, ui, lease, takeover = claimed
+                tile_id = runner.tile_id(bi, ui)
+                _log_sched(
+                    "reclaim" if takeover else "claim", host=hid, tile=tile_id,
+                )
+                shutdown.release_on_exit(lease)
+                # Beat at tile START so the staleness clock spans exactly one
+                # tile compute — peers (and gc) consider us dead only after
+                # TTL of silence measured from here. The TTL must exceed the
+                # worst-case tile wall-clock; a host misjudged as dead loses
+                # nothing (its leased tile is still protected by the lease
+                # TTL, and the plan merely re-shuffles unclaimed tiles).
+                hb.beat(tiles_done=done, cells_per_sec=tracker.rate)
+                t_tile = time.monotonic()
+                try:
+                    source, _arrays = runner.produce(bi, ui)
+                finally:
+                    try:
+                        lease.unlink()
+                    except OSError:
+                        pass
+                    shutdown.unregister_release(lease)
+                dur = time.monotonic() - t_tile
+                if source == "computed":
+                    tracker.update(int(costs[(bi, ui)]), dur)
+                done += 1
+                claims_since_plan += 1
+                remaining.discard((bi, ui))
+                hb.beat(tiles_done=done, cells_per_sec=tracker.rate)
+                _log_sched(
+                    "done", host=hid, tile=tile_id, source=source,
+                    dur_s=round(dur, 6), cells=int(costs[(bi, ui)]),
+                )
+                if verbose:
+                    print(f"  elastic: {tile_id} {source} in {dur:.3f}s "
+                          f"({len(remaining)} left)")
+        finally:
+            hb.withdraw()
+            _log_sched("leave", host=hid, tiles_done=done)
+
+    if runner.ckpt is not None and runner.repairs:
+        ckpt_mod._record_repairs(runner.ckpt, runner.repairs)
+    # Gate on COMPUTED tiles, not done tiles: an all-cache-hit sweep never
+    # measured anything, and re-appending the history-seeded rate would
+    # pin recent_median to a stale value forever.
+    _append_rate_history(tracker.rate, runner.counts.get("computed", 0))
+    if not wait:
+        return None
+
+    # Assembly: all tiles on disk — a pure cache read, like the legacy
+    # barrier's final pass (the ORIGINAL tile_shape flows down so an
+    # "auto" resolution re-runs against its own plan record, free).
+    _cleanup_leases(ckpt)
+    return ckpt_mod.run_tiled_grid(
+        beta_values, u_values, base, config=config, tile_shape=tile_shape,
+        checkpoint_dir=checkpoint_dir, dtype=dtype, verbose=verbose,
+        tile_cache=cache,
+    )

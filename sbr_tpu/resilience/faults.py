@@ -18,7 +18,10 @@ point                  planted in
 ``tile.result``        same, after a tile computes (site poisons results)
 ``checkpoint.save``    after a tile's atomic save (site corrupts the file)
 ``checkpoint.load``    before a cached tile is read back
-``barrier.poll``       `parallel.distributed` filesystem barrier, per poll
+``tilecache.load``     `resilience.elastic.TileCache`, before a cross-run
+                       global-cache entry is read (verify/quarantine path)
+``barrier.poll``       `parallel.distributed` filesystem barrier and the
+                       elastic scheduler's claim loop, per poll
 ``bench.probe``        `bench.py`'s accelerator probe, per attempt
 =====================  ====================================================
 

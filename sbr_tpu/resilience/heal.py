@@ -10,7 +10,10 @@ Three healing mechanisms, used by `utils.checkpoint.run_tiled_grid` and
 and the tile is **quarantined** (moved into ``quarantine/`` next to the
 checkpoint, never silently deleted — it is evidence) and recomputed.
 Tiles written by pre-sidecar builds verify as ``"legacy"`` and are trusted,
-so old checkpoint dirs keep resuming.
+so old checkpoint dirs keep resuming. The cross-run global tile cache
+(`resilience.elastic.TileCache`) reuses the same sidecar + quarantine
+machinery for its entries — a corrupt cache entry is quarantined beside
+the cache and the tile recomputed, never served.
 
 **Degrade ladder.** A cell whose `sbr_tpu.diag` health bitmask carries a
 divergent bit (NaN poison, non-finite residual, fixed-point failure)
@@ -55,11 +58,16 @@ def _digest(path) -> str:
     return h.hexdigest()
 
 
-def write_sidecar(path) -> Path:
-    """Write (atomically) the sha256 sidecar for an already-saved file."""
+def write_sidecar(path, source=None) -> Path:
+    """Write (atomically) the sha256 sidecar for ``path``. ``source``
+    (default ``path``) is the file whose bytes are hashed — the cross-run
+    tile cache hashes its staged temp file and publishes the sidecar
+    BEFORE renaming the entry into place, so a concurrent reader can never
+    observe a cache entry without its sidecar (there is no "legacy
+    trusted" grace for cache entries)."""
     side = sidecar_path(path)
     tmp = Path(str(side) + ".tmp")
-    tmp.write_text(_digest(path) + "\n")
+    tmp.write_text(_digest(source if source is not None else path) + "\n")
     os.replace(tmp, side)
     return side
 

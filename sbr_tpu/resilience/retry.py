@@ -53,14 +53,41 @@ class RetryError(RuntimeError):
 
 
 class RetryBudget:
-    """Shared pool of extra attempts across scopes (see module docstring)."""
+    """Shared pool of extra attempts across scopes (see module docstring).
 
-    def __init__(self, total: int) -> None:
+    With ``refill_s`` the pool refreshes on a wall-clock cadence: a
+    LONG-LIVED process (the serving engine, an elastic sweep host that
+    outlives many tile batches) must not let a handful of recovered
+    hiccups spread over days permanently latch the budget empty, while a
+    genuinely dead backend still fail-fasts (many failures inside one
+    refill window). Refill is applied lazily on `take`/`remaining` reads —
+    no timer thread — against an injectable ``clock`` so tests drive it
+    deterministically. ``refill_s=None`` (the default) keeps the historic
+    one-shot semantics sweeps rely on."""
+
+    def __init__(self, total: int, refill_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.total = int(total)
         self.used = 0
+        self.refill_s = refill_s
+        self._clock = clock
+        self._epoch = clock()
+
+    def maybe_refill(self) -> bool:
+        """Reset the pool when the refill period has fully lapsed (>=, so a
+        read exactly at the boundary refills). Returns True on a refill."""
+        if not self.refill_s or self.refill_s <= 0:
+            return False
+        now = self._clock()
+        if now - self._epoch >= self.refill_s:
+            self._epoch = now
+            self.used = 0
+            return True
+        return False
 
     def take(self) -> bool:
         """Consume one retry if any remain; False means the pool is dry."""
+        self.maybe_refill()
         if self.used >= self.total:
             return False
         self.used += 1
@@ -68,6 +95,7 @@ class RetryBudget:
 
     @property
     def remaining(self) -> int:
+        self.maybe_refill()
         return max(self.total - self.used, 0)
 
 

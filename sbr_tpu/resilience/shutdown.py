@@ -15,6 +15,10 @@ and possibly a partially-written tile temp file. Inside a
   "crashed";
 - any temp file still registered via :func:`track_tmp` (a save that never
   reached its cleanup) is removed;
+- any held coordination file registered via :func:`release_on_exit` —
+  tile lease files, the elastic scheduler's heartbeat — is released, so
+  peers reclaim the preempted host's work at their next poll instead of
+  waiting out the lease/heartbeat TTL;
 - the process exits via ``SystemExit(128+signum)`` for SIGTERM, or
   re-raises ``KeyboardInterrupt`` for SIGINT (the Python convention).
 
@@ -48,6 +52,11 @@ class Interrupted(BaseException):
 # Temp files currently being written by atomic-save helpers; a shutdown
 # sweeps whatever is still registered (see utils.checkpoint._save_atomic).
 _TMP_REGISTRY: set = set()
+# Coordination files this process HOLDS and must hand back on shutdown:
+# tile lease files and the elastic scheduler's heartbeat file. Releasing
+# them on SIGTERM/SIGINT lets peers reclaim the work immediately instead
+# of waiting out SBR_STEAL_LEASE_TTL_S / SBR_HEARTBEAT_TTL_S.
+_RELEASE_REGISTRY: set = set()
 _DEPTH = 0  # reentrancy: only the outermost graceful_shutdown owns handlers
 
 
@@ -59,6 +68,30 @@ def track_tmp(path):
         yield
     finally:
         _TMP_REGISTRY.discard(str(path))
+
+
+def release_on_exit(path) -> None:
+    """Register a held coordination file (lease / heartbeat) for removal
+    when a graceful shutdown unwinds this process — peers then reclaim the
+    work at their next poll instead of waiting out the TTL."""
+    _RELEASE_REGISTRY.add(str(path))
+
+
+def unregister_release(path) -> None:
+    """The file was handed back normally; shutdown no longer owns it."""
+    _RELEASE_REGISTRY.discard(str(path))
+
+
+def _release_registered() -> list:
+    released = []
+    for p in sorted(_RELEASE_REGISTRY):
+        try:
+            os.remove(p)
+            released.append(p)
+        except OSError:
+            pass
+    _RELEASE_REGISTRY.clear()
+    return released
 
 
 def _cleanup_tmp() -> list:
@@ -122,6 +155,7 @@ def graceful_shutdown(label: str = "run"):
     except Interrupted as itr:
         _finalize_obs_interrupted()
         _cleanup_tmp()
+        _release_registered()
         if itr.signum == signal.SIGINT:
             raise KeyboardInterrupt from itr
         raise SystemExit(128 + itr.signum) from itr
@@ -136,4 +170,7 @@ def graceful_shutdown(label: str = "run"):
 
 def interrupted_status() -> Optional[str]:
     """Hook for tests: the registry size (debug aid)."""
-    return f"tracked_tmp={len(_TMP_REGISTRY)} depth={_DEPTH}"
+    return (
+        f"tracked_tmp={len(_TMP_REGISTRY)} "
+        f"held_releases={len(_RELEASE_REGISTRY)} depth={_DEPTH}"
+    )

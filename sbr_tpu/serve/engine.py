@@ -285,13 +285,16 @@ class Engine:
         # Unlike a sweep (one bounded run), a server lives for days: a
         # LIFETIME budget would let 8 unrelated recovered hiccups spread
         # over a week permanently latch /healthz unhealthy. The budget
-        # refreshes every SBR_SERVE_RETRY_REFILL_S (default 900 s), so it
-        # still fail-fasts a genuinely dead backend (many failures within
-        # one refill window) without ratcheting.
+        # refreshes every SBR_SERVE_RETRY_REFILL_S (default 900 s) — the
+        # time-based refill now lives in RetryBudget itself (shared with
+        # the elastic sweep scheduler), so it still fail-fasts a genuinely
+        # dead backend (many failures within one refill window) without
+        # ratcheting.
         refill_env = os.environ.get("SBR_SERVE_RETRY_REFILL_S", "").strip()
         self._budget_refill_s = float(refill_env) if refill_env else 900.0
-        self.retry_budget = retry.RetryBudget(self._budget_total)
-        self._budget_epoch = time.monotonic()
+        self.retry_budget = retry.RetryBudget(
+            self._budget_total, refill_s=self._budget_refill_s or None
+        )
 
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
@@ -437,15 +440,10 @@ class Engine:
         return {"status": status, "reasons": reasons}
 
     def _maybe_refill_budget(self) -> None:
-        """Swap in a fresh retry budget once the refill period has lapsed
-        (reference swap — in-flight dispatches keep drawing on the old
+        """Apply the budget's own time-based refill (retry.RetryBudget
+        handles the cadence; in-flight dispatches keep drawing on the same
         object, which is fine: the pool bounds failures per window)."""
-        if self._budget_refill_s <= 0:
-            return
-        now = time.monotonic()
-        if now - self._budget_epoch >= self._budget_refill_s:
-            self._budget_epoch = now
-            self.retry_budget = retry.RetryBudget(self._budget_total)
+        self.retry_budget.maybe_refill()
 
     def statz(self) -> dict:
         """Full live snapshot — `/statz` body and the `live.json` document."""

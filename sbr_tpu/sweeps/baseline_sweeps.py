@@ -34,6 +34,14 @@ from sbr_tpu.models.results import LearningSolution
 from sbr_tpu.obs import prof
 from sbr_tpu.resilience import faults
 
+# Version of the β×u grid-cell NUMERICS, folded into the cross-run global
+# tile cache key (`resilience.elastic.TileCache.key`). The local checkpoint
+# fingerprint protects one sweep dir, but the global cache outlives code
+# versions: bump this whenever a change alters any cell's bytes (solver
+# math, status semantics, health-driven healing inputs) so stale entries
+# miss instead of silently serving old numerics.
+GRID_PROGRAM_VERSION = 1
+
 
 @struct.dataclass
 class USweepResult:

@@ -27,6 +27,12 @@ from sbr_tpu.interest.solver import solve_equilibrium_interest_core
 from sbr_tpu.models.params import ModelParamsInterest, SolverConfig
 from sbr_tpu.sweeps.baseline_sweeps import _TracedLearning
 
+# Version of the (β, u, r) policy-cell numerics — the policy analogue of
+# `baseline_sweeps.GRID_PROGRAM_VERSION`, reserved for the same cross-run
+# cache keying discipline when policy sweeps gain tiling: bump on any
+# change that alters a cell's bytes.
+POLICY_PROGRAM_VERSION = 1
+
 
 @struct.dataclass
 class PolicySweepResult:

@@ -242,7 +242,12 @@ def _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype) -
     return h.hexdigest()
 
 
-def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
+def _check_fingerprint(ckpt: Path, fingerprint: str, tile_shape=None) -> None:
+    """Create-or-verify the checkpoint manifest. The creating process also
+    records its RESOLVED ``tile_shape`` so a late-joining elastic host can
+    adopt the sweep's geometry instead of re-planning from its own device
+    capacity (`resilience.elastic.recorded_tile_shape`) — without it, a
+    heterogeneous joiner's "auto" resolution would fingerprint-mismatch."""
     manifest = ckpt / "manifest.json"
     if manifest.exists():
         try:
@@ -280,9 +285,12 @@ def _check_fingerprint(ckpt: Path, fingerprint: str) -> None:
         # one dir concurrently; a peer must never observe a partial file.
         # Losing the os.replace race to a peer writing the SAME sweep is
         # fine (identical content).
+        doc = {"fingerprint": fingerprint}
+        if tile_shape is not None:
+            doc["tile_shape"] = [int(t) for t in tile_shape]
         fd, tmp = tempfile.mkstemp(dir=ckpt, suffix=".tmp")
         with os.fdopen(fd, "w") as f:
-            f.write(json.dumps({"fingerprint": fingerprint}))
+            f.write(json.dumps(doc))
         os.replace(tmp, manifest)
 
 
@@ -371,6 +379,267 @@ def _record_repairs(ckpt: Path, repairs: list) -> None:
     os.replace(tmp, manifest)
 
 
+class TileRunner:
+    """Per-tile production engine shared by `run_tiled_grid`'s loop and the
+    elastic scheduler (`resilience.elastic`): produce ONE tile's arrays via
+    local checkpoint -> cross-run global cache -> compute, with the full
+    resilience stack (retry policy + shared budget, fault points, NaN
+    poison hook, degrade-ladder healing, atomic save + sha256 sidecar)
+    applied on the compute path.
+
+    Factoring this out of the sweep loop is what makes elastic scheduling
+    affordable: a host claiming one tile at a time calls `produce` per
+    claim instead of re-running the whole `run_tiled_grid` scan (which
+    loads every cached tile — O(tiles²) reads over a sweep).
+
+    ``counts`` tallies tiles by source ("local" / "cache" / "computed") and
+    ``repairs`` accumulates degrade-ladder reports for the checkpoint
+    manifest. Construct via `tile_runner` (which resolves config/tile-shape
+    defaults, checks the sweep fingerprint, and runs the OOM preflight) —
+    the raw constructor assumes all of that already happened.
+    """
+
+    def __init__(
+        self, beta_values, u_values, base, config, tile_shape, ckpt,
+        mesh=None, dtype=None, policy=None, retry_budget=None,
+        heal_divergent: bool = True, tile_cache=None, verbose: bool = False,
+    ) -> None:
+        self.beta_values = np.asarray(beta_values)
+        self.u_values = np.asarray(u_values)
+        self.base = base
+        self.config = config
+        self.tb, self.tu = (int(t) for t in tile_shape)
+        self.nb, self.nu = len(self.beta_values), len(self.u_values)
+        self.ckpt = Path(ckpt) if ckpt is not None else None
+        self.mesh = mesh
+        self.dtype = dtype
+        self.policy = policy
+        self.retry_budget = retry_budget
+        self.heal_divergent = heal_divergent
+        self.tile_cache = tile_cache
+        self.verbose = verbose
+        self.repairs: list = []
+        self.counts = {"local": 0, "cache": 0, "computed": 0}
+
+    # -- geometry ------------------------------------------------------------
+    def slices(self, bi: int, ui: int) -> Tuple[slice, slice]:
+        return (
+            slice(bi, min(bi + self.tb, self.nb)),
+            slice(ui, min(ui + self.tu, self.nu)),
+        )
+
+    def tile_id(self, bi: int, ui: int) -> str:
+        return f"tile_b{bi:05d}_u{ui:05d}"
+
+    def path(self, bi: int, ui: int) -> Optional[Path]:
+        return _tile_path(self.ckpt, bi, ui) if self.ckpt is not None else None
+
+    # -- production ----------------------------------------------------------
+    def load_local(self, bi: int, ui: int, may_quarantine: bool = True):
+        """Verified read of the local checkpoint slot (None on miss/corrupt;
+        corrupt slots are quarantined only when ``may_quarantine``)."""
+        path = self.path(bi, ui)
+        if path is None or not path.exists():
+            return None
+        return _load_tile_verified(path, may_quarantine=may_quarantine)
+
+    def cache_key(self, bi: int, ui: int) -> Optional[str]:
+        if self.tile_cache is None:
+            return None
+        bs, us = self.slices(bi, ui)
+        return self.tile_cache.key(
+            self.base, self.config, self.dtype,
+            self.beta_values[bs], self.u_values[us],
+        )
+
+    def produce(self, bi: int, ui: int, skip_local: bool = False):
+        """Make tile (bi, ui) exist locally; returns ``(source, arrays)``
+        with source in {"local", "cache", "computed"}. ``skip_local`` skips
+        the local read when the caller already checked (the sweep loop)."""
+        path = self.path(bi, ui)
+        if not skip_local:
+            cached = self.load_local(bi, ui)
+            if cached is not None:
+                self.counts["local"] += 1
+                return "local", cached
+        key = self.cache_key(bi, ui)
+        if key is not None:
+            arrays = self.tile_cache.load(key, tile=self.tile_id(bi, ui))
+            if arrays is not None:
+                self.counts["cache"] += 1
+                if path is not None:
+                    _save_atomic(path, arrays)
+                return "cache", arrays
+        arrays = self._compute(bi, ui)
+        self.counts["computed"] += 1
+        if path is not None:
+            _save_atomic(path, arrays)
+            # Chaos hook: a ``corrupt`` rule on checkpoint.save tears the
+            # file AFTER the save (and its sidecar) landed — exactly the
+            # torn-write mode verify-on-load must catch on the next read.
+            inj = faults.fire("checkpoint.save", target=self.tile_id(bi, ui))
+            if inj is not None and inj.kind == "corrupt":
+                faults.corrupt_file(path)
+        if key is not None:
+            # Store AFTER the local save: the global entry is only ever
+            # written from arrays that also landed (atomically) locally.
+            self.tile_cache.store(key, arrays, tile=self.tile_id(bi, ui))
+        return "computed", arrays
+
+    def _compute(self, bi: int, ui: int) -> dict:
+        """One tile's compute under the unified retry policy, with the
+        fault-injection, poison, and degrade-ladder hooks of the sweep loop."""
+        bs, us = self.slices(bi, ui)
+        tile_id = self.tile_id(bi, ui)
+        tile_snap: dict = {}
+
+        def compute_tile():
+            faults.fire("tile.compute", target=tile_id)
+            tile = beta_u_grid(
+                self.beta_values[bs], self.u_values[us], self.base,
+                config=self.config, mesh=self.mesh, dtype=self.dtype,
+            )
+            arrays = {f: np.asarray(getattr(tile, f)).copy() for f in _FIELDS}
+            tile_flags = (
+                np.asarray(tile.health.flags).copy()
+                if tile.health is not None
+                else np.zeros(arrays["status"].shape, np.int32)
+            )
+            if obs.current_run() is not None:
+                # Snapshot while the tile's device buffers are still
+                # live — after the host copies land, the live-buffer
+                # sum would read an empty device.
+                tile_snap.clear()
+                tile_snap.update(obs.mem.snapshot())
+            return arrays, tile_flags
+
+        def observer(**rec):
+            if rec.get("outcome") in ("retrying", "gave_up", "budget_exhausted"):
+                print(
+                    f"  tile ({bi},{ui}) attempt "
+                    f"{rec.get('attempt')}/{rec.get('max_attempts')} "
+                    f"{rec['outcome']}: {rec.get('error', '')}",
+                    file=sys.stderr,
+                )
+            retry._default_observer(**rec)
+
+        policy = self.policy if self.policy is not None else default_tile_policy()
+        try:
+            arrays, tile_flags = policy.call(
+                compute_tile, scope=f"Tile ({bi},{ui})",
+                budget=self.retry_budget, observer=observer,
+            )
+        except retry.RetryError as err:
+            raise RuntimeError(str(err)) from err.__cause__
+
+        # Chaos hook: a ``nan`` rule on tile.result poisons the computed
+        # arrays + health flags, simulating device garbage downstream of
+        # a successful dispatch; the degrade ladder below must repair it.
+        inj = faults.fire("tile.result", target=tile_id)
+        if inj is not None and inj.kind == "nan":
+            _poison_tile(inj, arrays, tile_flags, tile_id)
+
+        if self.heal_divergent and (tile_flags != 0).any():
+            tile_report = heal.repair_divergent(
+                self.beta_values[bs], self.u_values[us], self.base,
+                self.config, self.dtype, arrays, tile_flags, scope=tile_id,
+            )
+            if tile_report:
+                self.repairs.extend({"tile": [bi, ui], **r} for r in tile_report)
+
+        # Per-tile peak-memory attribution (obs.mem): one `mem` event
+        # with a `tile` field, folded into the manifest's tile table —
+        # `report memory` renders it and flags near-capacity tiles.
+        obs.log_tile_mem(tile_id, **tile_snap)
+        return arrays
+
+
+def default_tile_policy(max_retries: int = 2) -> retry.RetryPolicy:
+    """The tile loop's retry policy (``SBR_RETRY_*`` env overrides layered
+    over ``max_retries`` extra attempts) — shared by `run_tiled_grid` and
+    the elastic scheduler so both paths retry identically."""
+    return retry.policy_from_env(
+        "SBR_RETRY",
+        max_attempts=max_retries + 1,
+        base_delay_s=1.0,
+        multiplier=2.0,
+        max_delay_s=60.0,
+    )
+
+
+def default_retry_budget(n_tiles: int) -> retry.RetryBudget:
+    """The per-sweep shared retry budget (``SBR_RETRY_BUDGET`` override)."""
+    budget_env = os.environ.get("SBR_RETRY_BUDGET", "").strip()
+    return retry.RetryBudget(int(budget_env) if budget_env else max(16, n_tiles))
+
+
+def tile_runner(
+    beta_values,
+    u_values,
+    base: ModelParams,
+    checkpoint_dir,
+    config: Optional[SolverConfig] = None,
+    tile_shape=(256, 256),
+    mesh=None,
+    dtype=None,
+    max_retries: int = 2,
+    heal_divergent: Optional[bool] = None,
+    retry_budget: Optional[retry.RetryBudget] = None,
+    tile_cache=None,
+    verbose: bool = False,
+) -> TileRunner:
+    """Build a ready `TileRunner` for one sweep: resolves the config/tile-
+    shape defaults exactly like `run_tiled_grid` (so fingerprints agree),
+    creates+checks the checkpoint dir, and runs the OOM preflight once.
+    ``tile_shape`` must already be resolved when it was "auto" upstream —
+    pass the resolved pair (the elastic scheduler resolves before the
+    claim loop, like the multihost ownership split always has)."""
+    if config is None:  # sweep default: refinement off (see beta_u_grid)
+        config = SolverConfig(refine_crossings=False)
+    beta_values = np.asarray(beta_values)
+    u_values = np.asarray(u_values)
+    nb, nu = len(beta_values), len(u_values)
+    tile_shape, _plan = resolve_tile_shape(nb, nu, tile_shape, config, dtype, mesh)
+    if mesh is not None:
+        # Every tile (including ragged edge tiles) must satisfy
+        # beta_u_grid's divisibility precondition; validate BEFORE the
+        # manifest write below — a deterministic sharding error must not
+        # leave a fingerprint for a tile shape the corrected retry will
+        # then mismatch against. beta_u_grid shards by the axes NAMED
+        # "b" and "u" (its default mesh_axes), regardless of mesh order.
+        tb, tu = tile_shape
+        mb, mu = mesh.shape["b"], mesh.shape["u"]
+        tile_dims = {min(tb, nb - bi) for bi in range(0, nb, tb)}, {
+            min(tu, nu - ui) for ui in range(0, nu, tu)
+        }
+        if any(d % mb for d in tile_dims[0]) or any(d % mu for d in tile_dims[1]):
+            raise ValueError(
+                f"Tile sizes {sorted(tile_dims[0])}×{sorted(tile_dims[1])} must be "
+                f"divisible by the mesh axes {mb}×{mu}; choose tile_shape/grid "
+                "sizes that are multiples of the mesh shape."
+            )
+    if heal_divergent is None:
+        heal_divergent = os.environ.get("SBR_HEAL", "").strip() != "0"
+    ckpt = None
+    if checkpoint_dir is not None:
+        ckpt = Path(checkpoint_dir)
+        ckpt.mkdir(parents=True, exist_ok=True)
+        _check_fingerprint(
+            ckpt,
+            _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype),
+            tile_shape=tile_shape,
+        )
+    _preflight_tile(nb, nu, tile_shape[0], tile_shape[1], config, dtype, mesh, plan=_plan)
+    if retry_budget is None:
+        retry_budget = default_retry_budget(len(tile_origins(nb, nu, tile_shape)))
+    return TileRunner(
+        beta_values, u_values, base, config, tile_shape, ckpt,
+        mesh=mesh, dtype=dtype, policy=default_tile_policy(max_retries),
+        retry_budget=retry_budget, heal_divergent=heal_divergent,
+        tile_cache=tile_cache, verbose=verbose,
+    )
+
+
 def run_tiled_grid(
     beta_values,
     u_values,
@@ -385,6 +654,7 @@ def run_tiled_grid(
     tile_owner=None,
     heal_divergent: Optional[bool] = None,
     retry_budget: Optional[retry.RetryBudget] = None,
+    tile_cache=None,
 ) -> GridSweepResult:
     """β×u grid in tiles with optional on-disk resume.
     NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
@@ -423,164 +693,69 @@ def run_tiled_grid(
     cells are repaired up the degrade ladder unless ``heal_divergent``
     (env ``SBR_HEAL``) disables it. A repaired-but-still-divergent cell
     keeps its original values — the ladder only ever upgrades trust.
+
+    Cross-run global cache (ISSUE 8): with ``tile_cache`` (a
+    `resilience.elastic.TileCache`, default from ``SBR_TILE_CACHE_DIR``),
+    a tile missing locally is first looked up in the content-addressed
+    cross-run store — keyed by params/config/dtype fingerprint × the
+    tile's actual β/u values — and every computed tile is stored back, so
+    repeated or overlapping sweeps recompute only cold tiles. Entries are
+    sha256-verified on read (mismatch → quarantine + recompute, never
+    trusted), and hits/misses/stores land as obs ``cache`` events.
     """
-    if config is None:  # sweep default: refinement off (see beta_u_grid)
-        config = SolverConfig(refine_crossings=False)
-    beta_values = np.asarray(beta_values)
-    u_values = np.asarray(u_values)
-    nb, nu = len(beta_values), len(u_values)
-    tile_shape, _plan = resolve_tile_shape(nb, nu, tile_shape, config, dtype, mesh)
-    tb, tu = tile_shape
-    if heal_divergent is None:
-        heal_divergent = os.environ.get("SBR_HEAL", "").strip() != "0"
+    # The cross-run global tile cache (resilience.elastic): None resolves
+    # from SBR_TILE_CACHE_DIR (unset = disabled, the historical behavior).
+    if tile_cache is None:
+        from sbr_tpu.resilience.elastic import default_tile_cache
 
-    if mesh is not None:
-        # Every tile (including ragged edge tiles) must satisfy
-        # beta_u_grid's divisibility precondition; validate up front so a
-        # deterministic sharding error is not retried.
-        # beta_u_grid shards by the axes NAMED "b" and "u" (its default
-        # mesh_axes), regardless of their order in the mesh.
-        mb, mu = mesh.shape["b"], mesh.shape["u"]
-        tile_dims = {min(tb, nb - bi) for bi in range(0, nb, tb)}, {
-            min(tu, nu - ui) for ui in range(0, nu, tu)
-        }
-        if any(d % mb for d in tile_dims[0]) or any(d % mu for d in tile_dims[1]):
-            raise ValueError(
-                f"Tile sizes {sorted(tile_dims[0])}×{sorted(tile_dims[1])} must be "
-                f"divisible by the mesh axes {mb}×{mu}; choose tile_shape/grid "
-                "sizes that are multiples of the mesh shape."
-            )
+        tile_cache = default_tile_cache()
 
-    ckpt = None
-    if checkpoint_dir is not None:
-        ckpt = Path(checkpoint_dir)
-        ckpt.mkdir(parents=True, exist_ok=True)
-        _check_fingerprint(
-            ckpt, _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype)
-        )
-
-    # OOM preflight: fail closed on an analytically-oversized tile BEFORE
-    # any device work (graceful skip on CPU/sharded — see _preflight_tile).
-    _preflight_tile(nb, nu, tb, tu, config, dtype, mesh, plan=_plan)
-
-    origins = tile_origins(nb, nu, tile_shape)
-    policy = retry.policy_from_env(
-        "SBR_RETRY",
-        max_attempts=max_retries + 1,
-        base_delay_s=1.0,
-        multiplier=2.0,
-        max_delay_s=60.0,
+    runner = tile_runner(
+        beta_values, u_values, base, checkpoint_dir, config=config,
+        tile_shape=tile_shape, mesh=mesh, dtype=dtype, max_retries=max_retries,
+        heal_divergent=heal_divergent, retry_budget=retry_budget,
+        tile_cache=tile_cache, verbose=verbose,
     )
-    if retry_budget is None:
-        budget_env = os.environ.get("SBR_RETRY_BUDGET", "").strip()
-        retry_budget = retry.RetryBudget(
-            int(budget_env) if budget_env else max(16, len(origins))
-        )
+    beta_values, u_values = runner.beta_values, runner.u_values
+    nb, nu, tb, tu = runner.nb, runner.nu, runner.tb, runner.tu
+    ckpt = runner.ckpt
+    origins = tile_origins(nb, nu, (tb, tu))
 
     # Keyed off _FIELDS so the accumulator, tile save, and cache load stay in
     # lockstep: adding a field without an init entry fails loudly here.
     field_init = {"max_aw": (np.nan, np.float64), "xi": (np.nan, np.float64), "status": (-1, np.int32)}
     out = {f: np.full((nb, nu), *field_init[f]) for f in _FIELDS}
 
-    n_cached = 0
-    repairs: list = []
     with shutdown.graceful_shutdown(label="tiled_grid"):
         for bi, ui in origins:
-            bs = slice(bi, min(bi + tb, nb))
-            us = slice(ui, min(ui + tu, nu))
-            path = _tile_path(ckpt, bi, ui) if ckpt is not None else None
-            tile_id = f"tile_b{bi:05d}_u{ui:05d}"
-
+            bs, us = runner.slices(bi, ui)
             owned = tile_owner is None or tile_owner(bi, ui)
-            if path is not None and path.exists():
-                cached = _load_tile_verified(path, may_quarantine=owned)
-                if cached is not None:
-                    for f in _FIELDS:
-                        out[f][bs, us] = cached[f]
-                    n_cached += 1
-                    continue
-                # corrupt tile: quarantined above (if owned) — recompute
+            cached = runner.load_local(bi, ui, may_quarantine=owned)
+            if cached is not None:
+                for f in _FIELDS:
+                    out[f][bs, us] = cached[f]
+                # Count through the runner so its per-source tally stays
+                # authoritative for every caller (the elastic driver reads
+                # counts["computed"] to gate its throughput-history append).
+                runner.counts["local"] += 1
+                continue
+            # corrupt tile: quarantined above (if owned) — recompute
 
             if not owned:
                 continue  # another process's tile; it lands on disk, not here
 
-            tile_snap: dict = {}
-
-            def compute_tile():
-                faults.fire("tile.compute", target=tile_id)
-                tile = beta_u_grid(
-                    beta_values[bs], u_values[us], base, config=config, mesh=mesh, dtype=dtype
-                )
-                arrays = {f: np.asarray(getattr(tile, f)).copy() for f in _FIELDS}
-                tile_flags = (
-                    np.asarray(tile.health.flags).copy()
-                    if tile.health is not None
-                    else np.zeros(arrays["status"].shape, np.int32)
-                )
-                if obs.current_run() is not None:
-                    # Snapshot while the tile's device buffers are still
-                    # live — after the host copies land, the live-buffer
-                    # sum would read an empty device.
-                    tile_snap.clear()
-                    tile_snap.update(obs.mem.snapshot())
-                return arrays, tile_flags
-
-            def observer(**rec):
-                if rec.get("outcome") in ("retrying", "gave_up", "budget_exhausted"):
-                    print(
-                        f"  tile ({bi},{ui}) attempt "
-                        f"{rec.get('attempt')}/{rec.get('max_attempts')} "
-                        f"{rec['outcome']}: {rec.get('error', '')}",
-                        file=sys.stderr,
-                    )
-                retry._default_observer(**rec)
-
-            try:
-                arrays, tile_flags = policy.call(
-                    compute_tile, scope=f"Tile ({bi},{ui})",
-                    budget=retry_budget, observer=observer,
-                )
-            except retry.RetryError as err:
-                raise RuntimeError(str(err)) from err.__cause__
-
-            # Chaos hook: a ``nan`` rule on tile.result poisons the computed
-            # arrays + health flags, simulating device garbage downstream of
-            # a successful dispatch; the degrade ladder below must repair it.
-            inj = faults.fire("tile.result", target=tile_id)
-            if inj is not None and inj.kind == "nan":
-                _poison_tile(inj, arrays, tile_flags, tile_id)
-
-            if heal_divergent and (tile_flags != 0).any():
-                tile_report = heal.repair_divergent(
-                    beta_values[bs], u_values[us], base, config, dtype,
-                    arrays, tile_flags, scope=tile_id,
-                )
-                if tile_report:
-                    repairs.extend({"tile": [bi, ui], **r} for r in tile_report)
-
+            _, arrays = runner.produce(bi, ui, skip_local=True)
             for f in _FIELDS:
                 out[f][bs, us] = arrays[f]
-            # Per-tile peak-memory attribution (obs.mem): one `mem` event
-            # with a `tile` field, folded into the manifest's tile table —
-            # `report memory` renders it and flags near-capacity tiles.
-            obs.log_tile_mem(tile_id, **tile_snap)
-            if path is not None:
-                _save_atomic(path, arrays)
-                # Chaos hook: a ``corrupt`` rule on checkpoint.save tears the
-                # file AFTER the save (and its sidecar) landed — exactly the
-                # torn-write mode verify-on-load must catch on the next read.
-                inj = faults.fire("checkpoint.save", target=tile_id)
-                if inj is not None and inj.kind == "corrupt":
-                    faults.corrupt_file(path)
             if verbose:
                 done = (bi // tb) * ((nu + tu - 1) // tu) + ui // tu + 1
                 total = ((nb + tb - 1) // tb) * ((nu + tu - 1) // tu)
                 print(f"  tile {done}/{total} done")
 
-    if verbose and n_cached:
-        print(f"  resumed {n_cached} tiles from {ckpt}")
-    if ckpt is not None and repairs:
-        _record_repairs(ckpt, repairs)
+    if verbose and runner.counts["local"]:
+        print(f"  resumed {runner.counts['local']} tiles from {ckpt}")
+    if ckpt is not None and runner.repairs:
+        _record_repairs(ckpt, runner.repairs)
 
     import jax.numpy as jnp
 

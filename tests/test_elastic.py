@@ -1,0 +1,401 @@
+"""Tests for the elastic sweep scheduler (`sbr_tpu.resilience.elastic`):
+heartbeat membership, the deterministic throughput-weighted claim plan,
+the cross-run global tile cache, the elastic multihost driver, the
+`report elastic` gate, and the gc satellites (ISSUE 8)."""
+
+import json
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.resilience import elastic, shutdown
+from sbr_tpu.utils import run_tiled_grid
+
+CFG = SolverConfig(n_grid=96, bisect_iters=40)
+BETAS = np.linspace(0.5, 2.0, 4)
+US = np.linspace(0.05, 0.5, 4)
+
+
+# ---------------------------------------------------------------------------
+# Membership: heartbeats
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeat:
+    def test_announce_live_withdraw(self, tmp_path):
+        hb = elastic.Heartbeat(tmp_path, host="h1", ttl_s=60.0)
+        hb.beat(tiles_done=3, cells_per_sec=12.5)
+        hosts = elastic.live_hosts(tmp_path)
+        assert hosts["h1"]["tiles_done"] == 3
+        assert hosts["h1"]["cells_per_sec"] == 12.5
+        hb.withdraw()
+        assert elastic.live_hosts(tmp_path) == {}
+        # withdraw also unregisters from the shutdown release registry
+        assert str(hb.path) not in shutdown._RELEASE_REGISTRY
+
+    def test_ttl_expiry_and_torn_write(self, tmp_path):
+        hb = elastic.Heartbeat(tmp_path, host="h1", ttl_s=10.0)
+        hb.beat()
+        # Exactly at TTL: dead (>=, matching the lease boundary semantics).
+        rec = json.loads(hb.path.read_text())
+        assert elastic.live_hosts(tmp_path, now=rec["ts"] + 10.0) == {}
+        assert "h1" in elastic.live_hosts(tmp_path, now=rec["ts"] + 9.999)
+        # A torn heartbeat counts as dead, not a crash.
+        hb.path.write_text("{torn")
+        assert elastic.live_hosts(tmp_path) == {}
+        hb.withdraw()
+
+    def test_heartbeat_released_on_graceful_shutdown(self, tmp_path):
+        """SIGTERM inside the shutdown envelope must remove registered
+        coordination files (heartbeat/lease) so peers reclaim immediately."""
+        hb = elastic.Heartbeat(tmp_path, host="h1", ttl_s=600.0)
+        hb.beat()
+        lease = tmp_path / "tile_b00000_u00000.lease"
+        lease.write_text("{}")
+        shutdown.release_on_exit(lease)
+        assert hb.path.exists() and lease.exists()
+        with pytest.raises(SystemExit) as exc:
+            with shutdown.graceful_shutdown(label="t"):
+                raise shutdown.Interrupted(signal.SIGTERM)
+        assert exc.value.code == 128 + signal.SIGTERM
+        assert not hb.path.exists() and not lease.exists()
+
+
+# ---------------------------------------------------------------------------
+# Cost model / rebalancing plan
+# ---------------------------------------------------------------------------
+
+
+class TestPlanClaims:
+    TILES = [((b, u), 16.0) for b in (0, 4, 8, 12) for u in (0, 4)]
+
+    def test_deterministic_and_exact_partition(self):
+        rates = {"b": 1.0, "a": 1.0, "c": 1.0}
+        p1 = elastic.plan_claims(self.TILES, rates)
+        p2 = elastic.plan_claims(list(reversed(self.TILES)), dict(rates))
+        assert p1 == p2  # same inputs (any order) -> same plan on every host
+        assigned = [t for tiles in p1.values() for t in tiles]
+        assert sorted(assigned) == sorted(t for t, _ in self.TILES)
+
+    def test_throughput_proportional_shares(self):
+        plan = elastic.plan_claims(self.TILES, {"fast": 3.0, "slow": 1.0})
+        assert len(plan["fast"]) == 6 and len(plan["slow"]) == 2
+
+    def test_lpt_orders_large_tiles_first(self):
+        tiles = [((0, 0), 4.0), ((0, 2), 16.0), ((2, 0), 16.0)]
+        plan = elastic.plan_claims(tiles, {"only": 1.0})
+        assert plan["only"][0] in ((0, 2), (2, 0))  # big tiles claimed first
+        assert plan["only"][-1] == (0, 0)
+
+    def test_degenerate_inputs(self):
+        assert elastic.plan_claims([], {"a": 1.0}) == {"a": []}
+        assert elastic.plan_claims(self.TILES, {}) == {}
+        # Non-positive published rates fall back to 1.0, not a crash.
+        plan = elastic.plan_claims(self.TILES, {"a": 0.0, "b": -3.0})
+        assert len(plan["a"]) + len(plan["b"]) == len(self.TILES)
+
+    def test_tracker_ewma_and_history_seed(self, tmp_path, monkeypatch):
+        tr = elastic.ThroughputTracker()
+        tr.update(100, 2.0)
+        assert tr.rate == 50.0
+        tr.update(100, 1.0)
+        assert 50.0 < tr.rate < 100.0
+        # Seed from the SIDECAR elastic history (kept beside, not inside,
+        # the trend-gated file — see _rate_history_path).
+        monkeypatch.setenv("SBR_OBS_HISTORY", str(tmp_path / "h.jsonl"))
+        from sbr_tpu.obs import history
+
+        sidecar = elastic._rate_history_path()
+        assert str(sidecar).endswith("h.jsonl.elastic.jsonl")
+        for v in (10.0, 30.0, 20.0):
+            history.append({"elastic_cells_per_sec": v}, label="elastic_sweep",
+                           path=sidecar)
+        assert elastic.seed_rate_from_history() == 20.0
+        # The gated main history stays untouched by elastic appends.
+        elastic._append_rate_history(42.0, tiles_computed=3)
+        assert not (tmp_path / "h.jsonl").exists()
+        assert len(history.load(sidecar)) == 4
+
+
+# ---------------------------------------------------------------------------
+# Cross-run global tile cache
+# ---------------------------------------------------------------------------
+
+
+def _arrays(seed=0.0):
+    return {
+        "max_aw": np.full((2, 2), 1.5 + seed),
+        "xi": np.full((2, 2), 2.5 + seed),
+        "status": np.zeros((2, 2), np.int32),
+    }
+
+
+class TestTileCache:
+    def test_roundtrip_byte_identical(self, tmp_path):
+        cache = elastic.TileCache(tmp_path / "cache")
+        base = make_model_params()
+        key = cache.key(base, CFG, None, BETAS[:2], US[:2])
+        arrays = _arrays()
+        assert cache.load(key) is None  # cold
+        cache.store(key, arrays)
+        got = cache.load(key)
+        assert all(got[f].tobytes() == arrays[f].tobytes() for f in arrays)
+
+    def test_key_distinguishes_sweeps(self):
+        cache = elastic.TileCache("/nonexistent")
+        base = make_model_params()
+        k = cache.key(base, CFG, None, BETAS[:2], US[:2])
+        assert k != cache.key(base, CFG, None, BETAS[:2], US[2:])  # values
+        assert k != cache.key(base, SolverConfig(n_grid=128), None, BETAS[:2], US[:2])
+        assert k != cache.key(base, CFG, "float32", BETAS[:2], US[:2])
+        # Same inputs reproduce the key (process-stable content address).
+        assert k == cache.key(base, CFG, None, BETAS[:2], US[:2])
+
+    def test_corrupt_entry_quarantined_not_served(self, tmp_path):
+        from sbr_tpu.resilience import faults
+
+        cache = elastic.TileCache(tmp_path / "cache")
+        key = cache.key(make_model_params(), CFG, None, BETAS[:2], US[:2])
+        cache.store(key, _arrays())
+        faults.corrupt_file(cache.path(key))
+        assert cache.load(key) is None
+        assert not cache.path(key).exists()  # slot freed for recompute
+        assert list((cache.path(key).parent / "quarantine").glob("*.npz"))
+
+    def test_gc_prunes_cold_keeps_warm(self, tmp_path):
+        import os
+
+        cache = elastic.TileCache(tmp_path / "cache")
+        base = make_model_params()
+        k_cold = cache.key(base, CFG, None, BETAS[:2], US[:2])
+        k_warm = cache.key(base, CFG, None, BETAS[2:], US[2:])
+        cache.store(k_cold, _arrays())
+        cache.store(k_warm, _arrays(1.0))
+        old = time.time() - 40 * 86400
+        os.utime(cache.path(k_cold), (old, old))
+        # A hard-killed writer's orphaned store tmp is debris past an hour.
+        orphan = cache.path(k_warm).parent / "tmpdead.tmp"
+        orphan.write_bytes(b"partial")
+        os.utime(orphan, (time.time() - 7200, time.time() - 7200))
+        removed = elastic.gc_tile_cache(tmp_path / "cache", keep_days=30.0)
+        assert cache.path(k_cold) in removed
+        assert not cache.path(k_cold).exists()
+        assert orphan in removed and not orphan.exists()
+        assert cache.load(k_warm) is not None  # warm entry survived
+
+    def test_recorded_tile_shape_adopted_by_auto_joiner(self, tmp_path):
+        """The creating host's resolved shape lands in the checkpoint
+        manifest; a late joiner with tile_shape='auto' adopts it instead of
+        re-planning from its own capacity (heterogeneous-fleet join)."""
+        base = make_model_params()
+        ck = tmp_path / "ck"
+        run_tiled_grid(BETAS, US, base, config=CFG, tile_shape=(2, 2),
+                       checkpoint_dir=ck, tile_owner=lambda b, u: False)
+        assert elastic.recorded_tile_shape(ck) == (2, 2)
+        assert elastic.recorded_tile_shape(tmp_path / "nope") is None
+
+    def test_heartbeat_survives_transient_write_failure(self, tmp_path, monkeypatch):
+        hb = elastic.Heartbeat(tmp_path, host="h1", ttl_s=60.0)
+        import os as _os
+
+        real_replace = _os.replace
+        monkeypatch.setattr(
+            elastic.os, "replace",
+            lambda *a: (_ for _ in ()).throw(OSError("ESTALE")),
+        )
+        hb.beat()  # must not raise: liveness telemetry is best-effort
+        monkeypatch.setattr(elastic.os, "replace", real_replace)
+        hb.beat(tiles_done=1)
+        assert elastic.live_hosts(tmp_path)["h1"]["tiles_done"] == 1
+        hb.withdraw()
+
+
+# ---------------------------------------------------------------------------
+# The elastic driver end-to-end (single process playing several roles)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticSweep:
+    def test_single_host_matches_direct_run(self, tmp_path):
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        base = make_model_params()
+        full = run_tiled_grid_multihost(
+            BETAS, US, base, str(tmp_path / "ck"), config=CFG, tile_shape=(2, 2),
+            poll_s=0.05, timeout_s=60.0, elastic=True,
+        )
+        direct = run_tiled_grid(BETAS, US, base, config=CFG, tile_shape=(2, 2))
+        for f in ("max_aw", "xi", "status"):
+            assert np.asarray(getattr(full, f)).tobytes() == np.asarray(
+                getattr(direct, f)
+            ).tobytes()
+        # Scaffolding cleaned: no leases, no heartbeats left behind.
+        assert not list((tmp_path / "ck").glob("*.lease"))
+        assert not list((tmp_path / "ck").glob("host_*.hb"))
+
+    def test_joiner_adopts_mid_sweep_remainder(self, tmp_path):
+        """A 'late joiner' against a checkpoint dir where another host
+        already landed half the tiles computes only the remainder —
+        launch-time ownership does not exist."""
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        base = make_model_params()
+        ck = tmp_path / "ck"
+        # Half the sweep already on disk (the departed host's work).
+        run_tiled_grid(
+            BETAS, US, base, config=CFG, tile_shape=(2, 2), checkpoint_dir=ck,
+            tile_owner=lambda b, u: b == 0,
+        )
+        assert len(list(ck.glob("tile_*.npz"))) == 2
+        from sbr_tpu import obs
+
+        with obs.run_context(label="join", run_dir=tmp_path / "run"):
+            run_tiled_grid_multihost(
+                BETAS, US, base, str(ck), config=CFG, tile_shape=(2, 2),
+                poll_s=0.05, timeout_s=60.0, elastic=True,
+            )
+        manifest = json.loads((tmp_path / "run" / "manifest.json").read_text())
+        blk = manifest["elastic"]
+        assert blk["tiles"].get("computed") == 2  # only the remainder
+        assert blk["scheduler"]["join"] == 1 and blk["scheduler"]["leave"] == 1
+
+    def test_live_peer_lease_respected_then_reclaimed_after_ttl(self, tmp_path):
+        """A tile leased by a live peer is not touched; once the lease TTL
+        lapses the claim loop takes it over (the silent-death path)."""
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+        from sbr_tpu.parallel.distributed import _try_lease
+
+        base = make_model_params()
+        ck = tmp_path / "ck"
+        ck.mkdir()
+        assert _try_lease(ck, 0, 0, ttl_s=2.0)  # a "peer" holds tile (0,0)
+        t0 = time.monotonic()
+        from sbr_tpu import obs
+
+        with obs.run_context(label="reclaim", run_dir=tmp_path / "run"):
+            full = run_tiled_grid_multihost(
+                BETAS, US, base, str(ck), config=CFG, tile_shape=(2, 2),
+                poll_s=0.1, timeout_s=60.0, elastic=True,
+            )
+        assert time.monotonic() - t0 >= 1.0  # actually waited out the TTL
+        direct = run_tiled_grid(BETAS, US, base, config=CFG, tile_shape=(2, 2))
+        assert np.asarray(full.xi).tobytes() == np.asarray(direct.xi).tobytes()
+        manifest = json.loads((tmp_path / "run" / "manifest.json").read_text())
+        assert manifest["elastic"]["scheduler"].get("reclaim", 0) >= 1
+
+    def test_warm_global_cache_computes_zero_tiles(self, tmp_path, monkeypatch):
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        monkeypatch.setenv("SBR_TILE_CACHE_DIR", str(tmp_path / "cache"))
+        base = make_model_params()
+        kwargs = dict(config=CFG, tile_shape=(2, 2), poll_s=0.05,
+                      timeout_s=60.0, elastic=True)
+        cold = run_tiled_grid_multihost(BETAS, US, base, str(tmp_path / "ck1"), **kwargs)
+        from sbr_tpu import obs
+
+        with obs.run_context(label="warm", run_dir=tmp_path / "run"):
+            warm = run_tiled_grid_multihost(
+                BETAS, US, base, str(tmp_path / "ck2"), **kwargs
+            )
+        assert np.asarray(warm.xi).tobytes() == np.asarray(cold.xi).tobytes()
+        manifest = json.loads((tmp_path / "run" / "manifest.json").read_text())
+        blk = manifest["elastic"]
+        assert blk["tiles"].get("computed") is None or blk["tiles"].get("computed", 0) == 0
+        assert blk["tiles"].get("cache") == 4
+        assert blk["cache"].get("hit") == 4
+
+    def test_wait_false_returns_none_after_claiming(self, tmp_path):
+        from sbr_tpu.parallel import run_tiled_grid_multihost
+
+        base = make_model_params()
+        out = run_tiled_grid_multihost(
+            BETAS, US, base, str(tmp_path / "ck"), config=CFG, tile_shape=(2, 2),
+            wait=False, elastic=True,
+        )
+        assert out is None
+        # Sole host + work-conserving queue: it computed everything.
+        assert len(list((tmp_path / "ck").glob("tile_*.npz"))) == 4
+
+
+# ---------------------------------------------------------------------------
+# report elastic + gc satellites
+# ---------------------------------------------------------------------------
+
+
+class TestReportElastic:
+    def _report(self, run_dir, *extra):
+        return subprocess.run(
+            [sys.executable, "-m", "sbr_tpu.obs.report", "elastic", str(run_dir), *extra],
+            capture_output=True, text=True, timeout=120.0,
+        )
+
+    def test_no_elastic_data_exits_three(self, tmp_path):
+        from sbr_tpu import obs
+
+        with obs.run_context(label="plain", run_dir=tmp_path / "run"):
+            pass
+        proc = self._report(tmp_path / "run")
+        assert proc.returncode == 3
+        assert "no scheduler events" in proc.stdout
+
+    def test_scheduler_story_rendered_and_json(self, tmp_path):
+        from sbr_tpu import obs
+
+        with obs.run_context(label="el", run_dir=tmp_path / "run") as run:
+            run.log_scheduler("join", host="h1", tiles=4)
+            run.log_scheduler("claim", host="h1", tile="tile_b00000_u00000")
+            run.log_scheduler("done", host="h1", tile="tile_b00000_u00000",
+                              source="computed", dur_s=2.0, cells=4)
+            run.log_scheduler("done", host="h1", tile="tile_b00000_u00002",
+                              source="cache", dur_s=0.01, cells=4)
+            run.log_cache("hit", tile="tile_b00000_u00002")
+            run.log_scheduler("leave", host="h1", tiles_done=2)
+        proc = self._report(tmp_path / "run", "--json")
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["tiles_computed"] == 1 and doc["tiles_from_cache"] == 1
+        assert doc["hosts"]["h1"]["tiles_done"] == 2
+        assert doc["hosts"]["h1"]["cells_per_sec"] == 2.0
+        assert doc["cache"] == {"hit": 1}
+        human = self._report(tmp_path / "run")
+        assert human.returncode == 0
+        assert "HOSTS" in human.stdout and "GLOBAL TILE CACHE" in human.stdout
+
+    def test_gc_prunes_stale_heartbeats_keeps_live(self, tmp_path):
+        from sbr_tpu.obs import mem
+
+        live = elastic.Heartbeat(tmp_path, host="live", ttl_s=600.0)
+        live.beat()
+        dead = elastic.Heartbeat(tmp_path, host="dead", ttl_s=1.0)
+        dead.beat()
+        rec = json.loads(dead.path.read_text())
+        rec["ts"] -= 60.0
+        dead.path.write_text(json.dumps(rec))
+        removed = mem.gc_debris(tmp_path)
+        assert dead.path in removed and not dead.path.exists()
+        assert live.path.exists()
+        live.withdraw()
+
+    def test_report_gc_tile_cache_cli(self, tmp_path):
+        import os
+
+        cache = elastic.TileCache(tmp_path / "cache")
+        key = cache.key(make_model_params(), CFG, None, BETAS[:2], US[:2])
+        cache.store(key, _arrays())
+        old = time.time() - 40 * 86400
+        os.utime(cache.path(key), (old, old))
+        proc = subprocess.run(
+            [sys.executable, "-m", "sbr_tpu.obs.report", "gc", str(tmp_path / "runs"),
+             "--keep", "4", "--tile-cache", str(tmp_path / "cache"),
+             "--keep-days", "30"],
+            capture_output=True, text=True, timeout=120.0,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "cold tile-cache" in proc.stdout
+        assert not cache.path(key).exists()

@@ -216,6 +216,33 @@ class TestRetryPolicy:
         p = retry.policy_from_env("SBR_X", max_attempts=3, base_delay_s=10.0)
         assert p.max_attempts == 7 and p.base_delay_s == 0.5
 
+    def test_budget_time_based_refill(self):
+        """Direct RetryBudget refill coverage (ISSUE 8 satellite — the
+        serve engine only exercised it indirectly): the pool refreshes
+        lazily against an injectable clock, and a read EXACTLY at the
+        refill boundary (>=) refills."""
+        clock = {"t": 0.0}
+        budget = RetryBudget(2, refill_s=10.0, clock=lambda: clock["t"])
+        assert budget.take() and budget.take() and not budget.take()
+        assert budget.remaining == 0
+        clock["t"] = 9.999  # strictly inside the window: still dry
+        assert budget.remaining == 0 and not budget.take()
+        clock["t"] = 10.0  # exactly at the boundary: refilled
+        assert budget.remaining == 2
+        assert budget.take()
+        # The epoch reset at the refill: the NEXT window starts at t=10.
+        clock["t"] = 19.999
+        assert budget.remaining == 1
+        clock["t"] = 20.0
+        assert budget.remaining == 2
+
+    def test_budget_without_refill_keeps_one_shot_semantics(self):
+        clock = {"t": 0.0}
+        budget = RetryBudget(1, clock=lambda: clock["t"])
+        assert budget.take() and not budget.take()
+        clock["t"] = 1e9
+        assert budget.remaining == 0  # sweeps rely on a non-refilling pool
+
 
 # ---------------------------------------------------------------------------
 # Self-healing tile execution
@@ -422,7 +449,7 @@ class TestWorkStealing:
         full = run_tiled_grid_multihost(
             betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
             process_id=0, num_processes=2, poll_s=0.05, timeout_s=120.0,
-            steal_grace_s=0.2, lease_ttl_s=5.0,
+            steal_grace_s=0.2, lease_ttl_s=5.0, elastic=False,
         )
         assert len(list(tmp_path.glob("tile_*.npz"))) == 4
         assert not list(tmp_path.glob("tile_*.lease"))  # scaffolding cleaned
@@ -443,6 +470,48 @@ class TestWorkStealing:
         rec["ts"] -= 120.0
         lease.write_text(json.dumps(rec))
         assert _try_lease(tmp_path, 0, 0, ttl_s=60.0) is True
+
+    def test_lease_takeover_exactly_at_ttl_boundary(self, tmp_path, monkeypatch):
+        """age == ttl is EXPIRED (strict `<` keeps a lease alive only
+        strictly inside its window) — ISSUE 8 satellite, pinned with a
+        frozen clock so the boundary is exact."""
+        from sbr_tpu.parallel import distributed
+
+        assert distributed._try_lease(tmp_path, 0, 0, ttl_s=60.0) is True
+        lease = tmp_path / "tile_b00000_u00000.lease"
+        ts = json.loads(lease.read_text())["ts"]
+        monkeypatch.setattr(distributed.time, "time", lambda: ts + 60.0)
+        assert distributed._try_lease(tmp_path, 0, 0, ttl_s=60.0) is True
+        # One tick inside the window: the holder keeps it.
+        fresh_ts = json.loads(lease.read_text())["ts"]
+        monkeypatch.setattr(distributed.time, "time", lambda: fresh_ts + 59.999)
+        assert distributed._try_lease(tmp_path, 0, 0, ttl_s=60.0) is False
+
+    def test_expired_lease_race_loser_backs_off(self, tmp_path, monkeypatch):
+        """Double-steal window fix (ISSUE 8 satellite): when a racer's
+        record lands AFTER ours during an expired-lease takeover, the
+        nonce re-read must tell us we LOST and _try_lease returns False."""
+        import os as _os
+
+        from sbr_tpu.parallel import distributed
+
+        assert distributed._try_lease(tmp_path, 0, 0, ttl_s=60.0) is True
+        lease = tmp_path / "tile_b00000_u00000.lease"
+        rec = json.loads(lease.read_text())
+        rec["ts"] -= 120.0  # expired: both survivors go for the takeover
+        lease.write_text(json.dumps(rec))
+
+        real_replace = _os.replace
+
+        def racing_replace(src, dst):
+            real_replace(src, dst)
+            if str(dst) == str(lease):  # the racer replaces right after us
+                rival = dict(json.loads(lease.read_text()))
+                rival["nonce"] = "rival-nonce"
+                lease.write_text(json.dumps(rival))
+
+        monkeypatch.setattr(distributed.os, "replace", racing_replace)
+        assert distributed._try_lease(tmp_path, 0, 0, ttl_s=60.0) is False
 
 
 # ---------------------------------------------------------------------------

@@ -168,6 +168,9 @@ class TestMultiHostFarming:
                 assert max(sizes) - min(sizes) <= 1
 
     def test_two_process_farm_assembles_full_grid(self, tmp_path):
+        """Legacy static split (elastic=False): ownership is the launch-time
+        tile_assignment share — elastic claim-queue semantics are covered by
+        tests/test_elastic.py."""
         from sbr_tpu.parallel import run_tiled_grid_multihost
 
         base = make_model_params()
@@ -177,7 +180,7 @@ class TestMultiHostFarming:
         # worker 0: computes its share, returns immediately (wait=False)
         out0 = run_tiled_grid_multihost(
             betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
-            process_id=0, num_processes=2, wait=False,
+            process_id=0, num_processes=2, wait=False, elastic=False,
         )
         assert out0 is None
         n_after_0 = len(list(tmp_path.glob("tile_*.npz")))
@@ -187,6 +190,7 @@ class TestMultiHostFarming:
         full = run_tiled_grid_multihost(
             betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
             process_id=1, num_processes=2, poll_s=0.1, timeout_s=10.0,
+            elastic=False,
         )
         assert len(list(tmp_path.glob("tile_*.npz"))) == 4
         direct = run_tiled_grid(betas, us, base, config=CFG, tile_shape=(3, 4))
@@ -205,6 +209,7 @@ class TestMultiHostFarming:
             run_tiled_grid_multihost(
                 betas, us, base, str(tmp_path), config=CFG, tile_shape=(3, 4),
                 process_id=0, num_processes=2, poll_s=0.05, timeout_s=0.3,
+                elastic=False, work_steal=False,
             )
 
     def test_initialize_distributed_single_process_noop(self, monkeypatch):
