@@ -40,7 +40,7 @@ def logistic_pdf(t, beta, x0):
 
 def solve_learning(
     params: LearningParams,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     dtype=jnp.float64,
 ) -> LearningSolution:
     """Solve Stage 1 on a static uniform grid (reference `solve_learning`,
@@ -51,6 +51,8 @@ def solve_learning(
     curves (e.g. as the social-learning initial guess,
     `social_learning_solver.jl:90-94`).
     """
+    if config is None:
+        config = SolverConfig()
     from sbr_tpu import obs
 
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))  # x64-aware

@@ -29,7 +29,13 @@ import jax.numpy as jnp
 
 from sbr_tpu.baseline.learning import logistic_cdf, logistic_pdf
 from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre
-from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
+from sbr_tpu.core.rootfind import (
+    bisect,
+    chandrupatla,
+    first_upcrossing,
+    last_downcrossing,
+    threshold_crossings_masked,
+)
 from sbr_tpu.models.params import EconomicParams, SolverConfig
 from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
 
@@ -165,7 +171,7 @@ def _hazard_parts(p, lam, ls: LearningSolution, eta, config: SolverConfig):
     return tau_grid, hr, integ, int_eta
 
 
-def hazard_rate(p, lam, ls: LearningSolution, eta, config: SolverConfig = SolverConfig()):
+def hazard_rate(p, lam, ls: LearningSolution, eta, config: SolverConfig | None = None):
     """Hazard rate h(τ̄) on a static [0, η] grid (`solver.jl:153-185`).
 
     h(τ̄) = p·e^{λτ̄}·g(τ̄) / (p·∫₀^τ̄ e^{λs}g(s)ds + (1-p)·∫₀^η e^{λs}g(s)ds)
@@ -174,6 +180,8 @@ def hazard_rate(p, lam, ls: LearningSolution, eta, config: SolverConfig = Solver
     reference's division by a zero integral (used only by the plotting layer's
     h_f decomposition, `plotting.jl:62-132`).
     """
+    if config is None:
+        config = SolverConfig()
     tau_grid, hr, _, _ = _hazard_parts(p, lam, ls, eta, config)
     return tau_grid, hr
 
@@ -214,7 +222,14 @@ def _make_hazard_at(p, lam, ls: LearningSolution, tau_grid, integ, int_eta, conf
 
 
 def optimal_buffer(
-    u, tau_grid, hr, tspan_end, hazard_at=None, refine_iters: int = 60, with_health: bool = False
+    u,
+    tau_grid,
+    hr,
+    tspan_end,
+    hazard_at=None,
+    refine_iters: int = 60,
+    with_health: bool = False,
+    adaptive: bool = False,
 ):
     """Unconstrained buffer times (τ̄_IN, τ̄_OUT) where h crosses u
     (`solver.jl:211-264`), with the reference's boundary fallbacks.
@@ -226,11 +241,24 @@ def optimal_buffer(
     poison in the hazard/level) is appended; the refinement bisections stay
     health-free — in fallback lanes their brackets are legitimately
     degenerate and the coarse crossing flags already tell the story.
+
+    ``adaptive`` (SolverConfig.numerics == "adaptive", ISSUE 9) swaps the
+    two O(n) crossing scans for the fused blocked search
+    (`core.rootfind.threshold_crossings_masked` — bit-identical indices,
+    O(√n) per cell once the hazard tables hoist out of the sweeps' u axis)
+    and the fixed-iteration refinement bisections for convergence-masked
+    `chandrupatla` with the same iteration budget.
     """
     from sbr_tpu.diag.health import as_out_crossing
 
     default = jnp.asarray(tspan_end, dtype=hr.dtype)
-    if with_health:
+    if adaptive:
+        out = threshold_crossings_masked(
+            tau_grid, hr, u, default, with_health=with_health
+        )
+        t_in, has_up, t_out, has_dn = out[:4]
+        cross_health = out[4].merge(as_out_crossing(out[5])) if with_health else None
+    elif with_health:
         t_in, has_up, h_in = first_upcrossing(
             tau_grid, hr, u, default, return_flag=True, with_health=True
         )
@@ -256,11 +284,16 @@ def optimal_buffer(
         hi = tau_grid[jnp.minimum(i + 2, n - 1)]
         return lo, hi
 
+    refine = (
+        (lambda f, lo, hi: chandrupatla(f, lo, hi, budget=refine_iters))
+        if adaptive
+        else (lambda f, lo, hi: bisect(f, lo, hi, num_iters=refine_iters))
+    )
     lo_i, hi_i = bracket(t_in)
-    t_in_ref = bisect(lambda t: hazard_at(t) - u, lo_i, hi_i, num_iters=refine_iters)
+    t_in_ref = refine(lambda t: hazard_at(t) - u, lo_i, hi_i)
     lo_o, hi_o = bracket(t_out)
     # down-crossing: u - h is locally increasing
-    t_out_ref = bisect(lambda t: u - hazard_at(t), lo_o, hi_o, num_iters=refine_iters)
+    t_out_ref = refine(lambda t: u - hazard_at(t), lo_o, hi_o)
     t_in = jnp.where(has_up, t_in_ref, t_in)
     t_out = jnp.where(has_dn, t_out_ref, t_out)
     return (t_in, t_out, cross_health) if with_health else (t_in, t_out)
@@ -271,7 +304,7 @@ def compute_xi(
     tau_bar_out_unc,
     ls: LearningSolution,
     kappa,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     lo=None,
     hi=None,
     x0=None,
@@ -290,7 +323,15 @@ def compute_xi(
     With ``with_health`` the bisection's `diag.Health` (final residual —
     identical to abs_error, XLA CSEs the shared evaluation — bracket width,
     bracket-validity and NaN flags) is appended.
+
+    Under ``config.numerics == "adaptive"`` the fixed halvings become
+    convergence-masked `chandrupatla` with ``bisect_iters`` as the budget;
+    the health then carries ACTUAL per-cell iteration counts, not the
+    budget. ``numerics="fixed"`` reproduces the reference update rule
+    bit-for-bit.
     """
+    if config is None:
+        config = SolverConfig()
     dtype = ls.cdf.dtype
     kappa = jnp.asarray(kappa, dtype=dtype)
     lo = tau_bar_in_unc if lo is None else lo
@@ -301,14 +342,24 @@ def compute_xi(
         t_in = jnp.minimum(tau_bar_in_unc, xi)
         return ls.cdf_at(t_out) - ls.cdf_at(t_in)
 
-    out = bisect(
-        lambda x: aw_of(x) - kappa,
-        lo,
-        hi,
-        num_iters=config.bisect_iters,
-        x0=x0,
-        with_health=with_health,
-    )
+    if config.adaptive:
+        out = chandrupatla(
+            lambda x: aw_of(x) - kappa,
+            lo,
+            hi,
+            budget=config.bisect_iters,
+            x0=x0,
+            with_health=with_health,
+        )
+    else:
+        out = bisect(
+            lambda x: aw_of(x) - kappa,
+            lo,
+            hi,
+            num_iters=config.bisect_iters,
+            x0=x0,
+            with_health=with_health,
+        )
     xi, xi_health = out if with_health else (out, None)
 
     aw = aw_of(xi)
@@ -405,13 +456,15 @@ def solve_equilibrium_core(
     lam,
     eta,
     tspan_end,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
 ) -> EquilibriumResult:
     """Scalar-parameter equilibrium solve — the vmap/pjit unit of the sweeps.
 
     Faithful to `solve_equilibrium_baseline` (`solver.jl:413-462`) including
     the trivial no-crossing branch, expressed branchlessly via status codes.
     """
+    if config is None:
+        config = SolverConfig()
     from sbr_tpu import obs
 
     dtype = ls.cdf.dtype
@@ -430,7 +483,8 @@ def solve_equilibrium_core(
     )
     with obs.span("baseline.buffers") as sp:
         tau_in_unc, tau_out_unc, cross_health = optimal_buffer(
-            u, tau_grid, hr, tspan_end, hazard_at=hazard_at, with_health=True
+            u, tau_grid, hr, tspan_end, hazard_at=hazard_at, with_health=True,
+            adaptive=config.adaptive,
         )
         sp.sync(tau_in_unc, tau_out_unc)
 
@@ -512,7 +566,7 @@ def _jitted_core(config: SolverConfig):
 def solve_equilibrium_baseline(
     ls: LearningSolution,
     econ: EconomicParams,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     tspan_end=None,
 ) -> EquilibriumResult:
     """Convenience entry mirroring `solve_equilibrium_baseline(lr, econ)`
@@ -526,6 +580,8 @@ def solve_equilibrium_baseline(
     compile/execute split and XLA cost analysis; results are the same pure
     function of the inputs either way (jit vs eager may differ in the last
     ulp of f64, well inside every tolerance in the package)."""
+    if config is None:
+        config = SolverConfig()
     from sbr_tpu import obs
 
     if tspan_end is None:

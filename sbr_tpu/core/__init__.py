@@ -9,8 +9,10 @@ from sbr_tpu.core.interp import interp, interp_guided, interp_uniform
 from sbr_tpu.core.integrate import cumtrapz, cumulative_gauss_legendre, trapz
 from sbr_tpu.core.rootfind import (
     bisect,
+    chandrupatla,
     first_upcrossing,
     last_downcrossing,
     threshold_crossings,
+    threshold_crossings_masked,
 )
-from sbr_tpu.core.ode import rk4
+from sbr_tpu.core.ode import bs32, rk4

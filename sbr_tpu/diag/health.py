@@ -58,6 +58,7 @@ NAN_INPUT = 1 << 6  # NaN among the primitive's inputs (curve, level, bracket)
 NAN_OUTPUT = 1 << 7  # non-finite values in a computed result (iterate, curve)
 FP_NOT_CONVERGED = 1 << 8  # fixed point hit max_iter without converging
 FP_ABORTED = 1 << 9  # fixed point's ξ search exceeded η and gave up
+ODE_BUDGET = 1 << 10  # adaptive ODE interval exhausted its step budget
 
 FLAG_NAMES = {
     FALLBACK_IN_KNOT: "fallback_in_knot",
@@ -70,6 +71,7 @@ FLAG_NAMES = {
     NAN_OUTPUT: "nan_output",
     FP_NOT_CONVERGED: "fp_not_converged",
     FP_ABORTED: "fp_aborted",
+    ODE_BUDGET: "ode_budget",
 }
 ALL_FLAGS = tuple(FLAG_NAMES)
 
@@ -223,6 +225,12 @@ def summarize(health: Health, status=None, worst_k: int = 5) -> dict:
         "divergent": divergent,
         "flag_counts": flag_counts,
         "iterations_total": int(iters.sum()),
+        # Effective-iteration statistics (adaptive numerics, ISSUE 9): with
+        # convergence-masked solvers `iterations` records the count each
+        # cell ACTUALLY ran, so the mean/max expose how far typical cells
+        # undershoot the worst-case budget (fixed mode reports the budget).
+        "iterations_mean": round(float(iters.mean()), 2) if n else 0.0,
+        "iterations_max": int(iters.max()) if n else 0,
     }
 
     finite = np.isfinite(res)

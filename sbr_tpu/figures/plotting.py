@@ -58,7 +58,7 @@ def plot_hazard_rate_decomposition(
     result,
     ls,
     econ,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     threshold_curve: Optional[np.ndarray] = None,
     threshold_label: Optional[str] = None,
 ):
@@ -69,6 +69,8 @@ def plot_hazard_rate_decomposition(
     interest-rate extension's u + rV(τ) threshold instead of the flat u line
     (`scripts/3_interest_rates.jl:141-156`).
     """
+    if config is None:
+        config = SolverConfig()
     xi = float(result.xi)
     eta = float(econ.eta)
     u = float(econ.u)

@@ -22,7 +22,7 @@ import numpy as np
 from jax import lax
 
 from sbr_tpu.core.integrate import cumulative_gauss_legendre
-from sbr_tpu.core.ode import rk4
+from sbr_tpu.core.ode import bs32, rk4
 from sbr_tpu.models.params import LearningParamsHetero, SolverConfig
 from sbr_tpu.models.results import LearningSolutionHetero
 
@@ -59,12 +59,22 @@ def solve_learning_hetero_arrays(
     grid: jnp.ndarray,
     substeps: int,
     axis_name=None,
+    adaptive_tols=None,
 ) -> LearningSolutionHetero:
     """Array-level coupled solve — the shard_map-compatible core.
 
     ``betas``/``dist`` are the (local slice of the) group axis; with
     ``axis_name`` the ω reductions psum across the sharded axis, so every
     shard integrates its groups against the GLOBAL mixing field.
+
+    ``adaptive_tols=(rtol, atol)`` (ISSUE 9) integrates with the adaptive
+    embedded pair `core.ode.bs32` instead of ``substeps`` fixed RK4
+    micro-steps — smooth stretches take one step per save interval where
+    `hetero_substeps` budgets for the fastest group's worst case. Only for
+    the UNSHARDED path: under a sharded group axis the error norm would
+    have to psum so every shard takes identical steps; the sharded entry
+    keeps fixed RK4 (bit-exact sharding equivalence is one of its test
+    contracts).
     """
     dtype = betas.dtype
     g0 = jnp.full(betas.shape, x0, dtype=dtype)
@@ -75,7 +85,19 @@ def solve_learning_hetero_arrays(
         from sbr_tpu.parallel.compat import pcast
 
         g0 = pcast(g0, (axis_name,), to="varying")
-    cdfs = rk4(hetero_rhs, g0, grid, args=(betas, dist, axis_name), substeps=substeps)  # (n, K)
+    ode_flags = None
+    if adaptive_tols is not None and axis_name is None:
+        rtol, atol = adaptive_tols
+        cdfs, ode_health = bs32(
+            hetero_rhs, g0, grid, args=(betas, dist, None), rtol=rtol, atol=atol,
+            with_health=True,
+        )  # (n, K)
+        # Only the flags ride along: ODE_BUDGET (an interval exhausted its
+        # step cap and bridged unchecked) would otherwise be invisible —
+        # the clip below hides even wild trajectories from downstream.
+        ode_flags = ode_health.flags
+    else:
+        cdfs = rk4(hetero_rhs, g0, grid, args=(betas, dist, axis_name), substeps=substeps)  # (n, K)
     cdfs = jnp.clip(cdfs.T, 0.0, 1.0)  # (K, n)
 
     omega = jnp.einsum("k,kn->n", dist, cdfs)
@@ -91,6 +113,7 @@ def solve_learning_hetero_arrays(
         dt=grid[1] - grid[0],
         betas=betas,
         dist=dist,
+        ode_flags=ode_flags,
     )
 
 
@@ -171,7 +194,7 @@ def _omega_knots(betas, dist, x0, omega_hi, n_q, n_log, dtype):
 
 def solve_learning_hetero_exact(
     params: LearningParamsHetero,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     dtype=jnp.float64,
 ):
     """Exact hetero Stage 1 via the Ω reduction (module header above).
@@ -181,6 +204,8 @@ def solve_learning_hetero_exact(
     per-group arrays (kept separate so the sharded path can expand LOCAL
     group rows from the same replicated table).
     """
+    if config is None:
+        config = SolverConfig()
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
     t0, t1 = params.tspan
     if t0 != 0.0:
@@ -247,7 +272,7 @@ def hetero_solution_from_omega(
 
 def solve_learning_hetero(
     params: LearningParamsHetero,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     dtype=jnp.float64,
 ) -> LearningSolutionHetero:
     """Solve the K-group system.
@@ -259,6 +284,8 @@ def solve_learning_hetero(
     RK4 scan on a uniform grid (kept as a differential oracle for the
     exact path and for bit-exact sharding-equivalence tests).
     """
+    if config is None:
+        config = SolverConfig()
     dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
     betas = jnp.asarray(params.betas, dtype=dtype)
     dist = jnp.asarray(params.dist, dtype=dtype)
@@ -270,5 +297,6 @@ def solve_learning_hetero(
     t0, t1 = params.tspan
     grid = jnp.linspace(t0, t1, config.n_grid, dtype=dtype)
     return solve_learning_hetero_arrays(
-        betas, dist, params.x0, grid, hetero_substeps(params, config)
+        betas, dist, params.x0, grid, hetero_substeps(params, config),
+        adaptive_tols=(config.ode_rtol, config.ode_atol) if config.adaptive else None,
     )

@@ -39,7 +39,7 @@ from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSo
 def solve_hetero_sharded(
     params: ModelParamsHetero,
     mesh: Mesh,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     axis: str = "k",
     dtype=jnp.float64,
     with_aw: bool = True,
@@ -52,6 +52,8 @@ def solve_hetero_sharded(
     mesh, scalars and shared-grid curves replicated. K must be divisible by
     the mesh axis size (the K=1000 / 8-device parity config is).
     """
+    if config is None:
+        config = SolverConfig()
     k = params.learning.n_groups
     n_dev = mesh.shape[axis]
     if k % n_dev:

@@ -25,7 +25,7 @@ from jax import lax
 
 from sbr_tpu.baseline.solver import _root_tol
 from sbr_tpu.core.integrate import cumtrapz
-from sbr_tpu.core.rootfind import bisect, first_upcrossing, last_downcrossing
+from sbr_tpu.core.rootfind import bisect, chandrupatla, first_upcrossing, last_downcrossing
 from sbr_tpu.models.params import EconomicParams, SolverConfig
 from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero, Status
 
@@ -90,7 +90,7 @@ def compute_xi_hetero(
     tau_bar_out_uncs,
     lsh: LearningSolutionHetero,
     kappa,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     axis_name=None,
     with_health: bool = False,
 ):
@@ -104,6 +104,8 @@ def compute_xi_hetero(
     appended — its extra endpoint/final evaluations run the same
     psum-completed AW on every shard, so the health scalars replicate too.
     """
+    if config is None:
+        config = SolverConfig()
     dtype = lsh.cdfs.dtype
     kappa = jnp.asarray(kappa, dtype=dtype)
     dist = lsh.dist
@@ -122,14 +124,27 @@ def compute_xi_hetero(
         hi = lax.pmax(hi, axis_name)
     x0 = _wreduce(jnp.dot(dist, 0.5 * (tau_bar_in_uncs + tau_bar_out_uncs)), axis_name)
 
-    out = bisect(
-        lambda x: aw_of(x) - kappa,
-        lo,
-        hi,
-        num_iters=config.bisect_iters,
-        x0=x0,
-        with_health=with_health,
-    )
+    if config.adaptive:
+        # Convergence-masked Chandrupatla (ISSUE 9); under a sharded group
+        # axis every f evaluation is psum-completed, so all shards see the
+        # same iterates/termination and ξ stays replicated by construction.
+        out = chandrupatla(
+            lambda x: aw_of(x) - kappa,
+            lo,
+            hi,
+            budget=config.bisect_iters,
+            x0=x0,
+            with_health=with_health,
+        )
+    else:
+        out = bisect(
+            lambda x: aw_of(x) - kappa,
+            lo,
+            hi,
+            num_iters=config.bisect_iters,
+            x0=x0,
+            with_health=with_health,
+        )
     xi, xi_health = out if with_health else (out, None)
 
     aw = aw_of(xi)
@@ -188,7 +203,7 @@ def _first_crossing_ok(
 def solve_equilibrium_hetero(
     lsh: LearningSolutionHetero,
     econ: EconomicParams,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     tspan_end=None,
     axis_name=None,
 ) -> EquilibriumResultHetero:
@@ -199,6 +214,8 @@ def solve_equilibrium_hetero(
     stages stay local and only the weighted reductions cross shards; the
     returned scalars are replicated, per-group arrays sharded.
     """
+    if config is None:
+        config = SolverConfig()
     import time
 
     from sbr_tpu import obs
@@ -247,6 +264,12 @@ def solve_equilibrium_hetero(
 
     group_flags = h_in.flags | as_out_crossing(h_out).flags  # (K_local,)
     cross_flags = or_reduce_flags(group_flags, lambda s: _wreduce(s, axis_name))
+    # Adaptive coupled-K ODE flags (ISSUE 9): ODE_BUDGET from a bs32
+    # interval that exhausted its step cap — None on the fixed-RK4 and
+    # sharded paths, so their health bytes are untouched.
+    ode_flags = getattr(lsh, "ode_flags", None)
+    if ode_flags is not None:
+        cross_flags = cross_flags | ode_flags
     health = xi_health.replace(flags=xi_health.flags | cross_flags)
 
     valid = jnp.logical_and(root_ok, jnp.logical_and(increasing, first_ok))

@@ -58,9 +58,11 @@ def solve_equilibrium_interest_core(
     r,
     delta,
     tspan_end,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
 ) -> EquilibriumResultInterest:
     """Scalar-parameter interest-rate solve — the vmap unit for policy sweeps."""
+    if config is None:
+        config = SolverConfig()
     from sbr_tpu import obs
 
     dtype = ls.cdf.dtype
@@ -85,8 +87,9 @@ def solve_equilibrium_interest_core(
             t, eta_c, ls.beta, ls.x0, config.n_grid, config.grid_warp
         )
     with obs.span("interest.value_function") as sp:
-        v = solve_value_function(
-            tau_grid, hr, delta, r, u, config, uniform=not warped, index_fn=index_fn
+        v, v_health = solve_value_function(
+            tau_grid, hr, delta, r, u, config, uniform=not warped, index_fn=index_fn,
+            with_health=True,
         )
         sp.sync(v)
     hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
@@ -113,7 +116,8 @@ def solve_equilibrium_interest_core(
 
     with obs.span("interest.buffers") as sp:
         tau_in_unc, tau_out_unc, cross_health = optimal_buffer(
-            u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at, with_health=True
+            u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at, with_health=True,
+            adaptive=config.adaptive,
         )
         sp.sync(tau_in_unc, tau_out_unc)
     no_crossing = tau_in_unc == tau_out_unc
@@ -132,9 +136,15 @@ def solve_equilibrium_interest_core(
     # via hr_eff, this adds the Inf case and attributes it to V).
     from sbr_tpu.diag.health import NAN_OUTPUT, Health
 
+    # ODE flags ride along (ISSUE 9): under adaptive numerics this is how
+    # ODE_BUDGET — an interval that exhausted its step cap and bridged with
+    # an error-unchecked step — reaches the per-cell health; the fixed
+    # path's v_health carries zero flags by contract, so fixed-mode health
+    # bytes are unchanged. Flags only: the HJB's attempt counts must not
+    # perturb the root-find effective-iteration statistics.
     v_flags = jnp.where(
         jnp.any(~jnp.isfinite(v)), jnp.int32(NAN_OUTPUT), jnp.int32(0)
-    )
+    ) | v_health.flags
     health = cross_health.merge(xi_health, Health.of_flags(v_flags, dtype))
 
     run = jnp.logical_and(~no_crossing, jnp.logical_and(root_ok, increasing))
@@ -183,12 +193,14 @@ def solve_equilibrium_interest_core(
 def solve_equilibrium_interest(
     ls: LearningSolution,
     econ: EconomicParamsInterest,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     tspan_end=None,
 ) -> EquilibriumResultInterest:
     """Convenience entry mirroring `solve_equilibrium_interest(lr, econ, model)`
     (`interest_rate_solver.jl:51`). The embedded baseline result carries
     device-fenced ``solve_time`` like the reference's structs."""
+    if config is None:
+        config = SolverConfig()
     import time
 
     from sbr_tpu.baseline.solver import _stamp_solve_time

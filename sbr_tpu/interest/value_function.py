@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from sbr_tpu.core.interp import interp_guided, interp_uniform
+from sbr_tpu.core.ode import bs32
 from sbr_tpu.models.params import SolverConfig
 
 
@@ -29,9 +30,10 @@ def solve_value_function(
     delta,
     r,
     u,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     uniform: bool = True,
     index_fn=None,
+    with_health: bool = False,
 ):
     """Integrate the HJB forward in τ̄ over ``tau_grid``; returns V samples.
 
@@ -46,7 +48,16 @@ def solve_value_function(
     scan's sequential substeps, where searchsorted's ~10 dependent gathers
     per evaluation were the measured 3.7× cost of honoring the warp in the
     (β,u,r) policy sweep. Both flags must be static at trace time.
+
+    ``with_health`` appends a `diag.Health`: under adaptive numerics it is
+    bs32's (whose ODE_BUDGET flag is the ONLY sign an interval exhausted its
+    step cap and bridged unchecked — invisible in V itself); the fixed path
+    returns a zero-flag placeholder because its failure modes (non-finite V)
+    are already covered by the caller's finiteness probe, keeping fixed-mode
+    health bytes identical to the pre-adaptive solver.
     """
+    if config is None:
+        config = SolverConfig()
     dtype = hr.dtype
     delta = jnp.asarray(delta, dtype=dtype)
     r = jnp.asarray(r, dtype=dtype)
@@ -62,6 +73,27 @@ def solve_value_function(
         hr_at = lambda t: jnp.interp(t, tau_grid, hr)
 
     v0 = (u + delta) / (r + delta)  # boundary at crash (`value_function_solver.jl:77,101`)
+
+    def rhs_at(hv, v):
+        return (hv + delta) * (1.0 - v) + jnp.maximum(u + r * v - hv, 0.0)
+
+    if config.adaptive:
+        # Adaptive embedded-pair integration (ISSUE 9): replaces the static
+        # max(ode_substeps, 4) worst-case budget — the budget existed for
+        # the one reentry kink where max() switches, but every interval
+        # paid it. bs32's error control subdivides the kink-crossing
+        # interval and single-steps the smooth rest. Hazard lookups here
+        # are data-dependent (adaptive node times), so they interpolate
+        # in-loop; the hoisted-node trick below is specific to the static
+        # fixed-step schedule.
+        return bs32(
+            lambda t, v, _: rhs_at(hr_at(t), v),
+            v0,
+            tau_grid,
+            rtol=config.ode_rtol,
+            atol=config.ode_atol,
+            with_health=with_health,
+        )
 
     # The kink in max() halves the local order where it crosses; extra
     # substeps keep the global error budget comfortable.
@@ -83,9 +115,6 @@ def solve_value_function(
     nodes = jnp.stack([tj, tj + 0.5 * h[:, None], tj + h[:, None]], axis=-1)
     hr_nodes = hr_at(nodes)  # (n-1, s, 3), one vectorized interp
 
-    def rhs_at(hv, v):
-        return (hv + delta) * (1.0 - v) + jnp.maximum(u + r * v - hv, 0.0)
-
     def interval(v, xs):
         hstep, hrow = xs
         for j in range(substeps):  # static unroll: all node reads static
@@ -98,4 +127,9 @@ def solve_value_function(
         return v, v
 
     _, vs = lax.scan(interval, v0, (h, hr_nodes))
-    return jnp.concatenate([v0[None], vs], axis=0)
+    out = jnp.concatenate([v0[None], vs], axis=0)
+    if not with_health:
+        return out
+    from sbr_tpu.diag.health import Health
+
+    return out, Health.of_flags(jnp.int32(0), dtype)

@@ -256,6 +256,8 @@ class SolverConfig:
       hazard grid (replaces the adaptive grid of `learning.jl:51`).
     - bisect_iters: fixed bisection halvings (replaces the 10*eps(κ)
       tolerance exit of `solver.jl:310`; 90 halvings over-satisfy it in f64).
+      Under ``numerics="adaptive"`` the same number is the Chandrupatla
+      BUDGET — the while_loop's hard cap; typical cells exit in ~10-25.
     - ode_substeps: RK4 substeps per save interval for ODE-backed stages.
     - quad_order: Gauss-Legendre nodes per interval for closed-form
       integrands.
@@ -265,6 +267,21 @@ class SolverConfig:
       it OFF — grid AW_max accuracy is interpolation-bound anyway, and the
       embedded per-cell bisection-with-quadrature dominates the vmap²
       program's compile time.
+    - numerics (ISSUE 9): ``"adaptive"`` routes the solver hot loop through
+      convergence-masked kernels — `core.rootfind.chandrupatla` for every
+      bracketing root-find, `core.rootfind.threshold_crossings_masked` for
+      the buffer crossings, `core.ode.bs32` for the hetero coupled-K ODE
+      and the interest HJB, and Anderson/secant acceleration on the social
+      fixed point. ``"fixed"`` is the bit-exact escape hatch: the exact
+      pre-adaptive code paths (fixed-iteration `bisect`, scan crossings,
+      fixed-substep RK4, plain damping), preserving byte-identical outputs
+      for the chaos/golden/parity suites and stable cross-run tile-cache
+      keys. The default ``"auto"`` resolves at construction from
+      SBR_NUMERICS (``adaptive`` when unset); the resolved value is what
+      hashes/serializes, so fingerprints and jit caches see only concrete
+      modes.
+    - ode_rtol / ode_atol: local-error tolerances for the adaptive
+      embedded-pair integrator (ignored under ``numerics="fixed"``).
     """
 
     n_grid: int = 4096
@@ -278,6 +295,9 @@ class SolverConfig:
     # highest-β columns of the Figure-5 heatmap mislabel running cells as
     # false equilibria (see baseline/solver.py::_warped_grid). 0 disables.
     grid_warp: float = 0.5
+    numerics: str = "auto"
+    ode_rtol: float = 1e-6
+    ode_atol: float = 1e-9
 
     def __post_init__(self):
         _check(self.n_grid >= 16, "n_grid too small")
@@ -285,3 +305,19 @@ class SolverConfig:
         _check(self.ode_substeps >= 1, "ode_substeps must be >= 1")
         _check(self.quad_order >= 1, "quad_order must be >= 1")
         _check(0.0 <= self.grid_warp <= 1.0, "grid_warp must be in [0, 1]")
+        if self.numerics == "auto":
+            import os
+
+            resolved = os.environ.get("SBR_NUMERICS", "").strip().lower() or "adaptive"
+            object.__setattr__(self, "numerics", resolved)
+        _check(
+            self.numerics in ("adaptive", "fixed"),
+            f"numerics must be 'adaptive', 'fixed', or 'auto', got {self.numerics!r}",
+        )
+        _check(self.ode_rtol > 0, "ode_rtol must be positive")
+        _check(self.ode_atol > 0, "ode_atol must be positive")
+
+    @property
+    def adaptive(self) -> bool:
+        """Whether the convergence-masked adaptive kernels are active."""
+        return self.numerics == "adaptive"

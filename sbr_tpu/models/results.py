@@ -119,6 +119,11 @@ class LearningSolutionHetero:
     # use local spacings of ``grid`` for anything resolution-sensitive)
     betas: jnp.ndarray  # (K,) group learning rates
     dist: jnp.ndarray  # (K,) group weights (simplex)
+    # Flags from the adaptive coupled-K ODE (ISSUE 9): bs32's Health flags
+    # (ODE_BUDGET when an interval exhausted its step cap and bridged with
+    # an error-unchecked step) for the equilibrium solver to fold into the
+    # per-cell health. None on the fixed-RK4 and sharded paths.
+    ode_flags: Optional[jnp.ndarray] = None
 
     def cdf_at(self, t):
         """G_k at time(s) t: output shape (K, *t.shape). Searchsorted interp —

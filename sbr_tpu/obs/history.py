@@ -48,11 +48,15 @@ from typing import Optional
 # per-host ``elastic_cells_per_sec`` records live in a SIDECAR file
 # (``<history>.elastic.jsonl`` — the trend gate evaluates only the latest
 # main-history record, so cost-model records must not displace bench
-# lines) and seed `resilience.elastic.seed_rate_from_history` (ISSUE 8).
+# lines) and seed `resilience.elastic.seed_rate_from_history` (ISSUE 8);
+# 5 adds the adaptive-numerics split (ISSUE 9): grid_adaptive_speedup
+# (adaptive vs bit-exact fixed control, timed back-to-back on the same
+# shape) and grid_mean_effective_iters (mean per-cell root-find iterations
+# from the Health grid — the fixed path records its constant budget).
 # Readers accept every version: the key set only grows, and
-# `load` stamps schema-less legacy lines as 1, so a committed schema-1/2/3
-# history keeps gating new schema-4 appends.
-SCHEMA = 4
+# `load` stamps schema-less legacy lines as 1, so a committed schema-1/2/3/4
+# history keeps gating new schema-5 appends.
+SCHEMA = 5
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -150,6 +154,12 @@ def bench_metrics(result: dict) -> dict:
         "sweep_cold_cells_per_sec",
         "sweep_warm_cells_per_sec",
         "sweep_warm_hit_rate",
+        # schema 5: the adaptive-numerics split (bench.py bench_grid):
+        # speedup of the default adaptive program over the bit-exact fixed
+        # control (higher-better) and mean effective root-find iterations
+        # per cell (lower-better by the _iters polarity rule)
+        "grid_adaptive_speedup",
+        "grid_mean_effective_iters",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
@@ -182,15 +192,23 @@ def bench_metrics(result: dict) -> dict:
 
 
 def polarity(metric: str) -> int:
-    """+1 when higher is better (throughput, cache hit rates), -1 when lower
-    is better (durations, latencies, byte counts, divergence counts)."""
+    """+1 when higher is better (throughput, cache hit rates, speedups), -1
+    when lower is better (durations, latencies, byte counts, divergence and
+    effective-iteration counts)."""
     m = metric.lower()
-    if m.endswith("_per_sec") or "per_sec" in m or "throughput" in m or "hit_rate" in m:
+    if (
+        m.endswith("_per_sec")
+        or "per_sec" in m
+        or "throughput" in m
+        or "hit_rate" in m
+        or "speedup" in m
+    ):
         return 1
     if (
         m.endswith("_s")
         or m.endswith("_ms")
         or m.endswith("_bytes")
+        or m.endswith("_iters")
         or "latency" in m
         or "divergent" in m
         or "retrace" in m

@@ -351,12 +351,14 @@ def _health_by_stage(events) -> dict:
                 "worst_cells": [],
                 "residual_hist": {},
                 "iterations_total": 0,
+                "iterations_max": 0,
             },
         )
         agg["events"] += 1
         agg["cells"] += int(ev.get("cells", 0))
         agg["divergent"] += int(ev.get("divergent", 0))
         agg["iterations_total"] += int(ev.get("iterations_total", 0))
+        agg["iterations_max"] = max(agg["iterations_max"], int(ev.get("iterations_max", 0)))
         mr = ev.get("max_residual")
         if mr is not None:
             prev = agg["max_residual"]
@@ -407,8 +409,20 @@ def render_health(run: dict) -> tuple:
     rows = []
     for name, v in sorted(stages.items()):
         flags = ", ".join(f"{k}={n}" for k, n in sorted(v["flag_counts"].items())) or "-"
-        rows.append([name, v["cells"], v["divergent"], _fmt_resid(v["max_residual"]), flags])
-    out.append(_table(["stage", "cells", "divergent", "max resid", "flags"], rows))
+        # effective iterations (adaptive numerics, ISSUE 9): mean/max of
+        # what cells ACTUALLY ran — under numerics="fixed" this just echoes
+        # the constant budget
+        iters = (
+            f"{v['iterations_total'] / v['cells']:.1f}/{v['iterations_max']}"
+            if v["cells"]
+            else "-"
+        )
+        rows.append(
+            [name, v["cells"], v["divergent"], _fmt_resid(v["max_residual"]), iters, flags]
+        )
+    out.append(
+        _table(["stage", "cells", "divergent", "max resid", "eff iters μ/max", "flags"], rows)
+    )
 
     # NaN census: the poison-tracking subset of the flag counts.
     nan_rows = []

@@ -387,6 +387,12 @@ def _agent_uniforms(key, step_k, ids, dtype, impl: str = "counter"):
 
     A run is comparable across engines/shardings/platforms under either
     stream, but the streams are different (equally valid) realizations.
+
+    The counter path requires the 2-word threefry key layout (ADVICE r5):
+    under jax_default_prng_impl=rbg/unsafe_rbg key data is 4 uint32 words
+    with no contract that the first two vary per step, which would silently
+    degrade the stream to half the key material. A non-2-word layout falls
+    back to the foldin path, which is layout-agnostic by construction.
     """
     step_key = jax.random.fold_in(key, step_k)
     if impl == "counter":
@@ -395,6 +401,9 @@ def _agent_uniforms(key, step_k, ids, dtype, impl: str = "counter"):
             if getattr(step_key, "dtype", None) == jnp.uint32
             else jax.random.key_data(step_key)
         )
+        if kd.shape[-1] != 2:  # rbg/unsafe_rbg: 4-word keys — see docstring
+            impl = "foldin"
+    if impl == "counter":
         c0 = ids.astype(jnp.uint32)
         x0, x1 = _threefry2x32(kd[0], kd[1], c0, jnp.zeros_like(c0))
         if np.dtype(dtype) == np.float64:
@@ -406,7 +415,14 @@ def _agent_uniforms(key, step_k, ids, dtype, impl: str = "counter"):
             return one_to_two - 1.0
         mant = (x0 >> jnp.uint32(9)) | jnp.uint32(0x3F800000)
         one_to_two = jax.lax.bitcast_convert_type(mant, jnp.float32)
-        return (one_to_two - 1.0).astype(dtype)
+        u = (one_to_two - 1.0).astype(dtype)
+        if jnp.finfo(dtype).bits < 32:
+            # f16/bf16 (ADVICE r5): the cast can round draws within ~2^-11
+            # of 1.0 up to exactly 1.0, breaking the [0,1) contract the
+            # jax.random.uniform path guarantees; clamp to the largest
+            # representable value below 1.
+            u = jnp.minimum(u, jnp.asarray(1.0 - jnp.finfo(dtype).epsneg, dtype))
+        return u
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(step_key, ids)
     return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
 

@@ -90,7 +90,7 @@ def close_loop(
     g0: Optional[float] = 0.02,
     n_reps: int = 1,
     seed: int = 0,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     tol: float = 1e-4,
     max_iter: int = 500,
     mesh=None,
@@ -117,6 +117,8 @@ def close_loop(
     ``fp`` supplies a precomputed fixed point (skipping the solve — the most
     expensive step); it must come from the same ``model``.
     """
+    if config is None:
+        config = SolverConfig()
     if model is None:
         model = make_model_params(
             beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25

@@ -107,6 +107,12 @@ class _LoopState:
     hist_xi: jnp.ndarray
     res: EquilibriumResult
     ls: LearningSolution
+    # Anderson(1) secant memory (numerics="adaptive", ISSUE 9): the previous
+    # iterate and its fixed-point residual. Carried as zeros (and never
+    # read) under numerics="fixed", so the fixed path's values are
+    # untouched.
+    prev_aw: jnp.ndarray = None
+    prev_r: jnp.ndarray = None
 
 
 @functools.lru_cache(maxsize=None)
@@ -142,11 +148,47 @@ def _build_fixed_point(
         def cond(s: _LoopState):
             return (s.it < max_iter) & (~s.converged) & (~s.aborted)
 
+        adaptive = config.adaptive
+
         def body(s: _LoopState):
             ls, res, xi_new, exceeded, aw_new = step(s.aw, s.xi)
             err = jnp.max(jnp.abs(aw_new - s.aw))
             conv = jnp.logical_and(err < tol_, ~exceeded)
-            aw_next = jnp.where(conv, aw_new, (1.0 - alpha) * s.aw + alpha * aw_new)
+            if adaptive:
+                # Anderson(1)/secant acceleration on the damping update
+                # (ISSUE 9): extrapolate along the last two fixed-point
+                # residuals. Gated to the NEAR-CONVERGED regime (previous
+                # undamped residual under 10·tol): far from the fixed point
+                # the map is only piecewise smooth (inner status flips, the
+                # no-run ξ-march), and secant extrapolation there measurably
+                # wanders — it landed 2.6e-3 off the true ξ at the Figure-12
+                # parameters, outside the golden envelope, while the gated
+                # form polishes the damped tail in a few steps and lands
+                # CLOSER to the tol→0 fixed point than plain damping does.
+                # Remaining safeguards: degenerate secant denominators,
+                # non-finite extrapolations, and inner no-run iterations
+                # fall back to the reference's damped update; γ is clamped
+                # so a near-parallel residual pair cannot fling the iterate.
+                r_k = aw_new - s.aw
+                dr = r_k - s.prev_r
+                denom = jnp.sum(dr * dr)
+                tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+                gamma = jnp.sum(dr * r_k) / jnp.where(denom > tiny, denom, 1.0)
+                gamma = jnp.clip(gamma, -5.0, 5.0)
+                accel = s.aw + alpha * r_k - gamma * (s.aw - s.prev_aw + alpha * dr)
+                accel_ok = (
+                    (s.it > 0)
+                    & (s.err < 10.0 * tol_)
+                    & (denom > tiny)
+                    & jnp.all(jnp.isfinite(accel))
+                    & res.bankrun
+                )
+                aw_step = jnp.where(accel_ok, accel, (1.0 - alpha) * s.aw + alpha * aw_new)
+                prev_aw, prev_r = s.aw, r_k
+            else:
+                aw_step = (1.0 - alpha) * s.aw + alpha * aw_new
+                prev_aw, prev_r = s.prev_aw, s.prev_r
+            aw_next = jnp.where(conv, aw_new, aw_step)
             aw_next = jnp.where(exceeded, s.aw, aw_next)
             if verbose:
                 jax.debug.print(
@@ -165,6 +207,8 @@ def _build_fixed_point(
                 hist_xi=s.hist_xi.at[slot].set(xi_new),
                 res=res,
                 ls=ls,
+                prev_aw=prev_aw,
+                prev_r=prev_r,
             )
 
         aw0 = logistic_cdf(grid, beta, x0)  # word-of-mouth init (`:90-94`)
@@ -181,6 +225,8 @@ def _build_fixed_point(
             hist_xi=jnp.full((HISTORY_LEN,), jnp.nan, dtype),
             res=res0,
             ls=ls0,
+            prev_aw=jnp.zeros_like(aw0),
+            prev_r=jnp.zeros_like(aw0),
         )
         final = jax.lax.while_loop(cond, body, init)
 
@@ -224,7 +270,7 @@ def _build_fixed_point(
 
 def solve_equilibrium_social(
     model: ModelParams,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     tol: float = 1e-4,
     max_iter: int = 250,
     damping: float = 0.5,
@@ -238,6 +284,8 @@ def solve_equilibrium_social(
     the Figure-12/13 script calls with max_iter=500
     (`scripts/4_social_learning.jl:55-56`).
     """
+    if config is None:
+        config = SolverConfig()
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     import time

@@ -40,7 +40,11 @@ from sbr_tpu.resilience import faults
 # versions: bump this whenever a change alters any cell's bytes (solver
 # math, status semantics, health-driven healing inputs) so stale entries
 # miss instead of silently serving old numerics.
-GRID_PROGRAM_VERSION = 1
+# v2 (ISSUE 9): adaptive numerics — SolverConfig grew the `numerics` mode
+# (also in the key via the config fingerprint, so adaptive and fixed tiles
+# can never share entries) and adaptive cells carry convergence-masked
+# Health iteration counts; pre-adaptive entries must miss.
+GRID_PROGRAM_VERSION = 2
 
 
 @struct.dataclass
@@ -124,7 +128,7 @@ def u_sweep(
     ls: LearningSolution,
     u_values,
     econ,
-    config: SolverConfig = SolverConfig(),
+    config: SolverConfig | None = None,
     tspan_end=None,
     mesh: Optional[jax.sharding.Mesh] = None,
     mesh_axis: str = "u",
@@ -135,6 +139,8 @@ def u_sweep(
     With ``mesh``, the u axis is sharded over ``mesh_axis`` (cells are
     independent; the shared learning solution replicates). The mesh axis
     size must divide len(u_values)."""
+    if config is None:
+        config = SolverConfig()
     from sbr_tpu import obs
     from sbr_tpu.obs.metrics import metrics
 

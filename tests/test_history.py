@@ -42,11 +42,11 @@ def test_append_load_round_trip(tmp_path):
     )
     assert out == p
     (rec,) = history.load(p)
-    # schema 4 (ISSUE 8): the tiled-sweep/elastic metrics joined the
-    # record (3 added serving, 2 added memory); the key set only grew, and
-    # schema-1/2/3/-less lines still load (tests/test_mem.py,
-    # tests/test_serve.py, tests/test_elastic.py).
-    assert rec["schema"] == history.SCHEMA == 4
+    # schema 5 (ISSUE 9): the adaptive-numerics split joined the record
+    # (4 added elastic sweeps, 3 serving, 2 memory); the key set only grew,
+    # and schema-1/2/3/4/-less lines still load (tests/test_mem.py,
+    # tests/test_serve.py, tests/test_elastic.py, tests/test_numerics.py).
+    assert rec["schema"] == history.SCHEMA == 5
     assert rec["label"] == "x" and rec["platform"] == "cpu"
     # only finite numerics survive; bools coerce to gateable ints
     assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}

@@ -1,0 +1,539 @@
+"""Adaptive batched numerics (ISSUE 9).
+
+Four contracts under test:
+
+1. `core.rootfind.chandrupatla` — convergence-masked bracketing — agrees
+   with the 90-iteration `bisect` to ≤1e-10 on oracle-checked root
+   batteries and on the β×u grid, in a fraction of the iterations, and
+   flags degenerate brackets (no sign change, NaN endpoints, root at an
+   endpoint) the way its Health contract promises.
+2. `core.rootfind.threshold_crossings_masked` — the O(√n) blocked crossing
+   search — is BIT-identical to the `first_upcrossing`/`last_downcrossing`
+   scan pair (values, fallback ladder, and health flags) across adversarial
+   curves; these are the index-identity proofs the module docstring cites.
+3. `core.ode.bs32` — the Bogacki–Shampine 3(2) embedded pair — meets its
+   tolerance on smooth problems in ~1 attempt per save interval and raises
+   `ODE_BUDGET` when an interval exhausts its step cap.
+4. `numerics="fixed"` is the bit-exact escape hatch: outputs are BITWISE
+   identical to the pre-PR solver (golden arrays captured from the parent
+   commit in tests/data/golden_fixed_numerics.npz), while the default
+   adaptive mode matches fixed status grids exactly and ξ to 1e-10.
+
+Plus the history side: schema-5 records (grid_adaptive_speedup,
+grid_mean_effective_iters) gate against schema 1-4 lines in `report trend`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu.core.ode import bs32, rk4
+from sbr_tpu.core.rootfind import (
+    bisect,
+    chandrupatla,
+    first_upcrossing,
+    last_downcrossing,
+    threshold_crossings_masked,
+)
+from sbr_tpu.diag.health import (
+    FALLBACK_IN_DEFAULT,
+    FALLBACK_IN_KNOT,
+    NAN_INPUT,
+    NO_BRACKET,
+    ODE_BUDGET,
+)
+from sbr_tpu.models.params import SolverConfig, make_model_params
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "golden_fixed_numerics.npz")
+
+
+# -- chandrupatla vs bisect ---------------------------------------------------
+
+
+class TestChandrupatla:
+    def test_agrees_with_bisect_on_root_battery(self):
+        """Cube roots, transcendental roots, and scaled logistics — the
+        ≤1e-10 oracle-grid agreement criterion on a vmapped lane battery."""
+        cs = jnp.linspace(0.5, 8.0, 64)
+
+        # increasing f per `bisect`'s reference update-rule convention
+        # (positive error contracts the upper bound) — every solver call
+        # site is oriented this way
+        for f, lo, hi in [
+            (lambda x: x**3 - cs, jnp.zeros_like(cs), jnp.full_like(cs, 2.5)),
+            (lambda x: 0.1 * cs * x - jnp.cos(x), jnp.zeros_like(cs), jnp.full_like(cs, 4.0)),
+            (lambda x: 1.0 / (1.0 + jnp.exp(-cs * x)) - 0.7, jnp.zeros_like(cs), jnp.full_like(cs, 9.0)),
+        ]:
+            x_b = bisect(f, lo, hi, num_iters=90)
+            x_c = chandrupatla(f, lo, hi, budget=90)
+            np.testing.assert_allclose(np.asarray(x_c), np.asarray(x_b), rtol=0, atol=1e-10)
+
+    def test_converges_far_under_budget(self):
+        """The whole point: actual per-lane iterations ≪ the fixed budget,
+        and the Health records them (the fixed path can only report 90)."""
+        cs = jnp.linspace(0.5, 8.0, 64)
+        f = lambda x: x**3 - cs
+        x, h = chandrupatla(f, jnp.zeros_like(cs), jnp.full_like(cs, 2.5), budget=90, with_health=True)
+        iters = np.asarray(h.iterations)
+        assert iters.shape == (64,)
+        assert iters.max() < 40 and iters.mean() < 25
+        _, h_b = bisect(f, jnp.zeros_like(cs), jnp.full_like(cs, 2.5), num_iters=90, with_health=True)
+        assert np.asarray(h_b.iterations).min() == 90  # budget, not actual
+        assert np.all(np.asarray(h.residual) <= np.asarray(h_b.residual) + 1e-12)
+
+    def test_x0_seed_agrees(self):
+        c = jnp.asarray(2.0)
+        f = lambda x: x**2 - c
+        x = chandrupatla(f, jnp.asarray(0.0), jnp.asarray(2.0), x0=jnp.asarray(1.5))
+        assert float(x) == pytest.approx(np.sqrt(2.0), abs=1e-12)
+
+    def test_root_at_endpoint(self):
+        f = lambda x: x  # root exactly at lo
+        x, h = chandrupatla(f, jnp.asarray(0.0), jnp.asarray(2.0), with_health=True)
+        assert abs(float(x)) < 1e-12
+        assert int(h.flags) & NO_BRACKET == 0 or abs(float(x)) < 1e-12
+
+    def test_no_sign_change_flagged(self):
+        """Non-bracketing input: like `bisect`, no convergence promise — the
+        call terminates, returns a candidate inside the interval, and the
+        Health carries NO_BRACKET so the caller can classify."""
+        f = lambda x: x**2 + 1.0
+        x, h = chandrupatla(f, jnp.asarray(-2.0), jnp.asarray(2.0), budget=50, with_health=True)
+        assert int(h.flags) & NO_BRACKET
+        assert -2.0 <= float(x) <= 2.0
+
+    def test_nan_endpoint_flagged(self):
+        f = lambda x: x - 0.5
+        x, h = chandrupatla(f, jnp.asarray(jnp.nan), jnp.asarray(2.0), budget=20, with_health=True)
+        assert int(h.flags) & NAN_INPUT
+
+    def test_mixed_batch_early_exit(self):
+        """Easy lanes freeze while a hard lane keeps iterating: per-lane
+        counts differ inside one while_loop."""
+        cs = jnp.asarray([1.0, 1.0 + 1e-14])  # second root sits ~eps from lo
+        f = lambda x: x - cs
+        _, h = chandrupatla(f, jnp.zeros(2), jnp.full((2,), 100.0), budget=90, with_health=True)
+        iters = np.asarray(h.iterations)
+        assert iters[0] <= iters[1] <= 90
+
+
+# -- blocked crossings: bit-identity vs the scan pair -------------------------
+
+
+def _scan_pair(x, y, level, default):
+    t_in, has_up, h_in = first_upcrossing(x, y, level, default, return_flag=True, with_health=True)
+    t_out, has_dn, h_out = last_downcrossing(x, y, level, default, return_flag=True, with_health=True)
+    return t_in, has_up, t_out, has_dn, h_in, h_out
+
+
+def _assert_crossings_identical(x, y, level, default):
+    ref = _scan_pair(x, y, level, default)
+    got = threshold_crossings_masked(x, y, level, default, with_health=True)
+    for name, r, g in zip(("t_in", "has_up", "t_out", "has_dn"), ref[:4], got[:4]):
+        r, g = np.asarray(r), np.asarray(g)
+        assert r.tobytes() == g.tobytes(), f"{name}: scan={r} blocked={g}"
+    for name, r, g in zip(("h_in", "h_out"), ref[4:], got[4:]):
+        assert np.asarray(r.flags).tobytes() == np.asarray(g.flags).tobytes(), name
+
+
+class TestMaskedCrossings:
+    @pytest.mark.parametrize("n", [17, 100, 256, 257, 1000])
+    def test_random_curves_bit_identical(self, n):
+        rng = np.random.default_rng(n)
+        x = jnp.asarray(np.linspace(0.0, 10.0, n))
+        for trial in range(5):
+            y = jnp.asarray(np.cumsum(rng.normal(size=n)))
+            level = float(np.quantile(np.asarray(y), rng.uniform(0.05, 0.95)))
+            _assert_crossings_identical(x, y, level, 10.0)
+
+    def test_hazard_shaped_curve(self):
+        """The actual workload shape: unimodal hazard, level sweeping from
+        below the min to above the max (the no-crossing fallback rungs)."""
+        x = jnp.asarray(np.linspace(0.0, 15.0, 512))
+        y = jnp.asarray(np.exp(-0.5 * (np.asarray(x) - 6.0) ** 2) * 0.8)
+        for level in [-0.1, 0.0, 0.2, 0.5, 0.79999, 0.8, 0.9]:
+            _assert_crossings_identical(x, y, level, 15.0)
+
+    def test_fallback_rungs_and_flags(self):
+        x = jnp.asarray(np.linspace(0.0, 1.0, 64))
+        always_above = jnp.ones(64) * 2.0
+        ref = _scan_pair(x, always_above, 1.0, 9.0)
+        got = threshold_crossings_masked(x, always_above, 1.0, 9.0, with_health=True)
+        # always above: no transition, first/last-knot fallback
+        assert float(got[0]) == float(ref[0]) == 0.0
+        assert float(got[2]) == float(ref[2]) == 1.0
+        assert int(got[4].flags) & FALLBACK_IN_KNOT
+        never_above = jnp.zeros(64)
+        got2 = threshold_crossings_masked(x, never_above, 1.0, 9.0, with_health=True)
+        assert float(got2[0]) == float(got2[2]) == 9.0
+        assert int(got2[4].flags) & FALLBACK_IN_DEFAULT
+        _assert_crossings_identical(x, never_above, 1.0, 9.0)
+
+    def test_nan_poison_bit_identical(self):
+        x = jnp.asarray(np.linspace(0.0, 1.0, 128))
+        y = np.sin(np.asarray(x) * 7.0)
+        for poison in [slice(0, 5), slice(60, 70), slice(120, 128)]:
+            yp = y.copy()
+            yp[poison] = np.nan
+            _assert_crossings_identical(x, jnp.asarray(yp), 0.3, 2.0)
+        _assert_crossings_identical(x, jnp.full(128, jnp.nan), 0.3, 2.0)  # all NaN
+        # NaN level disables every crossing on both paths
+        _assert_crossings_identical(x, jnp.asarray(y), jnp.nan, 2.0)
+        got = threshold_crossings_masked(x, jnp.full(128, jnp.nan), 0.3, 2.0, with_health=True)
+        assert int(got[4].flags) & NAN_INPUT
+
+    def test_exact_knot_touch(self):
+        """y == level at a knot: `>` strictness must match the scan exactly."""
+        x = jnp.asarray(np.linspace(0.0, 1.0, 33))
+        y = np.zeros(33)
+        y[10:20] = 1.0
+        y[15] = 0.5  # dip exactly to the level
+        _assert_crossings_identical(x, jnp.asarray(y), 0.5, 3.0)
+        _assert_crossings_identical(x, jnp.asarray(y), 1.0, 3.0)
+
+    def test_under_vmap(self):
+        """Batched curves (the sweep layout) stay bit-identical lane-wise."""
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(np.linspace(0.0, 5.0, 200))
+        ys = jnp.asarray(np.cumsum(rng.normal(size=(8, 200)), axis=-1))
+        levels = jnp.asarray(rng.normal(size=8))
+        blocked = jax.vmap(lambda y, l: threshold_crossings_masked(x, y, l, 5.0))(ys, levels)
+        for k in range(8):
+            ref = _scan_pair(x, ys[k], levels[k], 5.0)
+            for r, g in zip(ref[:4], [b[k] for b in blocked]):
+                assert np.asarray(r).tobytes() == np.asarray(g).tobytes()
+
+
+# -- adaptive ODE -------------------------------------------------------------
+
+
+class TestBS32:
+    def test_exponential_decay_accuracy(self):
+        ts = jnp.linspace(0.0, 2.0, 41)
+        ys = bs32(lambda t, y, _: -1.5 * y, jnp.asarray(1.0), ts, rtol=1e-8, atol=1e-12)
+        assert ys.shape == (41,)
+        assert float(ys[0]) == 1.0
+        np.testing.assert_allclose(np.asarray(ys), np.exp(-1.5 * np.asarray(ts)), rtol=1e-6)
+
+    def test_matches_dense_rk4_on_logistic(self):
+        """The hetero RHS shape: logistic growth, vector state."""
+        f = lambda t, y, _: y * (1.0 - y)
+        y0 = jnp.asarray([1e-4, 1e-2, 0.3])
+        ts = jnp.linspace(0.0, 12.0, 257)
+        adaptive = bs32(f, y0, ts, rtol=1e-9, atol=1e-12)
+        fixed = rk4(f, y0, ts, substeps=8)
+        assert adaptive.shape == fixed.shape == (257, 3)
+        np.testing.assert_allclose(np.asarray(adaptive), np.asarray(fixed), rtol=0, atol=1e-8)
+
+    def test_cheap_on_smooth_dense_grid(self):
+        """A dense save grid on smooth dynamics costs ~1 attempt per
+        interval — the speedup the fixed worst-case substeps left behind."""
+        ts = jnp.linspace(0.0, 1.0, 513)
+        _, h = bs32(lambda t, y, _: -y, jnp.asarray(1.0), ts, with_health=True)
+        assert int(h.iterations) < 2 * 512
+        assert int(h.flags) & ODE_BUDGET == 0
+
+    def test_budget_exhaustion_flagged(self):
+        """Fast dynamics under an artificially tiny per-interval cap: the
+        bridge fires and Health carries ODE_BUDGET."""
+        ts = jnp.linspace(0.0, 1.0, 3)
+        out, h = bs32(
+            lambda t, y, _: -800.0 * y, jnp.asarray(1.0), ts,
+            rtol=1e-10, atol=1e-12, max_steps_per_interval=2, with_health=True,
+        )
+        assert int(h.flags) & ODE_BUDGET
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+# -- numerics="fixed": bitwise regression vs the pre-PR solver ---------------
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(GOLDEN)
+
+
+class TestFixedBitwise:
+    """Golden arrays in tests/data/golden_fixed_numerics.npz were captured
+    from the PARENT commit (pre-adaptive solver, f64, CPU). The fixed path
+    must reproduce them byte-for-byte — the escape-hatch contract that keeps
+    the chaos/golden/parity suites and tile-cache keys stable."""
+
+    def test_grid_bitwise_identical(self, golden):
+        from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+        cfg = SolverConfig(n_grid=512, bisect_iters=60, refine_crossings=False, numerics="fixed")
+        g = beta_u_grid(golden["betas"], golden["us"], make_model_params(), config=cfg, dtype=jnp.float64)
+        for name, got in [("grid_xi", g.xi), ("grid_aw", g.max_aw), ("grid_status", g.status)]:
+            got = np.asarray(got)
+            assert got.dtype == golden[name].dtype
+            assert got.tobytes() == golden[name].tobytes(), name
+
+    def test_baseline_scalar_bitwise(self, golden):
+        from sbr_tpu import solve_equilibrium_baseline, solve_learning
+
+        cfg = SolverConfig(numerics="fixed")
+        base = make_model_params()
+        ls = solve_learning(base.learning, cfg)
+        res = solve_equilibrium_baseline(ls, base.economic, cfg)
+        assert float(res.xi) == float(golden["scalar_xi"])
+        assert float(res.aw_max) == float(golden["scalar_aw"])
+
+    def test_hetero_scalar_bitwise(self, golden):
+        from sbr_tpu.hetero.learning import solve_learning_hetero
+        from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
+        from sbr_tpu.models.params import make_hetero_params
+
+        cfg = SolverConfig(numerics="fixed")
+        m = make_hetero_params(
+            betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=0.1, p=0.9, kappa=0.3, lam=0.1
+        )
+        lsh = solve_learning_hetero(m.learning, cfg)
+        res = solve_equilibrium_hetero(lsh, m.economic, cfg)
+        assert float(res.xi) == float(golden["hetero_xi"])
+        assert float(get_aw_hetero(res, lsh).aw_max) == float(golden["hetero_aw"])
+
+    @pytest.mark.slow
+    def test_social_fixed_point_bitwise(self, golden):
+        from sbr_tpu.social.solver import solve_equilibrium_social
+
+        m = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+        res = solve_equilibrium_social(
+            m, SolverConfig(n_grid=1024, numerics="fixed"), tol=1e-4, max_iter=200
+        )
+        assert bool(res.converged) == bool(golden["social_converged"])
+        assert int(res.iterations) == int(golden["social_iters"])
+        assert float(res.equilibrium.xi) == float(golden["social_xi"])
+
+
+# -- adaptive vs fixed across the solver stacks ------------------------------
+
+
+class TestAdaptiveVsFixed:
+    def test_grid_status_exact_xi_close(self, golden):
+        """The acceptance-criteria parity shape in miniature: status grids
+        match EXACTLY, ξ to 1e-10, and adaptive's Health carries real
+        per-cell iteration counts far under the fixed budget. Reuses the
+        golden 12×12 shape so the fixed-mode program shares its compile
+        with TestFixedBitwise."""
+        from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+        base = make_model_params()
+        betas, us = golden["betas"], golden["us"]
+        kw = dict(n_grid=512, bisect_iters=60, refine_crossings=False)
+        g_a = beta_u_grid(betas, us, base, config=SolverConfig(numerics="adaptive", **kw), dtype=jnp.float64)
+        g_f = beta_u_grid(betas, us, base, config=SolverConfig(numerics="fixed", **kw), dtype=jnp.float64)
+        assert np.array_equal(np.asarray(g_a.status), np.asarray(g_f.status))
+        xi_a, xi_f = np.asarray(g_a.xi), np.asarray(g_f.xi)
+        both = np.isfinite(xi_a) & np.isfinite(xi_f)
+        assert np.array_equal(np.isfinite(xi_a), np.isfinite(xi_f))
+        np.testing.assert_allclose(xi_a[both], xi_f[both], rtol=0, atol=1e-10)
+        it_a = np.asarray(g_a.health.iterations)
+        it_f = np.asarray(g_f.health.iterations)
+        assert it_a.mean() < 0.5 * it_f.mean()  # typically ~7-25 vs 60
+
+    def test_interest_agreement(self):
+        from sbr_tpu import solve_learning
+        from sbr_tpu.interest import solve_equilibrium_interest
+        from sbr_tpu.models.params import make_interest_params
+
+        m = make_interest_params(beta=1.0, eta_bar=15.0, u=0.0, p=0.5, kappa=0.6, lam=0.01, r=0.06, delta=0.1)
+        out = {}
+        for mode in ("adaptive", "fixed"):
+            cfg = SolverConfig(n_grid=1024, numerics=mode)
+            ls = solve_learning(m.learning, cfg)
+            out[mode] = solve_equilibrium_interest(ls, m.economic, cfg)
+        assert bool(out["adaptive"].base.bankrun) == bool(out["fixed"].base.bankrun)
+        assert float(out["adaptive"].base.xi) == pytest.approx(float(out["fixed"].base.xi), abs=1e-6)
+
+    def test_hetero_agreement(self):
+        """Covers both hetero-only adaptive kernels: bs32 on the coupled-K
+        ODE (whole-vector error norm) and chandrupatla in compute_xi_hetero.
+        Same params as TestFixedBitwise so the fixed program shares its
+        compile."""
+        from sbr_tpu.hetero.learning import solve_learning_hetero
+        from sbr_tpu.hetero.solver import get_aw_hetero, solve_equilibrium_hetero
+        from sbr_tpu.models.params import make_hetero_params
+
+        m = make_hetero_params(
+            betas=[0.125, 12.5], dist=[0.9, 0.1], eta_bar=30.0, u=0.1, p=0.9, kappa=0.3, lam=0.1
+        )
+        out = {}
+        for mode in ("adaptive", "fixed"):
+            cfg = SolverConfig(numerics=mode)
+            lsh = solve_learning_hetero(m.learning, cfg)
+            out[mode] = (solve_equilibrium_hetero(lsh, m.economic, cfg), lsh)
+        r_a, lsh_a = out["adaptive"]
+        r_f, lsh_f = out["fixed"]
+        assert int(r_a.status) == int(r_f.status)
+        assert float(r_a.xi) == pytest.approx(float(r_f.xi), abs=1e-6)
+        assert float(get_aw_hetero(r_a, lsh_a).aw_max) == pytest.approx(
+            float(get_aw_hetero(r_f, lsh_f).aw_max), abs=1e-8
+        )
+
+    def test_hetero_sharded_agreement(self):
+        """compute_xi_hetero's comment claims the convergence-masked
+        while_loop is shard-safe (every f-eval psum-completed, so all
+        shards see identical iterates and termination). Exercise it on the
+        8-virtual-device mesh: a jax upgrade that tightens shard_map's
+        replication checking must fail HERE, not in production under the
+        adaptive default."""
+        from sbr_tpu.hetero import solve_hetero_sharded
+        from sbr_tpu.models.params import make_hetero_params
+
+        rng = np.random.default_rng(3)
+        k = 16  # 2 groups/device on the 8-device mesh
+        betas = np.exp(rng.uniform(np.log(0.3), np.log(3.0), k))
+        dist = rng.dirichlet(np.ones(k))
+        m = make_hetero_params(
+            betas=betas, dist=dist / dist.sum(), eta_bar=15.0, u=0.1, p=0.5,
+            kappa=0.6, lam=0.01,
+        )
+        mesh = jax.make_mesh((8,), ("k",))
+        out = {}
+        for mode in ("adaptive", "fixed"):
+            cfg = SolverConfig(n_grid=512, bisect_iters=60, numerics=mode)
+            _, res, aw = solve_hetero_sharded(m, mesh, cfg)
+            out[mode] = (res, aw)
+        r_a, aw_a = out["adaptive"]
+        r_f, aw_f = out["fixed"]
+        assert int(r_a.status) == int(r_f.status)
+        # Sharded learning keeps fixed RK4 under both modes (bit-exact
+        # sharding equivalence), so only the ξ bisection differs: both
+        # bracketers converge the bracket below 1e-9 here.
+        np.testing.assert_allclose(float(r_a.xi), float(r_f.xi), atol=1e-9)
+        np.testing.assert_allclose(float(aw_a.aw_max), float(aw_f.aw_max), atol=1e-9)
+
+    @pytest.mark.slow
+    def test_social_agreement(self):
+        """The Anderson-accelerated tail lands within the fixed point's own
+        tolerance envelope of the plain damped loop (tests/test_reference_parity
+        pins the damped iteration count; this pins cross-mode agreement)."""
+        from sbr_tpu.social.solver import solve_equilibrium_social
+
+        m = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+        out = {}
+        for mode in ("adaptive", "fixed"):
+            out[mode] = solve_equilibrium_social(
+                m, SolverConfig(n_grid=1024, numerics=mode), tol=1e-4, max_iter=200
+            )
+        assert bool(out["adaptive"].converged) and bool(out["fixed"].converged)
+        # ξ amplifies the 1e-4 AW tolerance through the crossing geometry;
+        # 5e-3 is the measured cross-trajectory envelope at these params.
+        assert float(out["adaptive"].equilibrium.xi) == pytest.approx(
+            float(out["fixed"].equilibrium.xi), abs=5e-3
+        )
+        assert int(out["adaptive"].iterations) <= int(out["fixed"].iterations) + 5
+
+
+# -- SolverConfig numerics resolution ----------------------------------------
+
+
+class TestNumericsConfig:
+    def test_auto_resolves_adaptive_by_default(self, monkeypatch):
+        monkeypatch.delenv("SBR_NUMERICS", raising=False)
+        cfg = SolverConfig()
+        assert cfg.numerics == "adaptive" and cfg.adaptive
+
+    def test_env_var_pins_fixed(self, monkeypatch):
+        monkeypatch.setenv("SBR_NUMERICS", "fixed")
+        cfg = SolverConfig()
+        assert cfg.numerics == "fixed" and not cfg.adaptive
+        # explicit beats env
+        assert SolverConfig(numerics="adaptive").adaptive
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(Exception):
+            SolverConfig(numerics="turbo")
+
+    def test_fingerprints_distinguish_modes(self):
+        """Adaptive and fixed tiles must never share cache entries: the
+        resolved mode is concrete in the config, so fingerprints differ —
+        and GRID_PROGRAM_VERSION bumped for the cross-run tile cache."""
+        from sbr_tpu.sweeps.baseline_sweeps import GRID_PROGRAM_VERSION
+        from sbr_tpu.utils.checkpoint import params_fingerprint
+
+        assert GRID_PROGRAM_VERSION >= 2
+        fa = params_fingerprint(SolverConfig(numerics="adaptive"))
+        ff = params_fingerprint(SolverConfig(numerics="fixed"))
+        assert fa != ff
+
+
+# -- history schema 5 ---------------------------------------------------------
+
+
+class TestHistorySchema5:
+    def test_bench_metrics_pick_up_numerics_columns(self):
+        from sbr_tpu.obs import history
+
+        m = history.bench_metrics(
+            {
+                "metric": "eq_per_sec",
+                "value": 1.0,
+                "extra": {"grid_adaptive_speedup": 2.4, "grid_mean_effective_iters": 9.1},
+            }
+        )
+        assert m["grid_adaptive_speedup"] == 2.4
+        assert m["grid_mean_effective_iters"] == 9.1
+
+    def test_polarity(self):
+        from sbr_tpu.obs import history
+
+        assert history.polarity("grid_adaptive_speedup") == 1
+        assert history.polarity("grid_mean_effective_iters") == -1
+
+    def test_schema5_gates_against_schema1_to_4(self, tmp_path):
+        """Committed schema 1-4 lines still load, and a schema-5 append
+        gates its shared metrics against them (the CI trend gate contract)."""
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        rows = [
+            {"ts": "t0", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1000.0}},  # schema-less → 1
+            {"schema": 2, "ts": "t1", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1010.0, "mem_peak_bytes": 5000}},
+            {"schema": 3, "ts": "t2", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1005.0, "serve_p99_ms": 4.0}},
+            {"schema": 4, "ts": "t3", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1002.0, "sweep_warm_hit_rate": 1.0}},
+        ]
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        history.append(
+            {"eq_per_sec": 1008.0, "grid_adaptive_speedup": 2.2, "grid_mean_effective_iters": 9.0},
+            platform="cpu", path=path,
+        )
+        records = history.load(path)
+        assert [r["schema"] for r in records] == [1, 2, 3, 4, history.SCHEMA]
+        verdicts, status = history.check(records, min_points=3)
+        assert status == "ok"
+        assert verdicts["eq_per_sec"]["n"] == 5
+        # new columns are short, never a false gate
+        assert verdicts["grid_adaptive_speedup"]["status"] == "short"
+
+    def test_speedup_regression_gates(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        rows = [
+            {"schema": 5, "ts": f"t{i}", "label": "bench", "platform": "cpu",
+             "metrics": {"grid_adaptive_speedup": 2.0}}
+            for i in range(3)
+        ] + [
+            {"schema": 5, "ts": "t9", "label": "bench", "platform": "cpu",
+             "metrics": {"grid_adaptive_speedup": 1.0}}
+        ]
+        path = tmp_path / "hist.jsonl"
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        verdicts, status = history.check(history.load(path), min_points=3)
+        assert status == "regression"
+        assert verdicts["grid_adaptive_speedup"]["status"] == "regression"

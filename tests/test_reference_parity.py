@@ -297,7 +297,14 @@ class TestSocialParity:
 
         ref = solve_reference_social()
         m = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
-        res = solve_equilibrium_social(m, SolverConfig(n_grid=4096), tol=1e-4, max_iter=500)
+        # numerics="fixed": the lockstep iteration-count assertion below is a
+        # statement about the reference's PLAIN DAMPED loop, which is exactly
+        # the fixed path's contract (ISSUE 9); the adaptive path's Anderson
+        # tail converges in fewer iterations by design and has its own
+        # adaptive-vs-fixed agreement test in tests/test_numerics.py.
+        res = solve_equilibrium_social(
+            m, SolverConfig(n_grid=4096, numerics="fixed"), tol=1e-4, max_iter=500
+        )
         assert ref.converged and bool(res.converged)
         assert bool(res.equilibrium.bankrun) == ref.bankrun
         # near-lockstep iteration counts (measured exactly equal; ±1 allows
