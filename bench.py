@@ -98,6 +98,16 @@ reporting `extra.sweep_cold_cells_per_sec` / `sweep_warm_cells_per_sec` /
 `sweep_warm_hit_rate` (history schema 4) so `report trend` gates both the
 scheduler's compute path and the cache's hit path.
 
+Mega-scale agents (ISSUE 10): the agents workload now generates its graph
+ON DEVICE (`sbr_tpu.social.graphgen` — the edge list never transits host
+RAM) at 10^7 agents / 10^8 edges on every non-tiny platform, CPU
+included, and reports generation separately from simulation:
+`extra.agents_graph_build_s` / `agents_graph_gen_edges_per_sec` (steady
+canonical-layout builds) and `agents_graph_gen_speedup` (device vs the
+host-numpy pipeline at a 10^7-edge control shape), appended to the perf
+history as schema 6 so `report trend` gates generation-path regressions;
+schema-1..5 lines still load and gate.
+
 Resilience (PR 4): the probe ladder's attempts/backoff now come from the
 unified retry engine (`sbr_tpu.resilience.retry`, loaded standalone by
 file path so the parent stays jax-free) — SBR_BENCH_PROBE_ATTEMPTS /
@@ -934,33 +944,113 @@ def bench_grid(platform: str) -> dict:
 
 
 def bench_agents(platform: str) -> dict:
-    """Agent-steps/sec: 10^6 agents, Erdős–Rényi deg 10, 200 steps, f32.
+    """Agent-steps/sec + on-device graph generation (ISSUE 10): 10^7
+    agents, Erdős–Rényi deg 10 → 10^8 edges, f32 — on every non-tiny
+    platform, CPU included (the pre-0.8 host pipeline capped CPU at 10^5
+    agents because the edge list transited host RAM; ~2.4 GB at this
+    shape).
 
-    The graph is PREPARED once (`prepare_agent_graph`: host edge sorts +
-    H2D upload — several seconds at 10^7 edges, reported separately as
-    `prep_s`), so the steady-state metric measures device simulation
-    throughput the way a repeated-use caller experiences it."""
+    Three stages, reported SEPARATELY (graph-gen throughput must not
+    launder into step throughput or vice versa):
+
+    - generation: `graphgen.prepare_generated_graph` builds the canonical
+      dst-sorted layout on device, chunked and capacity-planned against
+      the memory observatory (`plan_chunk_edges`). Steady-state rebuilds
+      → `graph_build_s` / `graph_gen_edges_per_sec` (history schema 6).
+    - host control at the 10^7-edge comparison shape (10^6 agents): the
+      device generator vs the HOST NUMPY pipeline (`erdos_renyi_edges` +
+      prepare under ``SBR_NATIVE=0`` — the portable baseline; the C
+      counting sort is not numpy and not everywhere) → `graph_gen_speedup`.
+      Skipped in tiny mode (sub-second shapes measure noise; the zero is
+      dropped before history like the other reduced-shape stats).
+    - simulation: unchanged steady-state protocol on the generated graph
+      (engine pinned "incremental" at the mega shape — the census answer
+      at this scale, pinned so the bench never times two engines across
+      rounds; the out-edge orientation it needs is the counting-sort part
+      of the build and lands in `prep_s`, not in the generation metric).
+    """
     import numpy as np
 
-    from sbr_tpu.social import (
-        AgentSimConfig,
-        erdos_renyi_edges,
-        prepare_agent_graph,
-        simulate_agents,
-    )
+    from sbr_tpu.social import AgentSimConfig, simulate_agents
+    from sbr_tpu.social.graphgen import ErdosRenyiSpec, prepare_generated_graph
 
-    if _tiny():
-        n, n_steps = 2_000, 20
-    elif platform == "cpu":  # degraded fallback size
-        n, n_steps = 100_000, 100
+    tiny = _tiny()
+    if tiny:
+        n, n_steps, engine = 2_000, 20, "auto"
+    elif platform == "cpu":
+        n, n_steps, engine = 10_000_000, 50, "incremental"
     else:
-        n, n_steps = 1_000_000, 200
-    t0 = time.perf_counter()
-    src, dst = erdos_renyi_edges(n, 10.0, seed=0)
-    _log(f"agents: graph built ({len(src)} edges) in {time.perf_counter() - t0:.1f}s")
+        n, n_steps, engine = 10_000_000, 200, "incremental"
+    spec = ErdosRenyiSpec(n=n, avg_degree=10.0)
     cfg = AgentSimConfig(n_steps=n_steps, dt=0.05)
+
+    # --- generation stage: canonical-layout builds, cold then steady ---
     t0 = time.perf_counter()
-    pg = prepare_agent_graph(1.0, src, dst, n, config=cfg)
+    pg_g = prepare_generated_graph(spec, seed=0, engine="gather", config=cfg)
+    pg_g.src.block_until_ready()
+    build_first_s = time.perf_counter() - t0
+    build_times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        pg_g = prepare_generated_graph(spec, seed=0, engine="gather", config=cfg)
+        pg_g.src.block_until_ready()
+        build_times.append(time.perf_counter() - t0)
+    build_s = min(build_times)
+    e = pg_g.n_edges
+    gen_rate = e / build_s
+    _log(
+        f"agents: {e} edges generated on device in {build_s:.2f}s steady "
+        f"({gen_rate / 1e6:.1f}M edges/s; first build {build_first_s:.2f}s "
+        f"incl. compile)"
+    )
+    del pg_g
+
+    # --- host control: device vs host-numpy at the 10^7-edge shape ---
+    gen_speedup = host_rate = 0.0
+    if not tiny:
+        from sbr_tpu.social import erdos_renyi_edges, prepare_agent_graph
+
+        spec_c = ErdosRenyiSpec(n=1_000_000, avg_degree=10.0)
+        dev_t = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            pg_c = prepare_generated_graph(spec_c, seed=0, engine="gather", config=cfg)
+            pg_c.src.block_until_ready()
+            dev_t.append(time.perf_counter() - t0)
+        e_c = pg_c.n_edges
+        del pg_c
+        host_t = []
+        prev_native = os.environ.get("SBR_NATIVE")
+        os.environ["SBR_NATIVE"] = "0"
+        try:
+            for _ in range(2):
+                t0 = time.perf_counter()
+                src_h, dst_h = erdos_renyi_edges(spec_c.n, 10.0, seed=0)
+                pg_h = prepare_agent_graph(
+                    1.0, src_h, dst_h, spec_c.n, config=cfg, engine="gather"
+                )
+                pg_h.src.block_until_ready()
+                host_t.append(time.perf_counter() - t0)
+            e_h = len(src_h)
+            del pg_h, src_h, dst_h
+        finally:
+            if prev_native is None:
+                os.environ.pop("SBR_NATIVE", None)
+            else:
+                os.environ["SBR_NATIVE"] = prev_native
+        host_rate = e_h / min(host_t)
+        gen_speedup = (e_c / min(dev_t)) / host_rate
+        _log(
+            f"agents: device {e_c / min(dev_t) / 1e6:.1f}M vs host-numpy "
+            f"{host_rate / 1e6:.1f}M edges/s at the 10^7-edge shape "
+            f"({gen_speedup:.1f}x)"
+        )
+
+    # --- simulation stage: prepared once (engine-specific structures on
+    # top of the canonical layout land here, not in the gen metric) ---
+    t0 = time.perf_counter()
+    pg = prepare_generated_graph(spec, seed=0, engine=engine, config=cfg)
+    (pg.inc[0] if pg.inc is not None else pg.src).block_until_ready()
     prep_s = time.perf_counter() - t0
     _log(f"agents: graph prepared (engine={pg.engine}) in {prep_s:.1f}s")
 
@@ -999,12 +1089,21 @@ def bench_agents(platform: str) -> dict:
         "agent_steps_per_sec": steps / elapsed,
         "n_agents": n,
         "n_steps": n_steps,
+        "n_edges": e,
         "first_call_s": first_s,
         "steady_s": elapsed,
         "prep_s": prep_s,
         "engine": pg.engine,
         "recount_steps": recounts,
         "mem_peak_bytes": mem_peak,
+        # Schema-6 generation metrics — zeroed in tiny mode (sub-second
+        # builds measure dispatch noise; the zeros are dropped before
+        # history like the other reduced-shape stats).
+        "graph_build_first_s": build_first_s,
+        "graph_build_s": 0.0 if tiny else build_s,
+        "graph_gen_edges_per_sec": 0.0 if tiny else gen_rate,
+        "graph_gen_speedup": gen_speedup,
+        "host_gen_edges_per_sec": host_rate,
     }
 
 
@@ -1265,6 +1364,21 @@ def _measure_inner(platform: str) -> None:
         out["extra"]["agents_recount_steps"] = agents["recount_steps"]
         if agents.get("mem_peak_bytes"):
             out["extra"]["agents_mem_peak_bytes"] = int(agents["mem_peak_bytes"])
+        # Schema-6 history metrics (ISSUE 10): the on-device generation
+        # split. Zero means "reduced shape / not measured" and is dropped
+        # here so it never enters a gated history as a fake baseline.
+        if agents.get("graph_build_s"):
+            out["extra"]["agents_graph_build_s"] = round(agents["graph_build_s"], 3)
+        if agents.get("graph_gen_edges_per_sec"):
+            out["extra"]["agents_graph_gen_edges_per_sec"] = round(
+                agents["graph_gen_edges_per_sec"], 1
+            )
+        if agents.get("graph_gen_speedup"):
+            out["extra"]["agents_graph_gen_speedup"] = round(
+                agents["graph_gen_speedup"], 2
+            )
+        if agents.get("n_edges"):
+            out["extra"]["agents_n_edges"] = int(agents["n_edges"])
     if serve is not None:
         # Schema-3 history metrics: bench_metrics picks the serve_* keys up
         # so `report trend` gates serving-latency regressions.

@@ -66,6 +66,10 @@ def _build_lib() -> Optional[ctypes.CDLL]:
 
 def get_lib() -> Optional[ctypes.CDLL]:
     global _lib, _lib_tried
+    if os.environ.get("SBR_NATIVE", "").strip() == "0":
+        # Checked per call (not once) so a bench can measure the portable
+        # numpy fallback alongside the native path in one process.
+        return None
     if not _lib_tried:
         _lib = _build_lib()
         _lib_tried = True

@@ -53,10 +53,16 @@ from typing import Optional
 # (adaptive vs bit-exact fixed control, timed back-to-back on the same
 # shape) and grid_mean_effective_iters (mean per-cell root-find iterations
 # from the Health grid — the fixed path records its constant budget).
+# 6 adds the mega-scale agents generation split (ISSUE 10):
+# agents_graph_build_s (steady on-device canonical-layout build),
+# agents_graph_gen_edges_per_sec (generation throughput) and
+# agents_graph_gen_speedup (device generator vs the host-numpy pipeline at
+# the 10^7-edge control shape), so `report trend` gates the generation
+# path separately from step throughput.
 # Readers accept every version: the key set only grows, and
-# `load` stamps schema-less legacy lines as 1, so a committed schema-1/2/3/4
-# history keeps gating new schema-5 appends.
-SCHEMA = 5
+# `load` stamps schema-less legacy lines as 1, so a committed
+# schema-1/2/3/4/5 history keeps gating new schema-6 appends.
+SCHEMA = 6
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -115,8 +121,8 @@ def load(path=None) -> list:
         except json.JSONDecodeError:
             continue
         if isinstance(rec, dict) and isinstance(rec.get("metrics"), dict):
-            # Schema-less lines predate versioning (= schema 1); schemas 2
-            # and 3 are pure supersets, so every known version loads
+            # Schema-less lines predate versioning (= schema 1); schemas
+            # 2-6 are pure supersets, so every known version loads
             # uniformly and older lines keep gating newer appends.
             rec.setdefault("schema", 1)
             records.append(rec)
@@ -160,6 +166,12 @@ def bench_metrics(result: dict) -> dict:
         # per cell (lower-better by the _iters polarity rule)
         "grid_adaptive_speedup",
         "grid_mean_effective_iters",
+        # schema 6: the mega-scale agents generation split (bench.py
+        # bench_agents on graphgen): build duration lower-better by the _s
+        # rule, generation throughput and device-vs-host speedup higher
+        "agents_graph_build_s",
+        "agents_graph_gen_edges_per_sec",
+        "agents_graph_gen_speedup",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):

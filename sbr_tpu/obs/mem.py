@@ -164,6 +164,21 @@ def tile_peak(snap: dict) -> int:
     )
 
 
+def host_available_bytes() -> Optional[int]:
+    """Host ``MemAvailable`` in bytes (/proc/meminfo), or None where the
+    kernel does not expose it (non-Linux). The capacity signal for
+    planning on capacity-less CPU backends, where `device_capacity` has
+    nothing to report — the "device" memory IS host memory there."""
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return None
+
+
 def snapshot(stats: Optional[dict] = None) -> dict:
     """One attribution snapshot: whatever is observable right now. Keys are
     present only when their source answered — consumers must treat every

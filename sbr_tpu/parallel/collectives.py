@@ -1,0 +1,36 @@
+"""Reusable mesh collectives beyond the lax builtins.
+
+First resident: ``exclusive_psum`` — the exclusive prefix sum over a mesh
+axis that distributed stable counting sort needs (each device must know
+how many same-key items EARLIER devices hold). lax has ``psum`` (inclusive
+of everyone) but no exclusive scan; this one is n_dev−1 ``ppermute``
+rotations with an axis-index mask, so peak memory stays O(len(x)) instead
+of the all_gather form's O(n_dev · len(x)) — at the 10^7-agent histogram
+length that is the difference between 40 MB and 40·n_dev MB per device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def exclusive_psum(x, axis: str, n_dev: int):
+    """Exclusive prefix sum of ``x`` over mesh axis ``axis``: device d
+    receives Σ_{d' < d} x_{d'} (zeros on device 0).
+
+    Must be called inside shard_map with ``n_dev`` equal to the axis size
+    (static — the rotation schedule unrolls). Deterministic: the sum is
+    accumulated in device order, so integer inputs are exact and float
+    inputs are reproducible across runs of the same mesh.
+    """
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+    excl = jnp.zeros_like(x)
+    cur = x
+    for i in range(n_dev - 1):
+        # after i+1 rotations device d holds x from device d-(i+1) (mod
+        # n_dev); accumulate only genuine predecessors (no wraparound)
+        cur = lax.ppermute(cur, axis, perm)
+        mask = lax.axis_index(axis) > i
+        excl = excl + jnp.where(mask, cur, jnp.zeros_like(cur))
+    return excl

@@ -6,9 +6,12 @@ Two tiers, per SURVEY §7.2.6:
   fixed-point equilibrium of the reference
   (`src/extensions/social_learning/`), run entirely on device as one
   `lax.while_loop`.
-- :mod:`agents` — the explicit-population extension (north star): 10^6
-  agents on Erdős–Rényi / scale-free graphs, neighbor-withdrawal learning
-  via `segment_sum`, sharded over a device mesh.
+- :mod:`agents` — the explicit-population extension (north star): 10^7+
+  agents on Erdős–Rényi / scale-free / stochastic-block graphs,
+  neighbor-withdrawal learning via `segment_sum`, sharded over a device
+  mesh. Graphs can be generated ON DEVICE (:mod:`graphgen`, 0.8.0) so the
+  edge list never transits host RAM, and every engine's per-step
+  draw→infection→update tail runs as one fused kernel (:mod:`fused`).
 """
 
 from sbr_tpu.social.dynamics import solve_forced_learning
@@ -25,6 +28,12 @@ from sbr_tpu.social.agents import (
     simulate_agents,
 )
 from sbr_tpu.social.closure import LoopComparison, close_loop, equilibrium_window
+from sbr_tpu.social.graphgen import (
+    ErdosRenyiSpec,
+    ScaleFreeSpec,
+    StochasticBlockSpec,
+    prepare_generated_graph,
+)
 
 __all__ = [
     "solve_forced_learning",
@@ -42,4 +51,8 @@ __all__ = [
     "LoopComparison",
     "close_loop",
     "equilibrium_window",
+    "ErdosRenyiSpec",
+    "ScaleFreeSpec",
+    "StochasticBlockSpec",
+    "prepare_generated_graph",
 ]

@@ -67,6 +67,12 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sbr_tpu.parallel.compat import pcast, shard_map
+from sbr_tpu.social.fused import infection_update
+
+# Re-exported for back-compat: the counter-RNG primitives moved to
+# sbr_tpu.social.rng in 0.8.0 so the fused kernel and the on-device graph
+# generators share them (benchmarks/tests import them from here).
+from sbr_tpu.social.rng import _agent_uniforms, _threefry2x32  # noqa: F401
 
 
 # ---------------------------------------------------------------------------
@@ -175,6 +181,14 @@ class AgentSimConfig:
     # shape (19.6M -> 45.1M agent-steps/s) and strictly less work on any
     # platform; "foldin" reproduces the realizations of pre-0.7 artifacts.
     rng_stream: str = "counter"
+    # Lowering of the per-step draw→infection→update tail (ISSUE 10):
+    # "auto" (default) resolves per platform — the fused Pallas kernel on
+    # TPU/GPU, the fused-lax form elsewhere (bit-identical to "unfused" by
+    # construction, so tier-1/CPU semantics are unchanged); "interpret"
+    # runs the Pallas kernel under the interpreter (the testable-anywhere
+    # path); "unfused" pins the pre-0.8 inline sequence (parity oracle).
+    # See `sbr_tpu.social.fused`.
+    fused: str = "auto"
 
     def __post_init__(self):
         if self.n_steps < 1:
@@ -194,6 +208,10 @@ class AgentSimConfig:
             )
         if self.rng_stream not in ("foldin", "counter"):
             raise ValueError("rng_stream must be 'foldin' or 'counter'")
+        from sbr_tpu.social.fused import MODES
+
+        if self.fused not in MODES:
+            raise ValueError(f"fused must be one of {MODES}, got {self.fused!r}")
 
 
 @struct.dataclass
@@ -341,92 +359,6 @@ def _prep_inputs(n: int, betas, x0: float, src, dst, seed: int, dtype, exact_see
     return betas, src, dst, indeg, row_ptr, informed0
 
 
-def _threefry2x32(k0, k1, c0, c1):
-    """One Threefry-2x32 block (Salmon et al. 2011), vectorized over the
-    counter arrays — bit-exact vs `jax._src.prng.threefry_2x32` (tested).
-    Re-implemented on public jnp ops so the counter RNG stream below does
-    not depend on a private JAX API."""
-
-    def rotl(x, r):
-        return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
-
-    ks = (k0, k1, jnp.uint32(0x1BD11BDA) ^ k0 ^ k1)
-    x0 = c0 + ks[0]
-    x1 = c1 + ks[1]
-    rot_a, rot_b = (13, 15, 26, 6), (17, 29, 16, 24)
-    for i in range(5):
-        for r in rot_a if i % 2 == 0 else rot_b:
-            x0 = x0 + x1
-            x1 = rotl(x1, r)
-            x1 = x1 ^ x0
-        j = i + 1
-        x0 = x0 + ks[j % 3]
-        x1 = x1 + ks[(j + 1) % 3] + jnp.uint32(j)
-    return x0, x1
-
-
-def _agent_uniforms(key, step_k, ids, dtype, impl: str = "counter"):
-    """Per-agent uniform draw as a pure function of (key, step, GLOBAL agent id).
-
-    Keying the stream by global agent id — not by device or array position —
-    makes the simulation invariant to sharding: a single-device run and an
-    n-device run draw bit-identical randomness per agent, so the two paths
-    are exactly equivalent (tested), not merely statistically close.
-
-    Two streams, both with that invariance (`AgentSimConfig.rng_stream`;
-    the default here matches the config default):
-
-    - "counter" (default since 0.7.0): one Threefry block per agent — the
-      per-step key pair hashes the id directly as the block counter, and
-      the uniform is built from the block's first word (both words for
-      f64's 52-bit mantissa).
-    - "foldin": uniform(fold_in(fold_in(key, step), id)) — two full
-      Threefry blocks per agent per step plus the vmapped key
-      construction (~16x the CPU cost); the stream every pre-0.7
-      committed measurement used.
-
-    A run is comparable across engines/shardings/platforms under either
-    stream, but the streams are different (equally valid) realizations.
-
-    The counter path requires the 2-word threefry key layout (ADVICE r5):
-    under jax_default_prng_impl=rbg/unsafe_rbg key data is 4 uint32 words
-    with no contract that the first two vary per step, which would silently
-    degrade the stream to half the key material. A non-2-word layout falls
-    back to the foldin path, which is layout-agnostic by construction.
-    """
-    step_key = jax.random.fold_in(key, step_k)
-    if impl == "counter":
-        kd = (
-            step_key
-            if getattr(step_key, "dtype", None) == jnp.uint32
-            else jax.random.key_data(step_key)
-        )
-        if kd.shape[-1] != 2:  # rbg/unsafe_rbg: 4-word keys — see docstring
-            impl = "foldin"
-    if impl == "counter":
-        c0 = ids.astype(jnp.uint32)
-        x0, x1 = _threefry2x32(kd[0], kd[1], c0, jnp.zeros_like(c0))
-        if np.dtype(dtype) == np.float64:
-            hi = x0.astype(jnp.uint64) << jnp.uint64(32)
-            mant = (hi | x1.astype(jnp.uint64)) >> jnp.uint64(12)
-            one_to_two = jax.lax.bitcast_convert_type(
-                mant | jnp.uint64(0x3FF0000000000000), jnp.float64
-            )
-            return one_to_two - 1.0
-        mant = (x0 >> jnp.uint32(9)) | jnp.uint32(0x3F800000)
-        one_to_two = jax.lax.bitcast_convert_type(mant, jnp.float32)
-        u = (one_to_two - 1.0).astype(dtype)
-        if jnp.finfo(dtype).bits < 32:
-            # f16/bf16 (ADVICE r5): the cast can round draws within ~2^-11
-            # of 1.0 up to exactly 1.0, breaking the [0,1) contract the
-            # jax.random.uniform path guarantees; clamp to the largest
-            # representable value below 1.
-            u = jnp.minimum(u, jnp.asarray(1.0 - jnp.finfo(dtype).epsneg, dtype))
-        return u
-    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(step_key, ids)
-    return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)
-
-
 def _auto_engine(
     edge_slices,
     max_degree: int,
@@ -569,6 +501,58 @@ def _default_incremental_budget(n_block: int, floor: int = 4096) -> int:
     return min(max(floor, n_block // 64), 65536)
 
 
+def _resolve_engine_from_outdeg(
+    outdeg_c,
+    n: int,
+    e: int,
+    config: AgentSimConfig,
+    mesh,
+    mesh_axis: str,
+    incremental_budget: Optional[int],
+    d0: int,
+    beta_mean: float,
+) -> str:
+    """The engine="auto" decision from a host out-degree census — the ONE
+    resolution shared by `prepare_agent_graph` (which bincounts the
+    canonicalized sources) and `graphgen.prepare_generated_graph` (which
+    pulls the device histogram): census over the out-degree vector
+    single-device, the per-chunk slice tail under a mesh, with the same
+    effective-budget rules the runtime will use."""
+    if e == 0:
+        return "gather"
+    outdeg_c = np.asarray(outdeg_c, dtype=np.int64)
+    if mesh is None:
+        census = outdeg_c
+        budget_est = incremental_budget or _default_incremental_budget(n)
+    else:
+        # edge-count sharding splits hub edges across chunks, and the
+        # per-device change budget multiplies across devices — census
+        # and budget are both the per-device effective values
+        n_dev_a = mesh.shape[mesh_axis]
+        ec_a = max(1, -(-e // n_dev_a))
+        out_ptr_c = np.concatenate([[0], np.cumsum(outdeg_c)])
+        census = _max_chunk_slice(out_ptr_c, ec_a, n)
+        # the same padded per-device block the runtime will use
+        # (byte-aligned for the incremental candidate, ADVICE r4:
+        # a ceil(n/n_dev) estimate drifted from the runtime budget
+        # near block boundaries)
+        n_gl_a = n + (-n) % (8 * n_dev_a)
+        nb_a = n_gl_a // n_dev_a
+        budget_est = (
+            incremental_budget or _default_incremental_budget(nb_a, floor=512)
+        ) * n_dev_a
+    return _auto_engine(
+        census,
+        d0,
+        config.n_steps,
+        n,
+        beta_mean,
+        config.dt,
+        int(budget_est),
+        waves=_census_waves(config),
+    )
+
+
 def _max_chunk_slice(out_ptr: np.ndarray, ec: int, n: int) -> np.ndarray:
     """Per-agent largest out-edge slice under edge-count sharding with chunk
     size ``ec``: an agent's contiguous src-sorted edge range [start, end)
@@ -688,12 +672,10 @@ def _incremental_sim(config: AgentSimConfig, budget_agents: int, budget_deg: int
                 return _seg_counts(wd[src], row_ptr)
 
             counts2 = lax.cond(overflow, full, incr, counts)
-            frac = counts2.astype(dtype) / safe_deg
-            p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
-            newly = (~informed) & (draws < p_inf)
-            informed2 = informed | newly
-            t_inf2 = jnp.where(newly, t + dt, t_inf)
+            informed2, t_inf2 = infection_update(
+                informed, t_inf, counts2, betas, safe_deg, key, k, ids, t,
+                dt, config.rng_stream, config.fused,
+            )
             obs = (
                 jnp.mean(informed.astype(dtype)),
                 jnp.mean(wd.astype(dtype)),
@@ -740,12 +722,10 @@ def _single_device_sim(config: AgentSimConfig):
             t = k.astype(dtype) * dt
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
             counts = _seg_counts(wd[src], row_ptr)
-            frac = counts.astype(dtype) / safe_deg
-            p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
-            newly = (~informed) & (draws < p_inf)
-            informed2 = informed | newly
-            t_inf2 = jnp.where(newly, t + dt, t_inf)
+            informed2, t_inf2 = infection_update(
+                informed, t_inf, counts, betas, safe_deg, key, k, ids, t,
+                dt, config.rng_stream, config.fused,
+            )
             obs = (jnp.mean(informed.astype(dtype)), jnp.mean(wd.astype(dtype)))
             return (informed2, t_inf2), obs
 
@@ -826,12 +806,10 @@ def _sharded_sim(config: AgentSimConfig, mesh: Mesh, axis: str, n_true: int, com
             wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
             # local edges carry global dst ids; the pad segment (dst = N) is
             # the last row of the pointer table and is dropped.
-            frac = neighbor_counts(wd).astype(dtype) / safe_deg
-            p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
-            newly = (~informed) & (draws < p_inf)
-            informed2 = informed | newly
-            t_inf2 = jnp.where(newly, t + dt, t_inf)
+            informed2, t_inf2 = infection_update(
+                informed, t_inf, neighbor_counts(wd), betas, safe_deg, key,
+                k, ids, t, dt, config.rng_stream, config.fused,
+            )
             g = lax.psum(jnp.sum(informed.astype(dtype)), axis) * inv_n
             aw = lax.psum(jnp.sum(wd.astype(dtype)), axis) * inv_n
             return (informed2, t_inf2), (g, aw)
@@ -951,12 +929,10 @@ def _sharded_incremental_sim(
                 return _bit_recount(bits_global, src, row_ptr, axis)
 
             counts2 = lax.cond(overflow_any, full, incr, counts)
-            frac = counts2.astype(dtype) / safe_deg
-            p_inf = 1.0 - jnp.exp(-betas * frac * dt)
-            draws = _agent_uniforms(key, k, ids, dtype, config.rng_stream)
-            newly = (~informed) & (draws < p_inf)
-            informed2 = informed | newly
-            t_inf2 = jnp.where(newly, t + dt, t_inf)
+            informed2, t_inf2 = infection_update(
+                informed, t_inf, counts2, betas, safe_deg, key, k, ids, t,
+                dt, config.rng_stream, config.fused,
+            )
             g = lax.psum(jnp.sum(informed.astype(dtype)), axis) * inv_n
             aw = lax.psum(jnp.sum(wd.astype(dtype)), axis) * inv_n
             return (informed2, t_inf2, counts2, bits_global), (g, aw, overflow_any)
@@ -1041,6 +1017,7 @@ def prepare_agent_graph(
     incremental_budget: Optional[int] = None,
     incremental_max_degree: Optional[int] = None,
     measure_probe: Optional[dict] = None,
+    _canonical: Optional[tuple] = None,
 ) -> PreparedAgentGraph:
     """Host-side canonicalization + upload, factored out of simulate_agents.
 
@@ -1065,6 +1042,12 @@ def prepare_agent_graph(
     the cap was not pinned by the caller — the measured-fastest
     (engine, cap) pair wins (results are identical for any cap; only
     throughput differs).
+
+    ``_canonical``: private — a `_canonicalize_graph(betas, src, dst, n,
+    dtype)` result to reuse instead of re-sorting (the engine="measure"
+    branch canonicalizes once and shares it with every candidate prepare,
+    closing ADVICE r5's duplicate O(E) census; `graphgen` has no use for
+    it — device-generated graphs never pass through the host sort).
     """
     dtype = np.dtype(dtype)
     md_pinned = incremental_max_degree is not None
@@ -1107,6 +1090,14 @@ def prepare_agent_graph(
         bad = set(probe) - {"x0", "seed", "informed0", "t_inf0", "exact_seeds"}
         if bad:
             raise ValueError(f"measure_probe: unknown keys {sorted(bad)}")
+        # ADVICE r5 (agents.py:1105): canonicalize ONCE up front — the dst
+        # range validation runs before any census math, the widened-cap
+        # gate below reuses the canonicalized host out-degrees instead of
+        # an extra O(E) device-to-host transfer + raw bincount, and every
+        # candidate prepare shares the same canonical arrays instead of
+        # re-sorting the edge list per candidate.
+        if _canonical is None:
+            _canonical = _canonicalize_graph(betas, src, dst, n, dtype)
         if np.size(src) == 0:
             # both candidates coerce to gather on an edgeless graph — no
             # measurement to run, and labeling a rate "incremental" would lie
@@ -1114,7 +1105,7 @@ def prepare_agent_graph(
                 betas, src, dst, n, config=config, mesh=mesh,
                 mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine="gather",
                 incremental_budget=incremental_budget,
-                incremental_max_degree=d0,
+                incremental_max_degree=d0, _canonical=_canonical,
             )
         candidates = [("gather", d0), ("incremental", d0)]
         if not md_pinned:
@@ -1129,11 +1120,11 @@ def prepare_agent_graph(
             # engine's true criterion is the per-chunk slice tail, but a
             # mis-gate here only costs one timed candidate or skips one,
             # never correctness.
-            outdeg_m = np.bincount(np.asarray(src).ravel(), minlength=n)
+            outdeg_m = np.bincount(_canonical[1], minlength=n)
             d_wide = 8 * d0
             predicted = _census_fallback_steps(
                 outdeg_m, d0, config.n_steps, n,
-                float(np.mean(np.broadcast_to(np.asarray(betas), (n,)))),
+                float(np.mean(_canonical[0], dtype=np.float64)),
                 config.dt,
                 incremental_budget or _default_incremental_budget(n),
                 waves=_census_waves(config),
@@ -1154,7 +1145,7 @@ def prepare_agent_graph(
                     betas, src, dst, n, config=config, mesh=mesh,
                     mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=cand_eng,
                     incremental_budget=incremental_budget,
-                    incremental_max_degree=cand_d,
+                    incremental_max_degree=cand_d, _canonical=_canonical,
                 )
                 cand_resident = cand
                 res = simulate_agents(prepared=pg_c, config=config, **probe)
@@ -1191,7 +1182,7 @@ def prepare_agent_graph(
                 betas, src, dst, n, config=config, mesh=mesh,
                 mesh_axis=mesh_axis, dtype=dtype, comm=comm, engine=winner[0],
                 incremental_budget=incremental_budget,
-                incremental_max_degree=winner[1],
+                incremental_max_degree=winner[1], _canonical=_canonical,
             )
         return dataclasses.replace(
             pg_c,
@@ -1200,48 +1191,21 @@ def prepare_agent_graph(
 
     from sbr_tpu.native import sort_edges_by_dst
 
-    betas_h, src_h, dst_h, indeg_h, row_ptr_h = _canonicalize_graph(
-        betas, src, dst, n, dtype
+    betas_h, src_h, dst_h, indeg_h, row_ptr_h = (
+        _canonical
+        if _canonical is not None
+        else _canonicalize_graph(betas, src, dst, n, dtype)
     )
 
     if engine == "auto":
-        if len(src_h) == 0:
-            engine = "gather"
-        else:
-            # the census needs only out-degrees (and their cumsum under a
-            # mesh) — an O(E) bincount, NOT the full edge re-sort, which is
-            # deferred to the branch that actually runs incremental
-            outdeg_c = np.bincount(src_h, minlength=n).astype(np.int64)
-            if mesh is None:
-                census = outdeg_c
-                budget_est = incremental_budget or _default_incremental_budget(n)
-            else:
-                # edge-count sharding splits hub edges across chunks, and the
-                # per-device change budget multiplies across devices — census
-                # and budget are both the per-device effective values
-                n_dev_a = mesh.shape[mesh_axis]
-                ec_a = max(1, -(-len(src_h) // n_dev_a))
-                out_ptr_c = np.concatenate([[0], np.cumsum(outdeg_c)])
-                census = _max_chunk_slice(out_ptr_c, ec_a, n)
-                # the same padded per-device block the runtime will use
-                # (byte-aligned for the incremental candidate, ADVICE r4:
-                # a ceil(n/n_dev) estimate drifted from the runtime budget
-                # near block boundaries)
-                n_gl_a = n + (-n) % (8 * n_dev_a)
-                nb_a = n_gl_a // n_dev_a
-                budget_est = (
-                    incremental_budget or _default_incremental_budget(nb_a, floor=512)
-                ) * n_dev_a
-            engine = _auto_engine(
-                census,
-                d0,
-                config.n_steps,
-                n,
-                float(np.mean(betas_h)),
-                config.dt,
-                int(budget_est),
-                waves=_census_waves(config),
-            )
+        # the census needs only out-degrees (and their cumsum under a
+        # mesh) — an O(E) bincount, NOT the full edge re-sort, which is
+        # deferred to the branch that actually runs incremental
+        engine = _resolve_engine_from_outdeg(
+            np.bincount(src_h, minlength=n), n, len(src_h), config, mesh,
+            mesh_axis, incremental_budget, d0,
+            float(np.mean(betas_h, dtype=np.float64)),
+        )
     if engine == "incremental" and len(src_h) == 0:
         # the incremental kernel's dense out-edge grid cannot gather from an
         # empty edge array; the gather kernel handles E = 0 fine
@@ -1349,6 +1313,13 @@ def save_agent_state(path, result: AgentSimResult, seed: int, dt: float) -> None
     deterministic in its inputs). ``seed`` must be the seed the run used:
     the per-(agent, step) RNG stream is keyed on it, so resuming under a
     different seed is a different (valid) realization, not a continuation.
+
+    Cross-version note (ADVICE r5): 0.7.0 flipped the default
+    ``rng_stream`` from "foldin" to "counter", so exact resume of a
+    pre-0.7 artifact requires pinning
+    ``AgentSimConfig(rng_stream="foldin")`` in the resuming call —
+    resuming under the new default splices two (individually valid)
+    streams and the combined trajectory reproduces as neither.
     """
     from sbr_tpu.utils.checkpoint import _save_atomic
 
@@ -1373,7 +1344,10 @@ def load_agent_state(path, dt: Optional[float] = None) -> dict:
     the resuming call (with the same graph and a config whose ``dt``
     matches; pass ``dt`` here to validate that early). Resumption is
     bit-identical to an uninterrupted run
-    (tests/test_social.py::TestLaunchChunking).
+    (tests/test_social.py::TestLaunchChunking) — for artifacts written by
+    pre-0.7 versions, only under ``rng_stream="foldin"`` (the pre-0.7
+    stream; the 0.7.0 default "counter" would splice a different stream
+    mid-trajectory — see `save_agent_state`).
     """
     with np.load(Path(path)) as d:
         if dt is not None and abs(float(d["dt"]) - dt) > 1e-12:

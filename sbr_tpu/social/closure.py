@@ -95,12 +95,25 @@ def close_loop(
     max_iter: int = 500,
     mesh=None,
     fp: Optional[SocialFixedPointResult] = None,
+    graph=None,
 ) -> LoopComparison:
     """Solve the fixed point, feed its window to the agent sim, compare.
 
     Defaults: Figure-12 calibration (β=0.9, η̄=30, u=0.5, p=0.99, κ=0.25,
     λ=0.25, `scripts/4_social_learning.jl:36-43`), Erdős–Rényi graph dense
     enough for the mean-field limit.
+
+    ``graph`` selects the graph source: None (default) samples a host
+    Erdős–Rényi graph per rep (`erdos_renyi_edges` — the pre-0.8 path); a
+    `sbr_tpu.social.graphgen` spec (ErdosRenyiSpec / ScaleFreeSpec /
+    StochasticBlockSpec with ``spec.n == n_agents``) generates the graph
+    ON DEVICE per rep (`prepare_generated_graph`) — no edge data transits
+    the host, which is what lets the loop close at 10^7-agent scale; the
+    device ER stream is a different (equally valid) realization of the
+    same model, so host-vs-device comparisons agree statistically, not
+    bitwise. Non-ER specs compare network scenarios against the same
+    mean-field curves (the dense-limit concentration argument needs high
+    average degree to hold tightly).
 
     ``g0`` selects a MID-TRAJECTORY start: the simulation begins at the time
     t0 where the fixed point's G reaches g0, with round(g0·N) agents seeded
@@ -150,11 +163,15 @@ def close_loop(
         n_steps=n_steps, dt=dt, exit_delay=exit_delay, reentry_delay=reentry_delay
     )
 
+    if graph is not None and graph.n != n_agents:
+        raise ValueError(
+            f"graph spec n={graph.n} does not match n_agents={n_agents}"
+        )
+
     aw_acc = g_acc = None
     t = None
     for rep in range(n_reps):
         rep_seed = seed + 1000 * rep
-        src, dst = erdos_renyi_edges(n_agents, avg_degree, seed=rep_seed)
         if g0 is not None:
             rng = np.random.default_rng(rep_seed + 17)
             informed0 = np.zeros(n_agents, dtype=bool)
@@ -162,19 +179,36 @@ def close_loop(
             informed0[chosen] = True
             t_inf0 = np.zeros(n_agents)
             t_inf0[chosen] = s - t0  # sim clock starts at t0: seeds are ≤ 0
-        sim = simulate_agents(
-            beta,
-            src,
-            dst,
-            n_agents,
-            x0=x0,
-            config=sim_cfg,
-            seed=rep_seed,
-            mesh=mesh,
-            exact_seeds=True,
-            informed0=informed0,
-            t_inf0=t_inf0,
-        )
+        if graph is not None:
+            from sbr_tpu.social.graphgen import prepare_generated_graph
+
+            pg = prepare_generated_graph(
+                graph, seed=rep_seed, betas=beta, config=sim_cfg, mesh=mesh
+            )
+            sim = simulate_agents(
+                prepared=pg,
+                x0=x0,
+                config=sim_cfg,
+                seed=rep_seed,
+                exact_seeds=True,
+                informed0=informed0,
+                t_inf0=t_inf0,
+            )
+        else:
+            src, dst = erdos_renyi_edges(n_agents, avg_degree, seed=rep_seed)
+            sim = simulate_agents(
+                beta,
+                src,
+                dst,
+                n_agents,
+                x0=x0,
+                config=sim_cfg,
+                seed=rep_seed,
+                mesh=mesh,
+                exact_seeds=True,
+                informed0=informed0,
+                t_inf0=t_inf0,
+            )
         aw = np.asarray(sim.withdrawn_frac, dtype=np.float64)
         g = np.asarray(sim.informed_frac, dtype=np.float64)
         aw_acc = aw if aw_acc is None else aw_acc + aw
@@ -184,8 +218,7 @@ def close_loop(
     aw_sim = aw_acc / n_reps
     g_sim = g_acc / n_reps
 
-    aw_fp = np.interp(t, grid, np.asarray(fp.aw, dtype=np.float64))
-    g_fp = np.interp(t, grid, g_curve)
+    g_fp, aw_fp = fp.curves_on(t)
 
     d = aw_sim - aw_fp
     dg = g_sim - g_fp

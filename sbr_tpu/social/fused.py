@@ -1,0 +1,202 @@
+"""Fused infection step: counter-Threefry draw → hazard → state update in
+one kernel (ISSUE 10).
+
+Every simulation engine in ``social.agents`` ends its step with the same
+per-agent chain::
+
+    frac   = counts / indeg
+    p_inf  = 1 - exp(-β · frac · dt)
+    draws  = threefry(key_step, agent_id)        ← N uniforms
+    newly  = ~informed & (draws < p_inf)
+    informed' = informed | newly
+    t_inf'    = where(newly, t+dt, t_inf)
+
+Unfused, each intermediate (frac, p_inf, draws, newly) is an N-length
+array the backend may materialize between ops; at 10^7–10^8 agents those
+five N-vectors per step are pure memory traffic. This module collapses the
+chain into one kernel with three lowerings, selected by
+``AgentSimConfig.fused``:
+
+- ``"lax"`` — the chain as a single jnp helper, byte-for-byte the ops the
+  pre-0.8 kernels inlined. XLA's fusion handles the rest on CPU; this is
+  the default off-TPU, so tier-1 semantics are UNCHANGED by construction.
+- ``"pallas"`` — a Pallas kernel, grid over agent blocks: each block loads
+  (informed, t_inf, counts, β, indeg) once, runs the Threefry block and
+  the hazard update in registers/VMEM, and writes only (informed',
+  t_inf') — no materialized uniform or mask intermediates. Default on
+  TPU/GPU backends for the counter stream at non-f64 dtypes.
+- ``"interpret"`` — the same Pallas kernel under ``interpret=True``: runs
+  everywhere (including the CPU tier-1 box), which is what makes the
+  fused-vs-unfused bitwise parity testable without TPU hardware.
+
+``"unfused"`` keeps the historical inline sequence (via
+``rng._agent_uniforms``) and is the parity oracle; ``"auto"`` resolves per
+platform (overridable via ``SBR_FUSED``). The lax and unfused paths are
+the same arithmetic, and the Pallas kernel reuses the exact
+`rng._threefry2x32` / `rng._uniform_from_bits` definitions, so CPU
+fallback and interpret mode are bit-identical to unfused (tested); only a
+compiled TPU Pallas `exp` could differ in ulps, which is why "auto" never
+picks "pallas" where the tier-1 contracts run.
+
+The foldin stream has no counter form (its draw is two chained Threefry
+blocks through `jax.random.fold_in`), so pallas/interpret requests under
+``rng_stream="foldin"`` resolve to the unfused path — pre-0.7 artifact
+resumes stay exact under any ``fused`` setting.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sbr_tpu.social.rng import (
+    _agent_uniforms,
+    _key_words,
+    _threefry2x32,
+    _uniform_from_bits,
+)
+
+MODES = ("auto", "unfused", "lax", "pallas", "interpret")
+
+# Pallas block: one grid step updates this many agents. 8·128 matches the
+# TPU (sublane, lane) tiling for f32; interpret mode uses the same value so
+# the tested program structure is the deployed one.
+_BLOCK = 1024
+
+
+def resolve_mode(mode: str, dtype, rng_stream: str) -> str:
+    """Concrete lowering for a requested ``AgentSimConfig.fused`` value.
+
+    "auto" consults ``SBR_FUSED`` then the backend: pallas on tpu/gpu, lax
+    elsewhere. Pallas variants degrade (never error) when the stream or
+    dtype cannot express them: foldin has no counter form → unfused; f64
+    needs uint64 words, which compiled TPU Pallas lacks → lax ("interpret"
+    keeps f64: the interpreter runs full-width ops).
+    """
+    if mode not in MODES:
+        raise ValueError(f"fused must be one of {MODES}, got {mode!r}")
+    if mode == "auto":
+        env = os.environ.get("SBR_FUSED", "").strip().lower()
+        if env and env not in MODES:
+            # a typo'd override must not silently fall through to the
+            # platform default — the user believes they pinned a lowering
+            raise ValueError(f"SBR_FUSED must be one of {MODES}, got {env!r}")
+        mode = env if env and env != "auto" else "auto"
+    if mode == "auto":
+        mode = "pallas" if jax.default_backend() in ("tpu", "gpu") else "lax"
+    if mode != "unfused" and rng_stream != "counter":
+        # Every fused lowering (lax included) computes the counter draw
+        # in-line; foldin draws only exist as `_agent_uniforms`' chained
+        # fold_in blocks, so non-counter streams always run unfused.
+        return "unfused"
+    if mode == "pallas" and np.dtype(dtype) == np.float64:
+        return "lax"
+    return mode
+
+
+def _update_lax(informed, t_inf, counts, betas, safe_deg, draws, t, dt):
+    """The infection-update arithmetic — the ONE definition the unfused,
+    lax, and Pallas (via identical jnp ops in-kernel) paths share."""
+    dtype = betas.dtype
+    frac = counts.astype(dtype) / safe_deg
+    p_inf = 1.0 - jnp.exp(-betas * frac * dt)
+    newly = (~informed) & (draws < p_inf)
+    informed2 = informed | newly
+    t_inf2 = jnp.where(newly, t + dt, t_inf)
+    return informed2, t_inf2
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_update(n: int, dtype_name: str, dt: float, interpret: bool):
+    """Build the Pallas fused-update callable for a fixed (N, dtype, dt).
+
+    Cached per shape/config so the pallas_call machinery is constructed
+    once per kernel program (mirrors the lru-cached sim constructors)."""
+    from jax.experimental import pallas as pl
+
+    dtype = jnp.dtype(dtype_name)
+    n_pad = (-n) % _BLOCK
+    n_b = (n + n_pad) // _BLOCK
+
+    def kernel(kd_ref, t_ref, ids_ref, informed_ref, tinf_ref, counts_ref,
+               betas_ref, deg_ref, inf2_ref, tinf2_ref):
+        ids = ids_ref[...]
+        x0, x1 = _threefry2x32(
+            kd_ref[0], kd_ref[1], ids, jnp.zeros_like(ids)
+        )
+        draws = _uniform_from_bits(x0, x1, dtype)
+        informed2, t_inf2 = _update_lax(
+            informed_ref[...], tinf_ref[...], counts_ref[...], betas_ref[...],
+            deg_ref[...], draws, t_ref[0], dt,
+        )
+        inf2_ref[...] = informed2
+        tinf2_ref[...] = t_inf2
+
+    block = pl.BlockSpec((_BLOCK,), lambda i: (i,))
+    scalar2 = pl.BlockSpec((2,), lambda i: (0,))
+    scalar1 = pl.BlockSpec((1,), lambda i: (0,))
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_b,),
+        in_specs=[scalar2, scalar1, block, block, block, block, block, block],
+        out_specs=[block, block],
+        out_shape=[
+            jax.ShapeDtypeStruct((n + n_pad,), jnp.bool_),
+            jax.ShapeDtypeStruct((n + n_pad,), dtype),
+        ],
+        interpret=interpret,
+    )
+
+    def run(kd, t, ids, informed, t_inf, counts, betas, safe_deg):
+        if n_pad:
+            # inert pad lanes: β=0 ⇒ p_inf=0 ⇒ never newly-informed; the
+            # Threefry draw for a pad id is computed and discarded
+            ids = jnp.concatenate([ids, jnp.zeros(n_pad, ids.dtype)])
+            informed = jnp.concatenate([informed, jnp.zeros(n_pad, jnp.bool_)])
+            t_inf = jnp.concatenate([t_inf, jnp.zeros(n_pad, t_inf.dtype)])
+            counts = jnp.concatenate([counts, jnp.zeros(n_pad, counts.dtype)])
+            betas = jnp.concatenate([betas, jnp.zeros(n_pad, betas.dtype)])
+            safe_deg = jnp.concatenate([safe_deg, jnp.ones(n_pad, safe_deg.dtype)])
+        informed2, t_inf2 = call(kd, t, ids, informed, t_inf, counts, betas, safe_deg)
+        return informed2[:n], t_inf2[:n]
+
+    return run
+
+
+def infection_update(informed, t_inf, counts, betas, safe_deg, key, step_k,
+                     ids, t, dt, rng_stream: str, mode: str):
+    """One fused infection step for every engine's per-agent tail.
+
+    Pure function of (state, counts, key, step, global ids) — all the
+    engine/sharding bit-identity contracts carry over unchanged because
+    the draw stays keyed by global agent id (`rng._agent_uniforms`'s
+    invariance). Returns (informed', t_inf').
+    """
+    dtype = betas.dtype
+    mode = resolve_mode(mode, dtype, rng_stream)
+    if mode == "unfused":
+        draws = _agent_uniforms(key, step_k, ids, dtype, rng_stream)
+        return _update_lax(informed, t_inf, counts, betas, safe_deg, draws, t, dt)
+    step_key = jax.random.fold_in(key, step_k)
+    words = _key_words(step_key)
+    if words is None:
+        # rbg/unsafe_rbg 4-word keys: no counter stream — the foldin
+        # fallback is the unfused path (same degradation as _agent_uniforms)
+        draws = _agent_uniforms(key, step_k, ids, dtype, rng_stream)
+        return _update_lax(informed, t_inf, counts, betas, safe_deg, draws, t, dt)
+    if mode == "lax":
+        c0 = ids.astype(jnp.uint32)
+        x0, x1 = _threefry2x32(words[0], words[1], c0, jnp.zeros_like(c0))
+        draws = _uniform_from_bits(x0, x1, dtype)
+        return _update_lax(informed, t_inf, counts, betas, safe_deg, draws, t, dt)
+    run = _pallas_update(
+        int(informed.shape[0]), jnp.dtype(dtype).name, float(dt),
+        interpret=(mode == "interpret"),
+    )
+    kd = jnp.stack([words[0], words[1]])
+    t_arr = jnp.reshape(jnp.asarray(t, dtype), (1,))
+    return run(kd, t_arr, ids, informed, t_inf, counts, betas, safe_deg)

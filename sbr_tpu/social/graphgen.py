@@ -1,0 +1,1014 @@
+"""On-device generative graph models (ISSUE 10 tentpole).
+
+Every graph the agent engine consumed before 0.8.0 was born on the host
+(`agents.erdos_renyi_edges` numpy / `native/graphgen.cpp`): at the
+10^7–10^8-edge north-star scale the edge list transits host RAM and PCIe
+before a single device step runs, and the host sort becomes the wall. This
+module generates graphs ON DEVICE, chunk by chunk, with the same stateless
+counter-RNG discipline as the simulation kernels (one Threefry-2x32 block
+per edge id, `rng._threefry2x32`), directly into the canonical dst-sorted /
+row-pointer layout — the edge list never materializes on the host.
+
+The streams are BORN dst-sorted. Each spec factors its edge law as
+(destination marginal) × (source conditional): the in-degree vector is ONE
+host multinomial draw over the destination marginal (node-length, O(N)
+host scalars — the only host work), its cumsum is the canonical row-pointer
+table, the destination of edge position p is structural (the row whose
+[row_ptr[d], row_ptr[d+1]) range contains p — a run-length `repeat`, never
+a search per edge), and only the SOURCE is drawn per edge, keyed by the
+edge id. Factoring this way deletes the device-side sort from the
+canonical build entirely: XLA's CPU sort runs ~700 ns/element where a
+Threefry draw runs ~4 ns/element, so a sort-then-canonicalize design would
+be slower than the host path it replaces, and this one is bound by the
+draw itself. Host parity stays bitwise and meaningful: the host
+canonicalization of the raw stream is asserted equal to the device layout
+(the stable dst-sort of a born-sorted stream is the identity, so the check
+pins row-pointer/self-loop/degree bookkeeping, not sort order).
+
+Three generative models (specs are frozen dataclasses, hashable jit keys):
+
+- `ErdosRenyiSpec` — sparse directed G(n, p): E ~ Binomial(n(n−1), p)
+  (host, O(1)), in-degrees multinomial-uniform, sources iid uniform —
+  exactly the law of E iid uniform (src, dst) pairs.
+- `ScaleFreeSpec` — Chung–Lu configuration model: in-degrees multinomial
+  over weights (i+1)^{−1/(γ−1)}, sources drawn ∝ the same weights via a
+  uint32-quantized inverse CDF, so in- AND out-degree tails carry
+  exponent γ.
+- `StochasticBlockSpec` — balanced contiguous blocks; the destination
+  marginal is uniform (blocks are near-equal), and each edge keeps its
+  SOURCE within the destination's block with probability ``p_in``
+  (uniform over the other blocks otherwise).
+
+The incremental engine's out-edge orientation additionally needs the
+edges grouped by source in the host layout's (src, dst, raw id) order —
+that one is a genuine DISTRIBUTED STABLE COUNTING SORT over the canonical
+stream: chunk histograms accumulate into the out-pointer table, each chunk
+is stable-sorted locally and scattered at
+``out_ptr[s] + offset_of_prior_chunks[s] + rank_within_chunk`` — order by
+(src, chunk, local rank) = (src, dst-sorted position) = (src, dst, raw
+id), exactly the host re-sort's tie-break. It runs lazily (gather-engine
+builds never pay it) and is the sort-bound part of an incremental-engine
+build on CPU.
+
+Under a mesh the same passes run inside shard_map with each device
+generating ONLY its contiguous position range (no collectives at all for
+the canonical layout — positions are pure functions of (seed, edge id));
+the out-edge sort merges per-device histograms with
+`parallel.collectives.exclusive_psum` (n_dev−1 ``ppermute`` rounds, O(N)
+peak instead of all_gather's O(N·n_dev)) and a tiled `psum_scatter` that
+both merges the scatter buffers and hands every device exactly its
+edge-count-balanced shard — the same collective family the simulation
+step already rides. Sharded generation is byte-identical to single-device
+generation for the same seed (tested): positions depend only on (seed,
+edge id), never on the mesh.
+
+`prepare_generated_graph` packages the result as a `PreparedAgentGraph`
+(both engines, single-device and mesh), so every existing simulate/
+closure/bench path consumes generated graphs unchanged. The raw stream is
+also exposed host-side (`generate_edges`) for parity tests and interop —
+that path re-introduces the O(E) host transit and exists for verification,
+not production.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sbr_tpu.parallel.collectives import exclusive_psum
+from sbr_tpu.parallel.compat import shard_map
+from sbr_tpu.social.rng import _threefry2x32
+
+__all__ = [
+    "ErdosRenyiSpec",
+    "ScaleFreeSpec",
+    "StochasticBlockSpec",
+    "generate_edges",
+    "plan_chunk_edges",
+    "prepare_generated_graph",
+]
+
+
+# ---------------------------------------------------------------------------
+# Graph specs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ErdosRenyiSpec:
+    """Sparse directed Erdős–Rényi G(n, p) with p = avg_degree/(n−1)."""
+
+    n: int
+    avg_degree: float
+
+    def __post_init__(self):
+        _check_spec(self.n, self.avg_degree)
+
+    def edge_count(self, seed: int) -> int:
+        # Same edge-count law as the host sampler: E ~ Binomial(n(n−1), p),
+        # drawn host-side (O(1) — no edge data touches the host).
+        rng = np.random.default_rng(seed)
+        p = self.avg_degree / max(self.n - 1, 1)
+        return int(rng.binomial(self.n * (self.n - 1), min(p, 1.0)))
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleFreeSpec:
+    """Chung–Lu power-law configuration model: both endpoints ∝
+    (i+1)^{−1/(γ−1)}, so in- AND out-degree tails carry exponent γ
+    (in-degree drives the learning dynamics — it must have the heavy
+    tail)."""
+
+    n: int
+    avg_degree: float
+    gamma: float = 2.5
+
+    def __post_init__(self):
+        _check_spec(self.n, self.avg_degree)
+        if not (self.gamma > 1.0):
+            raise ValueError("gamma must be > 1")
+
+    def edge_count(self, seed: int) -> int:
+        return int(self.n * self.avg_degree)
+
+
+@dataclasses.dataclass(frozen=True)
+class StochasticBlockSpec:
+    """Balanced stochastic block model: ``n_blocks`` contiguous blocks of
+    near-equal size; the destination marginal is uniform and each edge
+    keeps its source inside the destination's block with probability
+    ``p_in`` (uniform over the other blocks otherwise)."""
+
+    n: int
+    avg_degree: float
+    n_blocks: int = 4
+    p_in: float = 0.8
+
+    def __post_init__(self):
+        _check_spec(self.n, self.avg_degree)
+        if self.n_blocks < 2:
+            raise ValueError("n_blocks must be >= 2")
+        if self.n < 2 * self.n_blocks:
+            raise ValueError("need n >= 2*n_blocks (every block needs >= 2 nodes)")
+        if not (0.0 <= self.p_in <= 1.0):
+            raise ValueError("p_in must be in [0, 1]")
+
+    def edge_count(self, seed: int) -> int:
+        return int(self.n * self.avg_degree)
+
+
+# Edge positions are int32 and the chunked loops index up to one chunk past
+# E (pad lanes of the final chunk), so specs must leave chunk headroom below
+# 2^31: chunks are clamped to _MAX_CHUNK everywhere, and 2^27 of headroom
+# also absorbs the ER binomial's fluctuation around n·avg_degree (σ ~ √E ≪
+# 2^27 at any representable E).
+_MAX_CHUNK = 1 << 26
+_MAX_EDGES = 2**31 - 2**27
+
+
+def _check_spec(n: int, avg_degree: float) -> None:
+    if n < 2:
+        raise ValueError("need n >= 2 agents")
+    if not (avg_degree > 0):
+        raise ValueError("avg_degree must be positive")
+    if n >= 2**31 or n * avg_degree >= _MAX_EDGES:
+        raise ValueError(
+            "graphgen is int32-indexed with chunk headroom: need n < 2^31 "
+            "and E < 2^31 - 2^27"
+        )
+
+
+def _check_edges(e: int) -> int:
+    """Runtime backstop for the position arithmetic: the drawn edge count
+    (binomial for ER) must keep the final chunk's positions in int32."""
+    if e >= _MAX_EDGES:
+        raise ValueError(
+            f"drawn edge count {e} leaves no int32 chunk headroom "
+            f"(need E < {_MAX_EDGES})"
+        )
+    return e
+
+
+def _spec_key_words(seed: int) -> Tuple[np.uint32, np.uint32]:
+    """The (k0, k1) Threefry key words for a generation seed — derived via
+    numpy's SeedSequence, NOT jax.random, so the stream is identical under
+    any jax_default_prng_impl (rbg keys would violate the 2-word layout the
+    counter draws need) and across processes (tested)."""
+    k0, k1 = np.random.SeedSequence(seed).generate_state(2, np.uint32)
+    return np.uint32(k0), np.uint32(k1)
+
+
+def _spec_weights(spec) -> Optional[np.ndarray]:
+    """The destination-marginal weight vector, or None for uniform."""
+    if isinstance(spec, ScaleFreeSpec):
+        return np.arange(1, spec.n + 1, dtype=np.float64) ** (
+            -1.0 / (spec.gamma - 1.0)
+        )
+    return None
+
+
+def _indeg_host(spec, seed: int, e: int) -> np.ndarray:
+    """The in-degree vector: ONE host multinomial draw over the spec's
+    destination marginal (the only node-length host data in a build).
+    Destinations are then STRUCTURAL: edge position p's destination is the
+    row whose [row_ptr[d], row_ptr[d+1]) range contains p, which is what
+    removes every device-side sort from the canonical build. Seeded from
+    SeedSequence((seed, 1)) — independent of the edge-count draw,
+    deterministic across processes (tested).
+
+    Uniform marginals (ER, SBM) draw it as bincount(uniform ints) — the
+    law is exactly Multinomial(E, uniform) and numpy's bounded-int stream
+    runs ~2x faster than its sequential binomial chain. The draws are
+    consumed in bounded chunks (numpy Generators continue the SAME bit
+    stream across calls, so any chunking is bitwise the full draw —
+    tested) and discarded after counting, keeping the host transient
+    O(chunk) instead of the O(E) buffer the plan budget never sees; no
+    edge-length data survives the call. Weighted marginals (scale-free)
+    use the multinomial directly (node-length output)."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 1)))
+    w = _spec_weights(spec)
+    if w is None:
+        indeg = np.zeros(spec.n, np.int64)
+        done = 0
+        while done < e:
+            take = min(1 << 24, e - done)  # ≤128 MB int64 transient
+            indeg += np.bincount(
+                rng.integers(0, spec.n, size=take), minlength=spec.n
+            )
+            done += take
+        return indeg.astype(np.int32)
+    return rng.multinomial(e, w / w.sum()).astype(np.int32)
+
+
+def _spec_tables(spec) -> Tuple[np.ndarray, ...]:
+    """Host-built node-length lookup tables for the SOURCE conditional
+    (O(N) scalars, built once per prepare — never per edge): the quantized
+    inverse CDF for scale-free, block boundaries for SBM, nothing for ER."""
+    if isinstance(spec, ErdosRenyiSpec):
+        return ()
+    if isinstance(spec, ScaleFreeSpec):
+        cdf = np.cumsum(_spec_weights(spec))
+        cdf /= cdf[-1]
+        thr = np.minimum(np.floor(cdf * 2.0**32), 2.0**32 - 1).astype(np.uint32)
+        return (thr,)
+    if isinstance(spec, StochasticBlockSpec):
+        b = spec.n_blocks
+        starts = (np.arange(b + 1, dtype=np.int64) * spec.n + b - 1) // b
+        return (starts.astype(np.uint32),)
+    raise TypeError(f"unknown graph spec {type(spec).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Per-edge draws (pure functions of (key words, edge id, structural dst))
+# ---------------------------------------------------------------------------
+
+
+def _mulhi32(a, m):
+    """floor(a·m / 2^32) in pure uint32 arithmetic (Lemire multiply-shift
+    range map, TPU-safe: no uint64, works with x64 disabled). Bias is at
+    most m/2^32 relative per value — ≤ 2.4% at m = 10^8, vanishing at test
+    scales; acceptable for generative models, documented."""
+    mask = jnp.uint32(0xFFFF)
+    a_lo, a_hi = a & mask, a >> jnp.uint32(16)
+    m = jnp.asarray(m, jnp.uint32)
+    m_lo, m_hi = m & mask, m >> jnp.uint32(16)
+    mid = a_hi * m_lo + ((a_lo * m_lo) >> jnp.uint32(16))
+    mid2 = a_lo * m_hi + (mid & mask)
+    return a_hi * m_hi + (mid >> jnp.uint32(16)) + (mid2 >> jnp.uint32(16))
+
+
+def _searchsorted32(table, x, side):
+    return jnp.searchsorted(table, x, side=side).astype(jnp.int32)
+
+
+def _needs_dst(spec) -> bool:
+    """Whether the source conditional reads the structural destination.
+    ER and scale-free sources are marginal draws — their build never
+    materializes a destination array at all (self-loops are ALLOWED in
+    those streams: the expected count is E/n — ~10 edges at the 10^7-agent
+    bench shape — and a self-edge only adds a 1/deg self-observation term,
+    invisible against the dense-limit closure tolerances; the host sampler
+    resamples them, so the two streams are different, equally valid,
+    realizations of the same model). SBM conditions on the destination's
+    block, which the structural layout provides for free."""
+    return isinstance(spec, StochasticBlockSpec)
+
+
+def _src_at(spec, tables, k0, k1, eid, dst):
+    """Source node (int32 in [0, n)) of edge ``eid`` (uint32) given its
+    structural destination ``dst`` (may be None for specs whose source is
+    marginal — `_needs_dst`) — one Threefry block per edge."""
+    n = spec.n
+    x0, x1 = _threefry2x32(k0, k1, eid, jnp.zeros_like(eid))
+    if isinstance(spec, ErdosRenyiSpec):
+        return _mulhi32(x0, n).astype(jnp.int32)
+    if isinstance(spec, ScaleFreeSpec):
+        (thr,) = tables
+        return jnp.minimum(_searchsorted32(thr, x0, "right"), n - 1)
+    (starts,) = tables
+    blk = _searchsorted32(starts, dst.astype(jnp.uint32), "right") - 1
+    lo = starts[blk].astype(jnp.int32)
+    size = (starts[blk + 1] - starts[blk]).astype(jnp.int32)
+    within = x1 < jnp.uint32(min(int(spec.p_in * 2.0**32), 2**32 - 1))
+    s_in = lo + _mulhi32(x0, size.astype(jnp.uint32)).astype(jnp.int32)
+    # in-block self-loop rewire stays in-block: shift one slot (mod size)
+    off2 = jnp.where(s_in - lo + 1 >= size, 0, s_in - lo + 1)
+    s_in = jnp.where(s_in == dst, lo + off2, s_in)
+    m_out = (jnp.int32(n) - size).astype(jnp.uint32)
+    r = _mulhi32(x0, m_out).astype(jnp.int32)
+    s_out = r + jnp.where(r >= lo, size, 0)
+    return jnp.where(within, s_in, s_out)
+
+
+def _dst_chunk(row_ptr, n: int, c0, chunk: int):
+    """Structural destinations for the contiguous positions
+    [c0, c0+chunk): run-lengths are the row spans clipped to the window —
+    a `repeat`, never a per-edge search. Positions past E repeat the final
+    value; callers mask or slice those lanes away."""
+    reps = jnp.diff(jnp.clip(row_ptr, c0, c0 + chunk))
+    return jnp.repeat(jnp.arange(n, dtype=jnp.int32), reps, total_repeat_length=chunk)
+
+
+# ---------------------------------------------------------------------------
+# Chunked device builds
+# ---------------------------------------------------------------------------
+
+
+def _chunk_scatter(out, offs, key, val, row_base, chunk: int, n: int):
+    """Scatter one chunk's payloads at their global stable-sort positions.
+
+    ``key`` ∈ [0, n] (n = sentinel bucket for invalid/pad lanes, whose
+    ``row_base`` entry starts at E so they stream into the tail or fall
+    off and are dropped); position = row start + prior-chunk offset +
+    stable rank within the chunk. Returns the updated (out, offs)."""
+    order = jnp.argsort(key, stable=True)
+    k_s = key[order]
+    hist = jnp.zeros(n + 1, jnp.int32).at[key].add(1)
+    start_in_chunk = (jnp.cumsum(hist) - hist).astype(jnp.int32)[k_s]
+    rank = jnp.arange(chunk, dtype=jnp.int32) - start_in_chunk
+    pos = row_base[k_s] + offs[k_s] + rank
+    out = out.at[pos].set(val[order], mode="drop")
+    return out, offs + hist
+
+
+@functools.lru_cache(maxsize=32)
+def _single_device_programs(spec, chunk: int, n_chunks: int, e: int):
+    """Jitted (outdeg hist, src assemble, inc assemble) programs for one
+    (spec, chunk plan, E).
+
+    E is static (array shapes depend on it), so a fresh edge count
+    compiles a fresh program — the same per-shape cost the sim kernels
+    pay; repeated builds of one spec/seed reuse the cache."""
+    n = spec.n
+
+    def chunk_draw(tables, k0, k1, row_ptr, c):
+        c0 = c * jnp.int32(chunk)
+        eid = c0 + jnp.arange(chunk, dtype=jnp.int32)
+        d = _dst_chunk(row_ptr, n, c0, chunk) if _needs_dst(spec) else None
+        s = _src_at(spec, tables, k0, k1, eid.astype(jnp.uint32), d)
+        return eid, s
+
+    @jax.jit
+    def hist_out(tables, k0, k1, row_ptr):
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.graphgen.hist_out")
+
+        def body(c, h):
+            eid, s = chunk_draw(tables, k0, k1, row_ptr, c)
+            return h.at[jnp.where(eid < e, s, n)].add(1)
+
+        return lax.fori_loop(0, n_chunks, body, jnp.zeros(n + 1, jnp.int32))
+
+    @jax.jit
+    def assemble_src(tables, k0, k1, row_ptr):
+        # The canonical dst-sorted source array IS the draw itself: the
+        # stream is born sorted, so chunk c writes positions [c·chunk,
+        # (c+1)·chunk) verbatim — no sort, no scatter.
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.graphgen.assemble_src")
+
+        def body(c, out):
+            _, s = chunk_draw(tables, k0, k1, row_ptr, c)
+            return lax.dynamic_update_slice(out, s, (c * jnp.int32(chunk),))
+
+        out = jnp.zeros(n_chunks * chunk, jnp.int32)
+        return lax.fori_loop(0, n_chunks, body, out)[:e]
+
+    @jax.jit
+    def assemble_inc(src_srt, row_ptr, out_ptr):
+        # Counting-sort pass over the CANONICAL stream: chunking by
+        # dst-sorted position makes the stable tie-break exactly that
+        # position, so the global order is (src, dst, raw id) — the host
+        # `sort_edges_by_dst(dst_h, src_h, n)` re-sort, byte for byte.
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.graphgen.assemble_inc")
+
+        def body(c, carry):
+            out, offs = carry
+            c0 = c * jnp.int32(chunk)
+            p = c0 + jnp.arange(chunk, dtype=jnp.int32)
+            valid = p < e
+            key = jnp.where(valid, src_srt[jnp.minimum(p, max(e - 1, 0))], n)
+            d = _dst_chunk(row_ptr, n, c0, chunk)
+            return _chunk_scatter(out, offs, key, d, out_ptr, chunk, n)
+
+        out0 = jnp.zeros(e, jnp.int32)
+        offs0 = jnp.zeros(n + 1, jnp.int32)
+        out, _ = lax.fori_loop(0, n_chunks, body, (out0, offs0))
+        return out
+
+    return hist_out, assemble_src, assemble_inc
+
+
+def plan_chunk_edges(e: int, n: int, budget_bytes: Optional[int] = None) -> int:
+    """Capacity-planned generation chunk (edges per fori step).
+
+    Deterministic in (e, n, budget): per-chunk scratch is ~12 int32 lanes
+    per edge (Threefry temps, draws, sort keys/order and positions on the
+    inc pass) plus the fixed N-vectors and the E-length output the
+    chunking cannot avoid, so the chunk is the largest power of two whose
+    scratch fits the budget — floored at 2^14 (tiny chunks drown in fori
+    overhead) and capped at E. The budget defaults to
+    ``SBR_GRAPHGEN_BUDGET_BYTES``, else headroom × device capacity
+    (obs.mem), else a quarter of host MemAvailable on capacity-less CPU
+    backends, else 1 GiB. The canonical RESULT is chunk-invariant
+    (tested) — the plan affects peak memory and speed only, never bytes."""
+    from sbr_tpu.obs import mem
+
+    if budget_bytes is None:
+        env = os.environ.get("SBR_GRAPHGEN_BUDGET_BYTES", "").strip()
+        if env:
+            budget_bytes = int(env)
+        else:
+            cap = mem.device_capacity()
+            if cap:
+                budget_bytes = int(mem.headroom() * cap)
+            else:
+                host = mem.host_available_bytes()
+                budget_bytes = host // 4 if host else 1 << 30
+    fixed = 6 * 4 * (n + 1) + 4 * e  # N-vectors + output buffer
+    per_edge = 12 * 4
+    chunk = max((budget_bytes - fixed) // per_edge, 1)
+    chunk = 1 << min(max(int(math.floor(math.log2(max(chunk, 1)))), 14), 26)
+    return int(min(chunk, max(e, 1)))
+
+
+def _log_plan(rec: dict) -> None:
+    try:
+        from sbr_tpu.obs import runlog
+
+        run = runlog.current_run()
+        if run is not None:
+            run.log_plan(rec)
+    except Exception:
+        pass
+
+
+class _SingleBuild:
+    """Single-device build context: the canonical layout (indeg, row_ptr,
+    dst-sorted src) is sort-free — indeg is the host multinomial, src is
+    the chunked draw; the out-degree census and the incremental
+    orientation run lazily (gather-engine builds never pay the counting
+    sort). Layout is `agents._canonicalize_graph` bitwise (tested) —
+    ``row_ptr``'s last entry is E, which doubles as the sentinel bucket's
+    start in the inc scatter pass."""
+
+    def __init__(self, spec, seed: int, chunk_edges):
+        self._spec = spec
+        self._src_srt = None
+        self._outdeg = None
+        self.e = e = _check_edges(spec.edge_count(seed))
+        chunk = (
+            plan_chunk_edges(e, spec.n)
+            if chunk_edges in (None, "auto")
+            else int(chunk_edges)
+        )
+        chunk = max(1, min(chunk, max(e, 1), _MAX_CHUNK))
+        n_chunks = max(1, -(-e // chunk))
+        _log_plan(
+            {
+                "what": "graphgen.chunk", "spec": type(spec).__name__,
+                "n": spec.n, "edges": e, "chunk_edges": chunk,
+                "n_chunks": n_chunks,
+            }
+        )
+        k0, k1 = _spec_key_words(seed)
+        self._key = (k0, k1)
+        self._tables = tuple(jnp.asarray(t) for t in _spec_tables(spec))
+        self._hist_out, self._assemble, self._assemble_inc = (
+            _single_device_programs(spec, chunk, n_chunks, e)
+        )
+        indeg_h = _indeg_host(spec, seed, e)
+        self.indeg = jnp.asarray(indeg_h)
+        self.row_ptr = jnp.asarray(
+            np.concatenate([[0], np.cumsum(indeg_h)]).astype(np.int32)
+        )
+
+    def src_sorted(self):
+        """dst-sorted edge sources — the gather-engine layout."""
+        if self._src_srt is None:
+            k0, k1 = self._key
+            if self.e == 0:
+                self._src_srt = jnp.zeros(0, jnp.int32)
+            else:
+                self._src_srt = self._assemble(self._tables, k0, k1, self.row_ptr)
+        return self._src_srt
+
+    @property
+    def outdeg(self):
+        """Out-degree census (one chunked scatter-add pass, lazy — only
+        the auto-engine gate and the incremental orientation need it)."""
+        if self._outdeg is None:
+            k0, k1 = self._key
+            if self.e == 0:
+                self._outdeg = jnp.zeros(self._spec.n, jnp.int32)
+            else:
+                h = self._hist_out(self._tables, k0, k1, self.row_ptr)
+                self._outdeg = h[: self._spec.n]
+        return self._outdeg
+
+    def inc_arrays(self):
+        """(dst2, out_ptr) — the src-sorted out-edge structures the
+        incremental engine adds, in the host layout's (src, dst, raw id)
+        order (the counting-sort pass, see `assemble_inc`)."""
+        out_ptr = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(self.outdeg)]
+        ).astype(jnp.int32)
+        dst2 = self._assemble_inc(self.src_sorted(), self.row_ptr, out_ptr)
+        return dst2, out_ptr
+
+
+# ---------------------------------------------------------------------------
+# Mesh build (edge-count-sharded generation)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=16)
+def _mesh_hist_program(spec, chunk: int, k_slots: int, e: int, mesh: Mesh, axis: str):
+    """Jitted shard_map out-degree census: each device histograms only its
+    own position range; `psum` merges."""
+    n = spec.n
+    n_dev = mesh.shape[axis]
+    el = max(1, (e + (-e) % n_dev) // n_dev)
+    n_tab = len(_spec_tables(spec))
+
+    def hist_fn(*args):
+        tables = args[:n_tab]
+        (k0, k1), row_ptr = args[n_tab], args[n_tab + 1]
+        idx = lax.axis_index(axis)
+
+        def body(c, h):
+            c0 = idx * jnp.int32(el) + c * jnp.int32(chunk)
+            eid = c0 + jnp.arange(chunk, dtype=jnp.int32)
+            d = (
+                _dst_chunk(row_ptr, n, jnp.minimum(c0, max(e - 1, 0)), chunk)
+                if _needs_dst(spec)
+                else None
+            )
+            s = _src_at(spec, tables, k0, k1, eid.astype(jnp.uint32), d)
+            ok = (eid < e) & (eid < (idx + 1) * jnp.int32(el))
+            return h.at[jnp.where(ok, s, n)].add(1)
+
+        h = lax.fori_loop(0, k_slots, body, jnp.zeros(n + 1, jnp.int32))
+        return lax.psum(h, axis)
+
+    rep = (P(),) * (n_tab + 2)
+    return jax.jit(shard_map(hist_fn, mesh=mesh, in_specs=rep, out_specs=P()))
+
+
+@functools.lru_cache(maxsize=16)
+def _mesh_programs(
+    spec, chunk: int, k_slots: int, e: int, n_gl: int, mesh: Mesh, axis: str,
+    want_inc: bool,
+):
+    """Jitted shard_map (gather, inc) programs for a mesh build.
+
+    Each device generates ONLY its contiguous position range
+    [idx·el, (idx+1)·el) — the canonical layout needs no collectives at
+    all (positions are pure functions of (seed, edge id)); the inc pass
+    merges per-device histograms with `exclusive_psum` and delivers each
+    device its edge shard with a tiled `psum_scatter`."""
+    n = spec.n
+    n_dev = mesh.shape[axis]
+    e_pad = (-e) % n_dev
+    el = max(1, (e + e_pad) // n_dev)
+    ec = el  # inc edge-chunk size == the position-shard length
+    n_tab = len(_spec_tables(spec))
+
+    def local_src_dst(tables, k0, k1, row_ptr, idx):
+        def body(c, carry):
+            srcb, dstb = carry
+            c0 = idx * jnp.int32(el) + c * jnp.int32(chunk)
+            eid = c0 + jnp.arange(chunk, dtype=jnp.int32)
+            d = _dst_chunk(row_ptr, n, jnp.minimum(c0, max(e - 1, 0)), chunk)
+            s = _src_at(spec, tables, k0, k1, eid.astype(jnp.uint32), d)
+            off = (c * jnp.int32(chunk),)
+            return (
+                lax.dynamic_update_slice(srcb, s, off),
+                lax.dynamic_update_slice(dstb, d, off),
+            )
+
+        z = jnp.zeros(k_slots * chunk, jnp.int32)
+        srcb, dstb = lax.fori_loop(0, k_slots, body, (z, z))
+        return srcb[:el], dstb[:el]
+
+    def gather_fn(*args):
+        # dst-sorted orientation: local src shard + per-shard row table
+        tables = args[:n_tab]
+        (k0, k1), row_ptr = args[n_tab], args[n_tab + 1]
+        idx = lax.axis_index(axis)
+        src_l, dst_l = local_src_dst(tables, k0, k1, row_ptr, idx)
+        gpos = idx * jnp.int32(el) + jnp.arange(el, dtype=jnp.int32)
+        valid = gpos < e
+        src_l = jnp.where(valid, src_l, jnp.int32(0))  # src pad = 0 (host rule)
+        dst_l = jnp.where(valid, dst_l, jnp.int32(n_gl))
+        seg_ids = jnp.arange(n_gl + 2, dtype=jnp.int32)
+        table = jnp.searchsorted(dst_l, seg_ids, side="left").astype(jnp.int32)
+        return src_l, table[None]
+
+    def inc_fn(src_local, row_ptr, out_ptr):
+        # src-sorted orientation: distributed counting sort over the
+        # dst-sorted position shards (each device's chunk = its shard), so
+        # the stable tie-break is the dst-sorted position and the global
+        # order is (src, dst, raw id) — the host `sort_edges_by_dst`
+        # re-sort of the canonical stream, byte for byte.
+        idx = lax.axis_index(axis)
+        gpos = (idx * jnp.int32(el) + jnp.arange(el, dtype=jnp.int32))
+        valid = gpos < e
+        key = jnp.where(valid, src_local, jnp.int32(n))
+        d = _dst_chunk(
+            row_ptr, n, jnp.minimum(idx * jnp.int32(el), max(e - 1, 0)), el
+        )
+        hist = jnp.zeros(n + 1, jnp.int32).at[key].add(1)
+        prior = exclusive_psum(hist, axis, n_dev)
+        out0 = jnp.zeros(n_dev * ec, jnp.int32)
+        out, _ = _chunk_scatter(out0, prior, key, d, out_ptr, el, n)
+        local = lax.psum_scatter(out, axis, scatter_dimension=0, tiled=True)
+        gpos2 = idx * ec + jnp.arange(ec)
+        local = jnp.where(gpos2 < e, local, jnp.int32(n_gl))
+        lo = idx * ec
+        starts = out_ptr[:-1]
+        ends = out_ptr[1:]
+        s_c = jnp.clip(starts, lo, lo + ec)
+        e_c = jnp.clip(ends, lo, lo + ec)
+        pad = jnp.zeros(n_gl - n, jnp.int32)
+        lstart = jnp.concatenate([(s_c - lo).astype(jnp.int32), pad])
+        ldeg = jnp.concatenate([(e_c - s_c).astype(jnp.int32), pad])
+        return local, lstart[None], ldeg[None]
+
+    rep = (P(),) * (n_tab + 2)
+    gather_p = jax.jit(
+        shard_map(gather_fn, mesh=mesh, in_specs=rep, out_specs=(P(axis), P(axis)))
+    )
+    inc_p = (
+        jax.jit(
+            shard_map(
+                inc_fn, mesh=mesh, in_specs=(P(axis), P(), P()),
+                out_specs=(P(axis), P(axis), P(axis)),
+            )
+        )
+        if want_inc
+        else None
+    )
+    return gather_p, inc_p
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def generate_edges(spec, seed: int = 0, chunk_edges=None) -> Tuple[np.ndarray, np.ndarray]:
+    """The RAW (src, dst) edge stream as host numpy arrays (dst-sorted —
+    the stream is born that way).
+
+    This is the verification/interop surface (parity tests against the
+    host canonicalization, degree statistics) — it deliberately pays the
+    O(E) device→host transfer the production path
+    (`prepare_generated_graph`) exists to avoid."""
+    e = _check_edges(spec.edge_count(seed))
+    if e == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    k0, k1 = _spec_key_words(seed)
+    tables = tuple(jnp.asarray(t) for t in _spec_tables(spec))
+    indeg_h = _indeg_host(spec, seed, e)
+    row_ptr = jnp.asarray(np.concatenate([[0], np.cumsum(indeg_h)]).astype(np.int32))
+    chunk = (
+        max(1, min(int(chunk_edges), _MAX_CHUNK))
+        if chunk_edges
+        else min(max(e, 1), 1 << 22)
+    )
+
+    @functools.partial(jax.jit, static_argnames=("count",))
+    def raw(tables, row_ptr, c0, count: int):
+        eid = jnp.int32(c0) + jnp.arange(count, dtype=jnp.int32)
+        d = _dst_chunk(row_ptr, spec.n, c0, count)
+        s = _src_at(
+            spec, tables, jnp.uint32(k0), jnp.uint32(k1), eid.astype(jnp.uint32), d
+        )
+        return s, d
+
+    srcs, dsts = [], []
+    done = 0
+    while done < e:
+        count = min(chunk, e - done)
+        s, d = raw(tables, row_ptr, c0=done, count=count)
+        srcs.append(np.asarray(s, np.int32))
+        dsts.append(np.asarray(d, np.int32))
+        done += count
+    return np.concatenate(srcs), np.concatenate(dsts)
+
+
+def prepare_generated_graph(
+    spec,
+    seed: int = 0,
+    betas=1.0,
+    config=None,
+    mesh: Optional[Mesh] = None,
+    mesh_axis: str = "agents",
+    dtype=np.float32,
+    comm: str = "scatter",
+    engine: str = "auto",
+    incremental_budget: Optional[int] = None,
+    incremental_max_degree: Optional[int] = None,
+    chunk_edges=None,
+):
+    """Device-generated `PreparedAgentGraph` — `prepare_agent_graph` with
+    the host edge pipeline deleted.
+
+    The graph named by ``(spec, seed)`` is generated and canonicalized on
+    device (sharded over ``mesh`` when given, each device generating only
+    its position range) and packaged with the same engine resolution,
+    budget rules, and mesh padding as the host prepare — so
+    ``simulate_agents(prepared=...)``, the closure loop, and the bench
+    consume it unchanged, and the result is byte-identical to host-
+    preparing the same raw stream (tested). ``engine="measure"`` is not
+    offered here: measuring wants a resident reusable graph, which the
+    host prepare already covers; generated graphs pick via the census or
+    explicitly. ``engine="gather"`` builds never pay the out-degree census
+    or the incremental orientation's counting sort — the canonical layout
+    is sort-free.
+
+    Only node-length data ever touches the host: β broadcast, the spec
+    tables, the in-degree multinomial, and (for ``engine="auto"`` /
+    ``"incremental"``) the out-degree census.
+    """
+    from sbr_tpu.social import agents as A
+
+    if config is None:
+        config = A.AgentSimConfig()
+    dtype = np.dtype(dtype)
+    if engine not in ("auto", "gather", "incremental"):
+        raise ValueError(
+            f"engine must be 'auto', 'gather', or 'incremental' for generated "
+            f"graphs (got {engine!r})"
+        )
+    if comm not in ("scatter", "allgather_psum"):
+        raise ValueError(f"Unknown comm strategy {comm!r}")
+    n = spec.n
+    d0 = int(incremental_max_degree) if incremental_max_degree is not None else 64
+    betas_h = np.broadcast_to(np.asarray(betas, dtype=dtype), (n,)).copy()
+
+    from sbr_tpu import obs
+
+    if mesh is None:
+        with obs.span("graphgen.build", spec=type(spec).__name__, n=n):
+            built = _SingleBuild(spec, seed, chunk_edges)
+            e = built.e
+            if engine == "auto":
+                engine = A._resolve_engine_from_outdeg(
+                    np.asarray(built.outdeg), n, e, config, None, mesh_axis,
+                    incremental_budget, d0,
+                    float(np.mean(betas_h, dtype=np.float64)),
+                )
+            if engine == "incremental" and e == 0:
+                engine = "gather"
+            if engine == "incremental":
+                budget = incremental_budget or A._default_incremental_budget(n)
+                dst2, out_ptr = built.inc_arrays()
+                inc = (dst2, out_ptr, built.outdeg)
+            else:
+                budget, inc = 0, None
+            return A.PreparedAgentGraph(
+                n=n, n_gl=n, n_pad=0, n_edges=e, dtype=dtype, mesh=None,
+                mesh_axis=mesh_axis, comm=comm, engine=engine,
+                budget=int(budget), max_degree=d0,
+                betas=jnp.asarray(betas_h),
+                src=built.src_sorted(), row_ptr=built.row_ptr,
+                indeg=built.indeg.astype(dtype), inc=inc,
+            )
+
+    with obs.span("graphgen.build_sharded", spec=type(spec).__name__, n=n):
+        return _prepare_mesh(
+            spec, seed, betas_h, config, mesh, mesh_axis, dtype, comm, engine,
+            incremental_budget, d0, chunk_edges,
+        )
+
+
+def _prepare_mesh(
+    spec, seed, betas_h, config, mesh, mesh_axis, dtype, comm, engine,
+    incremental_budget, d0, chunk_edges,
+):
+    from sbr_tpu.social import agents as A
+
+    n = spec.n
+    n_dev = mesh.shape[mesh_axis]
+    e = _check_edges(spec.edge_count(seed))
+    el = max(1, (e + (-e) % n_dev) // n_dev)
+    chunk = (
+        plan_chunk_edges(e, n)
+        if chunk_edges in (None, "auto")
+        else int(chunk_edges)
+    )
+    chunk = max(1, min(chunk, el, _MAX_CHUNK))
+    k_slots = max(1, -(-el // chunk))
+    _log_plan(
+        {
+            "what": "graphgen.chunk",
+            "spec": type(spec).__name__,
+            "n": n,
+            "edges": e,
+            "chunk_edges": chunk,
+            "n_chunks": k_slots * int(n_dev),
+            "n_dev": int(n_dev),
+        }
+    )
+    k0, k1 = _spec_key_words(seed)
+    tables = tuple(jnp.asarray(t) for t in _spec_tables(spec))
+    kw = jnp.asarray(np.stack([k0, k1]))
+
+    indeg_h = _indeg_host(spec, seed, e)
+    row_ptr_g = jnp.asarray(np.concatenate([[0], np.cumsum(indeg_h)]).astype(np.int32))
+
+    outdeg_h = None
+    if engine == "auto" or engine == "incremental":
+        hist_p = _mesh_hist_program(spec, chunk, k_slots, e, mesh, mesh_axis)
+        outdeg_h = np.asarray(hist_p(*tables, kw, row_ptr_g)[:n])
+    if engine == "auto":
+        engine = A._resolve_engine_from_outdeg(
+            outdeg_h, n, e, config, mesh, mesh_axis, incremental_budget, d0,
+            float(np.mean(betas_h, dtype=np.float64)),
+        )
+    if engine == "incremental" and e == 0:
+        engine = "gather"
+
+    n_gl = _n_gl(n, n_dev, comm, engine)
+    n_pad = n_gl - n
+    gather_p, inc_p = _mesh_programs(
+        spec, chunk, k_slots, e, n_gl, mesh, mesh_axis, engine == "incremental"
+    )
+    src_sh, row_tables = gather_p(*tables, kw, row_ptr_g)
+
+    if engine == "incremental":
+        out_ptr_g = jnp.asarray(
+            np.concatenate([[0], np.cumsum(outdeg_h)]).astype(np.int32)
+        )
+        dst2_sh, lstart_sh, ldeg_sh = inc_p(src_sh, row_ptr_g, out_ptr_g)
+        nb = n_gl // n_dev
+        budget = incremental_budget or A._default_incremental_budget(nb, floor=512)
+        inc = (dst2_sh, lstart_sh, ldeg_sh)
+    else:
+        budget, inc = 0, None
+
+    shard = NamedSharding(mesh, P(mesh_axis))
+    if n_pad:
+        betas_h = np.concatenate([betas_h, np.zeros(n_pad, betas_h.dtype)])
+    indeg_p = np.concatenate([indeg_h, np.zeros(n_pad, indeg_h.dtype)]).astype(dtype)
+    put = lambda a: jax.device_put(jnp.asarray(a), shard)
+    return A.PreparedAgentGraph(
+        n=n, n_gl=n_gl, n_pad=n_pad, n_edges=e, dtype=dtype, mesh=mesh,
+        mesh_axis=mesh_axis, comm=comm, engine=engine, budget=int(budget),
+        max_degree=d0,
+        betas=put(betas_h), src=src_sh, row_ptr=row_tables,
+        indeg=put(indeg_p), inc=inc,
+    )
+
+
+def _n_gl(n: int, n_dev: int, comm: str, engine: str) -> int:
+    """Padded agent count — the same block rule as `prepare_agent_graph`:
+    byte-aligned local blocks for the bitpacked paths."""
+    block = 8 * n_dev if (comm == "scatter" or engine == "incremental") else n_dev
+    return n + (-n) % block
+
+
+# ---------------------------------------------------------------------------
+# Self-check CLI (the CI graphgen-parity smoke)
+# ---------------------------------------------------------------------------
+
+
+def _selfcheck(n: int = 600, deg: float = 6.0, seed: int = 3) -> int:
+    """Bitwise parity battery: device canonical layout vs the host sort of
+    the same raw stream, chunk invariance, sharded-vs-single byte
+    identity, and fused-vs-unfused simulation parity. Exit 0 on pass."""
+    from sbr_tpu.social import agents as A
+
+    failures = []
+
+    def check(label, ok):
+        print(("PASS  " if ok else "FAIL  ") + label)
+        if not ok:
+            failures.append(label)
+
+    specs = [
+        ErdosRenyiSpec(n=n, avg_degree=deg),
+        ScaleFreeSpec(n=n, avg_degree=deg, gamma=2.5),
+        StochasticBlockSpec(n=n, avg_degree=deg, n_blocks=4, p_in=0.8),
+    ]
+    for spec in specs:
+        name = type(spec).__name__
+        src, dst = generate_edges(spec, seed=seed)
+        _, src_h, _, indeg_h, row_ptr_h = A._canonicalize_graph(
+            1.0, src, dst, n, np.float32
+        )
+        built = _SingleBuild(spec, seed, None)
+        check(f"{name}: device src == host canonical src",
+              np.array_equal(np.asarray(built.src_sorted()), src_h))
+        check(f"{name}: row_ptr match",
+              np.array_equal(np.asarray(built.row_ptr), row_ptr_h.astype(np.int32)))
+        check(f"{name}: indeg match",
+              np.array_equal(np.asarray(built.indeg), indeg_h.astype(np.int32)))
+        built2 = _SingleBuild(spec, seed, 97)
+        check(f"{name}: chunk invariance (97-edge chunks)",
+              np.array_equal(np.asarray(built.src_sorted()),
+                             np.asarray(built2.src_sorted())))
+        pg_d = prepare_generated_graph(spec, seed=seed, engine="incremental")
+        pg_h = A.prepare_agent_graph(1.0, src, dst, n, engine="incremental")
+        check(
+            f"{name}: single-device incremental == host-prepared",
+            all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(pg_d.inc, pg_h.inc)
+            )
+            and np.array_equal(np.asarray(pg_d.src), np.asarray(pg_h.src)),
+        )
+        check(
+            f"{name}: chunk invariance (incremental, 97-edge chunks)",
+            np.array_equal(
+                np.asarray(built.inc_arrays()[0]), np.asarray(built2.inc_arrays()[0])
+            ),
+        )
+
+    spec = specs[0]
+    if len(jax.devices()) > 1:
+        from sbr_tpu.parallel import make_agent_mesh
+
+        mesh = make_agent_mesh()
+        src, dst = generate_edges(spec, seed=seed)
+        for eng in ("gather", "incremental"):
+            pg_d = prepare_generated_graph(
+                spec, seed=seed, mesh=mesh, engine=eng
+            )
+            pg_h = A.prepare_agent_graph(
+                1.0, src, dst, n, mesh=mesh, engine=eng
+            )
+            ok = (
+                np.array_equal(np.asarray(pg_d.src), np.asarray(pg_h.src))
+                and np.array_equal(np.asarray(pg_d.row_ptr), np.asarray(pg_h.row_ptr))
+                and np.array_equal(np.asarray(pg_d.indeg), np.asarray(pg_h.indeg))
+            )
+            if eng == "incremental":
+                ok = ok and all(
+                    np.array_equal(np.asarray(a), np.asarray(b))
+                    for a, b in zip(pg_d.inc, pg_h.inc)
+                )
+            check(f"sharded {eng}: generated == host-prepared (byte-identical)", ok)
+
+    import dataclasses as dc
+
+    cfg = A.AgentSimConfig(n_steps=25, dt=0.1)
+    pg = prepare_generated_graph(spec, seed=seed, config=cfg)
+    r_def = A.simulate_agents(prepared=pg, x0=0.02, config=cfg, seed=1)
+    r_unf = A.simulate_agents(
+        prepared=pg, x0=0.02, config=dc.replace(cfg, fused="unfused"), seed=1
+    )
+    r_int = A.simulate_agents(
+        prepared=pg, x0=0.02, config=dc.replace(cfg, fused="interpret"), seed=1
+    )
+    check("fused lax == unfused (bitwise)",
+          np.array_equal(np.asarray(r_def.informed), np.asarray(r_unf.informed))
+          and np.array_equal(np.asarray(r_def.t_inf), np.asarray(r_unf.t_inf)))
+    check("fused pallas-interpret == unfused (bitwise)",
+          np.array_equal(np.asarray(r_int.informed), np.asarray(r_unf.informed))
+          and np.array_equal(np.asarray(r_int.t_inf), np.asarray(r_unf.t_inf)))
+
+    print(f"\ngraphgen selfcheck: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+# CLI: `python -m sbr_tpu.social.graphgen_cli --selfcheck` — a sibling
+# module, NOT a __main__ block here: this module is imported by the
+# package __init__, so running it with -m would execute a second __main__
+# copy (duplicate spec classes breaking isinstance dispatch, duplicate
+# lru-cached program builders) behind a RuntimeWarning.

@@ -1,0 +1,37 @@
+"""CLI for the on-device graph-generation self-check (the CI
+graphgen-parity smoke).
+
+Lives OUTSIDE `graphgen` itself (which `sbr_tpu.social.__init__` imports):
+`python -m` on a package-imported module executes a second ``__main__``
+copy of it — duplicate spec classes that break `isinstance` dispatch and
+duplicate lru-cached program builders, behind a RuntimeWarning. This
+module is not imported by the package, so `-m` runs exactly one copy of
+everything.
+"""
+
+from __future__ import annotations
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.social.graphgen_cli",
+        description="On-device graph generation self-check (bitwise parity "
+        "vs the host canonical layout; CI smoke)",
+    )
+    parser.add_argument("--selfcheck", action="store_true")
+    parser.add_argument("--n", type=int, default=600)
+    parser.add_argument("--deg", type=float, default=6.0)
+    parser.add_argument("--seed", type=int, default=3)
+    args = parser.parse_args(argv)
+    if not args.selfcheck:
+        parser.print_help()
+        return 2
+    from sbr_tpu.social.graphgen import _selfcheck
+
+    return _selfcheck(args.n, args.deg, args.seed)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

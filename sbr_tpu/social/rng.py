@@ -1,0 +1,119 @@
+"""Counter-RNG primitives shared by the agent kernels, the fused infection
+step, and the on-device graph generators.
+
+The per-(agent, step) and per-edge random streams are pure functions of
+(key words, counter) built on one Threefry-2x32 block — stateless, so any
+chunking/sharding/fusion of the consuming computation draws bit-identical
+randomness. Extracted from ``social.agents`` (0.8.0) so ``social.fused``
+(the Pallas infection kernel) and ``social.graphgen`` (on-device edge
+generation) can share the block function without importing the 1.7-kloc
+agents module; ``agents`` re-exports the old private names.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _threefry2x32(k0, k1, c0, c1):
+    """One Threefry-2x32 block (Salmon et al. 2011), vectorized over the
+    counter arrays — bit-exact vs `jax._src.prng.threefry_2x32` (tested).
+    Re-implemented on public jnp ops so the counter RNG stream does not
+    depend on a private JAX API (and so it can run INSIDE a Pallas kernel,
+    where only jnp/lax ops lower)."""
+
+    def rotl(x, r):
+        return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+    ks = (k0, k1, jnp.uint32(0x1BD11BDA) ^ k0 ^ k1)
+    x0 = c0 + ks[0]
+    x1 = c1 + ks[1]
+    rot_a, rot_b = (13, 15, 26, 6), (17, 29, 16, 24)
+    for i in range(5):
+        for r in rot_a if i % 2 == 0 else rot_b:
+            x0 = x0 + x1
+            x1 = rotl(x1, r)
+            x1 = x1 ^ x0
+        j = i + 1
+        x0 = x0 + ks[j % 3]
+        x1 = x1 + ks[(j + 1) % 3] + jnp.uint32(j)
+    return x0, x1
+
+
+def _uniform_from_bits(x0, x1, dtype):
+    """[0, 1) uniform from one Threefry block's words — the ONE definition
+    shared by `_agent_uniforms`, the fused Pallas kernel, and the graph
+    generators, so every consumer's draws are bit-identical by construction.
+
+    f64 uses both words for the 52-bit mantissa; narrower dtypes use x0's
+    high bits via the mantissa-or trick, with the half-precision clamp
+    below 1.0 (ADVICE r5: the cast can round draws within ~2^-11 of 1.0 up
+    to exactly 1.0, breaking the [0,1) contract)."""
+    if np.dtype(dtype) == np.float64:
+        hi = x0.astype(jnp.uint64) << jnp.uint64(32)
+        mant = (hi | x1.astype(jnp.uint64)) >> jnp.uint64(12)
+        one_to_two = jax.lax.bitcast_convert_type(
+            mant | jnp.uint64(0x3FF0000000000000), jnp.float64
+        )
+        return one_to_two - 1.0
+    mant = (x0 >> jnp.uint32(9)) | jnp.uint32(0x3F800000)
+    one_to_two = jax.lax.bitcast_convert_type(mant, jnp.float32)
+    u = (one_to_two - 1.0).astype(dtype)
+    if jnp.finfo(dtype).bits < 32:
+        u = jnp.minimum(u, jnp.asarray(1.0 - jnp.finfo(dtype).epsneg, dtype))
+    return u
+
+
+def _key_words(step_key):
+    """The (k0, k1) uint32 words of a 2-word threefry key, or None for
+    rbg/unsafe_rbg 4-word layouts (no contract that the first two words
+    vary per step — the counter consumers must fall back to foldin)."""
+    kd = (
+        step_key
+        if getattr(step_key, "dtype", None) == jnp.uint32
+        else jax.random.key_data(step_key)
+    )
+    if kd.shape[-1] != 2:
+        return None
+    return kd[0], kd[1]
+
+
+def _agent_uniforms(key, step_k, ids, dtype, impl: str = "counter"):
+    """Per-agent uniform draw as a pure function of (key, step, GLOBAL agent id).
+
+    Keying the stream by global agent id — not by device or array position —
+    makes the simulation invariant to sharding: a single-device run and an
+    n-device run draw bit-identical randomness per agent, so the two paths
+    are exactly equivalent (tested), not merely statistically close.
+
+    Two streams, both with that invariance (`AgentSimConfig.rng_stream`;
+    the default here matches the config default):
+
+    - "counter" (default since 0.7.0): one Threefry block per agent — the
+      per-step key pair hashes the id directly as the block counter, and
+      the uniform is built from the block's first word (both words for
+      f64's 52-bit mantissa).
+    - "foldin": uniform(fold_in(fold_in(key, step), id)) — two full
+      Threefry blocks per agent per step plus the vmapped key
+      construction (~16x the CPU cost); the stream every pre-0.7
+      committed measurement used.
+
+    A run is comparable across engines/shardings/platforms under either
+    stream, but the streams are different (equally valid) realizations.
+
+    The counter path requires the 2-word threefry key layout (ADVICE r5):
+    under jax_default_prng_impl=rbg/unsafe_rbg key data is 4 uint32 words
+    with no contract that the first two vary per step, which would silently
+    degrade the stream to half the key material. A non-2-word layout falls
+    back to the foldin path, which is layout-agnostic by construction.
+    """
+    step_key = jax.random.fold_in(key, step_k)
+    words = _key_words(step_key) if impl == "counter" else None
+    if words is not None:
+        c0 = ids.astype(jnp.uint32)
+        x0, x1 = _threefry2x32(words[0], words[1], c0, jnp.zeros_like(c0))
+        return _uniform_from_bits(x0, x1, dtype)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(step_key, ids)
+    return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=dtype))(keys)

@@ -80,6 +80,19 @@ class SocialFixedPointResult:
         k = n % ln
         return np.concatenate([err[k:], err[:k]]), np.concatenate([xi[k:], xi[:k]])
 
+    def curves_on(self, t):
+        """(G, AW) interpolated onto host times ``t`` (host-side helper —
+        the mean-field curves every agent-level comparison measures
+        against; shared by `closure.close_loop` and the bench's mega-scale
+        agents workload so the two interpolate identically)."""
+        import numpy as np
+
+        t = np.asarray(t, dtype=np.float64)
+        grid = np.asarray(self.grid, dtype=np.float64)
+        g = np.interp(t, grid, np.asarray(self.learning.cdf, dtype=np.float64))
+        aw = np.interp(t, grid, np.asarray(self.aw, dtype=np.float64))
+        return g, aw
+
     def __repr__(self) -> str:
         from sbr_tpu.models.results import _fmt
 

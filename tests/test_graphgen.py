@@ -1,0 +1,575 @@
+"""On-device graph generation + fused infection step (ISSUE 10).
+
+Contracts under test:
+
+- device canonical layout == host canonicalization of the same raw stream
+  (bitwise: src, row_ptr, indeg, and the incremental orientation arrays);
+- dst-sorted invariants (row_ptr monotone from 0 to E, diffs == indeg,
+  sources in range);
+- seeded determinism, in-process and CROSS-PROCESS (the stream is keyed by
+  numpy SeedSequence words, never by jax PRNG state);
+- chunk-plan invariance (the capacity plan affects peak memory and speed,
+  never bytes);
+- degree-distribution statistics per spec (ER mean degree, scale-free
+  heavy tails on BOTH endpoints, SBM within-block fraction);
+- sharded generation assembles the same graph as single-device generation
+  byte-for-byte (and equals the sharded host prepare of the raw stream);
+- fused step == unfused step bitwise, on the CPU lax fallback AND in
+  Pallas interpret mode, for both engines and both dtypes — and the
+  foldin stream always resolves to the unfused path (no fused lowering
+  implements the fold_in draw chain);
+- history schema 6 (agents_graph_build_s / agents_graph_gen_edges_per_sec /
+  agents_graph_gen_speedup): bench_metrics pickup, polarity, and
+  back-compat gating against committed schema 1-5 lines.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from sbr_tpu.social import (
+    AgentSimConfig,
+    ErdosRenyiSpec,
+    ScaleFreeSpec,
+    StochasticBlockSpec,
+    erdos_renyi_edges,
+    prepare_agent_graph,
+    prepare_generated_graph,
+    simulate_agents,
+)
+from sbr_tpu.social import agents as A
+from sbr_tpu.social import fused, graphgen
+
+REPO = Path(__file__).resolve().parents[1]
+
+SPECS = [
+    ErdosRenyiSpec(n=500, avg_degree=6.0),
+    ScaleFreeSpec(n=500, avg_degree=6.0, gamma=2.5),
+    StochasticBlockSpec(n=500, avg_degree=6.0, n_blocks=4, p_in=0.8),
+]
+
+
+# ---------------------------------------------------------------------------
+# Spec validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpecs:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n >= 2"):
+            ErdosRenyiSpec(n=1, avg_degree=2.0)
+        with pytest.raises(ValueError, match="avg_degree"):
+            ErdosRenyiSpec(n=10, avg_degree=0.0)
+        with pytest.raises(ValueError, match="gamma"):
+            ScaleFreeSpec(n=10, avg_degree=2.0, gamma=1.0)
+        with pytest.raises(ValueError, match="n_blocks"):
+            StochasticBlockSpec(n=10, avg_degree=2.0, n_blocks=1)
+        with pytest.raises(ValueError, match="p_in"):
+            StochasticBlockSpec(n=10, avg_degree=2.0, p_in=1.5)
+        with pytest.raises(ValueError, match="2\\*n_blocks"):
+            StochasticBlockSpec(n=4, avg_degree=2.0, n_blocks=3)
+        with pytest.raises(ValueError, match="int32"):
+            ErdosRenyiSpec(n=2**20, avg_degree=3000.0)
+
+    def test_specs_are_hashable_jit_keys(self):
+        assert hash(ErdosRenyiSpec(n=10, avg_degree=2.0)) == hash(
+            ErdosRenyiSpec(n=10, avg_degree=2.0)
+        )
+
+    def test_edge_count_deterministic(self):
+        spec = ErdosRenyiSpec(n=1000, avg_degree=8.0)
+        assert spec.edge_count(7) == spec.edge_count(7)
+        # the ER count is the host sampler's binomial law, not a constant
+        assert spec.edge_count(7) != spec.edge_count(8)
+
+
+# ---------------------------------------------------------------------------
+# Canonical-layout parity vs the host pipeline + dst-sorted invariants
+# ---------------------------------------------------------------------------
+
+
+class TestCanonicalParity:
+    @pytest.mark.parametrize("spec", SPECS, ids=lambda s: type(s).__name__)
+    def test_device_layout_equals_host_canonicalization(self, spec):
+        """The device build's (src, row_ptr, indeg) must be BITWISE the
+        host `_canonicalize_graph` of the same raw stream."""
+        src, dst = graphgen.generate_edges(spec, seed=3)
+        _, src_h, _, indeg_h, row_ptr_h = A._canonicalize_graph(
+            1.0, src, dst, spec.n, np.float32
+        )
+        built = graphgen._SingleBuild(spec, 3, None)
+        np.testing.assert_array_equal(np.asarray(built.src_sorted()), src_h)
+        np.testing.assert_array_equal(
+            np.asarray(built.row_ptr), row_ptr_h.astype(np.int32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(built.indeg), indeg_h.astype(np.int32)
+        )
+
+    @pytest.mark.parametrize("spec", SPECS, ids=lambda s: type(s).__name__)
+    def test_dst_sorted_invariants(self, spec):
+        built = graphgen._SingleBuild(spec, 5, None)
+        row_ptr = np.asarray(built.row_ptr)
+        indeg = np.asarray(built.indeg)
+        src = np.asarray(built.src_sorted())
+        assert row_ptr[0] == 0 and row_ptr[-1] == built.e == len(src)
+        assert np.all(np.diff(row_ptr) >= 0)  # monotone
+        np.testing.assert_array_equal(np.diff(row_ptr), indeg)
+        assert int(indeg.sum()) == built.e
+        assert src.min() >= 0 and src.max() < spec.n
+        # out-degree census is consistent with the source stream
+        np.testing.assert_array_equal(
+            np.asarray(built.outdeg), np.bincount(src, minlength=spec.n)
+        )
+
+    def test_incremental_orientation_equals_host_prepare(self):
+        spec = ScaleFreeSpec(n=400, avg_degree=5.0, gamma=2.3)
+        src, dst = graphgen.generate_edges(spec, seed=11)
+        pg_d = prepare_generated_graph(spec, seed=11, engine="incremental")
+        pg_h = prepare_agent_graph(1.0, src, dst, spec.n, engine="incremental")
+        assert pg_d.engine == pg_h.engine == "incremental"
+        for a, b in zip(pg_d.inc, pg_h.inc):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(pg_d.src), np.asarray(pg_h.src))
+
+    def test_empty_graph_prepares_as_gather(self):
+        spec = ErdosRenyiSpec(n=64, avg_degree=1e-9)
+        assert spec.edge_count(0) == 0
+        pg = prepare_generated_graph(spec, seed=0, engine="incremental")
+        assert pg.engine == "gather" and pg.n_edges == 0
+
+    def test_engine_measure_rejected(self):
+        with pytest.raises(ValueError, match="engine"):
+            prepare_generated_graph(SPECS[0], seed=0, engine="measure")
+
+    def test_vector_betas_land_in_prepared(self):
+        spec = ErdosRenyiSpec(n=100, avg_degree=4.0)
+        betas = np.linspace(0.5, 2.0, 100, dtype=np.float32)
+        pg = prepare_generated_graph(spec, seed=0, betas=betas)
+        np.testing.assert_allclose(np.asarray(pg.betas), betas)
+
+
+# ---------------------------------------------------------------------------
+# Determinism
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_same_seed_bitwise_same_different_seed_differs(self):
+        spec = ErdosRenyiSpec(n=600, avg_degree=7.0)
+        a = graphgen._SingleBuild(spec, 9, None)
+        b = graphgen._SingleBuild(spec, 9, None)
+        c = graphgen._SingleBuild(spec, 10, None)
+        np.testing.assert_array_equal(
+            np.asarray(a.src_sorted()), np.asarray(b.src_sorted())
+        )
+        assert not np.array_equal(
+            np.asarray(a.src_sorted())[: min(a.e, c.e)],
+            np.asarray(c.src_sorted())[: min(a.e, c.e)],
+        )
+
+    def test_cross_process_bitwise(self):
+        """The stream is keyed by numpy SeedSequence words — bit-identical
+        across processes regardless of jax PRNG configuration."""
+        import hashlib
+
+        spec = ScaleFreeSpec(n=300, avg_degree=5.0, gamma=2.5)
+        built = graphgen._SingleBuild(spec, 21, None)
+        digest = hashlib.sha256(
+            np.asarray(built.src_sorted()).tobytes()
+            + np.asarray(built.row_ptr).tobytes()
+        ).hexdigest()
+        code = (
+            "import hashlib, numpy as np\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            "from sbr_tpu.social import graphgen\n"
+            "spec = graphgen.ScaleFreeSpec(n=300, avg_degree=5.0, gamma=2.5)\n"
+            "b = graphgen._SingleBuild(spec, 21, None)\n"
+            "print(hashlib.sha256(np.asarray(b.src_sorted()).tobytes()"
+            " + np.asarray(b.row_ptr).tobytes()).hexdigest())"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=240,
+            env={**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"},
+            cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        assert out.stdout.strip() == digest
+
+    def test_chunk_plan_never_changes_bytes(self):
+        spec = StochasticBlockSpec(n=500, avg_degree=6.0, n_blocks=5, p_in=0.7)
+        base = graphgen._SingleBuild(spec, 4, None)
+        for chunk in (64, 97, 4096):
+            other = graphgen._SingleBuild(spec, 4, chunk)
+            np.testing.assert_array_equal(
+                np.asarray(base.src_sorted()), np.asarray(other.src_sorted())
+            )
+            np.testing.assert_array_equal(
+                np.asarray(base.inc_arrays()[0]), np.asarray(other.inc_arrays()[0])
+            )
+
+
+# ---------------------------------------------------------------------------
+# Capacity plan
+# ---------------------------------------------------------------------------
+
+
+class TestChunkPlan:
+    def test_deterministic_power_of_two_with_floor_and_cap(self):
+        c = graphgen.plan_chunk_edges(10**8, 10**7, budget_bytes=1 << 30)
+        assert c == graphgen.plan_chunk_edges(10**8, 10**7, budget_bytes=1 << 30)
+        assert c & (c - 1) == 0  # power of two
+        # starving the budget floors at 2^14, never below
+        assert graphgen.plan_chunk_edges(10**8, 10**7, budget_bytes=1) == 1 << 14
+        # a tiny graph caps at E
+        assert graphgen.plan_chunk_edges(100, 50, budget_bytes=1 << 30) == 100
+
+    def test_budget_monotone(self):
+        small = graphgen.plan_chunk_edges(10**8, 10**6, budget_bytes=1 << 28)
+        large = graphgen.plan_chunk_edges(10**8, 10**6, budget_bytes=1 << 32)
+        assert large >= small
+
+    def test_env_budget_respected(self, monkeypatch):
+        monkeypatch.setenv("SBR_GRAPHGEN_BUDGET_BYTES", str(1 << 24))
+        assert graphgen.plan_chunk_edges(10**8, 10**6) == graphgen.plan_chunk_edges(
+            10**8, 10**6, budget_bytes=1 << 24
+        )
+
+
+# ---------------------------------------------------------------------------
+# Degree statistics per generative model
+# ---------------------------------------------------------------------------
+
+
+class TestDegreeStats:
+    def test_er_mean_degree(self):
+        spec = ErdosRenyiSpec(n=20_000, avg_degree=8.0)
+        src, dst = graphgen.generate_edges(spec, seed=1)
+        indeg = np.bincount(dst, minlength=spec.n)
+        outdeg = np.bincount(src, minlength=spec.n)
+        assert abs(indeg.mean() - 8.0) < 0.4
+        assert abs(outdeg.mean() - 8.0) < 0.4
+        # Poisson-like spread, not degenerate: var ≈ mean for ER
+        assert 0.5 * 8.0 < indeg.var() < 2.0 * 8.0
+
+    def test_scale_free_heavy_tails_both_endpoints(self):
+        spec = ScaleFreeSpec(n=20_000, avg_degree=8.0, gamma=2.2)
+        src, dst = graphgen.generate_edges(spec, seed=1)
+        indeg = np.bincount(dst, minlength=spec.n)
+        outdeg = np.bincount(src, minlength=spec.n)
+        # hubs: the max degree dwarfs the mean on BOTH orientations
+        # (in-degree drives the learning dynamics — it must be heavy)
+        assert indeg.max() > 20 * indeg.mean()
+        assert outdeg.max() > 20 * outdeg.mean()
+        # weights are (i+1)^{-1/(gamma-1)}: node 0 is the heaviest hub
+        assert indeg[0] > 100
+        er = np.bincount(
+            graphgen.generate_edges(ErdosRenyiSpec(n=20_000, avg_degree=8.0), 1)[1],
+            minlength=20_000,
+        )
+        # top-1% mass far exceeds ER's at the same mean degree
+        k = 200
+        sf_top = np.sort(indeg)[-k:].sum() / indeg.sum()
+        er_top = np.sort(er)[-k:].sum() / er.sum()
+        assert sf_top > 3 * er_top
+
+    def test_sbm_within_block_fraction(self):
+        spec = StochasticBlockSpec(
+            n=20_000, avg_degree=8.0, n_blocks=4, p_in=0.8
+        )
+        src, dst = graphgen.generate_edges(spec, seed=1)
+        block = np.minimum(src * spec.n_blocks // spec.n, spec.n_blocks - 1)
+        block_d = np.minimum(dst * spec.n_blocks // spec.n, spec.n_blocks - 1)
+        within = float(np.mean(block == block_d))
+        assert abs(within - 0.8) < 0.02
+        assert not np.any(src == dst)  # SBM rewires in-block self-loops
+
+
+# ---------------------------------------------------------------------------
+# Sharded generation
+# ---------------------------------------------------------------------------
+
+
+class TestShardedGeneration:
+    def test_sharded_equals_single_device_and_host(self):
+        """Each device generates only its position range; the assembled
+        graph is byte-identical to the single-device build (positions are
+        pure functions of (seed, edge id)) and to the sharded host prepare
+        of the same raw stream."""
+        mesh = jax.make_mesh((8,), ("agents",))
+        spec = ErdosRenyiSpec(n=640, avg_degree=6.0)
+        built = graphgen._SingleBuild(spec, 3, None)
+        src, dst = graphgen.generate_edges(spec, seed=3)
+        for eng in ("gather", "incremental"):
+            pg_d = prepare_generated_graph(spec, seed=3, mesh=mesh, engine=eng)
+            pg_h = prepare_agent_graph(1.0, src, dst, spec.n, mesh=mesh, engine=eng)
+            np.testing.assert_array_equal(
+                np.asarray(pg_d.src), np.asarray(pg_h.src), err_msg=eng
+            )
+            np.testing.assert_array_equal(
+                np.asarray(pg_d.row_ptr), np.asarray(pg_h.row_ptr), err_msg=eng
+            )
+            np.testing.assert_array_equal(
+                np.asarray(pg_d.indeg), np.asarray(pg_h.indeg), err_msg=eng
+            )
+            # the global concatenation's valid prefix IS the single-device
+            # canonical stream
+            np.testing.assert_array_equal(
+                np.asarray(pg_d.src).ravel()[: built.e],
+                np.asarray(built.src_sorted()),
+                err_msg=eng,
+            )
+            if eng == "incremental":
+                for a, b in zip(pg_d.inc, pg_h.inc):
+                    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sharded_simulation_matches_single_device(self):
+        """The full chain: generated sharded graph + fused sharded step ==
+        generated single-device graph + fused single-device step, bitwise
+        (the global-agent-id RNG invariance carries through graphgen)."""
+        mesh = jax.make_mesh((8,), ("agents",))
+        spec = ErdosRenyiSpec(n=640, avg_degree=6.0)
+        cfg = AgentSimConfig(n_steps=30, dt=0.1)
+        pg1 = prepare_generated_graph(spec, seed=3, engine="gather", config=cfg)
+        pg8 = prepare_generated_graph(
+            spec, seed=3, mesh=mesh, engine="gather", config=cfg
+        )
+        r1 = simulate_agents(prepared=pg1, x0=0.02, config=cfg, seed=5)
+        r8 = simulate_agents(prepared=pg8, x0=0.02, config=cfg, seed=5)
+        np.testing.assert_array_equal(
+            np.asarray(r1.informed), np.asarray(r8.informed)[: spec.n]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fused infection step
+# ---------------------------------------------------------------------------
+
+
+class TestFusedStep:
+    def _graph(self, n=800, seed=2):
+        return erdos_renyi_edges(n, 6.0, seed=seed)
+
+    @pytest.mark.parametrize("engine", ["gather", "incremental"])
+    @pytest.mark.parametrize("mode", ["lax", "interpret"])
+    def test_bitwise_parity_vs_unfused(self, engine, mode):
+        n = 800
+        src, dst = self._graph(n)
+        base_cfg = AgentSimConfig(n_steps=40, dt=0.1, fused="unfused")
+        want = simulate_agents(
+            1.2, src, dst, n, x0=0.02, config=base_cfg, seed=7, engine=engine
+        )
+        got = simulate_agents(
+            1.2, src, dst, n, x0=0.02,
+            config=dataclasses.replace(base_cfg, fused=mode), seed=7,
+            engine=engine,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(want.informed), np.asarray(got.informed)
+        )
+        np.testing.assert_array_equal(np.asarray(want.t_inf), np.asarray(got.t_inf))
+        np.testing.assert_array_equal(
+            np.asarray(want.informed_frac), np.asarray(got.informed_frac)
+        )
+
+    def test_bitwise_parity_f64_lax_and_interpret(self):
+        n = 400
+        src, dst = self._graph(n)
+        res = {}
+        for mode in ("unfused", "lax", "interpret"):
+            cfg = AgentSimConfig(n_steps=25, dt=0.1, fused=mode)
+            res[mode] = simulate_agents(
+                1.0, src, dst, n, x0=0.02, config=cfg, seed=3, dtype=np.float64
+            )
+        for mode in ("lax", "interpret"):
+            np.testing.assert_array_equal(
+                np.asarray(res["unfused"].informed), np.asarray(res[mode].informed),
+                err_msg=mode,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res["unfused"].t_inf), np.asarray(res[mode].t_inf),
+                err_msg=mode,
+            )
+
+    def test_foldin_stream_is_untouched_by_fusion(self):
+        """Every fused lowering computes the counter draw; the foldin
+        stream must resolve to unfused under ANY requested mode (the 0.8.0
+        regression guard: a fused-lax foldin run must not silently become
+        the counter stream)."""
+        n = 400
+        src, dst = self._graph(n)
+        want = simulate_agents(
+            1.0, src, dst, n, x0=0.02,
+            config=AgentSimConfig(n_steps=25, dt=0.1, rng_stream="foldin",
+                                  fused="unfused"),
+            seed=3,
+        )
+        for mode in ("auto", "lax", "interpret"):
+            got = simulate_agents(
+                1.0, src, dst, n, x0=0.02,
+                config=AgentSimConfig(n_steps=25, dt=0.1, rng_stream="foldin",
+                                      fused=mode),
+                seed=3,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(want.informed), np.asarray(got.informed), err_msg=mode
+            )
+            np.testing.assert_array_equal(
+                np.asarray(want.t_inf), np.asarray(got.t_inf), err_msg=mode
+            )
+
+    def test_resolve_mode_contract(self, monkeypatch):
+        monkeypatch.delenv("SBR_FUSED", raising=False)
+        # CPU backend: auto → lax (tier-1 semantics unchanged by construction)
+        assert fused.resolve_mode("auto", np.float32, "counter") == "lax"
+        # no fused lowering implements the foldin draw chain
+        for mode in ("auto", "lax", "pallas", "interpret"):
+            assert fused.resolve_mode(mode, np.float32, "foldin") == "unfused"
+        # compiled TPU Pallas lacks uint64 words; the interpreter keeps f64
+        assert fused.resolve_mode("pallas", np.float64, "counter") == "lax"
+        assert fused.resolve_mode("interpret", np.float64, "counter") == "interpret"
+        assert fused.resolve_mode("unfused", np.float32, "counter") == "unfused"
+        with pytest.raises(ValueError, match="fused"):
+            fused.resolve_mode("vectorized", np.float32, "counter")
+        monkeypatch.setenv("SBR_FUSED", "unfused")
+        assert fused.resolve_mode("auto", np.float32, "counter") == "unfused"
+        # a typo'd override must raise, not fall through to the default
+        monkeypatch.setenv("SBR_FUSED", "palas")
+        with pytest.raises(ValueError, match="SBR_FUSED"):
+            fused.resolve_mode("auto", np.float32, "counter")
+
+    def test_config_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="fused"):
+            AgentSimConfig(fused="simd")
+
+
+# ---------------------------------------------------------------------------
+# Closure-loop integration (graph= spec path)
+# ---------------------------------------------------------------------------
+
+
+class TestCloseLoopGraph:
+    def test_spec_mismatch_raises(self):
+        from sbr_tpu.social import close_loop
+
+        with pytest.raises(ValueError, match="n_agents"):
+            close_loop(
+                n_agents=1000, graph=ErdosRenyiSpec(n=999, avg_degree=15.0),
+                t_max=4.0,
+            )
+
+    @pytest.mark.slow
+    def test_generated_graph_closes_loop(self):
+        """A device-generated ER graph closes the Stage 1-3 loop within
+        the same tolerance envelope as the host-sampled path (different,
+        equally valid realization of the same model)."""
+        from sbr_tpu.social import close_loop
+
+        host = close_loop(n_agents=20_000, avg_degree=15.0, dt=0.05, t_max=16.0)
+        dev = close_loop(
+            n_agents=20_000, avg_degree=15.0, dt=0.05, t_max=16.0,
+            graph=ErdosRenyiSpec(n=20_000, avg_degree=15.0),
+        )
+        assert np.isfinite(dev.err_aw_sup)
+        # same MC scale as the host-sampled realization at this shape...
+        assert dev.err_aw_rms < 2.0 * host.err_aw_rms + 0.01
+        assert dev.err_g_rms < 2.0 * host.err_g_rms + 0.01
+        # ...and absolutely small against the mean-field curves
+        assert dev.err_aw_sup < 0.1
+
+
+# ---------------------------------------------------------------------------
+# History schema 6
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema6:
+    def test_bench_metrics_pick_up_graphgen_columns(self):
+        from sbr_tpu.obs import history
+
+        m = history.bench_metrics(
+            {
+                "metric": "eq_per_sec",
+                "value": 1.0,
+                "extra": {
+                    "agents_graph_build_s": 4.2,
+                    "agents_graph_gen_edges_per_sec": 2.4e7,
+                    "agents_graph_gen_speedup": 6.5,
+                },
+            }
+        )
+        assert m["agents_graph_build_s"] == 4.2
+        assert m["agents_graph_gen_edges_per_sec"] == 2.4e7
+        assert m["agents_graph_gen_speedup"] == 6.5
+
+    def test_polarity(self):
+        from sbr_tpu.obs import history
+
+        assert history.polarity("agents_graph_build_s") == -1
+        assert history.polarity("agents_graph_gen_edges_per_sec") == 1
+        assert history.polarity("agents_graph_gen_speedup") == 1
+
+    def test_schema6_gates_against_schema1_to_5(self, tmp_path):
+        """Committed schema 1-5 lines still load, and a schema-6 append
+        gates its shared metrics against them (the CI trend gate
+        contract)."""
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        rows = [
+            {"ts": "t0", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1000.0}},  # schema-less → 1
+            {"schema": 2, "ts": "t1", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1010.0, "mem_peak_bytes": 5000}},
+            {"schema": 3, "ts": "t2", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1005.0, "serve_p99_ms": 4.0}},
+            {"schema": 4, "ts": "t3", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1002.0, "sweep_warm_hit_rate": 1.0}},
+            {"schema": 5, "ts": "t4", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1004.0, "grid_adaptive_speedup": 2.2}},
+        ]
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        history.append(
+            {"eq_per_sec": 1008.0, "agents_graph_build_s": 4.0,
+             "agents_graph_gen_edges_per_sec": 2.0e7,
+             "agents_graph_gen_speedup": 6.0},
+            platform="cpu", path=path,
+        )
+        records = history.load(path)
+        assert [r["schema"] for r in records] == [1, 2, 3, 4, 5, history.SCHEMA]
+        verdicts, status = history.check(records, min_points=3)
+        assert status == "ok"
+        assert verdicts["eq_per_sec"]["n"] == 6
+        # new columns are short, never a false gate
+        assert verdicts["agents_graph_gen_edges_per_sec"]["status"] == "short"
+
+    def test_generation_regression_gates(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        rows = [
+            {"schema": 6, "ts": f"t{i}", "label": "bench", "platform": "cpu",
+             "metrics": {"agents_graph_gen_edges_per_sec": 2.0e7}}
+            for i in range(3)
+        ] + [
+            {"schema": 6, "ts": "t9", "label": "bench", "platform": "cpu",
+             "metrics": {"agents_graph_gen_edges_per_sec": 1.0e7}}
+        ]
+        path = tmp_path / "hist.jsonl"
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        verdicts, status = history.check(history.load(path), min_points=3)
+        assert status == "regression"
+        assert verdicts["agents_graph_gen_edges_per_sec"]["status"] == "regression"

@@ -42,11 +42,12 @@ def test_append_load_round_trip(tmp_path):
     )
     assert out == p
     (rec,) = history.load(p)
-    # schema 5 (ISSUE 9): the adaptive-numerics split joined the record
-    # (4 added elastic sweeps, 3 serving, 2 memory); the key set only grew,
-    # and schema-1/2/3/4/-less lines still load (tests/test_mem.py,
-    # tests/test_serve.py, tests/test_elastic.py, tests/test_numerics.py).
-    assert rec["schema"] == history.SCHEMA == 5
+    # schema 6 (ISSUE 10): the mega-scale agents generation split joined
+    # the record (5 added adaptive numerics, 4 elastic sweeps, 3 serving,
+    # 2 memory); the key set only grew, and schema-1..5/-less lines still
+    # load (tests/test_mem.py, tests/test_serve.py, tests/test_elastic.py,
+    # tests/test_numerics.py, tests/test_graphgen.py).
+    assert rec["schema"] == history.SCHEMA == 6
     assert rec["label"] == "x" and rec["platform"] == "cpu"
     # only finite numerics survive; bools coerce to gateable ints
     assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}

@@ -25,6 +25,20 @@ def test_native_library_builds():
     assert native.native_available()
 
 
+def test_sbr_native_env_gate(monkeypatch):
+    """SBR_NATIVE=0 disables the native library per CALL (the bench's
+    host-numpy control measures the portable path alongside the native one
+    in a single process), and unsetting it restores whatever the build
+    produced."""
+    monkeypatch.delenv("SBR_NATIVE", raising=False)  # baseline = build result
+    before = native.get_lib()
+    monkeypatch.setenv("SBR_NATIVE", "0")
+    assert native.get_lib() is None
+    assert not native.native_available()
+    monkeypatch.delenv("SBR_NATIVE")
+    assert native.get_lib() is before
+
+
 def test_sort_matches_numpy_reference():
     rng = np.random.default_rng(0)
     n, e = 500, 20_000
