@@ -98,6 +98,12 @@ reporting `extra.sweep_cold_cells_per_sec` / `sweep_warm_cells_per_sec` /
 `sweep_warm_hit_rate` (history schema 4) so `report trend` gates both the
 scheduler's compute path and the cache's hit path.
 
+Serving fleet (ISSUE 11): a fifth workload runs the MULTI-PROCESS fleet —
+worker subprocesses behind an in-process `sbr_tpu.serve.router.Router` —
+through the seeded loadgen mix over HTTP and reports the client-observed
+`extra.fleet_p99_ms` plus `fleet_failover_count` / `fleet_shed_rate`
+(history schema 7); any lost query fails the workload outright.
+
 Mega-scale agents (ISSUE 10): the agents workload now generates its graph
 ON DEVICE (`sbr_tpu.social.graphgen` — the edge list never transits host
 RAM) at 10^7 agents / 10^8 edges on every non-tiny platform, CPU
@@ -1173,6 +1179,57 @@ def bench_serve(platform: str) -> dict:
     }
 
 
+def bench_fleet(platform: str) -> dict:
+    """Serving-fleet SLO workload (ISSUE 11): the multi-process fleet —
+    N worker subprocesses behind an in-process router — driven with the
+    seeded loadgen mix over HTTP. Headline numbers are the client-observed
+    measured-phase p99 through the router (fleet_p99_ms, lower-better),
+    the failover count, and the admission shed rate; `report trend` gates
+    them as schema-7 history metrics. Tiny shapes run the pipeline but
+    zero the gated stats (reduced-shape numbers must not baseline the
+    trend gate, the established dry-run rule)."""
+    from types import SimpleNamespace
+
+    from sbr_tpu.serve.loadgen import run_fleet
+
+    tiny = _tiny()
+    if tiny:
+        n_workers, n_queries, pool_n, n_grid = 2, 16, 4, 96
+    elif platform == "cpu":
+        n_workers, n_queries, pool_n, n_grid = 3, 256, 16, 256
+    else:
+        n_workers, n_queries, pool_n, n_grid = 3, 1024, 32, 512
+    args = SimpleNamespace(
+        fleet=n_workers, queries=n_queries, pool=pool_n, group=8,
+        n_grid=n_grid, bisect_iters=40 if tiny else 60, seed=0,
+        buckets="1,8" if tiny else "1,8,64", run_dir=None, cache_dir=None,
+        platform="cpu" if platform == "cpu" else None, fleet_dir=None,
+        fleet_kill_after=None, answers_out=None,
+    )
+    summary = run_fleet(args)
+    if summary["failures"] or summary.get("fleet_lost", 0):
+        raise RuntimeError(f"fleet bench lost queries: {summary['failures']}")
+    _log(
+        f"fleet: {summary['answered']}/{n_queries} queries over "
+        f"{n_workers} worker(s); p50 {summary['fleet_p50_ms']} ms, "
+        f"p99 {summary['fleet_p99_ms']} ms, "
+        f"{summary['fleet_failover_count']} failover(s), "
+        f"shed rate {summary['fleet_shed_rate']}, {summary['fleet_qps']} qps"
+    )
+    return {
+        "fleet_workers": n_workers,
+        "fleet_queries": int(summary["answered"]),
+        "fleet_qps": summary["fleet_qps"],
+        # Gated schema-7 stats: None (dropped by measure()) on tiny shapes
+        # so a dry-run can never seed the regression baselines — None, not
+        # 0, because 0 is a MEANINGFUL baseline for failovers/sheds (any
+        # increase from a clean fleet regresses, the zero-baseline rule).
+        "fleet_p99_ms": None if tiny else summary["fleet_p99_ms"],
+        "fleet_failover_count": None if tiny else summary["fleet_failover_count"],
+        "fleet_shed_rate": None if tiny else summary["fleet_shed_rate"],
+    }
+
+
 def bench_sweep(platform: str) -> dict:
     """Tiled-sweep workload (ISSUE 8): one cold elastic tiled sweep through
     `run_tiled_grid_multihost` (heartbeats, claim plan, leases), then a
@@ -1326,6 +1383,20 @@ def _measure_inner(platform: str) -> None:
             "bench_sweep",
             **{k: round(v, 6) if isinstance(v, float) else v for k, v in sweep.items()},
         )
+    try:
+        with obs.span("bench.fleet"):
+            fleet = bench_fleet(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the multi-process fleet workload fails.
+        _log(f"fleet bench failed: {err!r}")
+        fleet = None
+    if fleet is not None:
+        obs.event(
+            "bench_fleet",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in fleet.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -1402,6 +1473,19 @@ def _measure_inner(platform: str) -> None:
         ):
             if sweep.get(k) is not None:
                 out["extra"][k] = sweep[k]
+    if fleet is not None:
+        # Schema-7 history metrics (ISSUE 11): the multi-process fleet SLO
+        # split. Tiny shapes return None for the gated three (never a fake
+        # baseline); fleet_qps/workers always land for visibility.
+        for k in (
+            "fleet_p99_ms",
+            "fleet_failover_count",
+            "fleet_shed_rate",
+            "fleet_qps",
+            "fleet_workers",
+        ):
+            if fleet.get(k) is not None:
+                out["extra"][k] = fleet[k]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

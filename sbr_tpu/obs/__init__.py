@@ -36,7 +36,9 @@ per-step ABM counters are the design references from PAPERS.md):
   telemetry (``live.json`` from `sbr_tpu.serve`; SLO breach = exit 1),
   `elastic` renders the elastic-scheduler census (hosts joined/left,
   claims, tile sources, global-cache outcomes — exit 3 when a churn gate
-  has nothing to read), `gc` prunes old run directories plus checkpoint
+  has nothing to read), `fleet` renders/gates a serving-fleet router run
+  (rolling ``fleet.json`` + fleet events; exit 1 on lost queries or a
+  breaker stuck open), `gc` prunes old run directories plus checkpoint
   debris (``quarantine/``, stale ``tile_*.lease``, expired ``host_*.hb``
   heartbeats) and, with ``--tile-cache``, cold cross-run tile-cache
   entries. Every subcommand takes ``--json``. Reports tolerate torn
@@ -71,6 +73,7 @@ from sbr_tpu.obs.runlog import (
     jit_call,
     log_cache,
     log_fault,
+    log_fleet,
     log_health,
     log_repair,
     log_retry,
@@ -102,6 +105,7 @@ __all__ = [
     "jit_call",
     "log_cache",
     "log_fault",
+    "log_fleet",
     "log_health",
     "log_repair",
     "log_retry",

@@ -59,10 +59,16 @@ from typing import Optional
 # agents_graph_gen_speedup (device generator vs the host-numpy pipeline at
 # the 10^7-edge control shape), so `report trend` gates the generation
 # path separately from step throughput.
+# 7 adds the serving-fleet SLO split (ISSUE 11, the multi-process
+# `loadgen --fleet` bench): fleet_p99_ms (client-observed measured-phase
+# p99 through the router — lower-better), fleet_failover_count
+# (re-dispatches after forward failures — lower-better by the _count
+# rule), and fleet_shed_rate (fraction of queries shed at admission —
+# lower-better by the shed rule).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1/2/3/4/5 history keeps gating new schema-6 appends.
-SCHEMA = 6
+# schema-1/2/3/4/5/6 history keeps gating new schema-7 appends.
+SCHEMA = 7
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -172,6 +178,12 @@ def bench_metrics(result: dict) -> dict:
         "agents_graph_build_s",
         "agents_graph_gen_edges_per_sec",
         "agents_graph_gen_speedup",
+        # schema 7: the serving-fleet workload (loadgen --fleet / bench.py
+        # bench_fleet): client p99 through the router, failover count, and
+        # the admission shed rate (all lower-better by polarity)
+        "fleet_p99_ms",
+        "fleet_failover_count",
+        "fleet_shed_rate",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
@@ -205,8 +217,8 @@ def bench_metrics(result: dict) -> dict:
 
 def polarity(metric: str) -> int:
     """+1 when higher is better (throughput, cache hit rates, speedups), -1
-    when lower is better (durations, latencies, byte counts, divergence and
-    effective-iteration counts)."""
+    when lower is better (durations, latencies, byte counts, divergence,
+    effective-iteration, failover/shed counts)."""
     m = metric.lower()
     if (
         m.endswith("_per_sec")
@@ -221,9 +233,12 @@ def polarity(metric: str) -> int:
         or m.endswith("_ms")
         or m.endswith("_bytes")
         or m.endswith("_iters")
+        or m.endswith("_count")
         or "latency" in m
         or "divergent" in m
         or "retrace" in m
+        or "shed" in m
+        or "failover" in m
     ):
         return -1
     return 1

@@ -37,6 +37,14 @@ Usage:
                                                     # tile-cache outcomes);
                                                     # exit 3 when no scheduler
                                                     # events were recorded
+    python -m sbr_tpu.obs.report fleet RUN_DIR      # serving-fleet report
+                                                    # (router fleet.json +
+                                                    # fleet events: failovers,
+                                                    # hedges, sheds, breaker
+                                                    # states); exit 1 on lost
+                                                    # queries or a breaker
+                                                    # stuck open, 3 when no
+                                                    # fleet data was recorded
     python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs +
                                                     # checkpoint debris
                                                     # (quarantine/, stale
@@ -823,6 +831,195 @@ def _main_elastic(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Fleet report (`fleet` subcommand — the serving-fleet renderer/gate)
+# ---------------------------------------------------------------------------
+
+
+def fleet_doc(run_dir, stuck_after_s: float = 600.0) -> tuple:
+    """Machine-readable serving-fleet report from a ROUTER run dir: the
+    rolling ``fleet.json`` snapshot (`sbr_tpu.serve.router`, atomic
+    rename — readable mid-flight) plus the obs ``fleet`` event fold.
+    Returns (doc, exit_code).
+
+    Exit codes: 0 healthy; 1 on LOST queries (a client got a non-200,
+    non-429 answer — failover exists precisely so this never happens) or
+    a breaker STUCK open (state "open" in the final snapshot for longer
+    than ``stuck_after_s`` — a breaker parked over a dead worker clears
+    when the heartbeat TTL reaps the worker from the table, so keep
+    ``stuck_after_s`` at or above the fleet's heartbeat TTL); 2 when
+    ``run_dir`` is not a directory; 3 when no fleet data was recorded
+    (a fleet gate with nothing to read must not pass silently)."""
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    snapshot = None
+    try:
+        snapshot = json.loads((run_dir / "fleet.json").read_text())
+    except (OSError, json.JSONDecodeError):
+        pass
+    events_fold: dict = {}
+    bad_lines = 0
+    try:
+        run = load_run(run_dir)
+        bad_lines = run.get("bad_event_lines", 0)
+        for ev in run["events"]:
+            if ev.get("kind") == "fleet":
+                action = str(ev.get("action", "?"))
+                events_fold[action] = events_fold.get(action, 0) + 1
+        manifest_fleet = run["manifest"].get("fleet") or {}
+    except (FileNotFoundError, json.JSONDecodeError):
+        manifest_fleet = {}
+    if snapshot is None and not events_fold and not manifest_fleet:
+        return {
+            "dir": str(run_dir),
+            "error": "no fleet data (no fleet.json, no fleet events)",
+            "exit": 3,
+        }, 3
+
+    counters = (snapshot or {}).get("counters") or {}
+    workers = (snapshot or {}).get("workers") or {}
+    # The event fold is the kill -9 fallback (a router that died before
+    # its throttled fleet.json caught up): take the max of the two views
+    # for EVERY gated/asserted count, never the sum — and never trust the
+    # snapshot alone, since Router initializes every counter key (a plain
+    # dict.get fallback would always pick the stale snapshot zero).
+    def _best(counter_key: str, event_key: str) -> int:
+        return max(int(counters.get(counter_key, 0)),
+                   int(events_fold.get(event_key, 0)))
+
+    lost = _best("failed", "lost")
+    stuck = sorted(
+        h
+        for h, w in workers.items()
+        if w.get("breaker") == "open"
+        and isinstance(w.get("breaker_age_s"), (int, float))
+        and w["breaker_age_s"] > stuck_after_s
+    )
+    breaches = []
+    if lost > 0:
+        breaches.append(f"{lost} lost quer(ies) — failover failed to absorb")
+    if stuck:
+        breaches.append(
+            f"breaker stuck open > {stuck_after_s:g}s for: {', '.join(stuck)}"
+        )
+    code = 1 if breaches else 0
+    doc = {
+        "dir": str(run_dir),
+        "snapshot": snapshot,
+        "counters": counters,
+        "workers": workers,
+        "events": events_fold,
+        "manifest_fleet": manifest_fleet or None,
+        "lost": lost,
+        "failover_count": _best("failover", "failover"),
+        "shed": _best("shed", "shed"),
+        "degraded": _best("degraded", "degraded"),
+        "stuck_breakers": stuck,
+        "stuck_after_s": stuck_after_s,
+        "breaches": breaches,
+        "bad_event_lines": bad_lines,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_fleet(doc: dict) -> str:
+    """Human-readable fleet report; same exit contract as `fleet_doc`."""
+    out = [f"run      {doc['dir']}"]
+    if doc["exit"] in (2, 3):
+        out.append(doc.get("error", "no fleet data"))
+        if doc["exit"] == 3:
+            out.append(
+                "was the run produced by sbr_tpu.serve.router (it writes a "
+                "rolling fleet.json + fleet events)?"
+            )
+        return "\n".join(out)
+    snap = doc.get("snapshot") or {}
+    c = doc["counters"]
+    out.append(
+        f"fleet    {int(c.get('queries', 0))} quer(ies): "
+        f"{int(c.get('completed', 0))} completed, {doc['lost']} lost, "
+        f"{doc['shed']} shed, {doc['degraded']} degraded"
+    )
+    out.append(
+        f"routing  {doc['failover_count']} failover(s), "
+        f"{int(c.get('hedged', 0))} hedge(s) ({int(c.get('hedge_wins', 0))} won), "
+        f"{int(c.get('forward_errors', 0))} forward error(s)"
+    )
+    lat = snap.get("latency_ms") or {}
+    if lat.get("count"):
+        out.append(
+            f"latency  p50 {_fmt_val_ms(lat.get('p50'))}   "
+            f"p95 {_fmt_val_ms(lat.get('p95'))}   p99 {_fmt_val_ms(lat.get('p99'))}"
+        )
+    if doc["workers"]:
+        out += ["", "WORKERS"]
+        out.append(
+            _table(
+                ["worker", "breaker", "age s", "forwards", "failures",
+                 "ewma ms", "healthz"],
+                [
+                    [
+                        h,
+                        (w.get("breaker") or "-").upper()
+                        if h in doc["stuck_breakers"] else (w.get("breaker") or "-"),
+                        "-" if w.get("breaker_age_s") is None else f"{w['breaker_age_s']:g}",
+                        w.get("forwards", 0),
+                        w.get("failures", 0),
+                        w.get("ewma_ms", "-"),
+                        w.get("healthz") or "-",
+                    ]
+                    for h, w in sorted(doc["workers"].items())
+                ],
+            )
+        )
+    if doc["events"]:
+        out += ["", "FLEET EVENTS"]
+        out.append(
+            _table(
+                ["action", "count"],
+                [[k, v] for k, v in sorted(doc["events"].items())],
+            )
+        )
+    out.append("")
+    if doc["breaches"]:
+        out.append("GATE: FLEET BREACH")
+        for b in doc["breaches"]:
+            out.append(f"  {b}")
+    else:
+        out.append("GATE: ok (zero lost queries, no breaker stuck open)")
+    return "\n".join(out)
+
+
+def _fmt_val_ms(v) -> str:
+    return "-" if v is None else f"{v:.2f} ms"
+
+
+def _main_fleet(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report fleet",
+        description="Serving-fleet report for one router run (rolling "
+        "fleet.json + fleet events); exit 1 on lost queries or a breaker "
+        "stuck open, 3 when no fleet data was recorded",
+    )
+    parser.add_argument("run_dir", help="router run directory (contains fleet.json)")
+    parser.add_argument(
+        "--stuck-after-s", type=float, default=600.0, dest="stuck_after_s",
+        help="age (s) past which an open breaker counts as stuck (default "
+        "600; keep >= the fleet heartbeat TTL so dead workers are reaped "
+        "from the table before their breakers can read as stuck)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = fleet_doc(args.run_dir, args.stuck_after_s)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_fleet(doc))
+    return code
+
+
+# ---------------------------------------------------------------------------
 # Memory report (`memory` subcommand — the obs.mem attribution renderer/gate)
 # ---------------------------------------------------------------------------
 
@@ -1367,6 +1564,8 @@ def main(argv=None) -> int:
         return _main_elastic(argv[1:])
     if argv and argv[0] == "serve":
         return _main_serve(argv[1:])
+    if argv and argv[0] == "fleet":
+        return _main_fleet(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -1378,8 +1577,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
-        "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'trend' / "
-        "'gc' subcommands",
+        "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
+        "'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -187,6 +187,11 @@ class RunContext:
         # actions (join/claim/reclaim/done/leave/plan), cross-run tile
         # cache outcomes (hit/miss/store/quarantine), and tiles by source.
         self.elastic: dict = {"scheduler": {}, "cache": {}, "tiles": {}}
+        # Serving-fleet roll-up (sbr_tpu.serve.fleet/router): per-action
+        # counts of fleet events (route failovers, hedges, sheds, degraded
+        # ladder answers, breaker transitions, worker joins/losses) — what
+        # `report fleet` gates on.
+        self.fleet: dict = {}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -584,6 +589,7 @@ class RunContext:
             "health": self.health or None,
             "resilience": self._resilience_manifest(),
             "elastic": self._elastic_manifest(),
+            "fleet": self.fleet or None,
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -670,6 +676,14 @@ class RunContext:
         self.event("cache", action=action, **fields)
         agg = self.elastic["cache"]
         agg[action] = agg.get(action, 0) + 1
+
+    def log_fleet(self, action: str = "?", **fields) -> None:
+        """Emit one serving-fleet ``fleet`` event (router forwards,
+        failovers, hedges, sheds, breaker transitions, degraded-ladder
+        answers — `sbr_tpu.serve`) and count it per action in the manifest
+        roll-up (`report fleet` gates on these counts)."""
+        self.event("fleet", action=action, **fields)
+        self.fleet[action] = self.fleet.get(action, 0) + 1
 
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
@@ -911,6 +925,14 @@ def log_cache(action: str = "?", **fields) -> None:
     run = current_run()
     if run is not None and _trace_clean():
         run.log_cache(action, **fields)
+
+
+def log_fleet(action: str = "?", **fields) -> None:
+    """Serving-fleet event + manifest roll-up (no-op when telemetry is off
+    or while tracing) — the `sbr_tpu.serve` fleet/router emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_fleet(action, **fields)
 
 
 def interrupt_all() -> int:

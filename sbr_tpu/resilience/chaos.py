@@ -33,6 +33,16 @@ the warm grids are bit-identical to the baseline, the preempted host left
 no leases or heartbeats behind, and ``report elastic`` proves the warm
 sweep computed ZERO tiles (every tile a cache hit).
 
+``python -m sbr_tpu.resilience.chaos --fleet`` runs the SERVING-FLEET
+smoke (ISSUE 11): a fault-free single-worker fleet run records the
+ground-truth answers for a seeded query mix, then a three-worker fleet
+serves the SAME mix while one worker is SIGKILLed mid-loadgen. It passes
+only if the fleet run lost ZERO queries, every answer is byte-identical
+to the single-worker ground truth (failover re-dispatch is benign by
+construction — results are pure and fingerprint-keyed), the failover and
+breaker-open events are visible in the router's telemetry, and ``report
+fleet`` exits 0 on both router run dirs.
+
 The driver itself never imports jax (workers are subprocesses), so it can
 run on a box whose accelerator stack is itself the thing being debugged.
 """
@@ -284,6 +294,128 @@ def main_churn(out: Path, as_json: bool) -> int:
     return 0 if ok else 1
 
 
+# Fleet smoke shape: small enough that three CPU workers compile their
+# buckets in seconds, large enough that the mid-run kill lands while
+# queries are still flowing (kill after 8 of 36).
+_FLEET = dict(queries=36, pool=6, group=8, n_grid=96, bisect_iters=30,
+              kill_after=8)
+
+_ANSWER_FIELDS = ("xi", "tau_bar_in", "aw_max", "status", "flags")
+
+
+def _run_loadgen_fleet(out: Path, name: str, n_workers: int,
+                       kill_after=None, timeout_s: float = 900.0) -> tuple:
+    """One `loadgen --fleet` subprocess; returns (rc, summary, answers,
+    router_run_dir)."""
+    run_dir = out / f"obs_{name}"
+    answers_path = out / f"{name}_answers.json"
+    argv = [
+        sys.executable, "-m", "sbr_tpu.serve.loadgen",
+        "--fleet", str(n_workers),
+        "--queries", str(_FLEET["queries"]),
+        "--pool", str(_FLEET["pool"]),
+        "--group", str(_FLEET["group"]),
+        "--n-grid", str(_FLEET["n_grid"]),
+        "--bisect-iters", str(_FLEET["bisect_iters"]),
+        "--seed", "0",
+        "--run-dir", str(run_dir),
+        "--answers-out", str(answers_path),
+    ]
+    if kill_after is not None:
+        argv += ["--fleet-kill-after", str(kill_after)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for k in ("SBR_FAULT_PLAN", "SBR_SERVE_DEADLINE_MS", "SBR_FLEET_DIR",
+              "SBR_SERVE_CACHE_DIR", "SBR_TILE_CACHE_DIR"):
+        env.pop(k, None)
+    proc = subprocess.run(argv, env=env, timeout=timeout_s,
+                          capture_output=True, text=True)
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    try:
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        summary = {}
+    try:
+        answers = json.loads(answers_path.read_text())
+    except (OSError, ValueError):
+        answers = None
+    return proc.returncode, summary, answers, run_dir
+
+
+def _answers_identical(a, b) -> bool:
+    """Byte-identity of two answer lists: JSON floats round-trip Python's
+    shortest repr exactly, so == on the parsed values IS bit equality of
+    the served doubles (None encodes NaN on both sides)."""
+    if not isinstance(a, list) or not isinstance(b, list) or len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if not isinstance(x, dict) or not isinstance(y, dict):
+            return False
+        if x.get("degraded") or y.get("degraded") or "shed" in x or "shed" in y:
+            return False  # the fault-free contract: full-fidelity answers
+        if any(x.get(f) != y.get(f) for f in _ANSWER_FIELDS):
+            return False
+    return True
+
+
+def main_fleet(out: Path, as_json: bool) -> int:
+    """The serving-fleet chaos smoke: kill one of three workers
+    mid-loadgen — zero lost queries, byte-identical answers, visible
+    failover + breaker events, `report fleet` exit 0 (module docstring)."""
+    checks: dict = {}
+
+    def log(msg):
+        if not as_json:
+            print(msg)
+
+    log("phase 1/2: fault-free single-worker fleet (ground-truth answers) …")
+    rc1, sum1, ans1, run1 = _run_loadgen_fleet(out, "fleet_solo", 1)
+    checks["solo_rc0"] = rc1 == 0
+    checks["solo_zero_lost"] = sum1.get("fleet_lost", 1) == 0
+    rc_f1, doc1 = _report("fleet", run1)
+    checks["solo_report_fleet_rc0"] = rc_f1 == 0
+
+    log("phase 2/2: three workers, one SIGKILLed after "
+        f"{_FLEET['kill_after']} of {_FLEET['queries']} queries …")
+    rc2, sum2, ans2, run2 = _run_loadgen_fleet(
+        out, "fleet_churn", 3, kill_after=_FLEET["kill_after"]
+    )
+    checks["churn_rc0"] = rc2 == 0
+    checks["churn_zero_lost"] = sum2.get("fleet_lost", 1) == 0
+    checks["churn_worker_killed"] = bool(sum2.get("killed_worker"))
+    checks["churn_zero_shed"] = sum2.get("fleet_shed_rate", 1) == 0
+    # The failover is the recovery path: it must have actually fired, and
+    # the dead worker's breaker must have opened (sick workers stop
+    # absorbing traffic) — all visible via report fleet, not logs.
+    rc_f2, doc2 = _report("fleet", run2)
+    checks["churn_report_fleet_rc0"] = rc_f2 == 0
+    checks["churn_failover_visible"] = doc2.get("failover_count", 0) >= 1
+    checks["churn_breaker_visible"] = (doc2.get("events") or {}).get(
+        "breaker_open", 0
+    ) >= 1
+    checks["churn_workers_joined"] = (doc2.get("events") or {}).get(
+        "worker_join", 0
+    ) == 3
+    # The headline: every answer the degraded fleet served is byte-
+    # identical to the fault-free single-worker ground truth.
+    checks["answers_bit_identical"] = _answers_identical(ans1, ans2)
+
+    ok = all(checks.values())
+    if as_json:
+        print(json.dumps({"ok": ok, "checks": checks, "out": str(out)}))
+    else:
+        for name, passed in checks.items():
+            print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+        print(
+            "fleet smoke: "
+            + ("OK — one of three workers died mid-loadgen, zero queries "
+               "lost, answers byte-identical" if ok else "FAILED")
+            + f" ({out})"
+        )
+        print(f"fleet story: python -m sbr_tpu.obs.report fleet {run2}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.resilience.chaos",
@@ -297,6 +429,13 @@ def main(argv=None) -> int:
         help="run the ELASTIC churn smoke instead: preempt one host "
         "mid-sweep, late-join a replacement, warm-cache re-sweep — "
         "bit-identical grids, zero warm recomputes (ISSUE 8)",
+    )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="run the SERVING-FLEET smoke instead: SIGKILL one of three "
+        "serve workers mid-loadgen — zero lost queries, answers "
+        "byte-identical to a fault-free single-worker run, failover + "
+        "breaker events visible via report fleet (ISSUE 11)",
     )
     parser.add_argument("--worker", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
     parser.add_argument("--worker-elastic", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
@@ -314,6 +453,8 @@ def main(argv=None) -> int:
 
     if args.churn:
         return main_churn(out, args.json)
+    if args.fleet:
+        return main_fleet(out, args.json)
 
     checks: dict = {}
 

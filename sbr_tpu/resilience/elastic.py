@@ -361,10 +361,73 @@ def _append_rate_history(rate: Optional[float], tiles_computed: int) -> None:
 # ---------------------------------------------------------------------------
 
 
+def cell_tag(params, config, dtype_name: str) -> str:
+    """Canonical tag of everything that — together with (β, u) — determines
+    one sweep cell's bytes: the non-swept economics/learning scalars, the
+    solver config, the resolved dtype, the x64 flag, the grid-program
+    version, and the params type name. The serving fleet's degradation
+    ladder (`sbr_tpu.serve.fleet.TileCacheBridge`) matches a point query
+    to a swept tile exactly when their tags agree — this ONE function is
+    both sides of that contract, so they cannot drift."""
+    from sbr_tpu.utils.checkpoint import canonicalize
+
+    x64 = None
+    try:
+        import jax
+
+        x64 = bool(jax.config.jax_enable_x64)
+    except Exception:
+        pass
+    version = 0
+    try:
+        from sbr_tpu.sweeps.baseline_sweeps import GRID_PROGRAM_VERSION
+
+        version = int(GRID_PROGRAM_VERSION)
+    except Exception:
+        pass
+    e, l = params.economic, params.learning
+    return canonicalize(
+        (
+            type(params).__name__,
+            float(e.p), float(e.kappa), float(e.lam), float(e.eta),
+            float(l.tspan[0]), float(l.tspan[1]), float(l.x0),
+            config, str(dtype_name), x64, version,
+        )
+    )
+
+
+def tile_meta(base, config, dtype, tile_betas, tile_us, key: str) -> dict:
+    """The ``<key>.meta.json`` document a tile store leaves beside its
+    entry: the cell tag plus the tile's actual β/u axes — what turns a
+    content-addressed whole tile into per-cell addressable answers for
+    the serving fleet's degradation ladder. ``dtype`` is resolved to the
+    concrete default exactly as the sweep entry points resolve it, so a
+    ``dtype=None`` sweep and a serve engine that resolved f64 agree."""
+    dtype_name = str(dtype)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if dtype is None:
+            dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        dtype_name = jax.dtypes.canonicalize_dtype(np.dtype(dtype)).name
+    except Exception:
+        if dtype is None:
+            dtype_name = "None"
+    return {
+        "key": key,
+        "cell_tag": cell_tag(base, config, dtype_name),
+        "betas": [float(b) for b in np.asarray(tile_betas).ravel()],
+        "us": [float(u) for u in np.asarray(tile_us).ravel()],
+    }
+
+
 class TileCache:
     """Content-addressed cross-run tile store (see module docstring).
 
-    Layout: ``<root>/<key[:2]>/<key>.npz`` + ``.sha256`` sidecar; writes
+    Layout: ``<root>/<key[:2]>/<key>.npz`` + ``.sha256`` sidecar (and,
+    when the store supplies one, a ``<key>.meta.json`` cell-index sidecar
+    for the serving fleet's degradation ladder); writes
     are atomic (tmp + rename, losing a race to a peer writing the SAME key
     is fine — identical content by construction); reads verify the sidecar
     and QUARANTINE mismatches (``<root>/<key[:2]>/quarantine/``) rather
@@ -456,7 +519,8 @@ class TileCache:
         _log_cache("hit", tile=tile, key=key[:12])
         return arrays
 
-    def store(self, key: str, arrays: dict, tile: str = "?") -> Optional[Path]:
+    def store(self, key: str, arrays: dict, tile: str = "?",
+              meta: Optional[dict] = None) -> Optional[Path]:
         from sbr_tpu.resilience import heal, shutdown
 
         path = self.path(key)
@@ -485,6 +549,20 @@ class TileCache:
                 raise
         except OSError:
             return None  # a read-only/full cache volume must not sink the sweep
+        if meta is not None:
+            # Cell-index sidecar (ISSUE 11): best-effort and AFTER the entry
+            # rename — a missing/torn meta file only makes the entry
+            # invisible to the serving bridge, never wrong (the bridge
+            # re-verifies the entry itself through `load`). Atomic like
+            # everything else beside it.
+            try:
+                meta_path = Path(str(path)[: -len(".npz")] + ".meta.json")
+                fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+                with os.fdopen(fd, "w") as fh:
+                    fh.write(json.dumps(meta))
+                os.replace(tmp, meta_path)
+            except OSError:
+                pass
         _log_cache("store", tile=tile, key=key[:12])
         return path
 
@@ -524,12 +602,15 @@ def gc_tile_cache(root, keep_days: float = 30.0, now: Optional[float] = None) ->
             removed.append(entry)
         except OSError:
             continue
-        side = Path(str(entry) + ".sha256")
-        try:
-            side.unlink()
-            removed.append(side)
-        except OSError:
-            pass
+        for side in (
+            Path(str(entry) + ".sha256"),
+            Path(str(entry)[: -len(".npz")] + ".meta.json"),
+        ):
+            try:
+                side.unlink()
+                removed.append(side)
+            except OSError:
+                pass
     for tmp in sorted(root.rglob("*.tmp")):
         try:
             # An hour of grace covers any live writer (stores take <1 s);
@@ -549,6 +630,18 @@ def gc_tile_cache(root, keep_days: float = 30.0, now: Optional[float] = None) ->
             ):
                 side.unlink()
                 removed.append(side)
+        except OSError:
+            continue
+    # Orphan cell-index metas (entry pruned by an older gc, or a writer
+    # died between the entry rename and the meta write's replace).
+    for meta in sorted(root.rglob("*.meta.json")):
+        try:
+            if (
+                not Path(str(meta)[: -len(".meta.json")] + ".npz").exists()
+                and now - meta.stat().st_mtime >= 3600.0
+            ):
+                meta.unlink()
+                removed.append(meta)
         except OSError:
             continue
     return removed

@@ -23,6 +23,14 @@ point                  planted in
 ``barrier.poll``       `parallel.distributed` filesystem barrier and the
                        elastic scheduler's claim loop, per poll
 ``bench.probe``        `bench.py`'s accelerator probe, per attempt
+``serve.dispatch``     `serve.engine.Engine._dispatch`, inside the retried
+                       scope of every micro-batch device dispatch (drives
+                       the breaker + degradation ladder)
+``router.forward``     `serve.router.Router._forward`, per forward attempt
+                       to a fleet worker (drives failover/hedging)
+``fleet.heartbeat``    `serve.fleet.WorkerAnnouncer.beat`, per announcement
+                       (a fired transient silences the beat — the worker
+                       ages out via the TTL like a silent death)
 =====================  ====================================================
 
 Fault kinds:

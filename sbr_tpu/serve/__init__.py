@@ -12,16 +12,48 @@ service" serving item).
   batch-occupancy counters, per-window divergent cells) snapshotted to a
   rolling ``live.json`` in the run dir.
 - ``serve.endpoint`` — `ServeEndpoint`: stdlib HTTP ``/metrics``
-  (Prometheus), ``/healthz`` (ready/degraded/unhealthy), ``/statz``.
+  (Prometheus), ``/healthz`` (ready/degraded/unhealthy), ``/statz``, and
+  the ``/query`` route (JSON params in, labeled equilibrium out; 429 +
+  ``Retry-After`` on admission shed).
+- ``serve.fleet``    — fleet membership (elastic heartbeats in a shared
+  ``SBR_FLEET_DIR``), the `CircuitBreaker` state machine, the
+  `TileCacheBridge` degradation-ladder rung, and the worker process
+  entry (``python -m sbr_tpu.serve.fleet``) with graceful SIGTERM drain.
+- ``serve.router``   — `Router`: throughput-weighted routing over the
+  live workers with failover, hedged retries, deadline propagation, and
+  per-worker circuit breakers (``python -m sbr_tpu.serve.router``).
 - ``serve.loadgen``  — ``python -m sbr_tpu.serve.loadgen``: seeded
-  deterministic query mix for CI and bench.
+  deterministic query mix for CI and bench; ``--fleet N`` drives a
+  multi-process worker fleet through an in-process router (the SLO bench
+  and the chaos fleet smoke ride it).
 
 Gate a (running or finished) server with
-``python -m sbr_tpu.obs.report serve RUN_DIR [--json]``.
+``python -m sbr_tpu.obs.report serve RUN_DIR [--json]`` and a router run
+with ``python -m sbr_tpu.obs.report fleet RUN_DIR [--json]`` (exit 1 on
+lost queries or a breaker stuck open).
 """
 
 from sbr_tpu.serve.endpoint import ServeEndpoint
-from sbr_tpu.serve.engine import Engine, QueryResult, ServeConfig
+from sbr_tpu.serve.engine import (
+    DeadlineExceeded,
+    Engine,
+    QueryResult,
+    ServeConfig,
+    SolverUnavailable,
+)
+from sbr_tpu.serve.fleet import CircuitBreaker, TileCacheBridge
 from sbr_tpu.serve.live import LiveMetrics
+from sbr_tpu.serve.router import Router
 
-__all__ = ["Engine", "LiveMetrics", "QueryResult", "ServeConfig", "ServeEndpoint"]
+__all__ = [
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "Engine",
+    "LiveMetrics",
+    "QueryResult",
+    "Router",
+    "ServeConfig",
+    "ServeEndpoint",
+    "SolverUnavailable",
+    "TileCacheBridge",
+]

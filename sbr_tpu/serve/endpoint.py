@@ -1,7 +1,8 @@
-"""HTTP exposition for the serving engine (ISSUE 7 tentpole, part 3).
+"""HTTP exposition + the query route for the serving engine (ISSUE 7
+tentpole, part 3; ISSUE 11 makes workers routable).
 
-A stdlib-only (``http.server``) thread serving three read-only routes off
-an `Engine`:
+A stdlib-only (``http.server``) thread serving four routes off an
+`Engine`:
 
 - ``/metrics``  — Prometheus text exposition 0.0.4: lifetime counters,
   rolling-window gauges (p50/p95/p99, hit rate, occupancy, divergent
@@ -14,17 +15,56 @@ an `Engine`:
   a dumb load-balancer probe needs no JSON parsing.
 - ``/statz``    — the full JSON live snapshot (same document as the
   rolling ``live.json``).
+- ``/query``    — POST a JSON parameter document (``make_model_params``
+  keywords, e.g. ``{"beta": 1.2, "u": 0.3}``, plus optional ``scenario``)
+  and get one served equilibrium back, ``degraded``/``source`` labeled.
+  The deadline rides the ``X-SBR-Deadline-Ms`` header (remaining ms —
+  what the fleet router propagates); a query shed at admission gets an
+  explicit ``429`` with a ``Retry-After`` header (the engine's measured
+  service-time estimate), never a silently growing queue. Solver outage
+  with an empty degradation ladder is a ``503``; malformed parameter
+  documents are ``400``.
 
-No jax import, no engine mutation: handlers only read. ``port=0`` binds
-an ephemeral port (tests, parallel CI); the bound port is `.port`.
+Only ``/query`` mutates engine state (it serves traffic); the other three
+only read. ``port=0`` binds an ephemeral port (tests, parallel CI); the
+bound port is `.port`.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# The make_model_params keywords a /query document may carry (everything
+# else is 400 — a typo like "bta" must not silently serve defaults).
+_PARAM_KEYS = ("beta", "eta", "eta_bar", "u", "p", "kappa", "lam", "tspan", "x0")
+
+
+def _json_safe(value):
+    """JSON floats can't carry NaN/Inf (degraded answers have NaN
+    tau_bar_in); encode them as None, the cross-language convention."""
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    return value
+
+
+def query_result_doc(result) -> dict:
+    """The wire form of one `QueryResult` (shared with the router)."""
+    return {
+        "xi": _json_safe(result.xi),
+        "tau_bar_in": _json_safe(result.tau_bar_in),
+        "aw_max": _json_safe(result.aw_max),
+        "status": int(result.status),
+        "flags": int(result.flags),
+        "residual": _json_safe(result.residual),
+        "source": result.source,
+        "degraded": bool(result.degraded),
+        "scenario": result.scenario,
+        "latency_ms": round(result.latency_s * 1e3, 3),
+    }
 
 
 class ServeEndpoint:
@@ -44,6 +84,101 @@ class ServeEndpoint:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path != "/query":
+                        self._send(404, b'{"error": "not found"}', "application/json")
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length") or 0)
+                        doc = json.loads(self.rfile.read(n).decode() or "{}")
+                        if not isinstance(doc, dict):
+                            raise ValueError("query body must be a JSON object")
+                    except (ValueError, UnicodeDecodeError) as err:
+                        self._send(
+                            400, json.dumps({"error": f"bad query body: {err}"}).encode(),
+                            "application/json",
+                        )
+                        return
+                    deadline_ms = None
+                    raw = self.headers.get("X-SBR-Deadline-Ms")
+                    try:
+                        if raw is not None:
+                            deadline_ms = float(raw)
+                        elif doc.get("deadline_ms") is not None:
+                            deadline_ms = float(doc["deadline_ms"])
+                    except (TypeError, ValueError):
+                        self._send(400, b'{"error": "bad deadline"}', "application/json")
+                        return
+                    scenario = str(doc.get("scenario", "default"))
+                    unknown = (
+                        set(doc) - set(_PARAM_KEYS) - {"scenario", "deadline_ms"}
+                    )
+                    if unknown:
+                        self._send(
+                            400,
+                            json.dumps(
+                                {"error": f"unknown parameter(s): {sorted(unknown)}"}
+                            ).encode(),
+                            "application/json",
+                        )
+                        return
+                    from sbr_tpu.models.params import make_model_params
+                    from sbr_tpu.serve.engine import DeadlineExceeded
+
+                    try:
+                        kw = {k: doc[k] for k in _PARAM_KEYS if k in doc}
+                        if "tspan" in kw:
+                            kw["tspan"] = tuple(float(v) for v in kw["tspan"])
+                        params = make_model_params(**kw)
+                    except (TypeError, ValueError) as err:
+                        self._send(
+                            400, json.dumps({"error": f"bad parameters: {err}"}).encode(),
+                            "application/json",
+                        )
+                        return
+                    try:
+                        result = endpoint.engine.query(
+                            params, scenario=scenario, deadline_ms=deadline_ms
+                        )
+                    except DeadlineExceeded as err:
+                        body = json.dumps(
+                            {"error": "deadline", "detail": str(err),
+                             "retry_after_s": err.retry_after_s}
+                        ).encode()
+                        self.send_response(429)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Retry-After", f"{err.retry_after_s:g}")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    except Exception as err:
+                        # Solver down AND the degradation ladder empty: an
+                        # honest 503 the router can fail over on.
+                        self._send(
+                            503,
+                            json.dumps({"error": "dispatch failed",
+                                        "detail": repr(err)}).encode(),
+                            "application/json",
+                        )
+                        return
+                    self._send(
+                        200, json.dumps(query_result_doc(result)).encode(),
+                        "application/json",
+                    )
+                except BrokenPipeError:
+                    pass
+                except Exception as err:  # the route must never kill serving
+                    try:
+                        self._send(
+                            500, json.dumps({"error": repr(err)}).encode(),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass
 
             def do_GET(self):
                 try:

@@ -35,6 +35,17 @@ observability as its load-bearing spine (`serve.live`, `serve.endpoint`):
   (``SBR_SERVE_RETRY_BUDGET``); `/healthz` folds the budget state and the
   per-window divergent-cell counts (from the solver's `Health` pytree)
   into ready/degraded/unhealthy.
+- **Deadlines & degradation (ISSUE 11)**: every query may carry a
+  deadline (``SBR_SERVE_DEADLINE_MS`` default); admission SHEDS queries
+  that cannot meet it (`DeadlineExceeded` → HTTP 429 + ``Retry-After``)
+  against the measured dispatch-time EWMA — never silent queue growth —
+  while a deadline expiring mid-batch still returns (the batch is paid
+  for). A dispatch circuit breaker (``SBR_BREAKER_*``) opens on
+  consecutive failures; while the solver path is down, batches climb the
+  degradation ladder: exact LRU/disk hit → the PR 7 global tile cache
+  (`serve.fleet.TileCacheBridge`, answers labeled ``degraded``) → error.
+  The on-disk result cache carries sha256 sidecars and is verified on
+  read (mismatches quarantined + recomputed, `resilience.heal`).
 
 The pickle-based executable cache trusts its cache directory (same trust
 model as the tile checkpoints beside it) — point ``SBR_SERVE_CACHE_DIR``
@@ -61,6 +72,7 @@ import numpy as np
 from sbr_tpu.diag.health import DIVERGENT_MASK
 from sbr_tpu.models.params import ModelParams, SolverConfig
 from sbr_tpu.resilience import retry
+from sbr_tpu.serve.fleet import CircuitBreaker, TileCacheBridge, default_deadline_ms
 from sbr_tpu.serve.live import LiveMetrics
 from sbr_tpu.utils.checkpoint import canonicalize, params_fingerprint
 
@@ -69,6 +81,25 @@ from sbr_tpu.utils.checkpoint import canonicalize, params_fingerprint
 _PROGRAM_VERSION = 1
 
 _SHUTDOWN = object()
+
+
+class DeadlineExceeded(RuntimeError):
+    """Query shed at admission: its deadline has already passed, or the
+    engine's measured service time says it cannot be met. Maps to HTTP 429
+    + ``Retry-After`` at the endpoint (ISSUE 11 backpressure — an explicit
+    rejection, never silent queue growth). ``retry_after_s`` is the
+    engine's service-time estimate — when the caller comes back with at
+    least that much deadline, admission will accept."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.1) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class SolverUnavailable(RuntimeError):
+    """The dispatch circuit breaker is open: the solver path is presumed
+    down and batches short-circuit to the degradation ladder instead of
+    burning the retry budget per batch."""
 
 
 def default_buckets() -> Tuple[int, ...]:
@@ -143,7 +174,11 @@ class ServeConfig:
 
 @dataclasses.dataclass(frozen=True)
 class QueryResult:
-    """One served equilibrium: the lean per-cell outputs plus provenance."""
+    """One served equilibrium: the lean per-cell outputs plus provenance.
+    ``degraded`` marks a degradation-ladder answer (source "tilecache"):
+    the numbers came from a swept tile in the global cache while the
+    solver path was unavailable — ``tau_bar_in``/``residual`` are NaN
+    there (tiles don't store them)."""
 
     xi: float
     tau_bar_in: float
@@ -151,9 +186,10 @@ class QueryResult:
     status: int
     flags: int
     residual: float
-    source: str  # "lru" | "disk" | "coalesced" | "computed"
+    source: str  # "lru" | "disk" | "coalesced" | "computed" | "tilecache"
     scenario: str
     latency_s: float
+    degraded: bool = False
 
     @property
     def divergent(self) -> bool:
@@ -161,13 +197,22 @@ class QueryResult:
 
 
 class _Ticket:
-    __slots__ = ("params", "scenario", "key", "t0", "event", "result", "error")
+    __slots__ = ("params", "scenario", "key", "t0", "deadline", "event",
+                 "result", "error")
 
-    def __init__(self, params: ModelParams, scenario: str, key: str) -> None:
+    def __init__(self, params: ModelParams, scenario: str, key: str,
+                 deadline: Optional[float] = None) -> None:
         self.params = params
         self.scenario = scenario
         self.key = key
         self.t0 = time.monotonic()
+        # Absolute monotonic deadline, or None. Admission already shed the
+        # unmeetable; a ticket whose deadline expires while still QUEUED
+        # is shed at batch formation (no dispatch burned); one whose
+        # deadline expires once its batch is DISPATCHED is not cancelled —
+        # that compute is already paid for, the caller still gets its
+        # answer (tested deadline semantics).
+        self.deadline = deadline
         self.event = threading.Event()
         self.result: Optional[QueryResult] = None
         self.error: Optional[BaseException] = None
@@ -296,6 +341,22 @@ class Engine:
             self._budget_total, refill_s=self._budget_refill_s or None
         )
 
+        # Dispatch circuit breaker (ISSUE 11): consecutive dispatch
+        # failures open it; while open, batches short-circuit straight to
+        # the degradation ladder (no device attempt, no retry-budget burn)
+        # until the cooldown admits one half-open probe. Transitions land
+        # as obs `fleet` events and /healthz degraded reasons.
+        self.breaker = CircuitBreaker(on_transition=self._on_breaker)
+        # Degradation-ladder rung 2: the PR 7 global tile cache, indexed
+        # per-cell via the store-side meta sidecars (serving↔sweep bridge).
+        self.bridge = TileCacheBridge()
+        # Per-query deadline default (SBR_SERVE_DEADLINE_MS) and the
+        # admission-control service-time estimate: an EWMA of measured
+        # dispatch durations — a deadline shorter than the typical service
+        # time cannot be met and is shed at admission (429), never queued.
+        self.default_deadline_ms = default_deadline_ms()
+        self._service_ewma_s: Optional[float] = None
+
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -336,7 +397,8 @@ class Engine:
                 if t is not _SHUTDOWN:
                     t.error = RuntimeError("engine closed before the query was served")
                     t.event.set()
-        self.live.maybe_write(self._run, self._live_extra(), force=True)
+        w = self.live.window()
+        self.live.maybe_write(self._run, self._live_extra(window=w), window=w, force=True)
         if self._run is not None:
             try:
                 self._run.event("serve_summary", **self.live.snapshot())
@@ -353,12 +415,71 @@ class Engine:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- deadlines / admission ----------------------------------------------
+    def _on_breaker(self, old: str, new: str) -> None:
+        """Breaker transition observer: obs `fleet` event + stderr note."""
+        if self._run is not None:
+            try:
+                self._run.log_fleet(
+                    f"breaker_{new}", scope="serve.dispatch", previous=old,
+                    failures=self.breaker.consecutive_failures,
+                )
+            except Exception:
+                pass
+
+    def service_estimate_s(self) -> Optional[float]:
+        """EWMA of measured dispatch durations (None before any dispatch)."""
+        return self._service_ewma_s
+
+    def _admit(self, deadline_ms: Optional[float]) -> Optional[float]:
+        """Admission control: resolve the query's deadline (explicit, else
+        ``SBR_SERVE_DEADLINE_MS``, else none) and SHED — an explicit
+        `DeadlineExceeded`, zero solver work — when it has already expired
+        or is shorter than the measured service time. Returns the absolute
+        monotonic deadline (None = no deadline)."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if deadline_ms is None:
+            return None
+        est = self._service_ewma_s
+        retry_after = round(max(est or 0.05, 0.05), 3)
+        if deadline_ms <= 0:
+            self.live.record_shed()
+            if self._run is not None:
+                try:
+                    self._run.log_fleet("shed", reason="expired",
+                                        deadline_ms=float(deadline_ms))
+                except Exception:
+                    pass
+            raise DeadlineExceeded(
+                f"deadline already expired ({deadline_ms:g} ms)",
+                retry_after_s=retry_after,
+            )
+        if est is not None and deadline_ms / 1e3 < est:
+            self.live.record_shed()
+            if self._run is not None:
+                try:
+                    self._run.log_fleet("shed", reason="unmeetable",
+                                        deadline_ms=float(deadline_ms),
+                                        service_est_s=round(est, 4))
+                except Exception:
+                    pass
+            raise DeadlineExceeded(
+                f"deadline {deadline_ms:g} ms under the measured service "
+                f"time ({est * 1e3:.1f} ms)",
+                retry_after_s=retry_after,
+            )
+        return time.monotonic() + deadline_ms / 1e3
+
     # -- public query API ---------------------------------------------------
-    def submit(self, params: ModelParams, scenario: str = "default") -> _Ticket:
+    def submit(self, params: ModelParams, scenario: str = "default",
+               deadline_ms: Optional[float] = None) -> _Ticket:
         """Enqueue one query for the micro-batcher (requires `start()`).
         Raises once the engine is closed — a ticket enqueued after the
-        batcher drained would block its waiter forever."""
-        ticket = _Ticket(params, scenario, self._result_key(params))
+        batcher drained would block its waiter forever — and sheds
+        (`DeadlineExceeded`) when the deadline cannot be met."""
+        deadline = self._admit(deadline_ms)
+        ticket = _Ticket(params, scenario, self._result_key(params), deadline)
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("engine is closed")
@@ -368,25 +489,29 @@ class Engine:
 
     def query(
         self, params: ModelParams, scenario: str = "default",
-        timeout: Optional[float] = None,
+        timeout: Optional[float] = None, deadline_ms: Optional[float] = None,
     ) -> QueryResult:
         """Synchronous single query. Batched with concurrent submitters
         when the engine is started; solved inline otherwise."""
         if self._thread is None:
-            return self.query_many([params], scenario=scenario)[0]
-        return self.submit(params, scenario).wait(timeout)
+            return self.query_many(
+                [params], scenario=scenario, deadline_ms=deadline_ms
+            )[0]
+        return self.submit(params, scenario, deadline_ms=deadline_ms).wait(timeout)
 
     def query_many(
         self, params_list: List[ModelParams], scenario: str = "default",
-        timeout: Optional[float] = None,
+        timeout: Optional[float] = None, deadline_ms: Optional[float] = None,
     ) -> List[QueryResult]:
         """Solve a list of queries. Started engine: all enqueue at once (the
         natural micro-batch). Unstarted: processed inline in this thread —
         the deterministic, thread-free path."""
         if self._closed:
             raise RuntimeError("engine is closed")
+        deadline = self._admit(deadline_ms)
         tickets = [
-            _Ticket(p, scenario, self._result_key(p)) for p in params_list
+            _Ticket(p, scenario, self._result_key(p), deadline)
+            for p in params_list
         ]
         if self._thread is None:
             self._process(tickets)
@@ -400,15 +525,21 @@ class Engine:
         return [t.wait(timeout) for t in tickets]
 
     # -- health / exposition -------------------------------------------------
-    def healthz(self) -> dict:
+    def healthz(self, window: Optional[dict] = None) -> dict:
         """Ready/degraded/unhealthy verdict with reasons — `/healthz` body.
 
         unhealthy: the batcher thread died, or the shared retry budget is
         exhausted (every future transient failure will fail fast until the
         next refill — see ``SBR_SERVE_RETRY_REFILL_S``).
-        degraded: divergent cells or dispatch errors in the current window,
-        a partially consumed retry budget (since the last refill), or a
+        degraded: divergent cells, dispatch errors, sheds, or ladder
+        answers in the current window, a partially consumed retry budget
+        (since the last refill), an open/half-open dispatch breaker, or a
         p99 over ``SBR_SERVE_SLO_MS``.
+
+        ``window`` (a prior `LiveMetrics.window()` result) lets a caller
+        building a larger document share ONE fold between the verdict and
+        the window it embeds — the scrape-coherence contract
+        (`serve.live._window_fold`).
         """
         self._maybe_refill_budget()
         reasons = []
@@ -420,13 +551,28 @@ class Engine:
             status = "unhealthy"
             reasons.append("retry budget exhausted")
         if status != "unhealthy":
-            window = self.live.window()
+            if window is None:
+                window = self.live.window()
             if window.get("divergent_cells", 0):
                 status = "degraded"
                 reasons.append(f"{int(window['divergent_cells'])} divergent cell(s) in window")
             if window.get("errors", 0):
                 status = "degraded"
                 reasons.append(f"{int(window['errors'])} dispatch error(s) in window")
+            if window.get("shed", 0):
+                status = "degraded"
+                reasons.append(f"{int(window['shed'])} shed quer(ies) in window")
+            if window.get("degraded", 0):
+                status = "degraded"
+                reasons.append(
+                    f"{int(window['degraded'])} degraded-ladder answer(s) in window"
+                )
+            if self.breaker.state != "closed":
+                status = "degraded"
+                reasons.append(
+                    f"dispatch breaker {self.breaker.state} "
+                    f"({self.breaker.consecutive_failures} consecutive failure(s))"
+                )
             if self.retry_budget.used > 0:
                 status = "degraded"
                 reasons.append(
@@ -446,8 +592,12 @@ class Engine:
         self.retry_budget.maybe_refill()
 
     def statz(self) -> dict:
-        """Full live snapshot — `/statz` body and the `live.json` document."""
-        return self.live.snapshot(self._live_extra())
+        """Full live snapshot — `/statz` body and the `live.json` document.
+        The embedded window AND the healthz verdict derive from ONE fold of
+        the slot ring (the scrape-coherence satellite): a scrape racing a
+        window rotation can never mix two windows in one document."""
+        window = self.live.window()
+        return self.live.snapshot(self._live_extra(window=window), window=window)
 
     def prometheus(self) -> str:
         extra = {
@@ -458,15 +608,27 @@ class Engine:
         }
         return self.live.to_prometheus(extra)
 
-    def _live_extra(self) -> dict:
+    def _live_extra(self, window: Optional[dict] = None) -> dict:
         return {
-            "healthz": self.healthz(),
+            "healthz": self.healthz(window=window),
             "retry_budget": {
                 "total": self.retry_budget.total,
                 "used": self.retry_budget.used,
                 "remaining": self.retry_budget.remaining,
             },
             "slo": {"slo_ms": slo_ms()},
+            "breaker": {
+                "state": self.breaker.state,
+                "consecutive_failures": self.breaker.consecutive_failures,
+            },
+            "deadline": {
+                "default_ms": self.default_deadline_ms,
+                "service_est_s": (
+                    round(self._service_ewma_s, 6)
+                    if self._service_ewma_s is not None
+                    else None
+                ),
+            },
             "engine": {
                 "buckets": list(self.serve.buckets),
                 "dtype": self.dtype.name,
@@ -490,7 +652,8 @@ class Engine:
                 # fold) 20×/s on an idle server just to hit the write
                 # throttle would be pure waste.
                 if self._run is not None and self.live.write_due():
-                    self.live.maybe_write(self._run, self._live_extra())
+                    w = self.live.window()
+                    self.live.maybe_write(self._run, self._live_extra(window=w), window=w)
                 continue
             batch, shutdown = [], item is _SHUTDOWN
             if not shutdown:
@@ -523,7 +686,8 @@ class Engine:
                 finally:
                     self.live.inflight = 0
                 if self._run is not None and self.live.write_due():
-                    self.live.maybe_write(self._run, self._live_extra())
+                    w = self.live.window()
+                    self.live.maybe_write(self._run, self._live_extra(window=w), window=w)
             if shutdown:
                 break
 
@@ -539,7 +703,28 @@ class Engine:
         for t in tickets:
             rec, source = self._lookup(t.key)
             if rec is not None:
+                # A cache hit is free: serve it even past its deadline (a
+                # late answer beats a late rejection at zero device cost).
                 self._fulfill(t, rec, source)
+            elif t.deadline is not None and time.monotonic() > t.deadline:
+                # Expired while QUEUED: the batch has not started, so shed
+                # now instead of burning a full dispatch on a dead query —
+                # admission's estimate cannot see queue wait, this check
+                # can. (A deadline expiring once the batch IS dispatched
+                # still returns: that compute is already paid for.)
+                self.live.record_shed()
+                if self._run is not None:
+                    try:
+                        self._run.log_fleet("shed", reason="queue-expired",
+                                            scenario=t.scenario)
+                    except Exception:
+                        pass
+                est = self._service_ewma_s
+                t.error = DeadlineExceeded(
+                    "deadline expired while queued",
+                    retry_after_s=round(max(est or 0.05, 0.05), 3),
+                )
+                t.event.set()
             else:
                 groups.setdefault(t.key, []).append(t)
         unique = [g[0] for g in groups.values()]
@@ -549,11 +734,23 @@ class Engine:
             try:
                 records = self._dispatch([t.params for t in chunk])
             except BaseException as err:
+                # Degradation ladder (ISSUE 11): the solver path is down
+                # (breaker open, retry budget exhausted, fault-injected).
+                # The exact LRU/disk rung already missed above, so the next
+                # rung is the PR 7 global tile cache — answer from a swept
+                # tile when one mathematically matches, labeled
+                # ``degraded``; only then fail the ticket (the endpoint's
+                # 503). Degraded answers are never cached: the moment the
+                # solver recovers, fresh dispatches must take over.
                 for t in chunk:
+                    rec = self._degraded_rec(t)
                     for dup in groups[t.key]:
-                        self.live.record_error()
-                        dup.error = err
-                        dup.event.set()
+                        if rec is not None:
+                            self._fulfill(dup, dict(rec), "tilecache", degraded=True)
+                        else:
+                            self.live.record_error()
+                            dup.error = err
+                            dup.event.set()
                 continue
             for t, rec in zip(chunk, records):
                 # A divergent result (DIVERGENT_MASK flag) is served — the
@@ -569,10 +766,31 @@ class Engine:
                 for j, dup in enumerate(groups[t.key]):
                     self._fulfill(dup, rec, "computed" if j == 0 else "coalesced")
 
-    def _fulfill(self, t: _Ticket, rec: dict, source: str) -> None:
+    def _degraded_rec(self, t: _Ticket) -> Optional[dict]:
+        """The tile-cache rung of the degradation ladder for one ticket
+        (None when the bridge has no mathematically-matching cell). Every
+        outcome is an obs ``fleet`` event — the ladder must be observable
+        end-to-end (`report fleet`, the manifest ``fleet`` block)."""
+        try:
+            rec = self.bridge.lookup(t.params, self.config, self.dtype.name)
+        except Exception:
+            rec = None  # a broken bridge must never mask the real error
+        if self._run is not None:
+            try:
+                self._run.log_fleet(
+                    "degraded" if rec is not None else "ladder_exhausted",
+                    scenario=t.scenario, key=t.key[:12],
+                )
+            except Exception:
+                pass
+        return rec
+
+    def _fulfill(self, t: _Ticket, rec: dict, source: str,
+                 degraded: bool = False) -> None:
         latency = time.monotonic() - t.t0
         t.result = QueryResult(
-            source=source, scenario=t.scenario, latency_s=latency, **rec
+            source=source, scenario=t.scenario, latency_s=latency,
+            degraded=degraded, **rec
         )
         self.live.record_query(
             latency, source, scenario=t.scenario, divergent=t.result.divergent
@@ -587,10 +805,20 @@ class Engine:
 
     def _dispatch(self, params_list: List[ModelParams]) -> List[dict]:
         """One padded vmapped dispatch under the retry policy; returns one
-        plain-float record per query (the cacheable form)."""
+        plain-float record per query (the cacheable form). Guarded by the
+        dispatch circuit breaker: while open, raise `SolverUnavailable`
+        without touching the device (the ladder answers), until the
+        cooldown lets one half-open probe through. The ``serve.dispatch``
+        fault point fires inside the retried scope, so injected transients
+        are first retried and can then exhaust into a real outage."""
         import jax.numpy as jnp
 
         self._maybe_refill_budget()
+        if not self.breaker.allow():
+            raise SolverUnavailable(
+                f"dispatch breaker open "
+                f"({self.breaker.consecutive_failures} consecutive failure(s))"
+            )
         n = len(params_list)
         bucket = self._bucket_for(n)
         cols = _query_columns(params_list, self.dtype)
@@ -601,6 +829,9 @@ class Engine:
         args = [jnp.asarray(c) for c in cols]
 
         def run():
+            from sbr_tpu.resilience import faults
+
+            faults.fire("serve.dispatch", target=f"bucket{bucket}")
             xi, tau_in, aw_max, status, health = exec_(*args)
             # Device→host fetch inside the retried scope: a transient that
             # surfaces at fetch time must count against THIS dispatch.
@@ -613,8 +844,22 @@ class Engine:
                 np.asarray(health.residual),
             )
 
-        xi, tau_in, aw_max, status, flags, residual = self._retry.call(
-            run, scope=f"serve.dispatch[{bucket}]", budget=self.retry_budget
+        t_disp = time.monotonic()
+        try:
+            xi, tau_in, aw_max, status, flags, residual = self._retry.call(
+                run, scope=f"serve.dispatch[{bucket}]", budget=self.retry_budget
+            )
+        except BaseException:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        # Admission control's service-time estimate: EWMA of measured
+        # dispatch durations (includes retry backoff — what a caller
+        # actually waits for the solver path).
+        dur = time.monotonic() - t_disp
+        self._service_ewma_s = (
+            dur if self._service_ewma_s is None
+            else 0.3 * dur + 0.7 * self._service_ewma_s
         )
         self.live.record_batch(n, bucket)
         if self._run is not None:
@@ -656,6 +901,20 @@ class Engine:
         if path is not None and path.exists():
             import json
 
+            from sbr_tpu.resilience import heal
+
+            # Verify-on-read (ISSUE 11 satellite): the tile cache has had
+            # sha256 sidecars since PR 7 while the serve cache trusted its
+            # bytes blindly. Same contract now: a digest mismatch is
+            # quarantined beside the cache (evidence, never silently
+            # deleted) and the query recomputes; sidecar-less entries from
+            # pre-sidecar builds verify as "legacy" and stay trusted.
+            try:
+                if heal.verify_file(path) == "mismatch":
+                    heal.quarantine(path, reason="serve-cache-mismatch")
+                    return None, None
+            except OSError:
+                return None, None
             try:
                 rec = json.loads(path.read_text())
                 rec = {
@@ -691,6 +950,15 @@ class Engine:
                 with os.fdopen(fd, "w") as f:
                     f.write(json.dumps(rec))
                 os.replace(tmp, path)
+                # sha256 sidecar for verify-on-read (best-effort, after the
+                # rename — the window leaves a "legacy"-trusted entry, same
+                # discipline as the tile checkpoints).
+                try:
+                    from sbr_tpu.resilience import heal
+
+                    heal.write_sidecar(path)
+                except OSError:
+                    pass
                 self._disk_writes += 1
                 if self._disk_writes % 512 == 0:
                     self._prune_disk_cache()
@@ -707,13 +975,24 @@ class Engine:
             return
         try:
             root = Path(self.serve.cache_dir) / "results"
-            entries = [(p.stat().st_mtime, p) for p in root.rglob("*.json")]
+            # quarantine/ dirs hold verify-on-read EVIDENCE: they neither
+            # count toward the cap nor get pruned here (an explicit
+            # `report gc` clears them, same contract as the tile caches).
+            entries = [
+                (p.stat().st_mtime, p)
+                for p in root.rglob("*.json")
+                if "quarantine" not in p.parts
+            ]
             if len(entries) <= cap:
                 return
             entries.sort()
             for _, p in entries[: len(entries) - cap]:
                 try:
                     p.unlink()
+                except OSError:
+                    pass
+                try:  # the verify-on-read sidecar goes with its entry
+                    Path(str(p) + ".sha256").unlink()
                 except OSError:
                     pass
             if self._run is not None:

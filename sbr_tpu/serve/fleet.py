@@ -1,0 +1,438 @@
+"""Serving-fleet membership, circuit breaking, and the degradation ladder's
+tile-cache bridge (ISSUE 11 tentpole, part 1).
+
+PR 6's engine is one process: a crash loses every in-flight query. This
+module grows it into a *fleet* by reusing the PR 7 elastic substrate
+verbatim (the ROADMAP's "apply verbatim to query routing" item):
+
+- **Membership.** Serve workers announce themselves with the SAME
+  heartbeat files the elastic sweep scheduler uses
+  (`resilience.elastic.Heartbeat` — atomic rewrite, TTL aging, graceful
+  release via `resilience.shutdown`), written into a shared *fleet dir*
+  (``SBR_FLEET_DIR``) instead of a sweep checkpoint dir. A worker's beat
+  carries its HTTP endpoint (``url``) plus live throughput stats (qps,
+  p50 ms, inflight), so the router's cost model reads the same record
+  the membership check does. `live_workers` is `elastic.live_hosts`
+  filtered to records that announce an endpoint.
+- **Circuit breaking.** `CircuitBreaker` is the shared
+  closed → open → half-open state machine: ``threshold`` consecutive
+  failures open it, ``cooldown_s`` later exactly one half-open probe is
+  allowed through, a success closes it. The router holds one per worker
+  (a dead worker stops absorbing traffic after ``threshold`` failed
+  forwards); the engine holds one over its own device dispatch (a sick
+  solver path short-circuits to the degradation ladder instead of
+  burning the retry budget on every batch). Injectable clock, no
+  threads: state advances lazily on `allow()` reads.
+- **Tile-cache bridge.** `TileCacheBridge` answers a point query from the
+  PR 7 cross-run global tile cache when the solver path is unavailable —
+  the serving↔sweep bridge the ROADMAP asks for. Tile stores now leave a
+  ``<key>.meta.json`` sidecar (base-economics *cell tag* + the tile's β/u
+  axes); the bridge indexes those and serves the exact (β, u) cell when
+  the query's economics/config/dtype tag matches a swept tile. Answers
+  are labeled ``degraded`` (the sweep program computed them, not this
+  engine's dispatch) and carry NaN for the fields a tile doesn't store
+  (``tau_bar_in``, ``residual``).
+
+Worker process entry: ``python -m sbr_tpu.serve.fleet --fleet-dir DIR``
+runs one engine + endpoint + heartbeat loop under the graceful-shutdown
+envelope — SIGTERM finishes in-flight batches, removes the heartbeat file
+(peers reclaim instantly instead of waiting out the TTL), and finalizes
+the obs manifest as ``"interrupted"`` (ISSUE 11 satellite).
+
+Module import stays jax-free (stdlib + numpy): the router and the chaos
+driver import it on boxes that must never wake a backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+
+def fleet_dir(value=None) -> Optional[Path]:
+    """The shared fleet rendezvous dir (``SBR_FLEET_DIR``); None = no fleet
+    (single-process serving, the PR 6 shape)."""
+    root = value or os.environ.get("SBR_FLEET_DIR", "").strip()
+    return Path(root) if root else None
+
+
+def default_deadline_ms() -> Optional[float]:
+    """Fleet-wide default per-query deadline (``SBR_SERVE_DEADLINE_MS``);
+    None when unset (queries without an explicit deadline never shed)."""
+    raw = os.environ.get("SBR_SERVE_DEADLINE_MS", "").strip()
+    return float(raw) if raw else None
+
+
+def _env_float(name: str, default):
+    """Float env override with a passthrough default (None allowed —
+    shared by the router's knob resolution)."""
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else default
+
+
+# ---------------------------------------------------------------------------
+# Membership: elastic heartbeats in a shared fleet dir
+# ---------------------------------------------------------------------------
+
+
+class WorkerAnnouncer:
+    """One serve worker's membership record: an `elastic.Heartbeat` in the
+    fleet dir whose stats block carries the worker's endpoint and live
+    throughput numbers. `beat` passes through the ``fleet.heartbeat``
+    fault point, so chaos plans can silence a worker's beats (the router
+    must then age it out via the TTL, exactly like a silent death)."""
+
+    def __init__(self, fleet_root, url: str, ttl_s: Optional[float] = None,
+                 host: Optional[str] = None) -> None:
+        from sbr_tpu.resilience import elastic
+
+        self.root = Path(fleet_root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.url = url
+        self.hb = elastic.Heartbeat(self.root, host=host, ttl_s=ttl_s)
+        self.host = self.hb.host
+
+    def beat(self, **stats) -> None:
+        from sbr_tpu.resilience import faults
+        from sbr_tpu.resilience.faults import InjectedFault
+
+        try:
+            faults.fire("fleet.heartbeat", target=self.host)
+        except InjectedFault:
+            return  # a silenced beat = a stale heartbeat, aged out by TTL
+        self.hb.beat(url=self.url, role="serve_worker", **stats)
+
+    def withdraw(self) -> None:
+        self.hb.withdraw()
+
+
+def live_workers(fleet_root, now: Optional[float] = None) -> Dict[str, dict]:
+    """{host_id: heartbeat record} for live serve workers — elastic's
+    membership scan restricted to records announcing an HTTP endpoint (a
+    sweep host sharing the dir never routes traffic)."""
+    from sbr_tpu.resilience import elastic
+
+    root = Path(fleet_root)
+    if not root.is_dir():
+        return {}
+    return {
+        h: rec
+        for h, rec in elastic.live_hosts(root, now=now).items()
+        if rec.get("url")
+    }
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker: closed → open → half-open → closed.
+
+    ``allow()`` is the single gate: True while closed; False while open
+    until ``cooldown_s`` has elapsed, then exactly ONE True (the half-open
+    probe) until its outcome lands — a success closes the breaker, a
+    failure re-opens it (and restarts the cooldown). Lazy state (no timer
+    thread), injectable ``clock`` so tests drive transitions
+    deterministically. ``on_transition(old, new)`` observes state changes
+    (the router logs them as obs ``fleet`` events).
+    """
+
+    def __init__(self, threshold: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable] = None) -> None:
+        self.threshold = int(threshold if threshold is not None
+                             else _env_float("SBR_BREAKER_THRESHOLD", 3))
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else _env_float("SBR_BREAKER_COOLDOWN_S", 5.0))
+        self._clock = clock
+        self._on_transition = on_transition
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_inflight = False
+        # Monotonic time of the last state change (None = never changed):
+        # `report fleet` ages open breakers against this to tell a breaker
+        # legitimately open over a dead peer from one STUCK open.
+        self.last_transition_at: Optional[float] = None
+
+    def age_s(self) -> Optional[float]:
+        """Seconds since the last state transition (None = never moved)."""
+        if self.last_transition_at is None:
+            return None
+        return self._clock() - self.last_transition_at
+
+    def _transition(self, new: str) -> None:
+        old = self.state
+        if old == new:
+            return
+        self.state = new
+        self.last_transition_at = self._clock()
+        if self._on_transition is not None:
+            try:
+                self._on_transition(old, new)
+            except Exception:
+                pass  # observation must never sink the breaker
+
+    def admissible(self) -> bool:
+        """Side-effect-free view of `allow()`: would a request be admitted
+        right now? Candidate *selection* must use this — `allow()` grants
+        the single half-open probe, and granting it to a worker that is
+        merely being RANKED (not forwarded to) would strand the breaker in
+        half_open forever, since no outcome ever lands for that probe."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            return self._clock() - (self._opened_at or 0.0) >= self.cooldown_s
+        return not self._probe_inflight
+
+    def allow(self) -> bool:
+        """Whether one request may proceed right now (see class docstring).
+        Call this only when the request will actually be SENT — a True in
+        half-open state grants the single probe, and the caller then owes
+        the breaker a `record_success`/`record_failure` outcome."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if self._clock() - (self._opened_at or 0.0) >= self.cooldown_s:
+                self._transition("half_open")
+                self._probe_inflight = True
+                return True
+            return False
+        # half_open: one probe at a time — concurrent traffic keeps waiting
+        if not self._probe_inflight:
+            self._probe_inflight = True
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._probe_inflight = False
+        self._transition("closed")
+
+    def record_abandoned(self) -> None:
+        """The request ended with no verdict on the PEER (e.g. the query's
+        own deadline expired in flight): release a held half-open probe
+        without moving the state machine in either direction."""
+        self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        self._probe_inflight = False
+        if self.state == "half_open" or (
+            self.state == "closed" and self.consecutive_failures >= self.threshold
+        ):
+            self._opened_at = self._clock()
+            self._transition("open")
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder: the global tile cache as a stale-answer source
+# ---------------------------------------------------------------------------
+
+
+def cell_tag(params, config, dtype_name: str) -> str:
+    """Canonical cell tag — delegate to `resilience.elastic.cell_tag`, the
+    ONE implementation the tile-store side also uses (a serve query and a
+    swept tile match exactly when the shared function says so)."""
+    from sbr_tpu.resilience import elastic
+
+    return elastic.cell_tag(params, config, dtype_name)
+
+
+class TileCacheBridge:
+    """Point-query lookups against the PR 7 cross-run global tile cache.
+
+    The cache's entries are content-addressed whole tiles; what makes them
+    addressable per-cell is the ``<key>.meta.json`` sidecar every store
+    now writes (`resilience.elastic.TileCache.store`): the cell tag plus
+    the tile's actual β/u axes. The bridge scans those sidecars lazily
+    (re-scanned when older than ``refresh_s``), indexes them by tag, and
+    on `lookup` returns the verified entry's exact (β, u) cell — or None
+    on any miss, mismatch, or corruption (the ladder then falls through
+    to 503). All reads go through `TileCache.load`, so sha256
+    verify-on-read and quarantine-on-mismatch apply unchanged."""
+
+    def __init__(self, cache_dir=None, refresh_s: float = 5.0) -> None:
+        from sbr_tpu.resilience.elastic import default_tile_cache
+
+        self.cache = default_tile_cache(cache_dir)
+        self.refresh_s = refresh_s
+        self._index: Dict[str, list] = {}  # cell_tag -> [meta, ...]
+        self._scanned_at: Optional[float] = None
+
+    @property
+    def available(self) -> bool:
+        return self.cache is not None
+
+    def _scan(self) -> None:
+        index: Dict[str, list] = {}
+        for meta_path in self.cache.root.rglob("*.meta.json"):
+            try:
+                meta = json.loads(meta_path.read_text())
+                tag = meta["cell_tag"]
+                betas = [float(b) for b in meta["betas"]]
+                us = [float(u) for u in meta["us"]]
+                key = str(meta["key"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # torn/alien sidecar: not an index entry
+            index.setdefault(tag, []).append(
+                {"key": key, "betas": betas, "us": us}
+            )
+        self._index = index
+        self._scanned_at = time.monotonic()
+
+    def lookup(self, params, config, dtype_name: str) -> Optional[dict]:
+        """The degraded answer for one query, or None. Matches by exact
+        cell tag + exact (β, u) membership in a swept tile's axes — the
+        bridge serves only cells that are mathematically the query."""
+        if self.cache is None:
+            return None
+        now = time.monotonic()
+        if self._scanned_at is None or now - self._scanned_at >= self.refresh_s:
+            self._scan()
+        tag = cell_tag(params, config, dtype_name)
+        beta = float(params.learning.beta)
+        u = float(params.economic.u)
+        for meta in self._index.get(tag, []):
+            if beta not in meta["betas"] or u not in meta["us"]:
+                continue
+            arrays = self.cache.load(meta["key"], tile="serve-bridge")
+            if arrays is None:
+                continue  # quarantined/raced away — try another tile
+            i = meta["betas"].index(beta)
+            j = meta["us"].index(u)
+            try:
+                return {
+                    "xi": float(arrays["xi"][i, j]),
+                    "tau_bar_in": float("nan"),  # tiles don't store it
+                    "aw_max": float(arrays["max_aw"][i, j]),
+                    "status": int(arrays["status"][i, j]),
+                    "flags": 0,
+                    "residual": float("nan"),
+                }
+            except (IndexError, KeyError, ValueError):
+                continue  # axes/meta drifted from the entry: not an answer
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Worker process entry
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """One fleet worker: engine + HTTP endpoint + heartbeat announcements.
+
+    Runs until SIGTERM/SIGINT; the graceful-shutdown envelope then drains
+    in-flight batches (engine close), withdraws the heartbeat (peers
+    reclaim instantly), and finalizes the obs manifest as "interrupted".
+    Prints one JSON line ``{"url": ..., "host": ...}`` on stdout once
+    ready — the fleet drivers (loadgen --fleet, chaos --fleet) read it.
+    """
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.serve.fleet",
+        description="Run one serving-fleet worker (engine + endpoint + "
+        "heartbeat membership) until SIGTERM",
+    )
+    parser.add_argument("--fleet-dir", required=True,
+                        help="shared fleet rendezvous dir (heartbeats)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="HTTP port (default 0 = ephemeral)")
+    parser.add_argument("--n-grid", type=int, default=192, dest="n_grid")
+    parser.add_argument("--bisect-iters", type=int, default=40, dest="bisect_iters")
+    parser.add_argument("--buckets", default="1,8,64")
+    parser.add_argument("--cache-dir", default=None,
+                        help="on-disk result/executable cache (shared across "
+                        "the fleet: results are fingerprint-keyed and pure, "
+                        "so concurrent writers are benign)")
+    parser.add_argument("--run-dir", default=None,
+                        help="obs run dir for this worker's telemetry")
+    parser.add_argument("--heartbeat-ttl", type=float, default=None,
+                        help="heartbeat TTL seconds (default SBR_HEARTBEAT_TTL_S)")
+    parser.add_argument("--beat-s", type=float, default=0.5,
+                        help="announcement cadence (default 0.5 s)")
+    parser.add_argument("--platform", default=None,
+                        help="pin a jax platform before backend init (cpu)")
+    args = parser.parse_args(argv)
+
+    if args.platform and args.platform.lower() == "cpu":
+        from sbr_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.resilience.shutdown import graceful_shutdown
+    from sbr_tpu.serve.endpoint import ServeEndpoint
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+
+    buckets = tuple(sorted({int(v) for v in args.buckets.split(",") if v.strip()}))
+    serve_cfg = ServeConfig.from_env(
+        buckets=buckets, **({"cache_dir": args.cache_dir} if args.cache_dir else {})
+    )
+    config = SolverConfig(
+        n_grid=args.n_grid, bisect_iters=args.bisect_iters, refine_crossings=False
+    )
+
+    # The WORKER owns the obs run (not the engine): on SIGTERM the
+    # graceful-shutdown envelope must finalize the manifest as
+    # "interrupted" — an engine-owned run would be finalized "complete"
+    # by the drain's engine.close() before the envelope ever saw it.
+    run = None
+    if args.run_dir:
+        from sbr_tpu import obs
+
+        run = obs.start_run(label="serve_worker", run_dir=args.run_dir)
+    engine = Engine(config=config, serve=serve_cfg, run=run)
+    engine.start()
+    endpoint = ServeEndpoint(engine, port=args.port).start()
+    url = f"http://127.0.0.1:{endpoint.port}"
+    announcer = WorkerAnnouncer(args.fleet_dir, url, ttl_s=args.heartbeat_ttl)
+    with graceful_shutdown(label="serve_worker"):
+        try:
+            announcer.beat(**_worker_stats(engine))
+            print(json.dumps({"url": url, "host": announcer.host,
+                              "pid": os.getpid()}), flush=True)
+            while True:
+                time.sleep(args.beat_s)
+                announcer.beat(**_worker_stats(engine))
+        finally:
+            # Graceful drain (ISSUE 11 satellite): runs on SIGTERM while
+            # unwinding toward graceful_shutdown's handler — in-flight
+            # batches finish (engine.close drains the queue), the
+            # heartbeat file is removed so router peers reclaim the slot
+            # immediately, and only THEN does the envelope finalize the
+            # obs manifest as "interrupted" and exit 143.
+            endpoint.close()
+            engine.close()
+            announcer.withdraw()
+    return 0
+
+
+def _worker_stats(engine) -> dict:
+    """The heartbeat stats block: what the router's cost model reads."""
+    window = engine.live.window()
+    lat = window.get("latency_ms") or {}
+    qps = (window.get("queries", 0) or 0) / max(engine.live.window_s, 1e-9)
+    return {
+        "qps": round(qps, 3),
+        "p50_ms": lat.get("p50"),
+        "inflight": engine.live.inflight,
+        "queue_depth": engine.live.queue_depth,
+        "healthz": engine.healthz(window=window)["status"],
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,7 +64,11 @@ class _Slot:
 
 # Counter keys shared by slots and totals. "queries" counts fulfilled
 # queries; "cache_hits" = LRU or disk hits, split out as "disk_hits";
-# "computed" = queries that went through a device dispatch.
+# "computed" = queries that went through a device dispatch; "shed" =
+# queries rejected at admission because their deadline could not be met
+# (ISSUE 11 backpressure — an explicit 429, never silent queue growth);
+# "degraded" = queries answered from the degradation ladder (global tile
+# cache) while the solver path was unavailable.
 _COUNTERS = (
     "queries",
     "cache_hits",
@@ -73,6 +77,8 @@ _COUNTERS = (
     "computed",
     "errors",
     "divergent_cells",
+    "shed",
+    "degraded",
     "batches",
     "batch_queries",
     "padded_lanes",
@@ -132,6 +138,11 @@ class LiveMetrics:
             keys.append("cache_hits")
             if source == "disk":
                 keys.append("disk_hits")
+        elif source == "tilecache":
+            # Degradation-ladder answer (ISSUE 11): served from the global
+            # tile cache while the solver path was down — neither a cache
+            # hit (it bypassed the serve caches) nor a computed query.
+            keys.append("degraded")
         else:
             keys += ["cache_misses", "computed"]
         if divergent:
@@ -152,6 +163,12 @@ class LiveMetrics:
         self._slot().inc("errors", n)
         self.totals["errors"] += n
 
+    def record_shed(self, n: int = 1) -> None:
+        """One query rejected at admission (deadline unmeetable): explicit
+        load shedding, counted so backpressure is observable, never silent."""
+        self._slot().inc("shed", n)
+        self.totals["shed"] += n
+
     def record_batch(self, n_queries: int, bucket: int) -> None:
         """One device dispatch: ``bucket`` lanes launched for ``n_queries``
         real queries (occupancy = batch_queries / padded capacity)."""
@@ -165,7 +182,17 @@ class LiveMetrics:
 
     # -- reading (endpoint / snapshot threads) ------------------------------
     def _window_fold(self) -> tuple:
-        """(hist, counters) folded over the slots still inside the window."""
+        """(hist, counters) folded over the slots still inside the window.
+
+        ONE fold is one coherent read of the 12-slot ring (ISSUE 11
+        satellite): every consumer of a given exposition — the `/statz`
+        document's ``window`` section, the ``healthz`` verdict embedded in
+        the same document, the Prometheus gauges of one scrape — must
+        derive from a SINGLE fold, passed down as a ``window`` dict, not
+        re-fold per reader. Two folds taken microseconds apart can span a
+        slot rotation and disagree (a scrape racing `record_query` would
+        report a healthz divergence count from a different window than the
+        ``divergent_cells`` gauge beside it)."""
         min_epoch = int(self._time() / self._slot_s) - _N_SLOTS + 1
         hist = LogHistogram(LATENCY_BOUNDS_MS)
         counters: Dict[str, float] = {k: 0 for k in _COUNTERS}
@@ -193,6 +220,12 @@ class LiveMetrics:
         }
 
     def window(self) -> dict:
+        """The rolling-window view, from exactly ONE fold of the slot ring
+        (counters, derived rates, and both latency renderings all come from
+        the same (hist, counters) pair — internally consistent by
+        construction). Callers that embed the window into a larger document
+        alongside window-derived verdicts (`Engine.statz`) take this dict
+        once and pass it down instead of re-folding."""
         hist, counters = self._window_fold()
         return {
             "window_s": self.window_s,
@@ -202,8 +235,12 @@ class LiveMetrics:
             "latency_hist_ms": hist.to_dict(),
         }
 
-    def snapshot(self, extra: Optional[dict] = None) -> dict:
-        """The full live document — `live.json` body and `/statz` payload."""
+    def snapshot(self, extra: Optional[dict] = None,
+                 window: Optional[dict] = None) -> dict:
+        """The full live document — `live.json` body and `/statz` payload.
+        ``window`` (a prior `window()` result) lets the caller share one
+        fold between this document and any window-derived extras (the
+        healthz verdict) — see `_window_fold` on why that matters."""
         from sbr_tpu.obs import prof
 
         doc = {
@@ -220,7 +257,7 @@ class LiveMetrics:
                 **self._derived(self.totals),
                 "latency_ms": self.total_hist.summary(),
             },
-            "window": self.window(),
+            "window": window if window is not None else self.window(),
             "scenarios": dict(sorted(list(self.scenarios.items()))),
             # Compile/retrace counters ride along so a scrape — not a log
             # grep — proves "zero post-warmup compiles" (acceptance gate).
@@ -247,11 +284,13 @@ class LiveMetrics:
         return self._time() - self._last_write >= min_interval_s
 
     def maybe_write(self, run, extra: Optional[dict] = None,
-                    min_interval_s: float = 0.5, force: bool = False) -> bool:
+                    min_interval_s: float = 0.5, force: bool = False,
+                    window: Optional[dict] = None) -> bool:
         """Write the rolling ``live.json`` through ``run.live_snapshot``
         at a bounded cadence (``force`` for the final write at close).
-        Returns whether a write happened; never raises — live telemetry
-        must not sink the serving path."""
+        ``window`` shares the caller's fold like `snapshot`. Returns
+        whether a write happened; never raises — live telemetry must not
+        sink the serving path."""
         if run is None:
             return False
         now = self._time()
@@ -259,7 +298,7 @@ class LiveMetrics:
             return False
         self._last_write = now
         try:
-            run.live_snapshot(self.snapshot(extra))
+            run.live_snapshot(self.snapshot(extra, window=window))
             return True
         except Exception:
             return False
@@ -284,6 +323,8 @@ class LiveMetrics:
             "sbr_serve_window_hit_rate": derived["hit_rate"],
             "sbr_serve_window_occupancy": derived["occupancy"],
             "sbr_serve_window_divergent_cells": counters.get("divergent_cells", 0),
+            "sbr_serve_window_shed": counters.get("shed", 0),
+            "sbr_serve_window_degraded": counters.get("degraded", 0),
         }
         for q in (0.5, 0.95, 0.99):
             v = hist.quantile(q)

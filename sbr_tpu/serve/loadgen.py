@@ -1,4 +1,5 @@
-"""Seeded deterministic load generator for the serving engine (ISSUE 7).
+"""Seeded deterministic load generator for the serving engine (ISSUE 7)
+and the multi-process serving fleet (ISSUE 11).
 
 ``python -m sbr_tpu.serve.loadgen`` drives a reproducible query mix
 against an in-process `Engine` + `ServeEndpoint`, scrapes its own
@@ -17,17 +18,40 @@ harness both ride this:
 - ``--run-dir`` lands the engine's rolling ``live.json`` in an obs run
   directory that ``python -m sbr_tpu.obs.report serve`` renders and gates.
 
-Exit codes: 0 ok, 1 failed assertion (--assert-warm), 2 setup error.
+**Fleet mode** (``--fleet N``): spawn N worker subprocesses
+(``python -m sbr_tpu.serve.fleet``, heartbeats in a scratch fleet dir),
+front them with an in-process `serve.router.Router`, and drive the SAME
+seeded mix over HTTP through the router. The summary gains the fleet SLO
+headline (``fleet_p99_ms`` measured-phase client latency,
+``fleet_failover_count``, ``fleet_shed_rate``) — history schema 7 —
+and fleet mode always asserts ZERO lost queries (any non-200 answer
+that is not a deliberate 429 shed fails the run). ``--fleet-kill-after
+K`` SIGKILLs one worker after K measured queries (the chaos fleet smoke:
+the router must fail over with zero lost queries and byte-identical
+answers); ``--answers-out`` writes the per-query answer list for
+cross-run byte-identity comparison. ``--run-dir`` is the ROUTER's obs
+run dir (``report fleet`` gates it); workers land their own run dirs
+beside it.
+
+Exit codes: 0 ok, 1 failed assertion (--assert-warm / fleet loss), 2
+setup error.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
+import signal
+import subprocess
 import sys
+import tempfile
+import threading
+import time
+import urllib.error
 import urllib.request
-from typing import List
+from typing import List, Optional
 
 from sbr_tpu.models.params import ModelParams, SolverConfig, make_model_params
 
@@ -58,6 +82,259 @@ def query_mix(seed: int, pool_size: int, n: int) -> List[int]:
 def _scrape(port: int, path: str) -> tuple:
     with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
         return resp.status, resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Fleet mode (ISSUE 11): N worker subprocesses behind an in-process router
+# ---------------------------------------------------------------------------
+
+
+def params_doc(p: ModelParams) -> dict:
+    """The /query wire form of one pool member (full precision: repr
+    round-trips floats exactly, so a routed query solves the identical
+    cell a direct `Engine.query` would)."""
+    return {
+        "beta": p.learning.beta,
+        "u": p.economic.u,
+        "p": p.economic.p,
+        "kappa": p.economic.kappa,
+        "lam": p.economic.lam,
+        "eta": p.economic.eta,
+        "tspan": list(p.learning.tspan),
+        "x0": p.learning.x0,
+    }
+
+
+def spawn_worker(fleet_dir: str, n_grid: int, bisect_iters: int, buckets: str,
+                 run_dir: Optional[str] = None, cache_dir: Optional[str] = None,
+                 platform: Optional[str] = "cpu", heartbeat_ttl: float = 30.0,
+                 timeout_s: float = 180.0) -> dict:
+    """Spawn one fleet worker subprocess and wait for its readiness line.
+    Returns ``{"proc", "url", "host", "pid"}``; raises on startup timeout
+    (the worker is killed first)."""
+    argv = [
+        sys.executable, "-m", "sbr_tpu.serve.fleet",
+        "--fleet-dir", str(fleet_dir),
+        "--n-grid", str(n_grid),
+        "--bisect-iters", str(bisect_iters),
+        "--buckets", buckets,
+        "--heartbeat-ttl", str(heartbeat_ttl),
+    ]
+    if platform:
+        argv += ["--platform", platform]
+    if run_dir:
+        argv += ["--run-dir", str(run_dir)]
+    if cache_dir:
+        argv += ["--cache-dir", str(cache_dir)]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=sys.stderr.fileno(), text=True
+    )
+    line: dict = {}
+    err: Optional[str] = None
+
+    def read_ready():
+        nonlocal err
+        raw = ""
+        try:
+            raw = proc.stdout.readline()
+            line.update(json.loads(raw))
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            err = f"{e!r} (raw={raw!r})"
+
+    t = threading.Thread(target=read_ready, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not line.get("url"):
+        proc.kill()
+        raise RuntimeError(
+            f"fleet worker failed to become ready within {timeout_s:.0f}s"
+            + (f": {err}" if err else "")
+        )
+    return {"proc": proc, "url": line["url"], "host": line.get("host"),
+            "pid": line.get("pid", proc.pid)}
+
+
+def run_fleet(args) -> dict:
+    """The fleet driver behind ``--fleet`` (and `bench.py`'s fleet
+    workload): N subprocess workers + in-process router, the seeded
+    warmup + measured mix over HTTP, optional mid-run worker kill.
+    Returns the summary dict (including ``failures`` and, when requested,
+    the per-query ``answers`` written to ``--answers-out``)."""
+    from sbr_tpu.obs.metrics import DEFAULT_LATENCY_BOUNDS_MS, LogHistogram
+    from sbr_tpu.serve.router import Router
+
+    pool = build_pool(args.seed, args.pool)
+    mix = query_mix(args.seed, args.pool, args.queries)
+    docs = [json.dumps(params_doc(p)).encode() for p in pool]
+
+    scratch = tempfile.mkdtemp(prefix="sbr_fleet_")
+    fleet_dir = args.fleet_dir or os.path.join(scratch, "fleet")
+    workers = []
+    router = None
+    failures: List[str] = []
+    answers: List[Optional[dict]] = [None] * len(mix)
+    hist = LogHistogram(DEFAULT_LATENCY_BOUNDS_MS)
+    killed: dict = {}
+    try:
+        for i in range(args.fleet):
+            wrun = (
+                os.path.join(args.run_dir + "_workers", f"w{i}")
+                if args.run_dir else None
+            )
+            workers.append(spawn_worker(
+                fleet_dir, args.n_grid, args.bisect_iters,
+                args.buckets or "1,8,64", run_dir=wrun,
+                cache_dir=args.cache_dir,
+                platform=args.platform or "cpu",
+            ))
+        router = Router(fleet_dir, run_dir=args.run_dir, poll_s=0.2).start()
+        base = f"http://127.0.0.1:{router.port}/query"
+        print(f"[loadgen] fleet of {len(workers)} worker(s) behind {base}",
+              file=sys.stderr)
+
+        def post(doc: bytes, timeout: float = 300.0) -> tuple:
+            req = urllib.request.Request(
+                base, data=doc, headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                try:
+                    return e.code, json.loads(e.read())
+                except ValueError:
+                    return e.code, {}
+            except OSError as e:
+                # Connection reset / refused / client timeout (URLError is
+                # an OSError): this MUST surface as a counted failure —
+                # an exception escaping the recording thread would vanish
+                # silently and let the zero-lost assertion pass on a run
+                # that actually lost a query.
+                return 599, {"error": repr(e)}
+
+        # Warmup: every pool member, --group at a time (concurrency spreads
+        # the pool over the workers, so every worker compiles its buckets).
+        def run_group(indices, record):
+            threads = []
+            for pos, pool_idx in indices:
+                t = threading.Thread(
+                    target=record, args=(pos, pool_idx), daemon=True
+                )
+                threads.append(t)
+                t.start()
+            for t in threads:
+                t.join()
+
+        def warm_one(pos, pool_idx):
+            code, doc = post(docs[pool_idx])
+            if code != 200:
+                failures.append(f"warmup query {pos} (pool {pool_idx}) -> {code}")
+
+        for i in range(0, len(pool), args.group):
+            run_group([(j, j) for j in range(i, min(i + args.group, len(pool)))],
+                      warm_one)
+
+        # Measured phase: the seeded mix; after --fleet-kill-after
+        # completions, SIGKILL one worker mid-run (the chaos fleet proof).
+        completed = [0]
+        kill_lock = threading.Lock()
+
+        def maybe_kill():
+            if args.fleet_kill_after is None or killed or len(workers) < 2:
+                return
+            with kill_lock:
+                if killed or completed[0] < args.fleet_kill_after:
+                    return
+                # Kill the router's FAVORITE worker (most forwards): its
+                # EWMA score is the lowest, so the router keeps preferring
+                # it after death — the hardest failover case, and the one
+                # that deterministically drives its breaker open.
+                stats = router.statz()["workers"]
+                victim = max(
+                    workers,
+                    key=lambda w: stats.get(w["host"], {}).get("forwards", 0),
+                )
+                killed.update(host=victim["host"], pid=victim["pid"])
+                os.kill(victim["pid"], signal.SIGKILL)
+                print(f"[loadgen] SIGKILLed worker {victim['host']} "
+                      f"(pid {victim['pid']}) after {completed[0]} queries",
+                      file=sys.stderr)
+
+        def measured_one(pos, pool_idx):
+            t0 = time.monotonic()
+            code, doc = post(docs[pool_idx])
+            if code == 200:
+                hist.record((time.monotonic() - t0) * 1e3)
+                answers[pos] = doc
+            elif code == 429:
+                answers[pos] = {"shed": True}
+            else:
+                failures.append(f"measured query {pos} (pool {pool_idx}) -> {code}: {doc}")
+            completed[0] += 1
+            maybe_kill()
+
+        t0 = time.monotonic()
+        for i in range(0, len(mix), args.group):
+            run_group(
+                [(j, mix[j]) for j in range(i, min(i + args.group, len(mix)))],
+                measured_one,
+            )
+        measured_s = time.monotonic() - t0
+
+        router_stats = router.statz()
+    finally:
+        if router is not None:
+            router.close()
+        for w in workers:
+            if w["pid"] != killed.get("pid"):
+                try:
+                    w["proc"].terminate()
+                except OSError:
+                    pass
+        for w in workers:
+            try:
+                w["proc"].wait(timeout=30)
+            except Exception:
+                w["proc"].kill()
+        import shutil
+
+        # The scratch dir (and the fleet rendezvous inside it, unless the
+        # caller supplied their own) is ours: repeated bench/chaos runs
+        # must not accumulate sbr_fleet_* debris in /tmp.
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    counters = router_stats["counters"]
+    shed = counters.get("shed", 0)
+    n_answered = sum(1 for a in answers if a is not None and "shed" not in a)
+    summary = {
+        "fleet": args.fleet,
+        "queries": len(mix),
+        "answered": n_answered,
+        "pool": args.pool,
+        "seed": args.seed,
+        "fleet_p99_ms": hist.quantile(0.99),
+        "fleet_p50_ms": hist.quantile(0.5),
+        "fleet_failover_count": counters.get("failover", 0),
+        "fleet_shed_rate": round(shed / max(len(mix), 1), 4),
+        "fleet_qps": round(len(mix) / measured_s, 1) if measured_s else 0.0,
+        "fleet_lost": counters.get("failed", 0),
+        "fleet_degraded": counters.get("degraded", 0),
+        "killed_worker": killed.get("host"),
+        "router_counters": counters,
+        "run_dir": args.run_dir,
+    }
+    # Fleet mode ALWAYS asserts zero lost queries: with a live peer, every
+    # failure mode in scope (worker death, breaker, straggler) must be
+    # absorbed by failover, not surfaced to the client.
+    if len(failures) > 0:
+        summary["failures"] = failures
+    else:
+        summary["failures"] = []
+    if args.answers_out:
+        with open(args.answers_out, "w") as fh:
+            json.dump(answers, fh)
+    return summary
 
 
 def _metric_value(text: str, name: str) -> float:
@@ -95,7 +372,34 @@ def main(argv=None) -> int:
                         "zero new XLA compiles after warmup (scraped from /metrics)")
     parser.add_argument("--hit-floor", type=float, default=0.5,
                         help="cache-hit-rate floor for --assert-warm (default 0.5)")
+    parser.add_argument("--fleet", type=int, default=0, metavar="N",
+                        help="fleet mode: N worker subprocesses behind an "
+                        "in-process router; asserts zero lost queries")
+    parser.add_argument("--fleet-dir", default=None,
+                        help="shared fleet rendezvous dir (default: scratch)")
+    parser.add_argument("--fleet-kill-after", type=int, default=None, metavar="K",
+                        dest="fleet_kill_after",
+                        help="SIGKILL one worker after K measured queries "
+                        "(fleet mode; needs >= 2 workers)")
+    parser.add_argument("--answers-out", default=None, dest="answers_out",
+                        help="write the per-query answer list (JSON) here "
+                        "(fleet mode; byte-identity comparisons)")
     args = parser.parse_args(argv)
+
+    if args.fleet:
+        try:
+            summary = run_fleet(args)
+        except Exception as err:  # noqa: BLE001 — setup failures exit 2
+            print(f"[loadgen] fleet setup failed: {err!r}", file=sys.stderr)
+            return 2
+        failures = list(summary["failures"])
+        if summary.get("fleet_lost", 0) > 0:
+            failures.append(f"router lost {summary['fleet_lost']} quer(ies)")
+        summary["failures"] = failures
+        print(json.dumps(summary))
+        for f in failures:
+            print(f"[loadgen] ASSERTION FAILED: {f}", file=sys.stderr)
+        return 1 if failures else 0
 
     if args.platform:
         if args.platform.lower() == "cpu":

@@ -483,7 +483,19 @@ class TileRunner:
         if key is not None:
             # Store AFTER the local save: the global entry is only ever
             # written from arrays that also landed (atomically) locally.
-            self.tile_cache.store(key, arrays, tile=self.tile_id(bi, ui))
+            # The meta sidecar makes the whole-tile entry per-cell
+            # addressable for the serving fleet's degradation ladder
+            # (resilience.elastic.tile_meta / serve.fleet.TileCacheBridge).
+            from sbr_tpu.resilience.elastic import tile_meta
+
+            bs, us = self.slices(bi, ui)
+            self.tile_cache.store(
+                key, arrays, tile=self.tile_id(bi, ui),
+                meta=tile_meta(
+                    self.base, self.config, self.dtype,
+                    self.beta_values[bs], self.u_values[us], key,
+                ),
+            )
         return "computed", arrays
 
     def _compute(self, bi: int, ui: int) -> dict:

@@ -1,0 +1,994 @@
+"""Serving-fleet tests (ISSUE 11): circuit breaker, fleet membership,
+deadline/admission semantics, the degradation ladder's tile-cache bridge,
+serve result-cache sidecars, scrape-coherent windows, router routing/
+failover/hedging, `report fleet` gating, history schema 7, and the
+graceful SIGTERM drain.
+
+Router tests run against STUB workers (canned stdlib HTTP servers) so the
+routing logic is exercised without paying a jax compile per worker; the
+engine-level tests share one tiny SolverConfig like tests/test_serve.py.
+"""
+
+import dataclasses
+import http.server
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.resilience import faults
+from sbr_tpu.resilience.elastic import TileCache, cell_tag, gc_tile_cache, tile_meta
+from sbr_tpu.serve.engine import (
+    DeadlineExceeded,
+    Engine,
+    ServeConfig,
+    SolverUnavailable,
+)
+from sbr_tpu.serve.fleet import (
+    CircuitBreaker,
+    TileCacheBridge,
+    WorkerAnnouncer,
+    live_workers,
+)
+from sbr_tpu.serve.live import LiveMetrics
+from sbr_tpu.serve.router import Router
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _feq(a, b) -> bool:
+    """Bitwise float equality (NaN-safe): the byte-identity contract."""
+    return np.float64(a).tobytes() == np.float64(b).tobytes()
+
+
+CFG = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _clocked(self, **kw):
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        return CircuitBreaker(clock=clock, **kw), now
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        b, _ = self._clocked(threshold=3, cooldown_s=5.0)
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b, _ = self._clocked(threshold=2, cooldown_s=5.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"  # never two CONSECUTIVE failures
+
+    def test_half_open_single_probe_then_close(self):
+        b, now = self._clocked(threshold=1, cooldown_s=5.0)
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+        now[0] = 5.0
+        assert b.allow()  # the half-open probe
+        assert b.state == "half_open"
+        assert not b.allow()  # exactly ONE probe until its outcome lands
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_half_open_failure_reopens_and_restarts_cooldown(self):
+        b, now = self._clocked(threshold=1, cooldown_s=5.0)
+        b.record_failure()
+        now[0] = 5.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        now[0] = 9.0  # cooldown restarted at t=5: not yet
+        assert not b.allow()
+        now[0] = 10.0
+        assert b.allow()
+
+    def test_admissible_is_side_effect_free(self):
+        # Ranking candidates must not consume the half-open probe: a True
+        # from admissible() leaves the state machine untouched; only
+        # allow() (called at send time) grants the probe.
+        b, now = self._clocked(threshold=1, cooldown_s=5.0)
+        b.record_failure()
+        now[0] = 5.0
+        for _ in range(3):
+            assert b.admissible()
+        assert b.state == "open"  # no transition, no probe granted
+        assert b.allow()  # the actual send takes the probe
+        assert b.state == "half_open"
+        assert not b.admissible()  # probe in flight: peers are not admitted
+        b.record_success()
+        assert b.admissible() and b.state == "closed"
+
+    def test_transitions_observed_and_aged(self):
+        seen = []
+        now = [0.0]
+        b = CircuitBreaker(threshold=1, cooldown_s=2.0, clock=lambda: now[0],
+                           on_transition=lambda old, new: seen.append((old, new)))
+        assert b.age_s() is None
+        b.record_failure()
+        now[0] = 1.5
+        assert seen == [("closed", "open")]
+        assert b.age_s() == pytest.approx(1.5)
+        now[0] = 2.0
+        b.allow()
+        b.record_success()
+        assert seen == [("closed", "open"), ("open", "half_open"),
+                        ("half_open", "closed")]
+
+
+# ---------------------------------------------------------------------------
+# Fleet membership
+# ---------------------------------------------------------------------------
+
+
+class TestFleetMembership:
+    def test_announce_and_filter_non_workers(self, tmp_path):
+        ann = WorkerAnnouncer(tmp_path, "http://127.0.0.1:1234", host="w1")
+        ann.beat(qps=2.5)
+        # A sweep host sharing the dir (no url) must never route traffic.
+        from sbr_tpu.resilience.elastic import Heartbeat
+
+        Heartbeat(tmp_path, "sweep-host").beat(tiles_done=3)
+        live = live_workers(tmp_path)
+        assert list(live) == ["w1"]
+        assert live["w1"]["url"] == "http://127.0.0.1:1234"
+        assert live["w1"]["qps"] == 2.5
+        ann.withdraw()
+        assert live_workers(tmp_path) == {}
+
+    def test_ttl_expiry(self, tmp_path):
+        ann = WorkerAnnouncer(tmp_path, "http://x", ttl_s=0.05, host="w1")
+        ann.beat()
+        assert "w1" in live_workers(tmp_path)
+        assert "w1" not in live_workers(tmp_path, now=time.time() + 1.0)
+
+    def test_heartbeat_fault_point_silences_beat(self, tmp_path):
+        plan = faults.FaultPlan(
+            {"seed": 0, "rules": [
+                {"point": "fleet.heartbeat", "kind": "transient", "at_hits": [1]},
+            ]}
+        )
+        faults.install(plan)
+        try:
+            ann = WorkerAnnouncer(tmp_path, "http://x", host="w1")
+            ann.beat()  # silenced: no heartbeat file lands
+            assert live_workers(tmp_path) == {}
+            ann.beat()  # next beat goes through
+            assert "w1" in live_workers(tmp_path)
+        finally:
+            faults.install(None)
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines & admission (ISSUE 11 satellite: deadline semantics)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_expired_deadline_sheds_with_zero_solver_work(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+
+        def boom(*a, **k):  # the solver path must never be touched
+            raise AssertionError("dispatch called for a shed query")
+
+        eng._dispatch = boom
+        with pytest.raises(DeadlineExceeded) as err:
+            eng.query(make_model_params(beta=1.1, u=0.2), deadline_ms=0)
+        assert err.value.retry_after_s > 0
+        snap = eng.statz()
+        assert snap["totals"]["shed"] == 1
+        assert snap["totals"]["queries"] == 0
+        eng.close()
+
+    def test_unmeetable_deadline_sheds_from_service_estimate(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        eng._service_ewma_s = 10.0  # measured: the solver takes ~10 s
+        eng._dispatch = lambda *a, **k: (_ for _ in ()).throw(AssertionError)
+        with pytest.raises(DeadlineExceeded) as err:
+            eng.query(make_model_params(beta=1.1, u=0.2), deadline_ms=100)
+        assert err.value.retry_after_s == pytest.approx(10.0)
+        # Plenty of deadline is admitted (and then fails on our stub,
+        # proving admission — not the solver — was the gate above).
+        with pytest.raises(AssertionError):
+            eng.query(make_model_params(beta=1.1, u=0.2), deadline_ms=60_000)
+        eng.close()
+
+    def test_deadline_expiring_mid_batch_still_returns(self):
+        # Admission and batch formation both pass (the 150 ms deadline is
+        # comfortably alive when the synchronous _process starts); the
+        # first-call compile + solve then takes far longer — the batch is
+        # already paid for, so the caller still gets its full answer, and
+        # nothing is shed or errored.
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        res = eng.query(make_model_params(beta=1.1, u=0.2), deadline_ms=150.0)
+        assert res.status in (0, 1, 2, 3)
+        snap = eng.statz()
+        assert snap["totals"]["queries"] == 1
+        assert snap["totals"]["shed"] == 0
+        eng.close()
+
+    def test_deadline_expired_while_queued_sheds_at_batch_formation(self):
+        # A ticket that outlives its deadline in the QUEUE (admission could
+        # not see queue wait) is shed at batch formation without burning a
+        # dispatch; the waiter gets the explicit DeadlineExceeded.
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+
+        def boom(*a, **k):
+            raise AssertionError("dispatch burned on a queue-expired query")
+
+        eng._dispatch = boom
+        tk = eng.submit(make_model_params(beta=1.1, u=0.2), deadline_ms=30.0)
+        time.sleep(0.06)  # the deadline lapses while "queued"
+        eng._process([tk])
+        with pytest.raises(DeadlineExceeded):
+            tk.wait(timeout=1)
+        assert eng.statz()["totals"]["shed"] == 1
+        eng.close()
+
+    def test_default_deadline_from_env(self, monkeypatch):
+        monkeypatch.setenv("SBR_SERVE_DEADLINE_MS", "250")
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        assert eng.default_deadline_ms == 250.0
+        eng._service_ewma_s = 5.0
+        with pytest.raises(DeadlineExceeded):  # 250 ms < 5 s estimate
+            eng.query(make_model_params(beta=1.1, u=0.2))
+        eng.close()
+
+    def test_endpoint_maps_shed_to_429_with_retry_after(self):
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        eng._service_ewma_s = 7.0
+        with ServeEndpoint(eng) as ep:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ep.port}/query",
+                data=json.dumps({"beta": 1.1, "u": 0.2}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-SBR-Deadline-Ms": "50"},
+                method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 429
+            assert float(err.value.headers["Retry-After"]) == pytest.approx(7.0)
+            body = json.loads(err.value.read())
+            assert body["error"] == "deadline"
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine breaker + degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def _force_open(breaker: CircuitBreaker) -> None:
+    for _ in range(breaker.threshold):
+        breaker.record_failure()
+
+
+@pytest.fixture(scope="module")
+def swept_cache(tmp_path_factory):
+    """One tiny tiled sweep whose tiles land in a global cache (shared by
+    the ladder tests — the sweep compile is the expensive part)."""
+    from sbr_tpu.utils.checkpoint import run_tiled_grid
+
+    tmp_path = tmp_path_factory.mktemp("swept_cache")
+    base = make_model_params()
+    betas = np.linspace(0.5, 2.0, 4)
+    us = np.linspace(0.05, 0.5, 4)
+    cache_dir = tmp_path / "tile_cache"
+    grid = run_tiled_grid(
+        betas, us, base, config=CFG, tile_shape=(2, 2),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        tile_cache=TileCache(cache_dir),
+    )
+    return base, betas, us, cache_dir, grid
+
+
+class TestEngineBreakerAndLadder:
+    def test_open_breaker_short_circuits_and_degrades_healthz(self):
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        _force_open(eng.breaker)
+        with pytest.raises(SolverUnavailable):
+            eng.query_many([make_model_params(beta=1.1, u=0.2)])[0]
+        health = eng.healthz()
+        assert health["status"] == "degraded"
+        assert any("breaker open" in r for r in health["reasons"])
+        eng.close()
+
+    @staticmethod
+    def _cell_params(base, beta, u):
+        """The ModelParams whose solve IS sweep cell (β, u): swept β/u with
+        the base's pinned η/tspan/x0 economics."""
+        return make_model_params(
+            beta=float(beta), u=float(u), eta=base.economic.eta,
+            tspan=base.learning.tspan, x0=base.learning.x0,
+        )
+
+    def test_store_writes_meta_and_bridge_finds_cell(self, swept_cache):
+        base, betas, us, cache_dir, grid = swept_cache
+        metas = list(cache_dir.rglob("*.meta.json"))
+        assert len(metas) == 4  # one per stored tile
+        doc = json.loads(metas[0].read_text())
+        assert set(doc) == {"key", "cell_tag", "betas", "us"}
+
+        bridge = TileCacheBridge(cache_dir)
+        q = self._cell_params(base, betas[1], us[2])
+        rec = bridge.lookup(q, CFG, "float64")
+        assert rec is not None
+        assert rec["xi"] == pytest.approx(
+            float(np.asarray(grid.xi)[1, 2]), nan_ok=True, abs=0.0
+        )
+        assert rec["status"] == int(np.asarray(grid.status)[1, 2])
+        # A different config must NOT match (tag includes the config).
+        other = dataclasses.replace(CFG, bisect_iters=31)
+        assert bridge.lookup(q, other, "float64") is None
+        # A point off the swept axes must not match either.
+        off = self._cell_params(base, 1.2345, us[2])
+        assert bridge.lookup(off, CFG, "float64") is None
+
+    def test_solver_outage_answered_from_tile_cache(self, tmp_path, monkeypatch,
+                                                    swept_cache):
+        base, betas, us, cache_dir, grid = swept_cache
+        monkeypatch.setenv("SBR_TILE_CACHE_DIR", str(cache_dir))
+        run_dir = tmp_path / "obs_run"
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)),
+                     run_dir=str(run_dir))
+        _force_open(eng.breaker)  # the solver path is DOWN
+        q = self._cell_params(base, betas[0], us[1])
+        res = eng.query_many([q])[0]
+        assert res.degraded is True
+        assert res.source == "tilecache"
+        assert res.xi == pytest.approx(float(np.asarray(grid.xi)[0, 1]), nan_ok=True)
+        assert np.isnan(res.tau_bar_in)  # tiles don't store it — labeled NaN
+        # Observable end-to-end: /statz counters + healthz reason + the
+        # obs manifest fleet block (the acceptance criterion).
+        snap = eng.statz()
+        assert snap["totals"]["degraded"] == 1
+        assert snap["window"]["degraded"] == 1
+        assert any("degraded-ladder" in r for r in snap["healthz"]["reasons"])
+        eng.close()
+        manifest = json.loads((run_dir / "manifest.json").read_text())
+        assert manifest["fleet"]["degraded"] == 1
+
+    def test_outage_without_matching_tile_errors_and_logs_exhaustion(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("SBR_TILE_CACHE_DIR", str(tmp_path / "empty_cache"))
+        run_dir = tmp_path / "obs_run"
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)),
+                     run_dir=str(run_dir))
+        _force_open(eng.breaker)
+        with pytest.raises(SolverUnavailable):
+            eng.query_many([make_model_params(beta=1.27, u=0.33)])[0]
+        eng.close()
+        manifest = json.loads((run_dir / "manifest.json").read_text())
+        assert manifest["fleet"]["ladder_exhausted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Serve result-cache sidecars (ISSUE 11 satellite: verify-on-read)
+# ---------------------------------------------------------------------------
+
+
+class TestServeCacheSidecars:
+    def _warm_cache(self, tmp_path):
+        serve = ServeConfig(buckets=(1,), cache_dir=str(tmp_path / "cache"))
+        eng = Engine(config=CFG, serve=serve)
+        p = make_model_params(beta=1.3, u=0.22)
+        first = eng.query_many([p])[0]
+        eng.close()
+        files = list((tmp_path / "cache" / "results").rglob("*.json"))
+        assert len(files) == 1
+        return serve, p, first, files[0]
+
+    def test_store_writes_sidecar_and_warm_hit_verifies(self, tmp_path):
+        serve, p, first, entry = self._warm_cache(tmp_path)
+        assert Path(str(entry) + ".sha256").exists()
+        eng = Engine(config=CFG, serve=serve)  # fresh LRU: disk path
+        res = eng.query_many([p])[0]
+        assert res.source == "disk"
+        assert _feq(res.xi, first.xi)
+        eng.close()
+
+    def test_corrupt_entry_quarantined_and_recomputed(self, tmp_path):
+        serve, p, first, entry = self._warm_cache(tmp_path)
+        good = entry.read_text()
+        entry.write_text(good.replace('"xi":', '"xi_corrupted":', 1))
+        eng = Engine(config=CFG, serve=serve)
+        res = eng.query_many([p])[0]
+        assert res.source == "computed"  # never trusted the corrupt bytes
+        assert _feq(res.xi, first.xi)
+        quarantined = list((entry.parent / "quarantine").glob("*.json"))
+        assert len(quarantined) == 1  # evidence preserved, slot freed
+        eng.close()
+
+    def test_legacy_sidecarless_entry_still_trusted(self, tmp_path):
+        serve, p, first, entry = self._warm_cache(tmp_path)
+        Path(str(entry) + ".sha256").unlink()
+        eng = Engine(config=CFG, serve=serve)
+        res = eng.query_many([p])[0]
+        assert res.source == "disk"  # pre-sidecar builds keep resuming
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Scrape-coherent windows (ISSUE 11 satellite: /metrics vs rotation race)
+# ---------------------------------------------------------------------------
+
+
+class TestWindowCoherence:
+    def test_statz_window_and_healthz_share_one_fold(self):
+        # A clock that jumps half a window per read: any second fold inside
+        # one statz() would see a DIFFERENT window than the first. The
+        # divergent count in the healthz verdict and the window beside it
+        # must still agree — one fold, passed down.
+        now = [0.0]
+
+        def stepping():
+            now[0] += 30.0
+            return now[0]
+
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+        eng.live = LiveMetrics(window_s=60.0, time_fn=stepping)
+        eng.live.record_query(0.001, "computed", divergent=True)
+        doc = eng.statz()
+        window_divergent = doc["window"]["divergent_cells"]
+        health_mentions = any(
+            "divergent" in r for r in doc["healthz"]["reasons"]
+        )
+        assert (window_divergent > 0) == health_mentions
+        eng.close()
+
+    def test_scrape_hammer_during_observe_stays_coherent(self):
+        lm = LiveMetrics(window_s=0.25)  # slot every ~20 ms: rotations galore
+        stop = threading.Event()
+
+        def observe():
+            while not stop.is_set():
+                lm.record_query(0.0005, "computed")
+                lm.record_query(0.0005, "lru")
+
+        t = threading.Thread(target=observe, daemon=True)
+        t.start()
+        try:
+            for _ in range(150):
+                w = lm.window()
+                # One fold: the quantile summary and the raw histogram
+                # describe the SAME slots. The lock-free contract allows a
+                # concurrent record to tear ONE in-flight count (count vs
+                # counts updated non-atomically), never to mix windows —
+                # so the two views may differ by at most the writer's two
+                # in-flight samples, not by a whole rotated slot.
+                assert abs(
+                    w["latency_ms"]["count"] - sum(w["latency_hist_ms"]["counts"])
+                ) <= 2
+                prom = lm.to_prometheus()
+                assert "sbr_serve_window_queries" in prom
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Router: routing, failover, hedging, shedding (stub workers, no jax)
+# ---------------------------------------------------------------------------
+
+
+class _StubWorker:
+    """A canned /query responder: fixed JSON body, optional delay/status."""
+
+    def __init__(self, fleet_dir, host_id, xi=1.0, status_code=200,
+                 delay_s=0.0, ttl_s=60.0):
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                stub.hits += 1
+                stub.deadlines.append(self.headers.get("X-SBR-Deadline-Ms"))
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                body = json.dumps(
+                    {"xi": stub.xi, "tau_bar_in": 1.0, "aw_max": 2.0,
+                     "status": 1, "flags": 0, "residual": 0.0,
+                     "source": "computed", "degraded": False,
+                     "scenario": "default", "latency_ms": 1.0}
+                ).encode()
+                code = stub.status_code
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                if code == 429:
+                    self.send_header("Retry-After", "2.5")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.xi = xi
+        self.status_code = status_code
+        self.delay_s = delay_s
+        self.hits = 0
+        self.deadlines = []
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+        self.announcer = WorkerAnnouncer(
+            fleet_dir, f"http://127.0.0.1:{self.port}", host=host_id, ttl_s=ttl_s
+        )
+        self.announcer.beat(healthz="ready")
+
+    def close(self):
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+        self.announcer.withdraw()
+
+
+def _post(router, doc=None, deadline_ms=None, timeout=10):
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms is not None:
+        headers["X-SBR-Deadline-Ms"] = str(deadline_ms)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/query",
+        data=json.dumps(doc or {"beta": 1.0, "u": 0.1}).encode(),
+        headers=headers, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestRouter:
+    def test_failover_absorbs_a_dead_worker(self, tmp_path, monkeypatch):
+        # Threshold 1: the dead worker's breaker opens on its FIRST failed
+        # forward (the default 3 needs more traffic than this short mix —
+        # the router's EWMA steers away from it after one failover).
+        monkeypatch.setenv("SBR_BREAKER_THRESHOLD", "1")
+        dead = _StubWorker(tmp_path, "w-dead", status_code=500)
+        live = _StubWorker(tmp_path, "w-live", xi=42.0)
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            codes = [_post(router) for _ in range(4)]
+            assert all(c == 200 for c, _ in codes)
+            assert all(d["xi"] == 42.0 for _, d in codes)
+            assert router.counters["failed"] == 0
+            assert router.counters["failover"] >= 1
+            # The dead worker's breaker opened after threshold failures and
+            # /healthz says so.
+            health = router.healthz()
+            assert health["status"] == "degraded"
+            assert "w-dead" in " ".join(health["reasons"])
+        finally:
+            router.close()
+            dead.close()
+            live.close()
+
+    def test_all_workers_down_is_a_lost_query_503(self, tmp_path):
+        dead = _StubWorker(tmp_path, "w-dead", status_code=500)
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(router)
+            assert err.value.code == 503
+            assert router.counters["failed"] == 1
+        finally:
+            router.close()
+            dead.close()
+
+    def test_worker_429_passes_through_as_shed_not_failover(self, tmp_path):
+        shedder = _StubWorker(tmp_path, "w-shed", status_code=429)
+        peer = _StubWorker(tmp_path, "w-peer", delay_s=0.2)
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            # Drive until the shedding worker is the one picked (scores tie
+            # at the seed; host-id tie-break makes w-peer first, but its
+            # 0.2 s delay raises its EWMA after one hit, so w-shed wins
+            # from the second query on).
+            saw_429 = False
+            for _ in range(4):
+                try:
+                    _post(router)
+                except urllib.error.HTTPError as err:
+                    assert err.code == 429
+                    assert float(err.headers["Retry-After"]) == 2.5
+                    saw_429 = True
+                    break
+            assert saw_429
+            assert router.counters["shed"] == 1
+            assert router.counters["failover"] == 0  # shed is NOT failed over
+            assert router.counters["failed"] == 0
+        finally:
+            router.close()
+            shedder.close()
+            peer.close()
+
+    def test_expired_deadline_sheds_at_router_without_forwarding(self, tmp_path):
+        w = _StubWorker(tmp_path, "w1")
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(router, deadline_ms=-1)
+            assert err.value.code == 429
+            assert w.hits == 0  # shed before any forward
+            assert router.counters["shed"] == 1
+        finally:
+            router.close()
+            w.close()
+
+    def test_deadline_header_propagates_to_worker(self, tmp_path):
+        w = _StubWorker(tmp_path, "w1")
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            _post(router, deadline_ms=5000)
+            assert len(w.deadlines) == 1
+            assert 0 < float(w.deadlines[0]) <= 5000
+        finally:
+            router.close()
+            w.close()
+
+    def test_hedge_win_recorded_once_in_latency_histogram(self, tmp_path):
+        slow = _StubWorker(tmp_path, "a-slow", delay_s=0.8, xi=1.0)
+        fast = _StubWorker(tmp_path, "b-fast", xi=2.0)
+        # Force the primary pick onto the slow worker: host-id tie-break
+        # ("a-slow" < "b-fast") at equal seed scores.
+        router = Router(tmp_path, poll_s=0.01, hedge_ms=50.0).start()
+        try:
+            code, doc = _post(router)
+            assert code == 200
+            assert doc["xi"] == 2.0  # the hedge won
+            assert router.counters["hedged"] == 1
+            assert router.counters["hedge_wins"] == 1
+            # Exactly ONE latency sample for the query — the hedged win is
+            # never double-counted (deadline-semantics satellite).
+            assert router.latency_hist.count == 1
+            assert router.counters["completed"] == 1
+        finally:
+            router.close()
+            slow.close()
+            fast.close()
+
+    def test_worker_4xx_passes_through_without_failover_or_loss(self, tmp_path):
+        # A client error is the CLIENT's fault: re-sending the same bytes
+        # to a peer would 4xx everywhere — so no failover, no breaker
+        # charge, and above all no "lost" count tripping `report fleet`.
+        bad = _StubWorker(tmp_path, "w-400", status_code=400)
+        peer = _StubWorker(tmp_path, "w-peer", delay_s=0.2)
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            saw_400 = False
+            for _ in range(4):
+                try:
+                    _post(router)
+                except urllib.error.HTTPError as err:
+                    assert err.code == 400
+                    saw_400 = True
+                    break
+            assert saw_400
+            assert router.counters["client_errors"] == 1
+            assert router.counters["failed"] == 0
+            assert router.counters["failover"] == 0
+            router.refresh_workers(force=True)
+            with router._workers_lock:
+                assert all(
+                    w.breaker.state == "closed"
+                    for w in router._workers.values()
+                )
+        finally:
+            router.close()
+            bad.close()
+            peer.close()
+
+    def test_bad_deadline_header_is_client_error_not_loss(self, tmp_path):
+        w = _StubWorker(tmp_path, "w1")
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/query",
+                data=b"{}", method="POST",
+                headers={"Content-Type": "application/json",
+                         "X-SBR-Deadline-Ms": "abc"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 400
+            assert router.counters["client_errors"] == 1
+            assert router.counters["failed"] == 0
+        finally:
+            router.close()
+            w.close()
+
+    def test_expired_heartbeat_drops_worker(self, tmp_path):
+        w = _StubWorker(tmp_path, "w1", ttl_s=0.2)
+        router = Router(tmp_path, poll_s=0.01).start()
+        try:
+            router.refresh_workers(force=True)
+            assert router.healthz()["routable"] == 1
+            time.sleep(0.4)  # the TTL lapses with no further beats
+            router.refresh_workers(force=True)
+            assert router.healthz()["routable"] == 0
+        finally:
+            router.close()
+            w.close()
+
+    def test_injected_forward_fault_drives_failover(self, tmp_path):
+        a = _StubWorker(tmp_path, "aa", xi=1.0)
+        b = _StubWorker(tmp_path, "bb", xi=7.0)
+        plan = faults.FaultPlan(
+            {"seed": 0, "rules": [
+                {"point": "router.forward", "kind": "transient",
+                 "match": "aa", "max_fires": 1},
+            ]}
+        )
+        faults.install(plan)
+        try:
+            router = Router(tmp_path, poll_s=0.01).start()
+            code, doc = _post(router)
+            assert code == 200 and doc["xi"] == 7.0
+            assert router.counters["failover"] == 1
+            router.close()
+        finally:
+            faults.install(None)
+            faults.reset()
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# report fleet gating
+# ---------------------------------------------------------------------------
+
+
+class TestReportFleet:
+    def _run_dir(self, tmp_path, counters=None, workers=None, events=()):
+        from sbr_tpu import obs
+
+        run_dir = tmp_path / "run"
+        run = obs.RunContext(run_dir=str(run_dir), label="router")
+        for action in events:
+            run.log_fleet(action)
+        run.live_snapshot(
+            {"schema": "sbr-fleet/1", "counters": counters or {},
+             "workers": workers or {}, "latency_ms": {}},
+            name="fleet.json",
+        )
+        run.finalize()
+        return run_dir
+
+    def _report(self, run_dir, *extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "sbr_tpu.obs.report", "fleet",
+             str(run_dir), "--json", *extra],
+            capture_output=True, text=True, timeout=120,
+        )
+        return proc.returncode, json.loads(proc.stdout)
+
+    def test_clean_run_exits_0(self, tmp_path):
+        run_dir = self._run_dir(
+            tmp_path,
+            counters={"queries": 10, "completed": 10, "failed": 0, "failover": 1},
+            workers={"w1": {"breaker": "closed", "breaker_age_s": None}},
+            events=["failover", "worker_join"],
+        )
+        rc, doc = self._report(run_dir)
+        assert rc == 0
+        assert doc["failover_count"] == 1
+        assert doc["events"]["failover"] == 1
+
+    def test_lost_queries_exit_1(self, tmp_path):
+        run_dir = self._run_dir(
+            tmp_path, counters={"queries": 10, "completed": 9, "failed": 1},
+            events=["lost"],
+        )
+        rc, doc = self._report(run_dir)
+        assert rc == 1
+        assert doc["lost"] == 1
+
+    def test_lost_events_gate_even_without_snapshot_counters(self, tmp_path):
+        # kill -9 fallback: the router died before its final snapshot —
+        # the event fold alone must still gate.
+        run_dir = self._run_dir(tmp_path, counters={"failed": 0}, events=["lost"])
+        rc, doc = self._report(run_dir)
+        assert rc == 1
+
+    def test_breaker_stuck_open_exit_1_and_threshold(self, tmp_path):
+        workers = {"w1": {"breaker": "open", "breaker_age_s": 120.0}}
+        run_dir = self._run_dir(
+            tmp_path, counters={"queries": 1, "completed": 1, "failed": 0},
+            workers=workers, events=["breaker_open"],
+        )
+        rc, doc = self._report(run_dir, "--stuck-after-s", "60")
+        assert rc == 1
+        assert doc["stuck_breakers"] == ["w1"]
+        # Default threshold (600 s) tolerates a recently opened breaker —
+        # e.g. one parked over a freshly dead worker.
+        rc, doc = self._report(run_dir)
+        assert rc == 0
+
+    def test_no_fleet_data_exit_3_and_bad_dir_exit_2(self, tmp_path):
+        from sbr_tpu import obs
+
+        empty = tmp_path / "empty_run"
+        run = obs.RunContext(run_dir=str(empty), label="not-a-router")
+        run.finalize()
+        rc, _ = self._report(empty)
+        assert rc == 3
+        rc, _ = self._report(tmp_path / "nope")
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# History schema 7
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema7:
+    def test_schema_is_7_and_keys_picked_up(self):
+        from sbr_tpu.obs import history
+
+        assert history.SCHEMA == 7
+        metrics = history.bench_metrics(
+            {"metric": "x", "value": 1.0,
+             "extra": {"fleet_p99_ms": 12.5, "fleet_failover_count": 0,
+                       "fleet_shed_rate": 0.0, "serve_p99_ms": 3.0}}
+        )
+        assert metrics["fleet_p99_ms"] == 12.5
+        assert metrics["fleet_failover_count"] == 0
+        assert metrics["fleet_shed_rate"] == 0.0
+
+    def test_polarity_fleet_metrics_lower_better(self):
+        from sbr_tpu.obs.history import polarity
+
+        assert polarity("fleet_p99_ms") == -1
+        assert polarity("fleet_failover_count") == -1
+        assert polarity("fleet_shed_rate") == -1
+        # The established polarities must not flip.
+        assert polarity("serve_cache_hit_rate") == 1
+        assert polarity("grid_adaptive_speedup") == 1
+        assert polarity("sweep_warm_cells_per_sec") == 1
+
+    def test_schemas_1_through_6_still_load_and_gate_schema_7(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        base = {"beta_u_grid_equilibria_per_sec": 100.0}
+        lines = [
+            {"metrics": base, "label": "bench", "platform": "cpu"},  # schema-less
+            {"schema": 2, "metrics": {**base, "mem_peak_bytes": 10}, "platform": "cpu"},
+            {"schema": 3, "metrics": {**base, "serve_p99_ms": 5.0}, "platform": "cpu"},
+            {"schema": 4, "metrics": {**base, "sweep_warm_hit_rate": 1.0}, "platform": "cpu"},
+            {"schema": 5, "metrics": {**base, "grid_adaptive_speedup": 2.0}, "platform": "cpu"},
+            {"schema": 6, "metrics": {**base, "agents_graph_gen_speedup": 9.0}, "platform": "cpu"},
+        ]
+        with open(path, "w") as fh:
+            for rec in lines:
+                fh.write(json.dumps({"ts": "t", **rec}) + "\n")
+        history.append(
+            {**base, "fleet_p99_ms": 12.0, "fleet_failover_count": 0,
+             "fleet_shed_rate": 0.0},
+            platform="cpu", path=path,
+        )
+        records = history.load(path)
+        assert len(records) == 7
+        assert records[0]["schema"] == 1 and records[-1]["schema"] == 7
+        verdicts, status = history.check(records, tolerance=0.15)
+        assert status == "ok"
+
+    def test_fleet_p99_regression_gates(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        for v in (10.0, 10.5, 9.8):
+            history.append({"fleet_p99_ms": v}, platform="cpu", path=path)
+        history.append({"fleet_p99_ms": 30.0}, platform="cpu", path=path)
+        verdicts, status = history.check(history.load(path), tolerance=0.15)
+        assert status == "regression"
+        assert verdicts["fleet_p99_ms"]["status"] == "regression"
+
+    def test_failover_increase_from_zero_baseline_regresses(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        for _ in range(3):
+            history.append({"fleet_failover_count": 0}, platform="cpu", path=path)
+        history.append({"fleet_failover_count": 2}, platform="cpu", path=path)
+        verdicts, status = history.check(history.load(path))
+        # Lower-better with a zero baseline: ANY increase is a regression
+        # (a clean fleet that starts failing over is a signal, not a %).
+        assert status == "regression"
+
+
+# ---------------------------------------------------------------------------
+# Tile-cache meta gc
+# ---------------------------------------------------------------------------
+
+
+class TestTileMetaGc:
+    def test_gc_removes_meta_with_entry_and_orphans(self, tmp_path):
+        cache = TileCache(tmp_path / "cache")
+        base = make_model_params()
+        key = cache.key(base, CFG, "float64", [1.0], [0.1])
+        arrays = {f: np.zeros((1, 1)) for f in ("max_aw", "xi", "status")}
+        meta = tile_meta(base, CFG, "float64", [1.0], [0.1], key)
+        cache.store(key, arrays, meta=meta)
+        entry = cache.path(key)
+        meta_path = Path(str(entry)[: -len(".npz")] + ".meta.json")
+        assert meta_path.exists()
+        # Cold entry: gc removes entry + sha256 + meta together.
+        removed = gc_tile_cache(cache.root, keep_days=0.0,
+                                now=time.time() + 86400.0)
+        assert entry in removed and meta_path in removed
+        # Orphan meta (entry pruned separately): swept after the grace hour.
+        meta_path.write_text(json.dumps(meta))
+        removed = gc_tile_cache(cache.root, keep_days=9999.0,
+                                now=time.time() + 7200.0)
+        assert meta_path in removed
+
+    def test_cell_tag_distinguishes_economics_and_config(self):
+        base = make_model_params()
+        t1 = cell_tag(base, CFG, "float64")
+        assert t1 == cell_tag(make_model_params(), CFG, "float64")
+        assert t1 != cell_tag(make_model_params(kappa=0.61), CFG, "float64")
+        assert t1 != cell_tag(base, dataclasses.replace(CFG, n_grid=128), "float64")
+        assert t1 != cell_tag(base, CFG, "float32")
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (ISSUE 11 satellite) — one subprocess worker
+# ---------------------------------------------------------------------------
+
+
+class TestGracefulDrain:
+    def test_sigterm_drains_heartbeat_and_finalizes_interrupted(self, tmp_path):
+        from sbr_tpu.serve.loadgen import spawn_worker
+
+        fleet_dir = tmp_path / "fleet"
+        run_dir = tmp_path / "wrun"
+        w = spawn_worker(
+            str(fleet_dir), n_grid=96, bisect_iters=30, buckets="1",
+            run_dir=str(run_dir), platform="cpu", heartbeat_ttl=60.0,
+            timeout_s=180.0,
+        )
+        try:
+            assert list(fleet_dir.glob("host_*.hb"))  # announced
+            os.kill(w["pid"], signal.SIGTERM)
+            rc = w["proc"].wait(timeout=60)
+            assert rc == 143  # 128 + SIGTERM: the graceful-shutdown contract
+            # The heartbeat was withdrawn at drain — router peers reclaim
+            # instantly instead of waiting out the 60 s TTL.
+            assert not list(fleet_dir.glob("host_*.hb"))
+            manifest = json.loads((run_dir / "manifest.json").read_text())
+            assert manifest["status"] == "interrupted"
+        finally:
+            if w["proc"].poll() is None:
+                w["proc"].kill()

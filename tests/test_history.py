@@ -42,12 +42,13 @@ def test_append_load_round_trip(tmp_path):
     )
     assert out == p
     (rec,) = history.load(p)
-    # schema 6 (ISSUE 10): the mega-scale agents generation split joined
-    # the record (5 added adaptive numerics, 4 elastic sweeps, 3 serving,
-    # 2 memory); the key set only grew, and schema-1..5/-less lines still
-    # load (tests/test_mem.py, tests/test_serve.py, tests/test_elastic.py,
-    # tests/test_numerics.py, tests/test_graphgen.py).
-    assert rec["schema"] == history.SCHEMA == 6
+    # schema 7 (ISSUE 11): the serving-fleet SLO split joined the record
+    # (6 added mega-agents generation, 5 adaptive numerics, 4 elastic
+    # sweeps, 3 serving, 2 memory); the key set only grew, and
+    # schema-1..6/-less lines still load (tests/test_mem.py,
+    # tests/test_serve.py, tests/test_elastic.py, tests/test_numerics.py,
+    # tests/test_graphgen.py, tests/test_fleet.py).
+    assert rec["schema"] == history.SCHEMA == 7
     assert rec["label"] == "x" and rec["platform"] == "cpu"
     # only finite numerics survive; bools coerce to gateable ints
     assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}
