@@ -104,6 +104,14 @@ through the seeded loadgen mix over HTTP and reports the client-observed
 `extra.fleet_p99_ms` plus `fleet_failover_count` / `fleet_shed_rate`
 (history schema 7); any lost query fails the workload outright.
 
+Differentiable equilibria (ISSUE 13): a sixth workload measures the
+`sbr_tpu.grad` subsystem — IFT sensitivity-surface throughput
+(`extra.grads_per_sec`: partial derivatives per second through the
+vmapped value-and-grad grid program) and calibration speed
+(`extra.calib_steps_per_sec`: jitted Adam steps over the IFT loss) —
+appended to the perf history as schema 8 (schema-1..7 lines still load
+and gate; both keys learn higher-better polarity from the per_sec rule).
+
 Mega-scale agents (ISSUE 10): the agents workload now generates its graph
 ON DEVICE (`sbr_tpu.social.graphgen` — the edge list never transits host
 RAM) at 10^7 agents / 10^8 edges on every non-tiny platform, CPU
@@ -1310,6 +1318,97 @@ def bench_sweep(platform: str) -> dict:
     }
 
 
+def bench_grad(platform: str) -> dict:
+    """Differentiable-equilibria workload (ISSUE 13): IFT gradient
+    throughput + calibration speed.
+
+    Part 1 times `grad.api.sensitivity_surface` — the vmapped
+    value-and-grad grid program — with the fenced single-dispatch
+    protocol: `grads_per_sec` counts PARTIAL DERIVATIVES per second
+    (cells × len(wrt)), the honest unit for a program whose cost scales
+    with the wrt set. Part 2 times `grad.calibrate.fit_withdrawals` on the
+    deterministic synthetic fixture: `calib_steps_per_sec` counts jitted
+    Adam steps (compile excluded — one untimed step first). Tiny shapes
+    zero the gated keys so reduced-shape stats never seed a baseline."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu.grad import api, calibrate
+    from sbr_tpu.models.params import SolverConfig, make_model_params, with_overrides
+
+    if _tiny():
+        n_beta = n_u = 6
+        n_grid = 128
+        calib_steps = 8
+    else:
+        n_beta = n_u = 32 if platform == "cpu" else 96
+        n_grid = 384 if platform == "cpu" else 1024
+        calib_steps = 120
+    config = SolverConfig(n_grid=n_grid, bisect_iters=60, refine_crossings=False)
+    wrt = ("beta", "u", "kappa")
+    base = make_model_params()
+    betas = np.linspace(0.5, 2.5, n_beta)
+
+    from sbr_tpu import obs
+
+    def dispatch(rep: int):
+        us = np.linspace(0.03, 0.3, n_u) + rep * 1e-7
+        surf = api.sensitivity_surface(betas, us, base, wrt=wrt, config=config)
+        fence = jnp.nansum(surf.xi) + sum(jnp.nansum(g) for g in surf.grads.values())
+        return surf, fence
+
+    t0 = time.perf_counter()
+    _, fence = dispatch(0)
+    float(fence)  # compile + fence
+    first_s = time.perf_counter() - t0
+
+    with obs.suspended(), obs.mem.live_disabled():
+        times = []
+        for rep in range(1, 4):
+            t0 = time.perf_counter()
+            _, fence = dispatch(rep)
+            float(fence)
+            times.append(time.perf_counter() - t0)
+        surface_s = min(times)
+
+        # Calibration: plant θ*, fit from a perturbed run-region init; one
+        # untimed step burns the compile so the rate is steady-state.
+        truth = make_model_params(beta=1.4, u=0.12, kappa=0.55)
+        t_obs, aw_obs, xi_obs = calibrate.synth_withdrawals(
+            truth, n_obs=48, config=config
+        )
+        init = with_overrides(truth, beta=1.1, u=0.15, kappa=0.62)
+        calibrate.fit_withdrawals(
+            t_obs, aw_obs, init, xi_obs=xi_obs, steps=1, config=config
+        )
+        t0 = time.perf_counter()
+        fit = calibrate.fit_withdrawals(
+            t_obs, aw_obs, init, xi_obs=xi_obs, steps=calib_steps,
+            loss_tol=0.0, config=config,
+        )
+        calib_s = time.perf_counter() - t0
+
+    n_cells = n_beta * n_u
+    n_grads = n_cells * len(wrt)
+    grads_per_sec = 0.0 if _tiny() else n_grads / surface_s
+    calib_rate = 0.0 if _tiny() else fit.steps / calib_s
+    _log(
+        f"grad: {n_grads} partials over {n_cells} cells in {surface_s:.3f}s "
+        f"steady ({first_s:.1f}s first incl. compile); calibration "
+        f"{fit.steps} step(s) in {calib_s:.3f}s (converged={fit.converged}, "
+        f"loss {fit.loss:.2e})"
+    )
+    return {
+        "grad_cells": n_cells,
+        "grad_surface_s": round(surface_s, 4),
+        "grad_first_call_s": round(first_s, 2),
+        "grads_per_sec": round(grads_per_sec, 1),
+        "calib_steps_per_sec": round(calib_rate, 2),
+        "calib_converged": bool(fit.converged),
+        "calib_loss": fit.loss,
+    }
+
+
 def measure(platform: str) -> None:
     """Measurement child entry: the real body runs inside a
     graceful-shutdown envelope so a preemption (SIGTERM) mid-bench still
@@ -1396,6 +1495,20 @@ def _measure_inner(platform: str) -> None:
             "bench_fleet",
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in fleet.items() if v is not None},
+        )
+    try:
+        with obs.span("bench.grad"):
+            grad = bench_grad(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the differentiable-equilibria workload fails.
+        _log(f"grad bench failed: {err!r}")
+        grad = None
+    if grad is not None:
+        obs.event(
+            "bench_grad",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in grad.items() if v is not None},
         )
 
     eq_per_sec = grid["eq_per_sec"]
@@ -1486,6 +1599,15 @@ def _measure_inner(platform: str) -> None:
         ):
             if fleet.get(k) is not None:
                 out["extra"][k] = fleet[k]
+    if grad is not None:
+        # Schema-8 history metrics (ISSUE 13): IFT gradient throughput +
+        # calibration step rate. Tiny shapes zero the gated keys (falsy →
+        # dropped here) so reduced-shape stats never seed baselines.
+        for k in ("grads_per_sec", "calib_steps_per_sec"):
+            if grad.get(k):
+                out["extra"][k] = grad[k]
+        out["extra"]["grad_cells"] = grad["grad_cells"]
+        out["extra"]["calib_converged"] = grad["calib_converged"]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

@@ -31,6 +31,10 @@ Subpackage map (reference component in parens):
                  graph simulation (new capability).
 - ``sweeps``   — vmapped / mesh-sharded comparative statics
                  (``scripts/1_baseline.jl`` sweeps).
+- ``grad``     — differentiable equilibria: implicit-function-theorem
+                 dξ/dθ through the fixed point (custom-JVP root rules),
+                 sensitivity surfaces, withdrawal-curve calibration, and
+                 gradient-based worst-case stress search (new capability).
 - ``diag``     — in-jit numerical-health diagnostics: the `Health` pytree
                  (residuals, bracket widths, NaN/fallback flags) threaded
                  through every solver stack and sweep (new capability).

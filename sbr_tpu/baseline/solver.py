@@ -96,8 +96,22 @@ def _warped_grid(eta, beta, x0, n, warp, dtype):
     q = jnp.linspace(jnp.zeros((), dtype), jnp.ones((), dtype), n_q)
     g_eta = logistic_cdf(eta, beta, x0)
     levels = x0 + q * (g_eta - x0)
+
+    # Saturation guard (ISSUE 13): once G(η) rounds to exactly 1 (f32 at
+    # β·η ≳ 29), log1p(-1) is -inf and every arithmetic step a saturated
+    # lane touches afterwards (the subtraction, the division by β) leaks
+    # 0·inf = NaN into the β-cotangent under reverse-mode AD — on RUN
+    # cells, since the poisoned lanes are only CLIPPED away, not removed.
+    # The where-pair keeps forward values BIT-IDENTICAL (unsaturated lanes
+    # compute the same expression; saturated lanes were +inf before and
+    # are the +inf constant now, pinned to η by the clip either way) while
+    # routing the differentiation path through a finite dummy whose
+    # cotangent the selects zero out.
     logit = lambda v: jnp.log(v) - jnp.log1p(-v)
-    t_quant = (logit(levels) - logit(jnp.asarray(x0, dtype))) / beta
+    sat = levels >= 1.0
+    safe_levels = jnp.where(sat, jnp.asarray(0.5, dtype), levels)
+    num = logit(safe_levels) - logit(jnp.asarray(x0, dtype))
+    t_quant = jnp.where(sat, jnp.asarray(jnp.inf, dtype), num / beta)
     grid = jnp.sort(jnp.concatenate([t_uniform, t_quant]))
     # pin the endpoints exactly (t_quant hits 0/η only up to rounding)
     return jnp.clip(grid, 0.0, eta).at[0].set(0.0).at[-1].set(eta)
@@ -186,6 +200,42 @@ def hazard_rate(p, lam, ls: LearningSolution, eta, config: SolverConfig | None =
     return tau_grid, hr
 
 
+def hazard_at_from_parts(
+    tau, tau_grid, integ, int_eta, p, lam, beta, x0, nodes, weights
+):
+    """Continuous exact hazard h(τ̄) as a PURE function of its parts — one
+    knot lookup plus a single Gauss-Legendre panel over the sub-interval,
+    exact for the analytic closed-form integrand.
+
+    Factored out of `_make_hazard_at`'s closure (ISSUE 13) so the grad
+    subsystem can evaluate the SAME formula with every argument explicit:
+    `sbr_tpu.grad.ift.implicit_root` needs the crossing residual
+    h(τ̄; θ) − u as ``f(x, operand)`` with all tangent-carrying inputs in
+    the operand pytree, and sharing this body (rather than transcribing it)
+    guarantees the IFT linearization differentiates exactly the function
+    whose root the forward refinement bisection found."""
+    n = tau_grid.shape[0]
+    # binary-search lookup: the grid may be warped (non-uniform)
+    i = jnp.clip(jnp.searchsorted(tau_grid, tau, side="right") - 1, 0, n - 2)
+    a = tau_grid[i]
+    half = 0.5 * (tau - a)
+    mid = 0.5 * (tau + a)
+    xs = mid + half * nodes
+    vals = jnp.exp(lam * xs) * logistic_pdf(xs, beta, x0)
+    i_loc = integ[i] + half * jnp.dot(weights, vals)
+    num = p * jnp.exp(lam * tau) * logistic_pdf(tau, beta, x0)
+    return num / (p * i_loc + (1.0 - p) * int_eta)
+
+
+def quad_nodes_weights(order: int, dtype):
+    """Gauss-Legendre nodes/weights as jnp arrays of ``dtype`` (shared by
+    the closure below and the grad subsystem's operand evaluator)."""
+    import numpy as np
+
+    nodes, weights = np.polynomial.legendre.leggauss(order)
+    return jnp.asarray(nodes, dtype=dtype), jnp.asarray(weights, dtype=dtype)
+
+
 def _make_hazard_at(p, lam, ls: LearningSolution, tau_grid, integ, int_eta, config: SolverConfig):
     """Continuous exact hazard evaluator for closed-form Stage 1.
 
@@ -193,30 +243,18 @@ def _make_hazard_at(p, lam, ls: LearningSolution, tau_grid, integ, int_eta, conf
     the precomputed knot value plus a single Gauss-Legendre panel over the
     sub-interval — exact for the analytic integrand, so buffer crossings can be
     refined to machine precision instead of the grid-linear-interp accuracy the
-    reference settles for (`solver.jl:233-250`).
-    """
-    import numpy as np
-
+    reference settles for (`solver.jl:233-250`). Thin closure over
+    `hazard_at_from_parts` (the shared pure form)."""
     dtype = tau_grid.dtype
-    nodes, weights = np.polynomial.legendre.leggauss(config.quad_order)
-    nodes = jnp.asarray(nodes, dtype=dtype)
-    weights = jnp.asarray(weights, dtype=dtype)
-    n = tau_grid.shape[0]
+    nodes, weights = quad_nodes_weights(config.quad_order, dtype)
     beta, x0 = ls.beta, ls.x0
     p = jnp.asarray(p, dtype=dtype)
     lam = jnp.asarray(lam, dtype=dtype)
 
     def hazard_at(tau):
-        # binary-search lookup: the grid may be warped (non-uniform)
-        i = jnp.clip(jnp.searchsorted(tau_grid, tau, side="right") - 1, 0, n - 2)
-        a = tau_grid[i]
-        half = 0.5 * (tau - a)
-        mid = 0.5 * (tau + a)
-        xs = mid + half * nodes
-        vals = jnp.exp(lam * xs) * logistic_pdf(xs, beta, x0)
-        i_loc = integ[i] + half * jnp.dot(weights, vals)
-        num = p * jnp.exp(lam * tau) * logistic_pdf(tau, beta, x0)
-        return num / (p * i_loc + (1.0 - p) * int_eta)
+        return hazard_at_from_parts(
+            tau, tau_grid, integ, int_eta, p, lam, beta, x0, nodes, weights
+        )
 
     return hazard_at
 

@@ -59,6 +59,12 @@ NAN_OUTPUT = 1 << 7  # non-finite values in a computed result (iterate, curve)
 FP_NOT_CONVERGED = 1 << 8  # fixed point hit max_iter without converging
 FP_ABORTED = 1 << 9  # fixed point's ξ search exceeded η and gave up
 ODE_BUDGET = 1 << 10  # adaptive ODE interval exhausted its step budget
+# Gradient-trust bits (sbr_tpu.grad, ISSUE 13): set on IFT sensitivity
+# outputs, never by the forward solvers. They classify whether dξ/dθ can be
+# trusted, the way DIVERGENT_MASK classifies whether ξ itself can.
+GRAD_AT_NONEQUILIBRIUM = 1 << 11  # root candidate is not a RUN equilibrium
+GRAD_ILL_CONDITIONED = 1 << 12  # |AW'(ξ)| near zero: dξ/dθ = -F_θ/F_ξ blows up
+GRAD_NONFINITE = 1 << 13  # a computed gradient came back NaN/Inf
 
 FLAG_NAMES = {
     FALLBACK_IN_KNOT: "fallback_in_knot",
@@ -72,6 +78,9 @@ FLAG_NAMES = {
     FP_NOT_CONVERGED: "fp_not_converged",
     FP_ABORTED: "fp_aborted",
     ODE_BUDGET: "ode_budget",
+    GRAD_AT_NONEQUILIBRIUM: "grad_at_nonequilibrium",
+    GRAD_ILL_CONDITIONED: "grad_ill_conditioned",
+    GRAD_NONFINITE: "grad_nonfinite",
 }
 ALL_FLAGS = tuple(FLAG_NAMES)
 
@@ -103,6 +112,26 @@ class Health:
     bracket_width: jnp.ndarray  # final bisection bracket; NaN = n/a
     iterations: jnp.ndarray  # int32, summed by merge
     flags: jnp.ndarray  # int32 bitmask of the module-level bits
+
+    def __post_init__(self):
+        # Differentiability contract (ISSUE 13): health is TELEMETRY, never
+        # part of the differentiated computation. Every leaf is cut from the
+        # tangent/cotangent graph at construction, so a solve that threads
+        # health through jax.grad/jvp has bitwise the same gradient as the
+        # health-free solve — a caller folding health.residual into a loss
+        # gets zero, not a spurious d|residual|/dθ term backpropagated
+        # through the residual evaluation (regression: tests/test_grad.py).
+        # Identity on values, so forward results and jaxpr shapes are
+        # untouched; runs again on `replace`/tree_unflatten, idempotently.
+        # Transform internals (vmap axis-tree building) unflatten structs
+        # with non-array SENTINEL leaves — those pass through untouched.
+        from jax import lax
+
+        for field in ("residual", "bracket_width", "iterations", "flags"):
+            try:
+                object.__setattr__(self, field, lax.stop_gradient(getattr(self, field)))
+            except TypeError:
+                pass
 
     @classmethod
     def empty(cls, dtype=jnp.float32) -> "Health":

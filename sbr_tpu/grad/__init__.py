@@ -1,0 +1,58 @@
+"""Differentiable equilibria (ISSUE 13): implicit-function-theorem
+gradients through the Stage 2–3 solve, calibration of structural
+parameters to observed withdrawal curves, and gradient-based worst-case
+stress search.
+
+- `grad.ift`       — `implicit_root`: custom-JVP root differentiation
+  (one linearization at the fixed point; zero backprop through solver
+  iterations).
+- `grad.cell`      — the differentiable baseline/interest cells (the
+  grad twin of `sweeps.baseline_sweeps.solve_param_cell`; bit-identical
+  primal ξ).
+- `grad.api`       — `xi_and_grad`, `interest_xi_and_grad`,
+  `sensitivity_surface`, grad-trust flags.
+- `grad.calibrate` — `fit_withdrawals` + the `synth_withdrawals` fixture.
+- `grad.stress`    — `run_margin`, `stress_search`.
+- `grad.parity`    — the CI IFT-vs-FD battery
+  (``python -m sbr_tpu.grad.parity``).
+
+Stack coverage: baseline and interest (both closed-form Stage 1; the
+interest HJB stage differentiates via the fixed-RK4 recompute rule). The
+hetero stack is deliberately NOT grad-capable yet — its coupled-K ODE
+runs an adjoint-less `lax.while_loop` and its sharded path would nest
+custom rules under `shard_map` (rationale in grad/cell.py).
+"""
+
+from sbr_tpu.grad.api import (
+    GRAD_UNTRUSTED_MASK,
+    GradResult,
+    SensitivitySurface,
+    cell_value_and_grads,
+    flag_census,
+    interest_xi_and_grad,
+    sensitivity_surface,
+    xi_and_grad,
+    xi_value,
+)
+from sbr_tpu.grad.calibrate import CalibResult, fit_withdrawals, synth_withdrawals
+from sbr_tpu.grad.ift import implicit_root
+from sbr_tpu.grad.stress import StressResult, run_margin, stress_search
+
+__all__ = [
+    "CalibResult",
+    "GRAD_UNTRUSTED_MASK",
+    "GradResult",
+    "SensitivitySurface",
+    "StressResult",
+    "cell_value_and_grads",
+    "fit_withdrawals",
+    "flag_census",
+    "implicit_root",
+    "interest_xi_and_grad",
+    "run_margin",
+    "sensitivity_surface",
+    "stress_search",
+    "synth_withdrawals",
+    "xi_and_grad",
+    "xi_value",
+]

@@ -1,0 +1,348 @@
+"""Public differentiable-equilibria API (ISSUE 13).
+
+Three entry points over `grad.cell`'s differentiable pipeline:
+
+- `xi_and_grad(params)` — one equilibrium plus dξ/dθ for the requested
+  parameters, as a `GradResult` with grad-trust flags.
+- `interest_xi_and_grad(params)` — the same for the interest-rate stack
+  (θ additionally spans r and δ; the HJB stage differentiates via the
+  fixed-RK4 recompute rule, see grad/cell.py).
+- `sensitivity_surface(beta_values, u_values, base)` — the Figure-5 grid
+  with ∂ξ/∂θ surfaces next to ξ: `jax.value_and_grad` vmapped over both
+  axes exactly like `sweeps.beta_u_grid` vmaps the forward cell, one
+  jitted program cached per (config, dtype, wrt).
+
+Flag semantics (bits live in `diag.health` so `flag_names` decodes them):
+
+- ``GRAD_AT_NONEQUILIBRIUM`` — the differentiated root candidate is not a
+  RUN equilibrium (status says why); dξ/dθ describes the candidate root,
+  not an equilibrium. The slope check rejecting a false equilibrium lands
+  here.
+- ``GRAD_ILL_CONDITIONED``  — |AW'(ξ)| ≤ `SBR_GRAD_APRIME_TOL` (default
+  √eps): the IFT division is blowing up, e.g. ξ at the withdrawal-curve
+  peak where the equilibrium is about to vanish.
+- ``GRAD_NONFINITE``        — a computed gradient is NaN/Inf.
+
+`GRAD_UNTRUSTED_MASK` collects all three; a host-side `flag_census` feeds
+the obs event stream (`report grad` renders and gates it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from sbr_tpu.diag.health import (
+    GRAD_AT_NONEQUILIBRIUM,
+    GRAD_ILL_CONDITIONED,
+    GRAD_NONFINITE,
+)
+from sbr_tpu.grad.cell import (
+    BASE_KEYS,
+    INTEREST_KEYS,
+    aprime_tol,
+    baseline_cell,
+    interest_cell,
+)
+from sbr_tpu.models.params import (
+    ModelParams,
+    SolverConfig,
+    params_to_pytree,
+)
+from sbr_tpu.obs import prof
+
+GRAD_UNTRUSTED_MASK = GRAD_AT_NONEQUILIBRIUM | GRAD_ILL_CONDITIONED | GRAD_NONFINITE
+
+WRT_DEFAULT = ("beta", "u", "kappa")
+
+
+@struct.dataclass
+class GradResult:
+    """One differentiated equilibrium. ``grads`` maps parameter name →
+    dξ/dθ of the ROOT CANDIDATE (well-defined across run boundaries, where
+    the NaN-masked ξ is not); trust them per ``flags``."""
+
+    xi: jnp.ndarray  # NaN-masked, identical to the forward solver's
+    xi_candidate: jnp.ndarray  # the unmasked differentiated root
+    grads: dict  # name -> dξ/dθ
+    aw_prime: jnp.ndarray  # AW'(ξ), the IFT denominator
+    status: jnp.ndarray  # int32 Status code
+    flags: jnp.ndarray  # int32 GRAD_* bitmask
+
+    @property
+    def trusted(self):
+        return (self.flags & GRAD_UNTRUSTED_MASK) == 0
+
+
+@struct.dataclass
+class SensitivitySurface:
+    """(B, U) sensitivity grids next to the ξ grid (Figure-5 shaped)."""
+
+    beta_values: jnp.ndarray
+    u_values: jnp.ndarray
+    xi: jnp.ndarray  # (B, U), NaN-masked
+    grads: dict  # name -> (B, U) dξ/dθ surfaces
+    aw_prime: jnp.ndarray  # (B, U)
+    status: jnp.ndarray  # (B, U) int32
+    flags: jnp.ndarray  # (B, U) int32 GRAD_* bitmask
+
+
+def _resolve(config: Optional[SolverConfig], dtype):
+    if config is None:
+        config = SolverConfig(refine_crossings=False)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return config, jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+
+
+def _validate_wrt(wrt, keys) -> Tuple[str, ...]:
+    wrt = tuple(wrt)
+    unknown = set(wrt) - set(keys)
+    if not wrt or unknown:
+        raise ValueError(f"wrt must be a non-empty subset of {keys}, got {wrt!r}")
+    return wrt
+
+
+def _with_nonfinite_flag(flags, grads: dict):
+    bad = jnp.zeros(jnp.shape(flags), bool)
+    for g in grads.values():
+        bad = bad | ~jnp.isfinite(g)
+    return flags | jnp.where(bad, jnp.int32(GRAD_NONFINITE), jnp.int32(0))
+
+
+def _cell_outputs(cell, theta: dict, wrt, config, dtype, tol_ap=None):
+    """value_and_grad of one cell w.r.t. the ``wrt`` sub-dict; returns
+    (xi, xi_candidate, grads dict, aw_prime, status, flags). Raw (unjitted)
+    so callers can jit/vmap the composition — the serve engine embeds this
+    inside its own batch programs."""
+    wrt_vals = {k: theta[k] for k in wrt}
+    rest = {k: v for k, v in theta.items() if k not in wrt}
+
+    def value_fn(wv):
+        out = cell({**rest, **wv}, config, dtype, aprime_tol_=tol_ap)
+        return out["xi_candidate"], out
+
+    (xi_c, out), grads = jax.value_and_grad(value_fn, has_aux=True)(wrt_vals)
+    flags = _with_nonfinite_flag(out["flags"], grads)
+    return out["xi"], xi_c, grads, out["aw_prime"], out["status"], flags
+
+
+def cell_value_and_grads(theta: dict, wrt, config: SolverConfig, dtype,
+                         interest: bool = False, aprime_tol_=None):
+    """In-jit building block: baseline/interest cell value + grads from a
+    θ dict of traced scalars (see `_cell_outputs` for the return shape).
+    ``aprime_tol_`` must be resolved by the CALLER when the composition is
+    cached/serialized — the env default is read at trace time and would
+    otherwise freeze into the program (see `_scalar_fn`)."""
+    cell = interest_cell if interest else baseline_cell
+    keys = INTEREST_KEYS if interest else BASE_KEYS
+    return _cell_outputs(
+        cell, {k: theta[k] for k in keys}, wrt, config, dtype, tol_ap=aprime_tol_
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _scalar_fn(config: SolverConfig, dtype_name: str, wrt: tuple, interest: bool,
+               tol_ap: float):
+    # ``tol_ap`` is resolved by the caller AT CALL TIME and is part of this
+    # cache key: the env knob SBR_GRAD_APRIME_TOL would otherwise be baked
+    # into the first-built program and silently ignored afterwards.
+    dtype = jnp.dtype(dtype_name)
+    keys = INTEREST_KEYS if interest else BASE_KEYS
+
+    def fn(*vals):
+        prof.note_trace("grad.cell")
+        theta = dict(zip(keys, vals))
+        return cell_value_and_grads(
+            theta, wrt, config, dtype, interest=interest, aprime_tol_=tol_ap
+        )
+
+    return jax.jit(fn)
+
+
+def _theta_values(params, keys, dtype) -> tuple:
+    tree = params_to_pytree(
+        params if isinstance(params, ModelParams)
+        else ModelParams(params.learning, params.economic)
+    )
+    tree.pop("eta_bar")
+    if "r" in keys:
+        tree["r"] = params.economic.r
+        tree["delta"] = params.economic.delta
+    return tuple(jnp.asarray(tree[k], dtype) for k in keys)
+
+
+def _log_flag_census(stage: str, status, flags) -> None:
+    """Host-boundary flag census → one obs ``grad`` event (enabled runs
+    only), the stream `report grad` folds and gates."""
+    from sbr_tpu import obs
+
+    if not obs.enabled():
+        return
+    obs.event("grad", action="flags", stage=stage, **flag_census(status, flags))
+
+
+def flag_census(status, flags) -> dict:
+    """JSON-ready counts of the grad-trust bits over a (batched) result.
+
+    ``nonfinite_run`` is the GATE signal (`report grad`): a NaN/Inf
+    gradient at a healthy RUN equilibrium is a genuine defect, while
+    non-finite gradients on non-equilibrium lanes are the expected face of
+    degenerate brackets (those lanes are already flagged untrusted)."""
+    import numpy as np
+
+    flags = np.atleast_1d(np.asarray(flags, dtype=np.int64)).ravel()
+    status = np.atleast_1d(np.asarray(status)).ravel()
+    nonfinite = (flags & GRAD_NONFINITE) != 0
+    return {
+        "cells": int(flags.size),
+        "run_cells": int((status == 0).sum()),
+        "at_nonequilibrium": int(((flags & GRAD_AT_NONEQUILIBRIUM) != 0).sum()),
+        "ill_conditioned": int(((flags & GRAD_ILL_CONDITIONED) != 0).sum()),
+        "nonfinite": int(nonfinite.sum()),
+        "nonfinite_run": int((nonfinite & (status == 0)).sum()),
+        "untrusted": int(((flags & GRAD_UNTRUSTED_MASK) != 0).sum()),
+    }
+
+
+def xi_and_grad(
+    params: ModelParams,
+    wrt=WRT_DEFAULT,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> GradResult:
+    """ξ and dξ/dθ for one parameter point (baseline stack).
+
+    ``wrt`` selects the differentiated parameters (subset of
+    `grad.cell.BASE_KEYS`). The forward value is bit-identical to
+    `solve_param_cell`'s; each gradient costs one residual linearization
+    at the fixed point (grad/ift.py), not a solver re-run.
+    """
+    from sbr_tpu import obs
+
+    config, dtype = _resolve(config, dtype)
+    wrt = _validate_wrt(wrt, BASE_KEYS)
+    fn = _scalar_fn(config, dtype.name, wrt, False, aprime_tol(dtype))
+    vals = _theta_values(params, BASE_KEYS, dtype)
+    with obs.span("grad.xi_and_grad") as sp:
+        xi, xi_c, grads, aw_prime, status, flags = obs.jit_call(
+            "grad.xi_and_grad", fn, *vals
+        )
+        sp.sync(xi_c)
+    _log_flag_census("grad.xi_and_grad", status, flags)
+    return GradResult(
+        xi=xi, xi_candidate=xi_c, grads=dict(grads), aw_prime=aw_prime,
+        status=status, flags=flags,
+    )
+
+
+def interest_xi_and_grad(
+    params,
+    wrt=("beta", "u", "kappa", "r"),
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> GradResult:
+    """ξ and dξ/dθ for the interest-rate stack (`ModelParamsInterest`).
+    θ additionally spans ``r`` and ``delta``; the HJB value-function stage
+    differentiates through the fixed-RK4 recompute rule (grad/cell.py)."""
+    from sbr_tpu import obs
+
+    config, dtype = _resolve(config, dtype)
+    wrt = _validate_wrt(wrt, INTEREST_KEYS)
+    fn = _scalar_fn(config, dtype.name, wrt, True, aprime_tol(dtype))
+    vals = _theta_values(params, INTEREST_KEYS, dtype)
+    with obs.span("grad.interest_xi_and_grad") as sp:
+        xi, xi_c, grads, aw_prime, status, flags = obs.jit_call(
+            "grad.interest_xi_and_grad", fn, *vals
+        )
+        sp.sync(xi_c)
+    _log_flag_census("grad.interest_xi_and_grad", status, flags)
+    return GradResult(
+        xi=xi, xi_candidate=xi_c, grads=dict(grads), aw_prime=aw_prime,
+        status=status, flags=flags,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _surface_fn(config: SolverConfig, dtype_name: str, wrt: tuple, tol_ap: float):
+    dtype = jnp.dtype(dtype_name)
+
+    def cell(beta, u, p, kappa, lam, eta, t0, t1, x0):
+        prof.note_trace("grad.surface")
+        theta = dict(zip(BASE_KEYS, (beta, u, p, kappa, lam, eta, t0, t1, x0)))
+        return cell_value_and_grads(theta, wrt, config, dtype, aprime_tol_=tol_ap)
+
+    bcast = (None,) * 7
+    return jax.jit(
+        jax.vmap(jax.vmap(cell, in_axes=(None, 0) + bcast), in_axes=(0, None) + bcast)
+    )
+
+
+def sensitivity_surface(
+    beta_values,
+    u_values,
+    base: ModelParams,
+    wrt=WRT_DEFAULT,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> SensitivitySurface:
+    """∂ξ/∂θ surfaces over the Figure-5 β×u grid, one jitted vmap² program.
+
+    Same copy-constructor semantics as `sweeps.beta_u_grid`: η and tspan
+    stay pinned at the base model's resolved values for every β. The ξ
+    grid is bit-identical to the forward sweep's (same cell, same config);
+    each gradient surface replaces an entire brute-force perturbed re-sweep
+    of the grid — the instant-sensitivity product the ROADMAP names.
+    """
+    from sbr_tpu import obs
+    from sbr_tpu.obs.metrics import metrics
+
+    config, dtype = _resolve(config, dtype)
+    wrt = _validate_wrt(wrt, BASE_KEYS)
+    beta_values = jnp.asarray(beta_values, dtype=dtype)
+    u_values = jnp.asarray(u_values, dtype=dtype)
+    econ = base.economic
+    tspan = base.learning.tspan
+    scalars = tuple(
+        jnp.asarray(v, dtype)
+        for v in (econ.p, econ.kappa, econ.lam, econ.eta, tspan[0], tspan[1],
+                  base.learning.x0)
+    )
+    fn = _surface_fn(config, dtype.name, wrt, aprime_tol(dtype))
+    n_b, n_u = int(beta_values.shape[0]), int(u_values.shape[0])
+    with obs.span("grad.sensitivity_surface", n_beta=n_b, n_u=n_u) as sp:
+        xi, xi_c, grads, aw_prime, status, flags = obs.jit_call(
+            "grad.sensitivity_surface", fn, beta_values, u_values, *scalars
+        )
+        sp.sync(status)
+    metrics().inc("grad.surface.cells", n_b * n_u)
+    _log_flag_census("grad.sensitivity_surface", status, flags)
+    return SensitivitySurface(
+        beta_values=beta_values, u_values=u_values, xi=xi,
+        grads=dict(grads), aw_prime=aw_prime, status=status, flags=flags,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _value_fn(config: SolverConfig, dtype_name: str):
+    dtype = jnp.dtype(dtype_name)
+
+    def fn(*vals):
+        prof.note_trace("grad.cell_value")
+        return baseline_cell(dict(zip(BASE_KEYS, vals)), config, dtype)["xi_candidate"]
+
+    return jax.jit(fn)
+
+
+def xi_value(params: ModelParams, config: Optional[SolverConfig] = None, dtype=None):
+    """The grad pipeline's forward value alone (the FD-oracle probe):
+    ``xi_candidate`` at ``params`` through a VALUE-ONLY jitted program —
+    no gradients are computed, so an FD sweep pays the plain forward cost
+    per probe instead of the value-and-grad program's."""
+    config, dtype = _resolve(config, dtype)
+    fn = _value_fn(config, dtype.name)
+    return fn(*_theta_values(params, BASE_KEYS, dtype))

@@ -1,0 +1,342 @@
+"""Differentiable equilibrium cells (ISSUE 13 tentpole).
+
+One fully-parameterized Stage 2–3 solve, mirroring
+`sweeps.baseline_sweeps.solve_param_cell` stage for stage, but built so
+`jax.grad` flows θ → ξ end to end:
+
+- **Stage 1 + hazard** are closed-form/quadrature arithmetic — plain
+  autodiff (the warped grid, the cumulative Gauss-Legendre integral, and
+  the hazard ratio are all smooth in θ).
+- **Buffer crossings**: the coarse crossing is the boolean-transition
+  argmax plus linear interpolation (`core.rootfind.first_upcrossing`) —
+  the selected indices are integers (no tangents), and the interpolation
+  arithmetic differentiates directly, giving the exact derivative of the
+  grid estimator. With ``config.refine_crossings`` the crossing is the
+  exact root of h(τ̄; θ) = u and is wrapped in `ift.implicit_root` with the
+  SHARED `baseline.solver.hazard_at_from_parts` residual: the gradient
+  linearizes exactly the function whose root the refinement solver found.
+- **ξ**: `ift.implicit_root` around the SAME `compute_xi` the forward
+  solvers run (bit-identical primal ξ, asserted in tests), residual
+  F(ξ, θ) = AW(ξ; θ) − κ in closed form. dξ/dθ = −F_θ/F_ξ: one division
+  at the fixed point, zero backprop through bisection/Chandrupatla
+  iterations (reverse mode through those is an exact 0 — see grad/ift.py).
+- **Interest stack** (`interest_cell`): the HJB value-function stage is
+  integrated with the FIXED RK4 `lax.scan` under `jax.checkpoint` — the
+  recompute-rule treatment of the ODE stage (torchode, PAPERS.md): jax's
+  native scan adjoint differentiates it, remat bounds the adjoint's
+  memory to O(√n) residency, and under an adaptive forward config the
+  gradient path simply recomputes the trajectory with the deterministic
+  fixed-step scheme (agreeing within the ODE tolerance). This is WHY the
+  grad layer covers interest rather than hetero first: interest keeps
+  Stage 1 closed form, so the only non-analytic stage is this one scan —
+  while hetero's coupled-K ODE runs bs32's `lax.while_loop` (no adjoint
+  exists) and its sharded path would put custom rules under `shard_map`,
+  whose autodiff interaction is unproven on the jax 0.4.x compat shims.
+
+Every ``theta`` is a flat dict of scalars (see `models.params
+.params_to_pytree`); classification (status, grad flags) happens on
+`stop_gradient` values so booleans/ints never carry tangents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sbr_tpu.baseline.learning import logistic_cdf, logistic_pdf, solve_learning
+from sbr_tpu.baseline.solver import (
+    _hazard_parts,
+    _root_tol,
+    compute_xi,
+    hazard_at_from_parts,
+    hazard_grid_is_uniform,
+    quad_nodes_weights,
+    warped_grid_index,
+)
+from sbr_tpu.core.rootfind import bisect, chandrupatla, first_upcrossing, last_downcrossing
+from sbr_tpu.diag.health import (
+    GRAD_AT_NONEQUILIBRIUM,
+    GRAD_ILL_CONDITIONED,
+)
+from sbr_tpu.grad.ift import implicit_root
+from sbr_tpu.models.params import SolverConfig
+from sbr_tpu.models.results import Status
+from sbr_tpu.obs import prof
+
+# θ keys of the baseline cell, `solve_param_cell` column order.
+BASE_KEYS = ("beta", "u", "p", "kappa", "lam", "eta", "t0", "t1", "x0")
+# θ keys of the interest cell (baseline + rate/maturity).
+INTEREST_KEYS = BASE_KEYS + ("r", "delta")
+
+
+def aprime_tol(dtype, override: float | None = None) -> float:
+    """|AW'(ξ)| threshold below which dξ/dθ = −F_θ/AW'(ξ) is flagged
+    `GRAD_ILL_CONDITIONED`. Default √eps of the dtype (≈1.5e-8 in f64);
+    ``SBR_GRAD_APRIME_TOL`` overrides globally, an explicit argument wins."""
+    if override is not None:
+        return float(override)
+    env = os.environ.get("SBR_GRAD_APRIME_TOL", "").strip()
+    if env:
+        return float(env)
+    return float(jnp.finfo(jnp.dtype(dtype)).eps) ** 0.5
+
+
+def _fixed_ode(config: SolverConfig) -> SolverConfig:
+    """The gradient path's ODE numerics: the deterministic fixed-step
+    scheme (the recompute rule — module docstring). Root-find numerics keep
+    the caller's mode; only the ODE stage is pinned."""
+    if not config.adaptive:
+        return config
+    return dataclasses.replace(config, numerics="fixed")
+
+
+def _ls_of(beta, t0, t1, x0, config: SolverConfig, dtype):
+    """Closed-form Stage 1 from traced scalars (free to rebuild; unused
+    sampled curves are dead-code-eliminated by XLA)."""
+    from sbr_tpu.sweeps.baseline_sweeps import _TracedLearning
+
+    return solve_learning(_TracedLearning(beta, (t0, t1), x0), config, dtype=dtype)
+
+
+def _crossing_ops(theta: dict, tau_grid, hr, integ, int_eta, config: SolverConfig, dtype):
+    """Buffer times (τ̄_IN, τ̄_OUT), differentiable. Mirrors
+    `baseline.solver.optimal_buffer`: coarse scan crossings (direct AD),
+    then — under ``config.refine_crossings`` — IFT-wrapped refinement
+    against the continuous exact hazard."""
+    default = jnp.asarray(theta["t1"], dtype)
+    u = theta["u"]
+    t_in, has_up = first_upcrossing(tau_grid, hr, u, default, return_flag=True)
+    t_out, has_dn = last_downcrossing(tau_grid, hr, u, default, return_flag=True)
+    if not config.refine_crossings:
+        return t_in, t_out
+
+    nodes, weights = quad_nodes_weights(config.quad_order, dtype)
+    # ALL tangent carriers ride the operand (the implicit_root contract) —
+    # including the coarse crossings, so the solve closures derive their
+    # brackets from exactly the forward path's coarse estimates (primal
+    # bit-identity) without re-deriving the grid hazard.
+    op = {
+        "tau_grid": tau_grid,
+        "integ": integ,
+        "int_eta": int_eta,
+        "p": theta["p"],
+        "lam": theta["lam"],
+        "beta": theta["beta"],
+        "x0": theta["x0"],
+        "u": u,
+        "t_in_coarse": t_in,
+        "t_out_coarse": t_out,
+    }
+
+    def hz(x, o):
+        return hazard_at_from_parts(
+            x, o["tau_grid"], o["integ"], o["int_eta"], o["p"], o["lam"],
+            o["beta"], o["x0"], nodes, weights,
+        )
+
+    n = tau_grid.shape[0]
+
+    def bracket(o, t):
+        # ±one LOCAL grid interval around the coarse crossing, exactly as
+        # optimal_buffer.bracket (the grid may be warped).
+        g = o["tau_grid"]
+        i = jnp.clip(jnp.searchsorted(g, t, side="right") - 1, 0, n - 1)
+        return g[jnp.maximum(i - 1, 0)], g[jnp.minimum(i + 2, n - 1)]
+
+    refine = (
+        (lambda f, lo, hi: chandrupatla(f, lo, hi, budget=60))
+        if config.adaptive
+        else (lambda f, lo, hi: bisect(f, lo, hi, num_iters=60))
+    )
+
+    def solve_in(o):
+        prof.note_trace("grad.root_solve")
+        lo, hi = bracket(o, o["t_in_coarse"])
+        return refine(lambda x: hz(x, o) - o["u"], lo, hi)
+
+    def solve_out(o):
+        prof.note_trace("grad.root_solve")
+        lo, hi = bracket(o, o["t_out_coarse"])
+        return refine(lambda x: o["u"] - hz(x, o), lo, hi)
+
+    t_in_ref = implicit_root(lambda x, o: hz(x, o) - o["u"], solve_in, op)
+    t_out_ref = implicit_root(lambda x, o: o["u"] - hz(x, o), solve_out, op)
+    t_in = jnp.where(has_up, t_in_ref, t_in)
+    t_out = jnp.where(has_dn, t_out_ref, t_out)
+    return t_in, t_out
+
+
+def _aw_residual(x, o):
+    """F(ξ, θ) = AW(ξ) − κ in closed form — the ξ-root's IFT residual,
+    formula-identical to `compute_xi.aw_of` with closed-form Stage 1."""
+    t_out = jnp.minimum(o["t_out"], x)
+    t_in = jnp.minimum(o["t_in"], x)
+    return (
+        logistic_cdf(t_out, o["beta"], o["x0"])
+        - logistic_cdf(t_in, o["beta"], o["x0"])
+        - o["kappa"]
+    )
+
+
+def _xi_and_class(theta: dict, t_in, t_out, config: SolverConfig, dtype, tol_ap: float):
+    """IFT-wrapped ξ root + the forward solver's exact classification."""
+    op = {
+        "beta": theta["beta"],
+        "x0": theta["x0"],
+        "kappa": theta["kappa"],
+        "t0": theta["t0"],
+        "t1": theta["t1"],
+        "t_in": t_in,
+        "t_out": t_out,
+    }
+
+    def solve(o):
+        # The SAME solver entry the forward stacks call (compute_xi →
+        # bisect/chandrupatla per config), on primal values: the grad
+        # cell's ξ is bit-identical to solve_param_cell's by construction.
+        # Pure function of ``o`` — no tracer-carrying closure capture.
+        prof.note_trace("grad.root_solve")
+        ls = _ls_of(o["beta"], o["t0"], o["t1"], o["x0"], config, dtype)
+        xi, _, _, _ = compute_xi(o["t_in"], o["t_out"], ls, o["kappa"], config)
+        return xi
+
+    xi_c = implicit_root(_aw_residual, solve, op)
+
+    # Classification on stop_gradient values — booleans/status carry no
+    # tangents, and the formulas mirror solve_equilibrium_core exactly.
+    sg = lax.stop_gradient
+    op_s = sg(op)
+    xi_s = sg(xi_c)
+    err = jnp.abs(_aw_residual(xi_s, op_s))
+    root_ok = err <= _root_tol(dtype)
+    beta_s, x0_s = op_s["beta"], op_s["x0"]
+    increasing = logistic_pdf(jnp.minimum(op_s["t_out"], xi_s), beta_s, x0_s) >= (
+        logistic_pdf(jnp.minimum(op_s["t_in"], xi_s), beta_s, x0_s)
+    )
+    no_crossing = op_s["t_in"] == op_s["t_out"]
+    run = (~no_crossing) & root_ok & increasing
+    status = jnp.where(
+        no_crossing,
+        Status.NO_CROSSING,
+        jnp.where(
+            ~root_ok,
+            Status.NO_ROOT,
+            jnp.where(increasing, Status.RUN, Status.FALSE_EQ),
+        ),
+    ).astype(jnp.int32)
+
+    # AW'(ξ) — the IFT denominator — by autodiff of the shared residual at
+    # the (stop-gradient) fixed point: the conditioning check measures
+    # exactly the division `implicit_root` performs.
+    aw_prime = jax.grad(_aw_residual, argnums=0)(xi_s, op_s)
+    flags = jnp.where(
+        status != jnp.int32(Status.RUN),
+        jnp.int32(GRAD_AT_NONEQUILIBRIUM),
+        jnp.int32(0),
+    ) | jnp.where(
+        jnp.abs(aw_prime) <= tol_ap, jnp.int32(GRAD_ILL_CONDITIONED), jnp.int32(0)
+    )
+
+    nan = jnp.asarray(jnp.nan, dtype)
+    xi = jnp.where(run, xi_c, nan)
+    return {
+        "xi": xi,
+        "xi_candidate": xi_c,
+        "tau_in": t_in,
+        "tau_out": t_out,
+        "status": status,
+        "flags": flags,
+        "aw_prime": aw_prime,
+        "residual": err,
+    }
+
+
+def baseline_cell(theta: dict, config: SolverConfig, dtype, aprime_tol_: float | None = None):
+    """Differentiable baseline Stage 2–3 solve from a θ dict (BASE_KEYS).
+
+    Returns a dict: ``xi`` (NaN-masked like the forward solver, with zero
+    tangent on non-run lanes), ``xi_candidate`` (the unmasked root — the
+    quantity to differentiate near run boundaries), buffers, ``status``,
+    grad-trust ``flags``, ``aw_prime`` (the IFT denominator), ``residual``.
+    """
+    theta = {k: jnp.asarray(theta[k], dtype) for k in BASE_KEYS}
+    tol_ap = aprime_tol(dtype, aprime_tol_)
+    ls = _ls_of(theta["beta"], theta["t0"], theta["t1"], theta["x0"], config, dtype)
+    tau_grid, hr, integ, int_eta = _hazard_parts(
+        theta["p"], theta["lam"], ls, theta["eta"], config
+    )
+    t_in, t_out = _crossing_ops(theta, tau_grid, hr, integ, int_eta, config, dtype)
+    return _xi_and_class(theta, t_in, t_out, config, dtype, tol_ap)
+
+
+def interest_cell(theta: dict, config: SolverConfig, dtype, aprime_tol_: float | None = None):
+    """Differentiable interest-rate Stage 2–3 solve (INTEREST_KEYS).
+
+    Baseline hazard → HJB value function (fixed RK4 scan under
+    `jax.checkpoint` — the recompute rule, module docstring) → effective
+    hazard h − rV → grid buffer crossings (direct AD; refinement is pinned
+    OFF on this stack: the effective hazard is only grid-known through V)
+    → the SAME IFT ξ root as baseline."""
+    from sbr_tpu.interest.value_function import solve_value_function
+
+    theta = {k: jnp.asarray(theta[k], dtype) for k in INTEREST_KEYS}
+    tol_ap = aprime_tol(dtype, aprime_tol_)
+    ls = _ls_of(theta["beta"], theta["t0"], theta["t1"], theta["x0"], config, dtype)
+    tau_grid, hr, integ, int_eta = _hazard_parts(
+        theta["p"], theta["lam"], ls, theta["eta"], config
+    )
+
+    warped = not hazard_grid_is_uniform(ls, config)
+    cfg_ode = _fixed_ode(config)
+
+    # Every tangent carrier enters as an explicit argument (never closure)
+    # so the remat boundary cannot mis-handle it; the warped index guesses
+    # are integers — tangent-free by construction — and the interpolated
+    # VALUES flow through tau_grid/hr.
+    def v_solve(tau_grid_, hr_, delta_, r_, u_, eta_, beta_, x0_):
+        index_fn = (
+            (lambda t: warped_grid_index(
+                t, eta_, beta_, x0_, config.n_grid, config.grid_warp
+            ))
+            if warped
+            else None
+        )
+        return solve_value_function(
+            tau_grid_, hr_, delta_, r_, u_, cfg_ode,
+            uniform=not warped, index_fn=index_fn,
+        )
+
+    v = jax.checkpoint(v_solve)(
+        tau_grid, hr, theta["delta"], theta["r"], theta["u"],
+        theta["eta"], theta["beta"], theta["x0"],
+    )
+    hr_eff = hr - theta["r"] * v
+
+    default = jnp.asarray(theta["t1"], dtype)
+    t_in, _ = first_upcrossing(tau_grid, hr_eff, theta["u"], default, return_flag=True)
+    t_out, _ = last_downcrossing(tau_grid, hr_eff, theta["u"], default, return_flag=True)
+    return _xi_and_class(theta, t_in, t_out, config, dtype, tol_ap)
+
+
+def aw_cum_at(t, xi, tau_in_unc, tau_out_unc, beta, x0):
+    """Cumulative aggregate-withdrawal curve AW(t) in closed form,
+    differentiable in every argument — `baseline.solver.get_aw`'s formula
+    freed from the LearningSolution wrapper, for the calibration loss:
+    AW(t) = [G(t−ξ+τ_OUT^CON)]₊ − [G(t−ξ+τ_IN^CON)]₊ + G(0)."""
+    t = jnp.asarray(t)
+    zero = jnp.zeros((), dtype=t.dtype)
+    tau_in_con = jnp.minimum(tau_in_unc, xi)
+    tau_out_con = jnp.minimum(tau_out_unc, xi)
+    shift_in = t - xi + tau_in_con
+    aw_in = jnp.where(
+        shift_in >= 0, logistic_cdf(jnp.maximum(shift_in, zero), beta, x0), zero
+    )
+    shift_out = t - xi + tau_out_con
+    aw_out = jnp.where(
+        shift_out >= 0, logistic_cdf(jnp.maximum(shift_out, zero), beta, x0), zero
+    )
+    return aw_out - aw_in + logistic_cdf(zero, beta, x0)

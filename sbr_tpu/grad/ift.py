@@ -1,0 +1,82 @@
+"""Implicit-function-theorem differentiation of fixed points (ISSUE 13).
+
+Every root the pipeline computes — the buffer-crossing times where
+h(τ̄) = u, the crash time ξ where AW(ξ) = κ — comes out of an iterative
+bracketing solver (`core.rootfind.bisect` / `chandrupatla`). Two facts make
+naive autodiff of those solvers useless:
+
+- **Reverse mode through the iterations is wrong, not just slow.** A
+  bisection iterate is a chain of midpoint SELECTIONS: as a function of the
+  parameters it is piecewise constant, so backprop through the loop returns
+  an exact 0 everywhere it is defined (verified in tests/test_grad.py).
+  The convergence-masked `chandrupatla` is a `lax.while_loop`, which jax
+  cannot reverse-differentiate at all.
+- **The derivative is available for free at the fixed point.** If
+  f(x*, θ) = 0 defines x*(θ) and ∂f/∂x ≠ 0 there, the implicit function
+  theorem gives dx*/dθ = −(∂f/∂θ)/(∂f/∂x): ONE linearization of the
+  residual at the solution, no iteration history (MPAX's differentiable-
+  optimization pattern in PAPERS.md; torchode's adjoint treatment of
+  adaptive solvers is the same move for ODE time-stepping).
+
+`implicit_root` packages that as a `jax.custom_jvp` rule: the forward call
+runs whatever solver the caller provides (while_loops and all — the custom
+rule means jax never tries to differentiate it), and the tangent is the IFT
+linear solve (scalar roots ⇒ a division). Because the JVP is linear in the
+operand tangents, jax transposes it automatically, so `jax.grad`,
+`jax.vmap`, `jax.jit`, and compositions all work; under `vmap` the "linear
+solve" is a per-lane division, i.e. a diagonal solve across the batch.
+
+Contract: ALL tangent-carrying inputs must flow through ``operand`` (a
+pytree of arrays). ``f(x, operand)`` and ``solve(operand)`` must be pure
+functions of their arguments — closure capture of traced values would leak
+tracers past the custom rule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def implicit_root(f, solve, operand, fx_floor: float | None = None):
+    """x*(operand) with IFT derivatives: forward = ``solve(operand)``,
+    tangent = −(∂f/∂operand · d operand)/(∂f/∂x) at x*.
+
+    - ``f(x, operand) -> residual``: the defining equation, differentiable
+      in both slots (closed-form arithmetic in every caller here).
+    - ``solve(operand) -> x*``: any root-finder; never differentiated.
+    - ``fx_floor``: |∂f/∂x| is floored at this magnitude (sign-preserving)
+      before the division, so a genuinely ill-conditioned root (AW'(ξ) → 0
+      at the withdrawal-curve peak) yields a large-but-finite tangent
+      instead of Inf/NaN poison; callers flag it via `GRAD_ILL_CONDITIONED`
+      from their own |∂f/∂x| check. Defaults to √tiny of the dtype: the
+      division then overflows only for |∂f/∂θ| beyond max·√tiny (~3e19 in
+      f32, ~1e154 in f64) — flooring at tiny itself would re-break the
+      finiteness guarantee for any O(1) numerator.
+    """
+
+    @jax.custom_jvp
+    def rooted(operand):
+        return solve(operand)
+
+    @rooted.defjvp
+    def _rooted_jvp(primals, tangents):
+        (op,), (dop,) = primals, tangents
+        x = solve(op)
+        fx = jax.grad(f, argnums=0)(x, op)
+        floor = jnp.asarray(
+            float(jnp.finfo(jnp.result_type(x)).tiny) ** 0.5
+            if fx_floor is None
+            else fx_floor,
+            fx.dtype,
+        )
+        fx_safe = jnp.where(
+            jnp.abs(fx) >= floor, fx, jnp.where(fx >= 0, floor, -floor)
+        )
+        # ∂f/∂θ · dθ without materializing the full Jacobian: one JVP of
+        # the residual in the operand slot at fixed x. Linear in ``dop`` —
+        # the property jax's transpose machinery needs to derive the VJP.
+        _, ft_dot = jax.jvp(lambda o: f(x, o), (op,), (dop,))
+        return x, -ft_dot / fx_safe
+
+    return rooted(operand)

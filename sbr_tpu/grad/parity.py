@@ -1,0 +1,134 @@
+"""Grad-parity battery: IFT gradients vs central finite differences.
+
+The CI smoke step (ISSUE 13): a seeded sample of parameter points in f64,
+dξ/dβ, dξ/du, dξ/dκ from `grad.api.xi_and_grad` against central
+differences of the same forward value, exit 1 on any relative disagreement
+beyond tolerance. This is a STRUCTURAL gate, not just numerics: backprop
+leaking through the root-finder iterations yields an exact 0 gradient
+(grad/ift.py), which fails the match — so a pass proves the IFT rules
+carry the derivative.
+
+    python -m sbr_tpu.grad.parity [--n 6] [--seed 0] [--tol 1e-5] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run_battery(n: int = 6, seed: int = 0, tol: float = 1e-5, config=None) -> dict:
+    """Sample ``n`` seeded parameter points in the run region and compare
+    IFT vs FD for each wrt dimension. Returns a JSON-ready report with
+    per-point worst relative errors; ``report["ok"]`` is the verdict."""
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from sbr_tpu.grad import api
+    from sbr_tpu.models.params import SolverConfig, make_model_params, with_overrides
+
+    if config is None:
+        # Refinement ON: the battery's crossings are then TRUE roots of the
+        # continuous hazard (IFT-differentiated), smooth in θ — so central
+        # differences are a valid oracle at 1e-5. The unrefined grid
+        # estimator is piecewise smooth with O(Δτ) derivative kinks at knot
+        # handoffs, which FD straddles (~1e-3 apparent error that is the
+        # ORACLE's, not the gradient's).
+        config = SolverConfig(n_grid=512, bisect_iters=90, refine_crossings=True)
+    rng = np.random.RandomState(seed)
+    wrt = ("beta", "u", "kappa")
+    points = []
+    checked = 0
+    worst = 0.0
+    # Sample inside the classic run region of the Figure-5 grid; points
+    # that land on non-run cells are reported but not FD-compared (the
+    # candidate root is still differentiable, but the oracle battery gates
+    # on equilibria — flags cover the rest).
+    for i in range(n):
+        beta = float(rng.uniform(0.8, 2.0))
+        u = float(rng.uniform(0.05, 0.14))
+        kappa = float(rng.uniform(0.4, 0.7))
+        params = make_model_params(beta=beta, u=u, kappa=kappa)
+        res = api.xi_and_grad(params, wrt=wrt, config=config, dtype=jnp.float64)
+        entry = {
+            "beta": beta, "u": u, "kappa": kappa,
+            "status": int(res.status), "flags": int(res.flags),
+            "xi": float(res.xi_candidate),
+        }
+        if int(res.status) == 0 and int(res.flags) == 0:
+            checked += 1
+            rels = {}
+            for k in wrt:
+                h = 1e-6 * max(1.0, abs(entry[k]))
+                # with_overrides PINS the resolved η/tspan (the reference's
+                # copy-constructor semantics): the FD probe varies ONE θ
+                # entry, matching the IFT partial derivative. Rebuilding via
+                # make_model_params would re-derive η = η̄/β and measure the
+                # total derivative along that constraint instead.
+                pp = with_overrides(params, **{k: entry[k] + h})
+                pm = with_overrides(params, **{k: entry[k] - h})
+                fd = (
+                    float(api.xi_value(pp, config=config, dtype=jnp.float64))
+                    - float(api.xi_value(pm, config=config, dtype=jnp.float64))
+                ) / (2 * h)
+                ift = float(res.grads[k])
+                rel = abs(ift - fd) / max(abs(fd), 1e-12)
+                rels[k] = {"ift": ift, "fd": fd, "rel": rel}
+                worst = max(worst, rel)
+            entry["rel_errors"] = rels
+        points.append(entry)
+    ok = checked > 0 and worst <= tol
+    return {
+        "n_points": n,
+        "n_checked": checked,
+        "worst_rel": worst,
+        "tol": tol,
+        "ok": bool(ok),
+        "points": points,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.grad.parity",
+        description="IFT-vs-finite-difference gradient parity battery "
+        "(f64); exit 1 on disagreement beyond tolerance",
+    )
+    parser.add_argument("--n", type=int, default=6, help="parameter points (default 6)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--tol", type=float, default=1e-5,
+                        help="max allowed relative error (default 1e-5)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    report = run_battery(n=args.n, seed=args.seed, tol=args.tol)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for pt in report["points"]:
+            rels = pt.get("rel_errors")
+            if rels is None:
+                print(f"  skip  β={pt['beta']:.3f} u={pt['u']:.3f} κ={pt['kappa']:.3f} "
+                      f"(status {pt['status']}, flags {pt['flags']})")
+                continue
+            line = " ".join(
+                f"d{k}: {v['rel']:.2e}" for k, v in rels.items()
+            )
+            print(f"  ok    β={pt['beta']:.3f} u={pt['u']:.3f} κ={pt['kappa']:.3f}  {line}")
+        print(
+            f"grad parity: {report['n_checked']}/{report['n_points']} run points, "
+            f"worst rel {report['worst_rel']:.3e} vs tol {report['tol']:g} "
+            f"-> {'OK' if report['ok'] else 'FAIL'}"
+        )
+    if not report["ok"]:
+        print("grad parity FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Gradient-based worst-case stress search (ISSUE 13).
+
+Replaces brute-force grid scans for "what is the smallest shock that tips
+this bank into a run?" with first-order search on a differentiable
+run-margin:
+
+    margin(θ) = max( u − max_τ̄ h(τ̄; θ),          # a crossing must exist
+                     κ − [G(τ̄_OUT) − G(τ̄_IN)] )   # AW must be able to reach κ
+
+Both binding constraints of a bank-run equilibrium are smooth in θ away
+from ties, and ``margin < 0`` is (to grid resolution) the run region: the
+first term flips when the hazard peak clears the outside option u, the
+second when the reachable withdrawal mass clears the solvency threshold κ.
+On the no-crossing side the buffers coincide at the default, the G-term
+collapses to κ > 0, and the max is governed by the crossing term alone —
+so the surrogate is consistent across the regime boundary.
+
+`stress_search` runs projected gradient DESCENT on the margin inside a box
+(only the ``wrt`` parameters move, clipped to ``bounds`` each step — the
+projection), then bisects along the straight segment from θ₀ to the first
+flipped iterate for the margin-zero boundary: the returned shock is the
+smallest parameter perturbation ALONG THE DISCOVERED DIRECTION that flips
+the cell, refined far below any grid scan's resolution. For a 1-D search
+(e.g. ``wrt=("kappa",)``) this is exactly the minimal κ shock. The result
+is validated against the REAL forward solver (`solve_param_cell` status at
+the flipped point), never against the surrogate alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sbr_tpu.baseline.learning import logistic_cdf, solve_learning
+from sbr_tpu.baseline.solver import _hazard_parts
+from sbr_tpu.core.rootfind import first_upcrossing, last_downcrossing
+from sbr_tpu.grad.cell import BASE_KEYS
+from sbr_tpu.models.params import ModelParams, SolverConfig, params_to_pytree
+from sbr_tpu.obs import prof
+
+# Default search boxes (natural parameter space).
+DEFAULT_BOUNDS: Dict[str, Tuple[float, float]] = {
+    "beta": (1e-3, 1e4),
+    "u": (1e-6, 10.0),
+    "kappa": (1e-4, 1.0 - 1e-4),
+    "p": (1e-4, 1.0 - 1e-4),
+    "lam": (1e-6, 10.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class StressResult:
+    """Outcome of one worst-case search (host-side)."""
+
+    flipped: bool  # a run-triggering perturbation was found
+    validated: bool  # the real solver confirms RUN at the flipped point
+    params0: dict  # starting θ (natural space, floats)
+    params_flipped: Optional[dict]  # boundary-refined flipped θ (or None)
+    delta: Optional[dict]  # params_flipped − params0 per wrt dim
+    shock_norm: Optional[float]  # L2 norm of delta (the shock size)
+    margin0: float  # starting margin (> 0: no run)
+    margin_final: float  # margin at the returned point
+    steps: int  # gradient steps taken until the first flip (or budget)
+
+
+def run_margin(theta: dict, config: SolverConfig, dtype):
+    """The differentiable run-margin (module docstring); negative ⇒ the
+    cell supports a bank-run equilibrium, up to grid resolution."""
+    from sbr_tpu.sweeps.baseline_sweeps import _TracedLearning
+
+    theta = {k: jnp.asarray(theta[k], dtype) for k in BASE_KEYS}
+    ls = solve_learning(
+        _TracedLearning(theta["beta"], (theta["t0"], theta["t1"]), theta["x0"]),
+        config, dtype=dtype,
+    )
+    tau_grid, hr, _, _ = _hazard_parts(
+        theta["p"], theta["lam"], ls, theta["eta"], config
+    )
+    # Hazard peak vs the outside option. hr[0] can be +inf only at p=1
+    # (the plotting-layer degenerate); exclude nothing — inf just makes
+    # the crossing constraint trivially satisfied, as it should.
+    m_cross = theta["u"] - jnp.max(hr)
+    default = jnp.asarray(theta["t1"], dtype)
+    t_in = first_upcrossing(tau_grid, hr, theta["u"], default)
+    t_out = last_downcrossing(tau_grid, hr, theta["u"], default)
+    reach = logistic_cdf(t_out, theta["beta"], theta["x0"]) - logistic_cdf(
+        t_in, theta["beta"], theta["x0"]
+    )
+    m_root = theta["kappa"] - reach
+    return jnp.maximum(m_cross, m_root)
+
+
+@functools.lru_cache(maxsize=None)
+def _margin_fns(config: SolverConfig, dtype_name: str, wrt: tuple):
+    """Jitted (margin, ∂margin/∂wrt) programs, cached per (config, dtype,
+    wrt) like every other grad entry point — θ values enter as ARGUMENTS,
+    so repeated `stress_search` calls (a sweep of searches, the report's
+    stress table) reuse two compiled programs instead of re-tracing the
+    whole Stage-1+hazard pipeline per call."""
+    dtype = jnp.dtype(dtype_name)
+
+    def margin(wv, rest):
+        prof.note_trace("grad.stress_margin")
+        return run_margin({**rest, **wv}, config, dtype)
+
+    return jax.jit(margin), jax.jit(jax.grad(margin, argnums=0))
+
+
+def stress_search(
+    params: ModelParams,
+    wrt=("kappa",),
+    bounds: Optional[Dict[str, Tuple[float, float]]] = None,
+    steps: int = 200,
+    lr: float = 0.02,
+    margin_eps: float = 1e-6,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> StressResult:
+    """Find the smallest shock along the steepest-descent path that flips
+    ``params`` from no-run into a bank run (module docstring).
+
+    ``lr`` is a RELATIVE step (each parameter moves by lr·|scale| per
+    step, scale = max(|θ₀|, bound width)); ``margin_eps`` is how far past
+    the boundary the returned point sits (a point exactly ON the boundary
+    is numerically ambiguous for the forward solver).
+    """
+    from sbr_tpu import obs
+    from sbr_tpu.grad.api import _resolve
+    from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+    config, dtype = _resolve(config, dtype)
+    wrt = tuple(wrt)
+    unknown = set(wrt) - set(DEFAULT_BOUNDS)
+    if not wrt or unknown:
+        raise ValueError(
+            f"wrt must be a non-empty subset of {tuple(DEFAULT_BOUNDS)}, got {wrt!r}"
+        )
+    box = {**DEFAULT_BOUNDS, **(bounds or {})}
+
+    theta0 = {k: jnp.asarray(v, dtype) for k, v in params_to_pytree(params).items()
+              if k != "eta_bar"}
+    rest = {k: v for k, v in theta0.items() if k not in wrt}
+    m_fn, g_fn = _margin_fns(config, dtype.name, wrt)
+    margin_of = lambda wv: m_fn(wv, rest)
+    grad_of = lambda wv: g_fn(wv, rest)
+
+    scale = {
+        k: max(abs(float(theta0[k])), (box[k][1] - box[k][0]) * 0.05)
+        for k in wrt
+    }
+    wv = {k: theta0[k] for k in wrt}
+    m0 = float(margin_of(wv))
+
+    def clip(wv):
+        return {
+            k: jnp.clip(v, box[k][0], box[k][1]) for k, v in wv.items()
+        }
+
+    with obs.span("grad.stress_search", wrt=list(wrt), steps=steps):
+        flipped = m0 < 0  # already a run: zero shock
+        n_steps = 0
+        wv_prev = dict(wv)
+        if not flipped:
+            for i in range(steps):
+                g = grad_of(wv)
+                wv_prev = dict(wv)
+                wv = clip({
+                    k: wv[k] - lr * scale[k] * jnp.sign(g[k]) for k in wrt
+                })
+                n_steps = i + 1
+                m = float(margin_of(wv))
+                if m < 0:
+                    flipped = True
+                    break
+                if all(float(wv[k]) == float(wv_prev[k]) for k in wrt):
+                    break  # pinned at the box: no flip reachable
+
+        result_kwargs = dict(
+            params0={k: float(theta0[k]) for k in BASE_KEYS},
+            margin0=m0, steps=n_steps,
+        )
+        if not flipped:
+            obs.event("grad", action="stress_done", flipped=False,
+                      margin0=m0, margin_final=float(margin_of(wv)), steps=n_steps)
+            return StressResult(
+                flipped=False, validated=False, params_flipped=None, delta=None,
+                shock_norm=None, margin_final=float(margin_of(wv)),
+                **result_kwargs,
+            )
+
+        # Bisect the segment θ₀ → flipped iterate for the margin boundary,
+        # then step margin_eps past it: the minimal shock along the path.
+        lo_t, hi_t = (0.0, 0.0) if m0 < 0 else (0.0, 1.0)
+        wv_flip = dict(wv)
+
+        def at(t):
+            return {
+                k: theta0[k] + t * (wv_flip[k] - theta0[k]) for k in wrt
+            }
+
+        if m0 >= 0:
+            for _ in range(60):
+                mid = 0.5 * (lo_t + hi_t)
+                if float(margin_of(at(mid))) < 0:
+                    hi_t = mid
+                else:
+                    lo_t = mid
+            # Nudge past the boundary until margin <= -margin_eps. The step
+            # GROWS geometrically from the bisection residual: after 60
+            # halvings hi_t - lo_t ~ 2^-60, so a constant-step walk could
+            # never cover the O(margin_eps / slope) distance the contract
+            # needs — doubling reaches any t <= 1 within ~60 iterations.
+            t_star = hi_t
+            step_t = max(hi_t - lo_t, 1e-9)
+            for _ in range(60):
+                if float(margin_of(at(t_star))) <= -margin_eps:
+                    break
+                t_star = min(1.0, t_star + step_t)
+                step_t *= 2.0
+                if t_star >= 1.0:
+                    break
+            wv_star = at(t_star)
+        else:
+            wv_star = {k: theta0[k] for k in wrt}
+
+        theta_star = {**theta0, **wv_star}
+        # Validate against the REAL forward solver, not the surrogate.
+        xi, _, _, status, _ = solve_param_cell(
+            *(theta_star[k] for k in BASE_KEYS), config, dtype
+        )
+        validated = int(status) == 0
+        delta = {k: float(wv_star[k]) - float(theta0[k]) for k in wrt}
+        shock = float(jnp.sqrt(sum(jnp.asarray(d) ** 2 for d in delta.values())))
+        m_final = float(margin_of(wv_star))
+        obs.event(
+            "grad", action="stress_done", flipped=True, validated=bool(validated),
+            margin0=m0, margin_final=m_final, steps=n_steps, shock_norm=shock,
+            **{f"delta_{k}": v for k, v in delta.items()},
+        )
+        return StressResult(
+            flipped=True, validated=bool(validated),
+            params_flipped={k: float(v) for k, v in theta_star.items()},
+            delta=delta, shock_norm=shock, margin_final=m_final,
+            **result_kwargs,
+        )

@@ -27,8 +27,32 @@ from typing import Optional, Tuple
 import numpy as np
 
 
-def _check(cond: bool, msg: str) -> None:
-    if not cond:
+def _check(cond, msg: str) -> None:
+    """Validate a constructor invariant — skipping TRACED conditions.
+
+    Parameter structs double as grad inputs (ISSUE 13): `sbr_tpu.grad`
+    builds `make_model_params(beta=traced_scalar, ...)` inside jit/grad so
+    parameter pytrees can flow through `jax.grad`. A traced comparison has
+    no concrete truth value; forcing ``bool(cond)`` would raise jax's
+    TracerBoolConversionError (and silently coercing the VALUE to float
+    would cut the tangent — the historical bug this guard replaces). Traced
+    invariants are therefore deferred: the numerics handle out-of-domain
+    values the same way the solvers do (NaN/status codes), and concrete
+    construction keeps failing loudly exactly as before.
+    """
+    try:
+        ok = bool(cond)
+    except Exception as err:
+        # Only jax's trace-time concretization errors defer validation; any
+        # other truth-evaluation failure (e.g. numpy's ambiguous-array
+        # ValueError for a vector parameter) must stay a loud construction
+        # error, exactly as before.
+        if type(err).__name__ in (
+            "TracerBoolConversionError", "ConcretizationTypeError",
+        ):
+            return
+        raise
+    if not ok:
         raise ValueError(msg)
 
 
@@ -117,6 +141,55 @@ def with_overrides(base: ModelParams, **kwargs) -> ModelParams:
     _check(not unknown, f"Unknown parameter overrides: {sorted(unknown)}")
     current.update(kwargs)
     return make_model_params(**current)
+
+
+# The scalar leaves of a baseline ModelParams, in `solve_param_cell`
+# column order first (beta, u, p, kappa, lam, eta, t0, t1, x0) plus
+# eta_bar — the full information content of the struct.
+PARAMS_LEAF_NAMES = (
+    "beta", "u", "p", "kappa", "lam", "eta", "t0", "t1", "x0", "eta_bar",
+)
+
+
+def params_to_pytree(params: ModelParams) -> dict:
+    """Flatten a `ModelParams` into a plain ``{name: scalar}`` dict — the
+    grad-input form (ISSUE 13): plain dataclasses are not jax pytrees, so
+    `jax.grad`/`vmap` take this dict instead. Lossless: carries the RESOLVED
+    eta and tspan, never re-derives them (the copy-constructor pinning
+    contract of `with_overrides` — see the module docstring)."""
+    return {
+        "beta": params.learning.beta,
+        "u": params.economic.u,
+        "p": params.economic.p,
+        "kappa": params.economic.kappa,
+        "lam": params.economic.lam,
+        "eta": params.economic.eta,
+        "t0": params.learning.tspan[0],
+        "t1": params.learning.tspan[1],
+        "x0": params.learning.x0,
+        "eta_bar": params.economic.eta_bar,
+    }
+
+
+def pytree_to_params(tree: dict) -> ModelParams:
+    """Rebuild a `ModelParams` from `params_to_pytree`'s dict, exactly:
+    eta/tspan come from the tree verbatim (no η̄/β re-derivation), so
+    ``pytree_to_params(params_to_pytree(p)) == p`` for every p — including
+    trees whose leaves are traced jax scalars (validation defers, see
+    `_check`)."""
+    unknown = set(tree) - set(PARAMS_LEAF_NAMES)
+    _check(not unknown, f"Unknown params leaves: {sorted(unknown)}")
+    missing = set(PARAMS_LEAF_NAMES) - set(tree)
+    _check(not missing, f"Missing params leaves: {sorted(missing)}")
+    return ModelParams(
+        learning=LearningParams(
+            beta=tree["beta"], tspan=(tree["t0"], tree["t1"]), x0=tree["x0"]
+        ),
+        economic=EconomicParams(
+            u=tree["u"], p=tree["p"], kappa=tree["kappa"], lam=tree["lam"],
+            eta_bar=tree["eta_bar"], eta=tree["eta"],
+        ),
+    )
 
 
 # ---------------------------------------------------------------------------

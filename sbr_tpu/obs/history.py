@@ -65,10 +65,16 @@ from typing import Optional
 # (re-dispatches after forward failures — lower-better by the _count
 # rule), and fleet_shed_rate (fraction of queries shed at admission —
 # lower-better by the shed rule).
+# 8 adds the differentiable-equilibria workload (ISSUE 13, bench.py
+# bench_grad): grads_per_sec (IFT sensitivity-surface throughput —
+# partial derivatives per second through the vmapped value-and-grad
+# program; higher-better by the per_sec rule) and calib_steps_per_sec
+# (calibration Adam steps per second over the jitted IFT loss;
+# higher-better likewise).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1/2/3/4/5/6 history keeps gating new schema-7 appends.
-SCHEMA = 7
+# schema-1/2/3/4/5/6/7 history keeps gating new schema-8 appends.
+SCHEMA = 8
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -128,7 +134,7 @@ def load(path=None) -> list:
             continue
         if isinstance(rec, dict) and isinstance(rec.get("metrics"), dict):
             # Schema-less lines predate versioning (= schema 1); schemas
-            # 2-6 are pure supersets, so every known version loads
+            # 2-8 are pure supersets, so every known version loads
             # uniformly and older lines keep gating newer appends.
             rec.setdefault("schema", 1)
             records.append(rec)
@@ -184,6 +190,11 @@ def bench_metrics(result: dict) -> dict:
         "fleet_p99_ms",
         "fleet_failover_count",
         "fleet_shed_rate",
+        # schema 8: the differentiable-equilibria workload (bench.py
+        # bench_grad): sensitivity-surface gradient throughput and
+        # calibration step rate (both higher-better by the per_sec rule)
+        "grads_per_sec",
+        "calib_steps_per_sec",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):

@@ -1024,6 +1024,185 @@ def _main_fleet(argv) -> int:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Grad report (`grad` subcommand — differentiable-equilibria renderer/gate)
+# ---------------------------------------------------------------------------
+
+
+def _grad_fold(events) -> dict:
+    """Fold ``grad`` events (the `sbr_tpu.grad` emissions): calibration
+    runs (start/step/done series), gradient-flag censuses per stage, and
+    stress-search outcomes."""
+    calibrations = []
+    current = None
+    censuses = []
+    stress = []
+    for ev in events:
+        if ev.get("kind") != "grad":
+            continue
+        action = ev.get("action")
+        if action == "calib_start":
+            current = {
+                "wrt": ev.get("wrt"), "budget": ev.get("steps"),
+                "n_obs": ev.get("n_obs"), "with_xi": ev.get("with_xi"),
+                "losses": [],
+            }
+            calibrations.append(current)
+        elif action == "calib_step":
+            if current is not None:
+                current["losses"].append(float(ev.get("loss", float("nan"))))
+        elif action == "calib_done":
+            rec = current if current is not None else {"losses": []}
+            rec["steps"] = ev.get("steps")
+            rec["loss"] = ev.get("loss")
+            rec["converged"] = bool(ev.get("converged"))
+            rec["fit"] = {
+                k[len("fit_"):]: v for k, v in ev.items() if k.startswith("fit_")
+            }
+            if current is None:
+                calibrations.append(rec)
+            current = None
+        elif action == "flags":
+            censuses.append({
+                k: ev.get(k)
+                for k in ("stage", "cells", "run_cells", "at_nonequilibrium",
+                          "ill_conditioned", "nonfinite", "nonfinite_run",
+                          "untrusted")
+            })
+        elif action == "stress_done":
+            stress.append({
+                k: v for k, v in ev.items()
+                if k not in ("kind", "ts", "mono", "action")
+            })
+    return {"calibrations": calibrations, "censuses": censuses, "stress": stress}
+
+
+def grad_doc(run: dict) -> tuple:
+    """Machine-readable differentiable-equilibria report; (doc, exit_code).
+
+    Exit contract (matching the other subcommands): 0 healthy, 1 when a
+    calibration finished unconverged or any flag census recorded NONFINITE
+    gradients AT RUN CELLS (``nonfinite_run`` — NaN sensitivities on
+    no-run lanes are the expected face of degenerate brackets, and
+    at_nonequilibrium / ill_conditioned are informational: a sensitivity
+    surface legitimately spans no-run cells), 3 when the run carries no
+    grad data at all (a gate with nothing to read must not pass
+    silently); the CLI returns 2 on an unreadable run dir.
+    """
+    folded = _grad_fold(run["events"])
+    has_data = any(folded.values())
+    if not has_data:
+        code = 3
+    else:
+        # `converged is False` only: a record without the key is a
+        # calibration still RUNNING (calib_start seen, calib_done not yet)
+        # — reading a live run dir must not produce a false-red gate.
+        bad_calib = any(
+            c.get("converged") is False for c in folded["calibrations"]
+        )
+        bad_grads = any(
+            int(c.get("nonfinite_run") or 0) > 0 for c in folded["censuses"]
+        )
+        code = 1 if (bad_calib or bad_grads) else 0
+    doc = {
+        "dir": run["dir"],
+        **folded,
+        "bad_event_lines": run.get("bad_event_lines", 0),
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_grad(run: dict) -> tuple:
+    """Human-readable grad report; same exit contract as `grad_doc`."""
+    from sbr_tpu.obs.history import sparkline
+
+    doc, code = grad_doc(run)
+    out = [f"run      {run['dir']}{_bad_lines_note(run)}"]
+    if code == 3:
+        out.append(
+            "no grad events recorded — did the run use sbr_tpu.grad "
+            "(xi_and_grad / sensitivity_surface / fit_withdrawals)?"
+        )
+        return "\n".join(out), code
+    if doc["calibrations"]:
+        out += ["", "CALIBRATIONS"]
+        rows = []
+        for c in doc["calibrations"]:
+            fit = c.get("fit") or {}
+            rows.append([
+                ",".join(c.get("wrt") or fit.keys()),
+                c.get("steps", "-"),
+                f"{c['loss']:.3e}" if isinstance(c.get("loss"), float) else "-",
+                "yes" if c.get("converged") else "NO",
+                sparkline(c.get("losses") or []) or "-",
+                " ".join(f"{k}={v:.4g}" for k, v in fit.items()) or "-",
+            ])
+        out.append(_table(["wrt", "steps", "loss", "converged", "trend", "fitted"], rows))
+    if doc["censuses"]:
+        out += ["", "GRADIENT FLAG CENSUS"]
+        out.append(
+            _table(
+                ["stage", "cells", "run", "non-eq", "ill-cond", "nonfinite", "nonfin@run"],
+                [
+                    [
+                        c.get("stage", "?"), c.get("cells", "-"),
+                        c.get("run_cells", "-"), c.get("at_nonequilibrium", 0),
+                        c.get("ill_conditioned", 0), c.get("nonfinite", 0),
+                        c.get("nonfinite_run", 0),
+                    ]
+                    for c in doc["censuses"]
+                ],
+            )
+        )
+    if doc["stress"]:
+        out += ["", "STRESS SEARCHES"]
+        out.append(
+            _table(
+                ["flipped", "validated", "steps", "shock", "margin0", "margin*"],
+                [
+                    [
+                        "yes" if s.get("flipped") else "no",
+                        "yes" if s.get("validated") else "-",
+                        s.get("steps", "-"),
+                        f"{s['shock_norm']:.4g}" if isinstance(s.get("shock_norm"), float) else "-",
+                        f"{s['margin0']:.3g}" if isinstance(s.get("margin0"), float) else "-",
+                        f"{s['margin_final']:.3g}" if isinstance(s.get("margin_final"), float) else "-",
+                    ]
+                    for s in doc["stress"]
+                ],
+            )
+        )
+    if code == 1:
+        out += ["", "GATE: unconverged calibration or non-finite gradients (exit 1)"]
+    return "\n".join(out), code
+
+
+def _main_grad(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report grad",
+        description="Differentiable-equilibria report for one run "
+        "(calibration convergence, gradient-flag census, stress searches); "
+        "exit 1 on unconverged calibration / non-finite gradients, 3 when "
+        "no grad data was recorded",
+    )
+    parser.add_argument("run_dir", help="run directory (contains manifest.json)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    try:
+        run = load_run(args.run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.json:
+        doc, code = grad_doc(run)
+        print(json.dumps(doc, default=str))
+        return code
+    text, code = render_grad(run)
+    print(text)
+    return code
+
+
 def _mem_fold(events) -> dict:
     """Fold ``mem`` events: per-where maxima for span attribution, per-tile
     peaks, and the last observed device capacity. The event log is the
@@ -1566,6 +1745,8 @@ def main(argv=None) -> int:
         return _main_serve(argv[1:])
     if argv and argv[0] == "fleet":
         return _main_fleet(argv[1:])
+    if argv and argv[0] == "grad":
+        return _main_grad(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -1578,7 +1759,7 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'trend' / 'gc' subcommands",
+        "'grad' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -16,8 +16,11 @@ A stdlib-only (``http.server``) thread serving four routes off an
 - ``/statz``    — the full JSON live snapshot (same document as the
   rolling ``live.json``).
 - ``/query``    — POST a JSON parameter document (``make_model_params``
-  keywords, e.g. ``{"beta": 1.2, "u": 0.3}``, plus optional ``scenario``)
-  and get one served equilibrium back, ``degraded``/``source`` labeled.
+  keywords, e.g. ``{"beta": 1.2, "u": 0.3}``, plus optional ``scenario``
+  and ``"grads": true`` — ISSUE 13: the answer then carries IFT
+  sensitivities dξ/d{β,u,κ} + ``grad_flags`` next to ξ, cached under
+  their own fingerprint tag) and get one served equilibrium back,
+  ``degraded``/``source`` labeled.
   The deadline rides the ``X-SBR-Deadline-Ms`` header (remaining ms —
   what the fleet router propagates); a query shed at admission gets an
   explicit ``429`` with a ``Retry-After`` header (the engine's measured
@@ -53,7 +56,7 @@ def _json_safe(value):
 
 def query_result_doc(result) -> dict:
     """The wire form of one `QueryResult` (shared with the router)."""
-    return {
+    doc = {
         "xi": _json_safe(result.xi),
         "tau_bar_in": _json_safe(result.tau_bar_in),
         "aw_max": _json_safe(result.aw_max),
@@ -65,6 +68,13 @@ def query_result_doc(result) -> dict:
         "scenario": result.scenario,
         "latency_ms": round(result.latency_s * 1e3, 3),
     }
+    # Sensitivities (ISSUE 13): present only on grads=true answers; a
+    # degraded grads answer has none (the tile cache stores no grads).
+    if getattr(result, "grads", None) is not None:
+        doc["grads"] = {k: _json_safe(v) for k, v in result.grads.items()}
+    if getattr(result, "grad_flags", None) is not None:
+        doc["grad_flags"] = int(result.grad_flags)
+    return doc
 
 
 class ServeEndpoint:
@@ -113,8 +123,9 @@ class ServeEndpoint:
                         self._send(400, b'{"error": "bad deadline"}', "application/json")
                         return
                     scenario = str(doc.get("scenario", "default"))
+                    grads = bool(doc.get("grads", False))
                     unknown = (
-                        set(doc) - set(_PARAM_KEYS) - {"scenario", "deadline_ms"}
+                        set(doc) - set(_PARAM_KEYS) - {"scenario", "deadline_ms", "grads"}
                     )
                     if unknown:
                         self._send(
@@ -141,7 +152,8 @@ class ServeEndpoint:
                         return
                     try:
                         result = endpoint.engine.query(
-                            params, scenario=scenario, deadline_ms=deadline_ms
+                            params, scenario=scenario, deadline_ms=deadline_ms,
+                            grads=grads,
                         )
                     except DeadlineExceeded as err:
                         body = json.dumps(

@@ -178,7 +178,13 @@ class QueryResult:
     ``degraded`` marks a degradation-ladder answer (source "tilecache"):
     the numbers came from a swept tile in the global cache while the
     solver path was unavailable — ``tau_bar_in``/``residual`` are NaN
-    there (tiles don't store them)."""
+    there (tiles don't store them), and ``grads`` stays None even when the
+    query asked for sensitivities (tiles don't store those either).
+
+    ``grads`` (ISSUE 13): dξ/dθ for θ ∈ {β, u, κ} when the query carried
+    ``grads=true`` — IFT sensitivities served next to ξ, fingerprint-keyed
+    and cached exactly like any result; ``grad_flags`` is the grad-trust
+    bitmask (`diag.health.GRAD_*`)."""
 
     xi: float
     tau_bar_in: float
@@ -190,6 +196,8 @@ class QueryResult:
     scenario: str
     latency_s: float
     degraded: bool = False
+    grads: Optional[dict] = None  # {"beta": .., "u": .., "kappa": ..}
+    grad_flags: Optional[int] = None
 
     @property
     def divergent(self) -> bool:
@@ -198,13 +206,14 @@ class QueryResult:
 
 class _Ticket:
     __slots__ = ("params", "scenario", "key", "t0", "deadline", "event",
-                 "result", "error")
+                 "result", "error", "grads")
 
     def __init__(self, params: ModelParams, scenario: str, key: str,
-                 deadline: Optional[float] = None) -> None:
+                 deadline: Optional[float] = None, grads: bool = False) -> None:
         self.params = params
         self.scenario = scenario
         self.key = key
+        self.grads = grads
         self.t0 = time.monotonic()
         # Absolute monotonic deadline, or None. Admission already shed the
         # unmeetable; a ticket whose deadline expires while still QUEUED
@@ -245,6 +254,44 @@ def _batch_fn(config: SolverConfig, dtype_name: str):
 
         def cell(*cols):
             return solve_param_cell(*cols, config, dtype)
+
+        return jax.vmap(cell)(beta, u, p, kappa, lam, eta, t0, t1, x0)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _grad_batch_fn(config: SolverConfig, dtype_name: str, aprime_tol_: float):
+    """Jitted 1-D micro-batch program for ``grads=true`` queries: the
+    forward `solve_param_cell` (the served ξ/status/flags are EXACTLY the
+    plain program's) plus the IFT value-and-grad of the grad twin cell —
+    dξ/dβ, dξ/du, dξ/dκ per lane with grad-trust flags. Cached per
+    (config, dtype, resolved SBR_GRAD_APRIME_TOL — part of the key so an
+    env change cannot be silently ignored by a warm program); traces
+    counted as ``serve.grad_batch``."""
+    import jax
+    import jax.numpy as jnp
+
+    from sbr_tpu.grad.api import WRT_DEFAULT, cell_value_and_grads
+    from sbr_tpu.grad.cell import BASE_KEYS
+    from sbr_tpu.obs import prof
+    from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+    dtype = jnp.dtype(dtype_name)
+
+    def fn(beta, u, p, kappa, lam, eta, t0, t1, x0):
+        prof.note_trace("serve.grad_batch")
+
+        def cell(*cols):
+            xi, tau_in, aw_max, status, health = solve_param_cell(*cols, config, dtype)
+            theta = dict(zip(BASE_KEYS, cols))
+            _, _, grads, _, _, gflags = cell_value_and_grads(
+                theta, WRT_DEFAULT, config, dtype, aprime_tol_=aprime_tol_
+            )
+            return (
+                xi, tau_in, aw_max, status, health,
+                grads["beta"], grads["u"], grads["kappa"], gflags,
+            )
 
         return jax.vmap(cell)(beta, u, p, kappa, lam, eta, t0, t1, x0)
 
@@ -473,13 +520,17 @@ class Engine:
 
     # -- public query API ---------------------------------------------------
     def submit(self, params: ModelParams, scenario: str = "default",
-               deadline_ms: Optional[float] = None) -> _Ticket:
+               deadline_ms: Optional[float] = None, grads: bool = False) -> _Ticket:
         """Enqueue one query for the micro-batcher (requires `start()`).
         Raises once the engine is closed — a ticket enqueued after the
         batcher drained would block its waiter forever — and sheds
-        (`DeadlineExceeded`) when the deadline cannot be met."""
+        (`DeadlineExceeded`) when the deadline cannot be met. With
+        ``grads`` the answer carries dξ/d{β,u,κ} next to ξ (ISSUE 13),
+        cached under its own fingerprint tag."""
         deadline = self._admit(deadline_ms)
-        ticket = _Ticket(params, scenario, self._result_key(params), deadline)
+        ticket = _Ticket(
+            params, scenario, self._result_key(params, grads), deadline, grads
+        )
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("engine is closed")
@@ -490,18 +541,22 @@ class Engine:
     def query(
         self, params: ModelParams, scenario: str = "default",
         timeout: Optional[float] = None, deadline_ms: Optional[float] = None,
+        grads: bool = False,
     ) -> QueryResult:
         """Synchronous single query. Batched with concurrent submitters
         when the engine is started; solved inline otherwise."""
         if self._thread is None:
             return self.query_many(
-                [params], scenario=scenario, deadline_ms=deadline_ms
+                [params], scenario=scenario, deadline_ms=deadline_ms, grads=grads
             )[0]
-        return self.submit(params, scenario, deadline_ms=deadline_ms).wait(timeout)
+        return self.submit(
+            params, scenario, deadline_ms=deadline_ms, grads=grads
+        ).wait(timeout)
 
     def query_many(
         self, params_list: List[ModelParams], scenario: str = "default",
         timeout: Optional[float] = None, deadline_ms: Optional[float] = None,
+        grads: bool = False,
     ) -> List[QueryResult]:
         """Solve a list of queries. Started engine: all enqueue at once (the
         natural micro-batch). Unstarted: processed inline in this thread —
@@ -510,7 +565,7 @@ class Engine:
             raise RuntimeError("engine is closed")
         deadline = self._admit(deadline_ms)
         tickets = [
-            _Ticket(p, scenario, self._result_key(p), deadline)
+            _Ticket(p, scenario, self._result_key(p, grads), deadline, grads)
             for p in params_list
         ]
         if self._thread is None:
@@ -729,10 +784,28 @@ class Engine:
                 groups.setdefault(t.key, []).append(t)
         unique = [g[0] for g in groups.values()]
         max_bucket = max(self.serve.buckets)
+        # Plain and grads queries dispatch through DIFFERENT compiled
+        # programs; partition before chunking (the key tag already keeps
+        # their cache entries and coalescing groups apart).
+        partitions = [
+            [t for t in unique if not t.grads],
+            [t for t in unique if t.grads],
+        ]
+        for part in partitions:
+            self._process_chunks(part, groups, max_bucket)
+
+    def _process_chunks(self, unique: List[_Ticket], groups, max_bucket: int) -> None:
         for i in range(0, len(unique), max_bucket):
             chunk = unique[i : i + max_bucket]
             try:
-                records = self._dispatch([t.params for t in chunk])
+                # Positional call for the plain path: `_dispatch(params)` is
+                # a stubbing point (tests monkeypatch it for failure
+                # injection) and its plain signature stays stable.
+                records = (
+                    self._dispatch([t.params for t in chunk], grads=True)
+                    if chunk[0].grads
+                    else self._dispatch([t.params for t in chunk])
+                )
             except BaseException as err:
                 # Degradation ladder (ISSUE 11): the solver path is down
                 # (breaker open, retry budget exhausted, fault-injected).
@@ -788,9 +861,21 @@ class Engine:
     def _fulfill(self, t: _Ticket, rec: dict, source: str,
                  degraded: bool = False) -> None:
         latency = time.monotonic() - t.t0
+        rec = dict(rec)
+        # Grad records are a superset of the plain shape; fold the dξ/dθ
+        # keys into the structured ``grads`` field. A degraded (tilecache)
+        # answer to a grads query has none — grads stays None.
+        grads = None
+        grad_flags = rec.pop("grad_flags", None)
+        if "dxi_dbeta" in rec:
+            grads = {
+                "beta": rec.pop("dxi_dbeta"),
+                "u": rec.pop("dxi_du"),
+                "kappa": rec.pop("dxi_dkappa"),
+            }
         t.result = QueryResult(
             source=source, scenario=t.scenario, latency_s=latency,
-            degraded=degraded, **rec
+            degraded=degraded, grads=grads, grad_flags=grad_flags, **rec
         )
         self.live.record_query(
             latency, source, scenario=t.scenario, divergent=t.result.divergent
@@ -803,14 +888,16 @@ class Engine:
                 return b
         return max(self.serve.buckets)
 
-    def _dispatch(self, params_list: List[ModelParams]) -> List[dict]:
+    def _dispatch(self, params_list: List[ModelParams], grads: bool = False) -> List[dict]:
         """One padded vmapped dispatch under the retry policy; returns one
         plain-float record per query (the cacheable form). Guarded by the
         dispatch circuit breaker: while open, raise `SolverUnavailable`
         without touching the device (the ladder answers), until the
         cooldown lets one half-open probe through. The ``serve.dispatch``
         fault point fires inside the retried scope, so injected transients
-        are first retried and can then exhaust into a real outage."""
+        are first retried and can then exhaust into a real outage. With
+        ``grads`` the batch runs the grad program (`_grad_batch_fn`) and
+        records carry dξ/dθ + grad-trust flags."""
         import jax.numpy as jnp
 
         self._maybe_refill_budget()
@@ -825,14 +912,20 @@ class Engine:
         if bucket > n:
             pad = bucket - n
             cols = [np.concatenate([c, np.repeat(c[:1], pad)]) for c in cols]
-        exec_ = self._exec(bucket)
+        exec_ = self._exec(bucket, grads=grads)
         args = [jnp.asarray(c) for c in cols]
 
         def run():
             from sbr_tpu.resilience import faults
 
             faults.fire("serve.dispatch", target=f"bucket{bucket}")
-            xi, tau_in, aw_max, status, health = exec_(*args)
+            out = exec_(*args)
+            if grads:
+                xi, tau_in, aw_max, status, health, db, du, dk, gflags = out
+                gextra = tuple(np.asarray(v) for v in (db, du, dk, gflags))
+            else:
+                xi, tau_in, aw_max, status, health = out
+                gextra = None
             # Device→host fetch inside the retried scope: a transient that
             # surfaces at fetch time must count against THIS dispatch.
             return (
@@ -842,11 +935,12 @@ class Engine:
                 np.asarray(status),
                 np.asarray(health.flags),
                 np.asarray(health.residual),
+                gextra,
             )
 
         t_disp = time.monotonic()
         try:
-            xi, tau_in, aw_max, status, flags, residual = self._retry.call(
+            xi, tau_in, aw_max, status, flags, residual, gextra = self._retry.call(
                 run, scope=f"serve.dispatch[{bucket}]", budget=self.retry_budget
             )
         except BaseException:
@@ -870,8 +964,9 @@ class Engine:
                 )
             except Exception:
                 pass
-        return [
-            {
+        records = []
+        for i in range(n):
+            rec = {
                 "xi": float(xi[i]),
                 "tau_bar_in": float(tau_in[i]),
                 "aw_max": float(aw_max[i]),
@@ -879,12 +974,23 @@ class Engine:
                 "flags": int(flags[i]),
                 "residual": float(residual[i]),
             }
-            for i in range(n)
-        ]
+            if gextra is not None:
+                db, du, dk, gflags = gextra
+                rec.update(
+                    dxi_dbeta=float(db[i]), dxi_du=float(du[i]),
+                    dxi_dkappa=float(dk[i]), grad_flags=int(gflags[i]),
+                )
+            records.append(rec)
+        return records
 
     # -- result cache --------------------------------------------------------
-    def _result_key(self, params: ModelParams) -> str:
-        return params_fingerprint((params, self._cfg_tag))
+    def _result_key(self, params: ModelParams, grads: bool = False) -> str:
+        # Grads records carry grad_flags computed under the resolved
+        # ill-conditioning tolerance — it joins the key so a cached answer
+        # (LRU or disk, surviving restarts) can never replay flags from a
+        # different SBR_GRAD_APRIME_TOL.
+        tag = (self._cfg_tag, "grads", self._aprime_tol()) if grads else self._cfg_tag
+        return params_fingerprint((params, tag))
 
     def _result_path(self, key: str) -> Optional[Path]:
         if not self.serve.cache_dir:
@@ -916,15 +1022,22 @@ class Engine:
             except OSError:
                 return None, None
             try:
-                rec = json.loads(path.read_text())
+                raw = json.loads(path.read_text())
                 rec = {
-                    "xi": float(rec["xi"]),
-                    "tau_bar_in": float(rec["tau_bar_in"]),
-                    "aw_max": float(rec["aw_max"]),
-                    "status": int(rec["status"]),
-                    "flags": int(rec["flags"]),
-                    "residual": float(rec["residual"]),
+                    "xi": float(raw["xi"]),
+                    "tau_bar_in": float(raw["tau_bar_in"]),
+                    "aw_max": float(raw["aw_max"]),
+                    "status": int(raw["status"]),
+                    "flags": int(raw["flags"]),
+                    "residual": float(raw["residual"]),
                 }
+                # Grad records are a superset (ISSUE 13): a grads=true
+                # entry restored from disk must keep its sensitivities.
+                for k in ("dxi_dbeta", "dxi_du", "dxi_dkappa"):
+                    if k in raw:
+                        rec[k] = float(raw[k])
+                if "grad_flags" in raw:
+                    rec["grad_flags"] = int(raw["grad_flags"])
             except (OSError, ValueError, KeyError, TypeError):
                 # Unreadable OR parseable-but-wrong-shape (a torn write can
                 # leave valid non-dict JSON; rec["xi"] then raises TypeError,
@@ -1003,7 +1116,7 @@ class Engine:
             pass
 
     # -- executable cache -----------------------------------------------------
-    def _exec_path(self, bucket: int) -> Optional[Path]:
+    def _exec_path(self, bucket: int, grads: bool = False) -> Optional[Path]:
         if not self.serve.cache_dir:
             return None
         import jax
@@ -1017,35 +1130,49 @@ class Engine:
                     jax.__version__,
                     d.platform,
                     d.device_kind,
+                    # grads execs bake the resolved ill-conditioning
+                    # tolerance into the program; a different tolerance
+                    # must miss, not reload a stale executable.
+                    ("grads", self._aprime_tol()) if grads else "plain",
                 )
             ).encode()
         ).hexdigest()[:24]
-        return Path(self.serve.cache_dir) / "execs" / f"serve_batch_{bucket}_{key}.pkl"
+        kind = "grad_batch" if grads else "batch"
+        return Path(self.serve.cache_dir) / "execs" / f"serve_{kind}_{bucket}_{key}.pkl"
 
     def _abstract_args(self, bucket: int) -> tuple:
         import jax
 
         return tuple(jax.ShapeDtypeStruct((bucket,), self.dtype) for _ in range(9))
 
-    def _exec(self, bucket: int):
-        """The compiled executable for one bucket shape: in-memory, else
-        deserialized from the cache dir (restart warm path), else freshly
-        lowered + compiled (and serialized back, best-effort)."""
-        exec_ = self._execs.get(bucket)
+    def _aprime_tol(self) -> float:
+        """The resolved SBR_GRAD_APRIME_TOL for this engine's dtype, read
+        per call so grads programs/executables key on the CURRENT value."""
+        from sbr_tpu.grad.cell import aprime_tol
+
+        return aprime_tol(self.dtype)
+
+    def _exec(self, bucket: int, grads: bool = False):
+        """The compiled executable for one (bucket, program-kind) shape:
+        in-memory, else deserialized from the cache dir (restart warm
+        path), else freshly lowered + compiled (and serialized back,
+        best-effort). ``grads`` selects the grad batch program."""
+        cache_key = (bucket, grads, self._aprime_tol() if grads else None)
+        exec_ = self._execs.get(cache_key)
         if exec_ is not None:
             return exec_
-        path = self._exec_path(bucket)
+        path = self._exec_path(bucket, grads)
         if path is not None and path.exists():
             try:
                 from jax.experimental.serialize_executable import deserialize_and_load
 
                 payload, in_tree, out_tree = pickle.loads(path.read_bytes())
                 exec_ = deserialize_and_load(payload, in_tree, out_tree)
-                self._execs[bucket] = exec_
+                self._execs[cache_key] = exec_
                 self._exec_meta["loaded"] += 1
                 if self._run is not None:
                     self._run.event("serve_exec", bucket=bucket, source="deserialized",
-                                    path=str(path))
+                                    grads=grads, path=str(path))
                 return exec_
             except Exception as err:
                 # A stale/foreign blob must never sink serving: recompile.
@@ -1053,15 +1180,19 @@ class Engine:
         from sbr_tpu import obs
 
         t0 = time.monotonic()
-        with obs.span(f"serve.compile[{bucket}]"):
-            fn = _batch_fn(self.config, self.dtype.name)
+        with obs.span(f"serve.compile[{bucket}{'g' if grads else ''}]"):
+            fn = (
+                _grad_batch_fn(self.config, self.dtype.name, self._aprime_tol())
+                if grads
+                else _batch_fn(self.config, self.dtype.name)
+            )
             compiled = fn.lower(*self._abstract_args(bucket)).compile()
-        self._execs[bucket] = compiled
+        self._execs[cache_key] = compiled
         self._exec_meta["compiled"] += 1
         if self._run is not None:
             try:
                 self._run.event(
-                    "serve_exec", bucket=bucket, source="compiled",
+                    "serve_exec", bucket=bucket, source="compiled", grads=grads,
                     compile_s=round(time.monotonic() - t0, 3),
                 )
             except Exception:

@@ -1,0 +1,721 @@
+"""Differentiable equilibria (ISSUE 13): IFT gradient correctness against
+finite-difference oracles, primal bit-identity with the forward solvers,
+grad-trust flags, Health tangent isolation, traced parameter construction,
+calibration recovery, stress search, the `report grad` gate, served
+sensitivities, and history schema 8.
+
+Structural notes the assertions lean on:
+
+- Reverse-mode THROUGH bisection iterations returns an exact 0 (the
+  iterates are piecewise constant in θ), so an FD match ≤ 1e-5 proves the
+  IFT custom rules carry the derivative — a leak cannot pass.
+- Under adaptive numerics the root-finder is a `lax.while_loop`, which
+  jax cannot reverse-differentiate AT ALL: `jax.grad` succeeding there is
+  structural proof that no backprop touches the solver iterations.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu.diag.health import (
+    GRAD_AT_NONEQUILIBRIUM,
+    GRAD_ILL_CONDITIONED,
+    GRAD_NONFINITE,
+    flag_names,
+)
+from sbr_tpu.grad import api, calibrate, stress
+from sbr_tpu.grad.cell import BASE_KEYS, aprime_tol, baseline_cell, interest_cell
+from sbr_tpu.grad.ift import implicit_root
+from sbr_tpu.models.params import (
+    PARAMS_LEAF_NAMES,
+    ModelParams,
+    SolverConfig,
+    make_interest_params,
+    make_model_params,
+    params_to_pytree,
+    pytree_to_params,
+    with_overrides,
+)
+
+F64 = jnp.float64
+CFG = SolverConfig(n_grid=256, bisect_iters=90, refine_crossings=False)
+CFG_REFINE = SolverConfig(n_grid=256, bisect_iters=90, refine_crossings=True)
+
+
+def _theta(params, dtype=F64, **extra):
+    th = {k: jnp.asarray(v, dtype) for k, v in params_to_pytree(params).items()
+          if k != "eta_bar"}
+    th.update({k: jnp.asarray(v, dtype) for k, v in extra.items()})
+    return th
+
+
+def _fd(fn, th, k, h_rel=1e-6):
+    h = h_rel * max(1.0, abs(float(th[k])))
+    up = dict(th)
+    up[k] = th[k] + h
+    dn = dict(th)
+    dn[k] = th[k] - h
+    return (float(fn(up)) - float(fn(dn))) / (2 * h)
+
+
+# ---------------------------------------------------------------------------
+# implicit_root
+# ---------------------------------------------------------------------------
+
+
+class TestImplicitRoot:
+    def test_grad_matches_fd_and_iteration_backprop_is_zero(self):
+        from sbr_tpu.core.rootfind import bisect
+
+        def resid(x, th):
+            return 1.0 / (1.0 + jnp.exp(-th["a"] * (x - 2.0))) - th["k"]
+
+        def solve(th):
+            return bisect(lambda x: resid(x, th), 0.0, 10.0, num_iters=70)
+
+        th = {"a": jnp.asarray(1.3, F64), "k": jnp.asarray(0.4, F64)}
+        x = implicit_root(resid, solve, th)
+        g = jax.grad(lambda t: implicit_root(resid, solve, t))(th)
+        for k in th:
+            h = 1e-6
+            up, dn = dict(th), dict(th)
+            up[k] = th[k] + h
+            dn[k] = th[k] - h
+            fd = (implicit_root(resid, solve, up) - implicit_root(resid, solve, dn)) / (2 * h)
+            assert abs(float(g[k]) - float(fd)) / abs(float(fd)) < 1e-6
+
+        # The anti-oracle: differentiating THROUGH the iterations yields an
+        # exact 0 — the structural reason the IFT rules exist.
+        g_naive = jax.grad(lambda t: solve(t))(th)
+        assert float(g_naive["a"]) == 0.0 and float(g_naive["k"]) == 0.0
+        assert np.isfinite(float(x))
+
+    def test_vmap_composes(self):
+        from sbr_tpu.core.rootfind import bisect
+
+        def resid(x, th):
+            return x * x - th["k"]
+
+        def solve(th):
+            return bisect(lambda x: resid(x, th), 0.0, 4.0, num_iters=70)
+
+        ks = jnp.linspace(1.0, 4.0, 5)
+        grads = jax.vmap(lambda k: jax.grad(
+            lambda t: implicit_root(resid, solve, t))({"k": k})["k"])(ks)
+        # d sqrt(k)/dk = 1/(2 sqrt(k))
+        np.testing.assert_allclose(
+            np.asarray(grads), 1.0 / (2.0 * np.sqrt(np.asarray(ks))), rtol=1e-8
+        )
+
+
+# ---------------------------------------------------------------------------
+# The FD oracle battery (acceptance: <= 1e-5 relative, f64)
+# ---------------------------------------------------------------------------
+
+
+class TestOracleBattery:
+    def test_battery_fixed_refined(self):
+        from sbr_tpu.grad.parity import run_battery
+
+        rep = run_battery(n=4, seed=0, tol=1e-5, config=CFG_REFINE)
+        assert rep["n_checked"] >= 2, rep
+        assert rep["ok"], rep
+        assert rep["worst_rel"] <= 1e-5
+
+    def test_adaptive_numerics_grad_succeeds_and_matches(self):
+        """Chandrupatla is a while_loop — reverse-mode through it raises;
+        jax.grad succeeding here proves zero backprop through iterations,
+        and the value matches the fixed path's gradient."""
+        cfg_a = SolverConfig(n_grid=256, bisect_iters=60,
+                             refine_crossings=False, numerics="adaptive")
+        cfg_f = SolverConfig(n_grid=256, bisect_iters=60,
+                             refine_crossings=False, numerics="fixed")
+        params = make_model_params(beta=1.5, u=0.1, kappa=0.6)
+        th = _theta(params)
+        grads = {}
+        for name, cfg in (("adaptive", cfg_a), ("fixed", cfg_f)):
+            wrt = {k: th[k] for k in ("beta", "u", "kappa")}
+            rest = {k: v for k, v in th.items() if k not in wrt}
+            g = jax.grad(
+                lambda wv: baseline_cell({**rest, **wv}, cfg, F64)["xi_candidate"]
+            )(wrt)
+            grads[name] = {k: float(v) for k, v in g.items()}
+        for k in ("beta", "u", "kappa"):
+            assert grads["adaptive"][k] == pytest.approx(grads["fixed"][k], rel=1e-6)
+
+    def test_interest_grads_match_fd(self):
+        params = make_interest_params(beta=1.5, u=0.1, kappa=0.6, r=0.005, delta=0.1)
+        th = _theta(ModelParams(params.learning, params.economic),
+                    r=0.005, delta=0.1)
+
+        def xi_of(t):
+            return interest_cell(t, CFG, F64)["xi_candidate"]
+
+        wrt = ("beta", "u", "kappa", "r")
+        g = jax.grad(lambda wv: xi_of({**th, **wv}))({k: th[k] for k in wrt})
+        for k in wrt:
+            fd = _fd(xi_of, th, k)
+            assert abs(float(g[k]) - fd) / max(abs(fd), 1e-9) < 1e-5, k
+
+
+# ---------------------------------------------------------------------------
+# Primal bit-identity with the forward solvers
+# ---------------------------------------------------------------------------
+
+
+class TestPrimalEquality:
+    def test_baseline_cell_bitwise_vs_solve_param_cell(self):
+        from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+        params = make_model_params(beta=1.5, u=0.1, kappa=0.6)
+        th = _theta(params)
+        out = baseline_cell(th, CFG, F64)
+        xi_f, tau_in_f, _, status_f, _ = solve_param_cell(
+            *(th[k] for k in BASE_KEYS), CFG, F64
+        )
+        assert float(out["xi"]) == float(xi_f)
+        assert float(out["tau_in"]) == float(tau_in_f)
+        assert int(out["status"]) == int(status_f)
+
+    def test_interest_cell_bitwise_vs_interest_solver(self):
+        from sbr_tpu.baseline.learning import solve_learning
+        from sbr_tpu.interest.solver import solve_equilibrium_interest
+
+        for r in (0.0, 0.01):
+            ip = make_interest_params(beta=1.5, u=0.1, kappa=0.6, r=r, delta=0.1)
+            ls = solve_learning(ip.learning, CFG, dtype=F64)
+            res = solve_equilibrium_interest(ls, ip.economic, CFG)
+            th = _theta(ModelParams(ip.learning, ip.economic), r=r, delta=0.1)
+            out = interest_cell(th, CFG, F64)
+            assert int(out["status"]) == int(res.base.status)
+            a, b = float(out["xi"]), float(res.base.xi)
+            assert (a == b) or (np.isnan(a) and np.isnan(b))
+
+    def test_nonrun_xi_masked_nan_with_zero_tangent(self):
+        params = make_model_params(beta=1.5, u=0.5, kappa=0.6)  # no crossing
+        th = _theta(params)
+        out = baseline_cell(th, CFG, F64)
+        assert np.isnan(float(out["xi"]))
+        g = jax.grad(lambda wv: baseline_cell({**th, **wv}, CFG, F64)["xi"])(
+            {"kappa": th["kappa"]}
+        )
+        assert float(g["kappa"]) == 0.0  # the NaN mask is a constant branch
+
+
+# ---------------------------------------------------------------------------
+# Grad-trust flags
+# ---------------------------------------------------------------------------
+
+
+class TestGradFlags:
+    def test_nonequilibrium_flag(self):
+        res = api.xi_and_grad(
+            make_model_params(beta=1.5, u=0.5, kappa=0.6), config=CFG
+        )
+        assert int(res.flags) & GRAD_AT_NONEQUILIBRIUM
+        assert not bool(res.trusted)
+        assert "grad_at_nonequilibrium" in flag_names(int(res.flags))
+
+    def test_ill_conditioned_flag_near_aw_plateau(self):
+        """AW'(ξ) = g(ξ) on the interior branch: κ just under the
+        reachable mass at SMALL u pushes ξ toward τ̄_OUT deep in the
+        saturated tail where g ≈ 0 — the IFT denominator degenerates."""
+        from sbr_tpu.baseline.learning import logistic_cdf
+
+        params = make_model_params(beta=1.5, u=0.005, kappa=0.6)
+        th = _theta(params)
+        out = baseline_cell(th, CFG, F64)
+        reach = float(
+            logistic_cdf(out["tau_out"], th["beta"], th["x0"])
+            - logistic_cdf(out["tau_in"], th["beta"], th["x0"])
+        )
+        th2 = dict(th)
+        th2["kappa"] = jnp.asarray(reach * (1.0 - 1e-6), F64)
+        out2 = baseline_cell(th2, CFG, F64, aprime_tol_=1e-2)
+        assert int(out2["status"]) == 0, "must still be a RUN root"
+        assert int(out2["flags"]) & GRAD_ILL_CONDITIONED
+        # the healthy cell at the same tolerance carries no flag
+        out_ok = baseline_cell(th, CFG, F64, aprime_tol_=1e-3)
+        assert not (int(out_ok["flags"]) & GRAD_ILL_CONDITIONED)
+
+    def test_aprime_tol_resolution(self, monkeypatch):
+        assert aprime_tol(jnp.float64) == pytest.approx(float(jnp.finfo(jnp.float64).eps) ** 0.5)
+        monkeypatch.setenv("SBR_GRAD_APRIME_TOL", "0.25")
+        assert aprime_tol(jnp.float64) == 0.25
+        assert aprime_tol(jnp.float64, 0.5) == 0.5  # explicit wins
+
+    def test_flag_census_counts(self):
+        surf = api.sensitivity_surface(
+            np.linspace(0.8, 2.0, 3), np.array([0.08, 0.5]),
+            make_model_params(), config=CFG,
+        )
+        census = api.flag_census(surf.status, surf.flags)
+        assert census["cells"] == 6
+        assert census["run_cells"] + census["at_nonequilibrium"] == 6
+        assert census["nonfinite_run"] == 0  # NaN grads only on no-run lanes
+
+
+# ---------------------------------------------------------------------------
+# Health tangent isolation (satellite: stop_gradient at construction)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthStopGradient:
+    def test_threaded_health_gradient_equals_health_free_bitwise(self):
+        from sbr_tpu.core.rootfind import bisect
+
+        def with_health(k):
+            x, h = bisect(lambda x: x * x - k, 0.0, 3.0, num_iters=40,
+                          with_health=True)
+            # A caller accidentally folding health leaves into a loss must
+            # get the health-free gradient: the leaves carry no tangents.
+            return x + h.residual + h.bracket_width
+
+        def health_free(k):
+            return bisect(lambda x: x * x - k, 0.0, 3.0, num_iters=40)
+
+        g1 = jax.grad(with_health)(2.0)
+        g0 = jax.grad(health_free)(2.0)
+        assert float(g1) == float(g0)
+
+    def test_full_solve_health_threading_leaks_nothing(self):
+        th = _theta(make_model_params(beta=1.5, u=0.1, kappa=0.6))
+
+        def loss_with_health(wv):
+            from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+            xi, tau_in, aw_max, status, health = solve_param_cell(
+                *( {**th, **wv}[k] for k in BASE_KEYS), CFG, F64
+            )
+            # residual depends on θ; stop_gradient must zero its tangent
+            return jnp.nansum(aw_max) + health.residual
+
+        def loss_plain(wv):
+            from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+            xi, tau_in, aw_max, status, health = solve_param_cell(
+                *( {**th, **wv}[k] for k in BASE_KEYS), CFG, F64
+            )
+            return jnp.nansum(aw_max)
+
+        wv = {"u": th["u"]}
+        g1 = jax.grad(loss_with_health)(wv)
+        g0 = jax.grad(loss_plain)(wv)
+        assert float(g1["u"]) == float(g0["u"])
+
+
+# ---------------------------------------------------------------------------
+# Traced params + pytree round-trip (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestParamsPytree:
+    def test_make_model_params_accepts_traced_scalars(self):
+        def f(beta):
+            p = make_model_params(beta=beta)
+            return p.economic.eta + p.learning.tspan[1]
+
+        v = jax.jit(f)(jnp.asarray(2.0, F64))
+        assert float(v) == pytest.approx(15.0 / 2.0 + 2 * 15.0 / 2.0)
+        # and it differentiates — no silent float() coercion anywhere
+        g = jax.grad(f)(jnp.asarray(2.0, F64))
+        assert float(g) == pytest.approx(-3 * 15.0 / 4.0)
+
+    def test_concrete_validation_still_raises(self):
+        with pytest.raises(ValueError):
+            make_model_params(beta=-1.0)
+        with pytest.raises(ValueError):
+            make_model_params(kappa=1.5)
+
+    def test_round_trip_exact(self):
+        p = make_model_params(beta=1.7, u=0.2, kappa=0.45, eta=3.3,
+                              tspan=(0.0, 9.9), x0=2e-4)
+        tree = params_to_pytree(p)
+        assert set(tree) == set(PARAMS_LEAF_NAMES)
+        q = pytree_to_params(tree)
+        assert q == p
+
+    def test_round_trip_rejects_bad_leaves(self):
+        tree = params_to_pytree(make_model_params())
+        tree["bogus"] = 1.0
+        with pytest.raises(ValueError):
+            pytree_to_params(tree)
+        tree.pop("bogus")
+        tree.pop("beta")
+        with pytest.raises(ValueError):
+            pytree_to_params(tree)
+
+    def test_pytree_to_params_accepts_traced_leaves(self):
+        def f(beta):
+            tree = params_to_pytree(make_model_params())
+            tree["beta"] = beta
+            return pytree_to_params(tree).learning.beta * 2.0
+
+        assert float(jax.jit(f)(jnp.asarray(3.0, F64))) == 6.0
+
+
+# ---------------------------------------------------------------------------
+# Trace accounting: differentiating adds zero solver traces
+# ---------------------------------------------------------------------------
+
+
+class TestTraceCounts:
+    def test_grad_program_traces_root_solver_once(self):
+        from sbr_tpu.obs import prof
+
+        cfg = SolverConfig(n_grid=224, bisect_iters=90, refine_crossings=False)
+        th = _theta(make_model_params(beta=1.5, u=0.1, kappa=0.6))
+
+        def count():
+            return prof.trace_counts().get("grad.root_solve", 0)
+
+        before = count()
+        jax.jit(lambda t: baseline_cell(t, cfg, F64)["xi_candidate"])(th)
+        value_traces = count() - before
+
+        before = count()
+        jax.jit(jax.grad(
+            lambda wv: baseline_cell({**th, **wv}, cfg, F64)["xi_candidate"]
+        ))({"kappa": th["kappa"]})
+        grad_traces = count() - before
+
+        # refine off => exactly the ξ solve, and the BACKWARD pass adds no
+        # additional solver program: one trace each.
+        assert value_traces == 1
+        assert grad_traces == 1
+
+
+# ---------------------------------------------------------------------------
+# Calibration
+# ---------------------------------------------------------------------------
+
+
+class TestCalibration:
+    def test_recovers_planted_parameters(self):
+        truth = make_model_params(beta=1.4, u=0.12, kappa=0.55)
+        t_obs, aw_obs, xi_obs = calibrate.synth_withdrawals(
+            truth, n_obs=48, config=CFG
+        )
+        init = with_overrides(truth, beta=1.1, u=0.16, kappa=0.62)
+        fit = calibrate.fit_withdrawals(
+            t_obs, aw_obs, init, xi_obs=xi_obs, steps=400, config=CFG
+        )
+        assert fit.converged, (fit.loss, fit.steps)
+        planted = {"beta": 1.4, "u": 0.12, "kappa": 0.55}
+        for k, v in planted.items():
+            assert abs(fit.params[k] - v) / v < 1e-3, (k, fit.params)
+
+    def test_dead_start_reports_unconverged(self):
+        truth = make_model_params(beta=1.4, u=0.12, kappa=0.55)
+        t_obs, aw_obs, xi_obs = calibrate.synth_withdrawals(
+            truth, n_obs=32, config=CFG
+        )
+        # u above the hazard peak: no crossing, flat curve, dead gradient
+        bad = with_overrides(truth, u=0.6)
+        fit = calibrate.fit_withdrawals(
+            t_obs, aw_obs, bad, xi_obs=xi_obs, steps=80, config=CFG
+        )
+        assert not fit.converged
+
+    def test_emits_obs_events(self, tmp_path):
+        from sbr_tpu import obs
+
+        truth = make_model_params(beta=1.4, u=0.12, kappa=0.55)
+        t_obs, aw_obs, xi_obs = calibrate.synth_withdrawals(
+            truth, n_obs=24, config=CFG
+        )
+        init = with_overrides(truth, beta=1.2, u=0.14, kappa=0.6)
+        run_dir = tmp_path / "run"
+        with obs.run_context(label="grad", run_dir=str(run_dir)):
+            calibrate.fit_withdrawals(
+                t_obs, aw_obs, init, xi_obs=xi_obs, steps=40, config=CFG
+            )
+        events = [
+            json.loads(line)
+            for line in (run_dir / "events.jsonl").read_text().splitlines()
+        ]
+        actions = [e.get("action") for e in events if e.get("kind") == "grad"]
+        assert "calib_start" in actions and "calib_done" in actions
+
+
+# ---------------------------------------------------------------------------
+# Stress search
+# ---------------------------------------------------------------------------
+
+
+class TestStress:
+    def test_flips_no_run_cell_and_matches_solver_boundary(self):
+        from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+        p0 = make_model_params(beta=1.5, u=0.1, kappa=0.97)  # NO_ROOT: κ too high
+        res = stress.stress_search(p0, wrt=("kappa",), steps=200, lr=0.02,
+                                   config=CFG)
+        assert res.flipped and res.validated
+        assert res.margin0 > 0 and res.margin_final < 0
+        kappa_star = res.params_flipped["kappa"]
+
+        # Direct solver bisection on κ for the true run boundary.
+        th = _theta(p0)
+
+        def status_at(kappa):
+            out = solve_param_cell(
+                *((jnp.asarray(kappa, F64) if k == "kappa" else th[k])
+                  for k in BASE_KEYS), CFG, F64,
+            )
+            return int(out[3])
+
+        lo, hi = 0.5, 0.97  # run at lo, no-run at hi
+        assert status_at(lo) == 0 and status_at(hi) != 0
+        for _ in range(40):
+            mid = 0.5 * (lo + hi)
+            if status_at(mid) == 0:
+                lo = mid
+            else:
+                hi = mid
+        assert abs(kappa_star - lo) < 2e-3, (kappa_star, lo)
+
+    def test_already_running_cell_is_zero_shock(self):
+        res = stress.stress_search(
+            make_model_params(beta=1.5, u=0.1, kappa=0.6),
+            wrt=("kappa",), config=CFG,
+        )
+        assert res.flipped and res.margin0 < 0
+        assert res.shock_norm == 0.0
+
+    def test_margin_sign_agrees_with_solver(self):
+        from sbr_tpu.sweeps.baseline_sweeps import solve_param_cell
+
+        for kappa, u in ((0.6, 0.1), (0.97, 0.1), (0.6, 0.5)):
+            th = _theta(make_model_params(beta=1.5, u=u, kappa=kappa))
+            m = float(stress.run_margin(th, CFG, F64))
+            status = int(solve_param_cell(*(th[k] for k in BASE_KEYS), CFG, F64)[3])
+            assert (m < 0) == (status == 0), (kappa, u, m, status)
+
+
+# ---------------------------------------------------------------------------
+# report grad
+# ---------------------------------------------------------------------------
+
+
+class TestReportGrad:
+    def _run_with_events(self, tmp_path, events):
+        from sbr_tpu import obs
+
+        run_dir = tmp_path / "run"
+        with obs.run_context(label="grad", run_dir=str(run_dir)):
+            for kw in events:
+                obs.event("grad", **kw)
+        return str(run_dir)
+
+    def test_exit0_on_healthy_run(self, tmp_path, capsys):
+        from sbr_tpu.obs.report import main
+
+        d = self._run_with_events(tmp_path, [
+            dict(action="calib_start", wrt=["beta"], steps=10, n_obs=8, lr=0.05),
+            dict(action="calib_step", step=0, loss=0.1),
+            dict(action="calib_done", steps=10, loss=1e-9, converged=True,
+                 fit_beta=1.4),
+            dict(action="flags", stage="s", cells=4, run_cells=2,
+                 at_nonequilibrium=2, ill_conditioned=0, nonfinite=2,
+                 nonfinite_run=0, untrusted=2),
+        ])
+        assert main(["grad", d]) == 0
+        out = capsys.readouterr().out
+        assert "CALIBRATIONS" in out and "GRADIENT FLAG CENSUS" in out
+
+    def test_exit1_on_unconverged_calibration(self, tmp_path, capsys):
+        from sbr_tpu.obs.report import main
+
+        d = self._run_with_events(tmp_path, [
+            dict(action="calib_done", steps=10, loss=0.5, converged=False),
+        ])
+        assert main(["grad", d, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["exit"] == 1 and doc["calibrations"][0]["converged"] is False
+
+    def test_running_calibration_does_not_gate(self, tmp_path):
+        """calib_start with no calib_done yet = a LIVE fit: reading the
+        run dir mid-calibration must not produce a false-red exit 1."""
+        from sbr_tpu.obs.report import main
+
+        d = self._run_with_events(tmp_path, [
+            dict(action="calib_start", wrt=["beta"], steps=100, n_obs=8, lr=0.05),
+            dict(action="calib_step", step=0, loss=0.1),
+        ])
+        assert main(["grad", d]) == 0
+
+    def test_exit1_on_nonfinite_run_gradients(self, tmp_path):
+        from sbr_tpu.obs.report import main
+
+        d = self._run_with_events(tmp_path, [
+            dict(action="flags", stage="s", cells=4, run_cells=4,
+                 at_nonequilibrium=0, ill_conditioned=0, nonfinite=1,
+                 nonfinite_run=1, untrusted=1),
+        ])
+        assert main(["grad", d]) == 1
+
+    def test_exit3_without_grad_data_and_2_on_bad_dir(self, tmp_path):
+        from sbr_tpu import obs
+        from sbr_tpu.obs.report import main
+
+        run_dir = tmp_path / "empty"
+        with obs.run_context(label="none", run_dir=str(run_dir)):
+            pass
+        assert main(["grad", str(run_dir)]) == 3
+        assert main(["grad", str(tmp_path / "missing")]) == 2
+
+    def test_real_surface_census_exits_zero(self, tmp_path, capsys):
+        from sbr_tpu import obs
+        from sbr_tpu.obs.report import main
+
+        run_dir = tmp_path / "surf"
+        with obs.run_context(label="grad", run_dir=str(run_dir)):
+            api.sensitivity_surface(
+                np.linspace(0.8, 2.0, 3), np.array([0.08, 0.5]),
+                make_model_params(), config=CFG,
+            )
+        assert main(["grad", str(run_dir), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["censuses"][0]["stage"] == "grad.sensitivity_surface"
+
+
+# ---------------------------------------------------------------------------
+# Serving: grads=true queries
+# ---------------------------------------------------------------------------
+
+
+class TestServeGrads:
+    def _engine(self, tmp_path=None):
+        from sbr_tpu.serve.engine import Engine, ServeConfig
+
+        cfg = SolverConfig(n_grid=128, bisect_iters=60, refine_crossings=False)
+        serve = ServeConfig(
+            buckets=(1, 4),
+            cache_dir=str(tmp_path / "cache") if tmp_path is not None else None,
+        )
+        return Engine(config=cfg, serve=serve)
+
+    def test_grads_query_matches_api_and_caches(self):
+        eng = self._engine()
+        p = make_model_params(beta=1.5, u=0.1, kappa=0.6)
+        plain = eng.query(p)
+        res = eng.query(p, grads=True)
+        assert plain.grads is None and res.grads is not None
+        assert res.xi == plain.xi  # the grad program serves the SAME ξ
+        gres = api.xi_and_grad(
+            p, config=eng.config, dtype=eng.dtype
+        )
+        for k in ("beta", "u", "kappa"):
+            assert res.grads[k] == pytest.approx(float(gres.grads[k]), rel=1e-9)
+        assert res.grad_flags == int(gres.flags)
+        # separate cache identities, both hit on repeat
+        assert eng.query(p, grads=True).source == "lru"
+        assert eng.query(p).source == "lru"
+        eng.close()
+
+    def test_grads_survive_disk_restart(self, tmp_path):
+        p = make_model_params(beta=1.5, u=0.1, kappa=0.6)
+        eng = self._engine(tmp_path)
+        first = eng.query(p, grads=True)
+        eng.close()
+        eng2 = self._engine(tmp_path)
+        res = eng2.query(p, grads=True)
+        assert res.source == "disk"
+        assert res.grads == first.grads and res.grad_flags == first.grad_flags
+        eng2.close()
+
+    def test_endpoint_grads_field(self):
+        import urllib.request
+
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+
+        eng = self._engine()
+        with ServeEndpoint(eng) as ep:
+            body = json.dumps(
+                {"beta": 1.5, "u": 0.1, "kappa": 0.6, "grads": True}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ep.port}/query", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert set(doc["grads"]) == {"beta", "u", "kappa"}
+            assert "grad_flags" in doc
+            # plain queries stay grad-free on the wire
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{ep.port}/query",
+                data=json.dumps({"beta": 1.5, "u": 0.1, "kappa": 0.6}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            doc2 = json.loads(urllib.request.urlopen(req2, timeout=30).read())
+            assert "grads" not in doc2
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# History schema 8
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema8:
+    def test_polarity(self):
+        from sbr_tpu.obs.history import polarity
+
+        assert polarity("grads_per_sec") == 1
+        assert polarity("calib_steps_per_sec") == 1
+
+    def test_bench_metrics_picks_grad_keys(self):
+        from sbr_tpu.obs.history import bench_metrics
+
+        result = {
+            "metric": "beta_u_grid_equilibria_per_sec", "value": 1000.0,
+            "extra": {"grads_per_sec": 5000.0, "calib_steps_per_sec": 40.0},
+        }
+        m = bench_metrics(result)
+        assert m["grads_per_sec"] == 5000.0
+        assert m["calib_steps_per_sec"] == 40.0
+
+    def test_schema8_gates_against_schema1_to_7(self, tmp_path):
+        """Committed schema 1-7 lines still load, and a schema-8 append
+        gates its new keys once enough points exist."""
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        lines = [
+            {"ts": "t0", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1000.0}},  # schema-less → 1
+        ] + [
+            {"schema": s, "ts": f"t{s}", "label": "bench", "platform": "cpu",
+             "metrics": {"eq_per_sec": 1000.0}}
+            for s in range(2, 8)
+        ]
+        with open(path, "w") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        history.append(
+            {"eq_per_sec": 990.0, "grads_per_sec": 5000.0}, platform="cpu",
+            path=path,
+        )
+        records = history.load(path)
+        assert [r["schema"] for r in records] == [1, 2, 3, 4, 5, 6, 7, history.SCHEMA]
+        verdicts, status = history.check(records, tolerance=0.15)
+        assert status == "ok"
+        assert verdicts["eq_per_sec"]["status"] == "ok"
+        # new key: too few points to gate yet — short, never a false alarm
+        assert verdicts["grads_per_sec"]["status"] == "short"
+
+    def test_schema8_regression_detected(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        for i in range(4):
+            history.append({"grads_per_sec": 5000.0}, platform="cpu", path=path)
+        history.append({"grads_per_sec": 2000.0}, platform="cpu", path=path)
+        verdicts, status = history.check(history.load(path), tolerance=0.15)
+        assert status == "regression"
+        assert verdicts["grads_per_sec"]["status"] == "regression"
