@@ -1409,6 +1409,117 @@ def bench_grad(platform: str) -> dict:
     }
 
 
+def bench_scenario(platform: str) -> dict:
+    """Composable-scenario workload (ISSUE 14): composition-layer overhead
+    + multi-bank contagion throughput.
+
+    Part 1 times the SAME β×u shape through `scenario.scenario_grid` with
+    the baseline-reducible spec and through the legacy `beta_u_grid`
+    program, back-to-back with the fenced protocol:
+    ``scenario_overhead_ratio`` = composed steady / legacy steady — the
+    composed cell IS `solve_param_cell`, so a ratio drifting above ~1
+    means the composition layer grew a real cost (history schema 9,
+    lower-better). Part 2 times an N-bank contagion solve on a ring
+    exposure network: ``scenario_multibank_cells_per_sec`` counts
+    bank-cells per second (contagion iterations × banks / wall). Tiny
+    dry-run shapes zero the gated keys so reduced-shape stats never seed
+    a baseline."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu import scenario
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+
+    if _tiny():
+        n_beta = n_u = 8
+        n_grid = 128
+        n_banks = 3
+    elif platform == "cpu":
+        n_beta = n_u = 96
+        n_grid = 512
+        n_banks = 16
+    else:
+        n_beta = n_u = 256
+        n_grid = 1024
+        n_banks = 64
+    config = SolverConfig(n_grid=n_grid, bisect_iters=60, refine_crossings=False)
+    base = make_model_params()
+    betas = np.linspace(0.25, 3.0, n_beta)
+    spec = scenario.ScenarioSpec()  # baseline-reducible: the overhead probe
+
+    from sbr_tpu import obs
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    def composed(rep: int):
+        us = np.linspace(0.01, 0.99, n_u) + rep * 1e-6
+        g = scenario.scenario_grid(spec, betas, us, base, config=config, dtype=jnp.float32)
+        return float(jnp.sum(g.status) + jnp.nansum(g.xi))
+
+    def legacy(rep: int):
+        us = np.linspace(0.01, 0.99, n_u) + rep * 1e-6
+        g = beta_u_grid(betas, us, base, config=config, dtype=jnp.float32)
+        return float(jnp.sum(g.status) + jnp.nansum(g.xi))
+
+    t0 = time.perf_counter()
+    composed(0)  # compile
+    first_s = time.perf_counter() - t0
+    legacy(0)
+
+    with obs.suspended(), obs.mem.live_disabled():
+        comp_s = min(
+            _timed(lambda r=r: composed(r)) for r in (1, 2, 3)
+        )
+        leg_s = min(
+            _timed(lambda r=r: legacy(r)) for r in (1, 2, 3)
+        )
+
+        # Multi-bank contagion: a directed ring of exposures, every bank
+        # fragile enough that spillovers move κ and the loop iterates.
+        ring = tuple(
+            (i, (i + 1) % n_banks, 0.6) for i in range(n_banks)
+        )
+        # tol at f32 resolution: the bench child runs without x64, and a
+        # tighter tol than the dtype can express just burns max_iter.
+        mb_spec = scenario.ScenarioSpec(
+            banks=n_banks, exposure=ring, contagion_max_iter=12, contagion_tol=1e-5
+        )
+        plist = [
+            make_model_params(beta=1.0 + 0.5 * (i / max(n_banks - 1, 1)), u=0.05)
+            for i in range(n_banks)
+        ]
+        scenario.solve_multibank(mb_spec, plist, config=config)  # compile
+        t0 = time.perf_counter()
+        mb = scenario.solve_multibank(mb_spec, plist, config=config)
+        jnp.asarray(mb.status).block_until_ready()
+        mb_s = time.perf_counter() - t0
+
+    overhead = comp_s / leg_s if leg_s > 0 else 0.0
+    mb_cells = mb.iterations * n_banks / mb_s if mb_s > 0 else 0.0
+    _log(
+        f"scenario: composed {comp_s:.3f}s vs legacy {leg_s:.3f}s "
+        f"({overhead:.3f}x overhead, {first_s:.1f}s first incl. compile); "
+        f"multibank {n_banks} banks x {mb.iterations} round(s) in {mb_s:.3f}s "
+        f"({mb_cells:.1f} bank-cells/s, converged={mb.converged})"
+    )
+    return {
+        "scenario_cells": n_beta * n_u,
+        "scenario_composed_s": round(comp_s, 4),
+        "scenario_legacy_s": round(leg_s, 4),
+        "scenario_first_call_s": round(first_s, 2),
+        "scenario_overhead_ratio": 0.0 if _tiny() else round(overhead, 4),
+        "scenario_multibank_cells_per_sec": 0.0 if _tiny() else round(mb_cells, 1),
+        "scenario_multibank_banks": n_banks,
+        "scenario_multibank_iterations": mb.iterations,
+        "scenario_multibank_converged": bool(mb.converged),
+    }
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def measure(platform: str) -> None:
     """Measurement child entry: the real body runs inside a
     graceful-shutdown envelope so a preemption (SIGTERM) mid-bench still
@@ -1510,6 +1621,20 @@ def _measure_inner(platform: str) -> None:
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in grad.items() if v is not None},
         )
+    try:
+        with obs.span("bench.scenario"):
+            scen = bench_scenario(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the composable-scenario workload fails.
+        _log(f"scenario bench failed: {err!r}")
+        scen = None
+    if scen is not None:
+        obs.event(
+            "bench_scenario",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in scen.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -1608,6 +1733,18 @@ def _measure_inner(platform: str) -> None:
                 out["extra"][k] = grad[k]
         out["extra"]["grad_cells"] = grad["grad_cells"]
         out["extra"]["calib_converged"] = grad["calib_converged"]
+    if scen is not None:
+        # Schema-9 history metrics (ISSUE 14): composition-layer overhead
+        # + multi-bank contagion throughput. Tiny shapes zero the gated
+        # keys (falsy → dropped here) so reduced-shape stats never seed
+        # baselines.
+        for k in ("scenario_overhead_ratio", "scenario_multibank_cells_per_sec"):
+            if scen.get(k):
+                out["extra"][k] = scen[k]
+        out["extra"]["scenario_multibank_banks"] = scen["scenario_multibank_banks"]
+        out["extra"]["scenario_multibank_converged"] = scen[
+            "scenario_multibank_converged"
+        ]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

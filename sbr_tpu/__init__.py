@@ -31,6 +31,11 @@ Subpackage map (reference component in parens):
                  graph simulation (new capability).
 - ``sweeps``   — vmapped / mesh-sharded comparative statics
                  (``scripts/1_baseline.jl`` sweeps).
+- ``scenario`` — composable scenario engine (ISSUE 14): `ScenarioSpec`
+                 pipelines — learning transformer × ordered hazard/policy
+                 modifiers × N-bank contagion on an interbank exposure
+                 network — with bit-identical legacy reductions and spec
+                 fingerprints keyed through every cache (new capability).
 - ``grad``     — differentiable equilibria: implicit-function-theorem
                  dξ/dθ through the fixed point (custom-JVP root rules),
                  sensitivity surfaces, withdrawal-curve calibration, and

@@ -486,6 +486,38 @@ def _aw_max_exact(xi, tau_bar_in_unc, tau_bar_out_unc, eta, ls: LearningSolution
     return jnp.max(aw_out - aw_in) + ls.cdf_at(zero)
 
 
+def classify_cell(no_crossing, root_ok, increasing, err, dtype, first_ok=None):
+    """Shared branchless outcome classification of one equilibrium cell —
+    the ONE definition of the reference's 5-case split (`solver.jl:341-372`)
+    used by the baseline, interest, hetero, and composed-scenario stacks
+    (ISSUE 14: stage algebra defined once). ``first_ok`` adds the hetero
+    family's first-crossing validation (`heterogeneity_solver.jl:175-210`)
+    without disturbing the 3-condition stacks' bytes.
+
+    Returns (run, status, converged, tolerance).
+    """
+    valid_slope = (
+        increasing if first_ok is None else jnp.logical_and(increasing, first_ok)
+    )
+    run = jnp.logical_and(
+        jnp.logical_not(no_crossing), jnp.logical_and(root_ok, valid_slope)
+    )
+    status = jnp.where(
+        no_crossing,
+        Status.NO_CROSSING,
+        jnp.where(
+            jnp.logical_not(root_ok),
+            Status.NO_ROOT,
+            jnp.where(valid_slope, Status.RUN, Status.FALSE_EQ),
+        ),
+    ).astype(jnp.int32)
+    converged = jnp.logical_or(no_crossing, run)  # `solver.jl:432,447-455`
+    tolerance = jnp.where(
+        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
+    )
+    return run, status, converged, tolerance
+
+
 def solve_equilibrium_core(
     ls: LearningSolution,
     u,
@@ -495,11 +527,27 @@ def solve_equilibrium_core(
     eta,
     tspan_end,
     config: SolverConfig | None = None,
+    hazard_transform=None,
+    kappa_transform=None,
 ) -> EquilibriumResult:
     """Scalar-parameter equilibrium solve — the vmap/pjit unit of the sweeps.
 
     Faithful to `solve_equilibrium_baseline` (`solver.jl:413-462`) including
     the trivial no-crossing branch, expressed branchlessly via status codes.
+
+    Stage-transformer hooks (ISSUE 14, the composable scenario engine):
+
+    - ``hazard_transform(tau_grid, hr, hazard_at)`` →
+      ``(hr, hazard_at, extra_health)`` rewrites the hazard between the
+      hazard stage and the buffer crossings (interest's h − rV, policy
+      modifiers); ``extra_health`` is a tuple of `diag.Health` merged after
+      the ξ stage's, preserving the legacy merge order.
+    - ``kappa_transform(kappa)`` rewrites the solvency threshold before the
+      ξ bisection (lender-of-last-resort injections).
+
+    Both default to None, on which path the function is bit-identical to
+    its pre-scenario form — the parity anchor every composed reduction is
+    measured against.
     """
     if config is None:
         config = SolverConfig()
@@ -519,6 +567,11 @@ def solve_equilibrium_core(
         if (ls.closed_form and config.refine_crossings)
         else None
     )
+    extra_health = ()
+    if hazard_transform is not None:
+        hr, hazard_at, extra_health = hazard_transform(tau_grid, hr, hazard_at)
+    if kappa_transform is not None:
+        kappa = kappa_transform(kappa)
     with obs.span("baseline.buffers") as sp:
         tau_in_unc, tau_out_unc, cross_health = optimal_buffer(
             u, tau_grid, hr, tspan_end, hazard_at=hazard_at, with_health=True,
@@ -533,24 +586,13 @@ def solve_equilibrium_core(
             tau_in_unc, tau_out_unc, ls, kappa, config, with_health=True
         )
         sp.sync(xi_c)
-    health = cross_health.merge(xi_health)
+    health = cross_health.merge(xi_health, *extra_health)
 
-    run = jnp.logical_and(jnp.logical_not(no_crossing), jnp.logical_and(root_ok, increasing))
-    status = jnp.where(
-        no_crossing,
-        Status.NO_CROSSING,
-        jnp.where(
-            jnp.logical_not(root_ok),
-            Status.NO_ROOT,
-            jnp.where(increasing, Status.RUN, Status.FALSE_EQ),
-        ),
-    ).astype(jnp.int32)
+    run, status, converged, tolerance = classify_cell(
+        no_crossing, root_ok, increasing, err, dtype
+    )
 
     xi = jnp.where(run, xi_c, nan)
-    converged = jnp.logical_or(no_crossing, run)  # `solver.jl:432,447-455`
-    tolerance = jnp.where(
-        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
-    )
 
     aw_cum, aw_out, aw_in = get_aw(xi, tau_in_unc, tau_out_unc, tau_grid, ls)
     aw_cum = jnp.where(run, aw_cum, nan)

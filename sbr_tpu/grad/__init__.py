@@ -21,6 +21,14 @@ interest HJB stage differentiates via the fixed-RK4 recompute rule). The
 hetero stack is deliberately NOT grad-capable yet — its coupled-K ODE
 runs an adjoint-less `lax.while_loop` and its sharded path would nest
 custom rules under `shard_map` (rationale in grad/cell.py).
+
+Composed scenarios (ISSUE 14): `scenario_xi_and_grad(spec, params)`
+covers exactly the baseline- and interest-REDUCIBLE `ScenarioSpec`s (the
+composed solve dispatches to the same legacy cells, so the primal stays
+bit-identical); hetero/social learning stages, policy modifiers, and
+multi-bank contagion raise `NotImplementedError` loudly — gradient
+coverage is part of the composition matrix (README "Composable
+scenarios"), never a silent wrong answer.
 """
 
 from sbr_tpu.grad.api import (
@@ -30,6 +38,7 @@ from sbr_tpu.grad.api import (
     cell_value_and_grads,
     flag_census,
     interest_xi_and_grad,
+    scenario_xi_and_grad,
     sensitivity_surface,
     xi_and_grad,
     xi_value,
@@ -50,6 +59,7 @@ __all__ = [
     "implicit_root",
     "interest_xi_and_grad",
     "run_margin",
+    "scenario_xi_and_grad",
     "sensitivity_surface",
     "stress_search",
     "synth_withdrawals",

@@ -209,6 +209,41 @@ def flag_census(status, flags) -> dict:
     }
 
 
+def scenario_xi_and_grad(
+    spec,
+    params,
+    wrt=None,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> GradResult:
+    """ξ and dξ/dθ for a composed scenario (ISSUE 14) — the gradient-
+    coverage matrix entry point.
+
+    Coverage: baseline- and interest-reducible `ScenarioSpec`s keep full
+    IFT gradients (they route to `xi_and_grad` / `interest_xi_and_grad`,
+    so the forward value stays bit-identical to the composed solve, which
+    dispatches to the same legacy cells). Every other composition —
+    hetero/social learning stages, policy modifiers, multi-bank — raises
+    `NotImplementedError` LOUDLY rather than returning a gradient of a
+    different pipeline; see README "Composable scenarios" for the matrix
+    and the roadmap of what would extend it (hetero's coupled-K ODE runs
+    a while_loop with no adjoint; the social fixed point needs its own
+    outer IFT rule)."""
+    red = spec.grad_reduction()
+    if red == "baseline":
+        return xi_and_grad(params, wrt=wrt or WRT_DEFAULT, config=config, dtype=dtype)
+    if red == "interest":
+        return interest_xi_and_grad(
+            params, wrt=wrt or ("beta", "u", "kappa", "r"), config=config, dtype=dtype
+        )
+    raise NotImplementedError(
+        f"gradient coverage: spec (learning={spec.learning!r}, "
+        f"modifiers={spec.modifiers}, banks={spec.banks}) does not reduce to a "
+        "grad-covered stack — only baseline- and interest-reducible "
+        "compositions keep IFT gradients (README 'Composable scenarios')"
+    )
+
+
 def xi_and_grad(
     params: ModelParams,
     wrt=WRT_DEFAULT,

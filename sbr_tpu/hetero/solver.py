@@ -23,11 +23,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from sbr_tpu.baseline.solver import _root_tol
+from sbr_tpu.baseline.solver import _root_tol, classify_cell
 from sbr_tpu.core.integrate import cumtrapz
 from sbr_tpu.core.rootfind import bisect, chandrupatla, first_upcrossing, last_downcrossing
 from sbr_tpu.models.params import EconomicParams, SolverConfig
-from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero, Status
+from sbr_tpu.models.results import AWHetero, EquilibriumResultHetero, LearningSolutionHetero
 
 
 def hazard_rates_hetero(p, lam, lsh: LearningSolutionHetero, eta, config: SolverConfig):
@@ -206,6 +206,8 @@ def solve_equilibrium_hetero(
     config: SolverConfig | None = None,
     tspan_end=None,
     axis_name=None,
+    hazard_transform=None,
+    kappa_transform=None,
 ) -> EquilibriumResultHetero:
     """Full hetero equilibrium (`solve_equilibrium_hetero`,
     `heterogeneity_solver.jl:241-293`), branchless with status codes.
@@ -213,6 +215,15 @@ def solve_equilibrium_hetero(
     With ``axis_name`` (group axis sharded under shard_map), per-group
     stages stay local and only the weighted reductions cross shards; the
     returned scalars are replicated, per-group arrays sharded.
+
+    Stage-transformer hooks (ISSUE 14, same contract as
+    `baseline.solver.solve_equilibrium_core`): ``hazard_transform(tau_grid,
+    hrs, None) -> (hrs, _, extra_health)`` rewrites the (K, n) per-group
+    hazard rows between the hazard stage and the buffer crossings (the
+    hetero family has no continuous-hazard refinement, so the middle slot
+    is unused); ``kappa_transform(kappa)`` rewrites the threshold before
+    the weighted-AW bisection. Both default to None — the bit-identical
+    legacy path.
     """
     if config is None:
         config = SolverConfig()
@@ -234,6 +245,11 @@ def solve_equilibrium_hetero(
         tau_grid, hrs = hazard_rates_hetero(econ.p, econ.lam, lsh, econ.eta, config)
         sp.sync(hrs)
 
+    extra_health = ()
+    if hazard_transform is not None:
+        hrs, _, extra_health = hazard_transform(tau_grid, hrs, None)
+    kappa_eff = econ.kappa if kappa_transform is None else kappa_transform(econ.kappa)
+
     with obs.span("hetero.buffers") as sp:
         default = jnp.asarray(tspan_end, dtype=dtype)
         tau_in_uncs, h_in = jax.vmap(
@@ -251,7 +267,7 @@ def solve_equilibrium_hetero(
 
     with obs.span("hetero.xi") as sp:
         xi_c, err, root_ok, increasing, first_ok, xi_health = compute_xi_hetero(
-            tau_in_uncs, tau_out_uncs, lsh, econ.kappa, config,
+            tau_in_uncs, tau_out_uncs, lsh, kappa_eff, config,
             axis_name=axis_name, with_health=True,
         )
         sp.sync(xi_c)
@@ -271,24 +287,13 @@ def solve_equilibrium_hetero(
     if ode_flags is not None:
         cross_flags = cross_flags | ode_flags
     health = xi_health.replace(flags=xi_health.flags | cross_flags)
+    if extra_health:
+        health = health.merge(*extra_health)
 
-    valid = jnp.logical_and(root_ok, jnp.logical_and(increasing, first_ok))
-    run = jnp.logical_and(~no_crossing, valid)
-    status = jnp.where(
-        no_crossing,
-        Status.NO_CROSSING,
-        jnp.where(
-            ~root_ok,
-            Status.NO_ROOT,
-            jnp.where(jnp.logical_and(increasing, first_ok), Status.RUN, Status.FALSE_EQ),
-        ),
-    ).astype(jnp.int32)
-
-    xi = jnp.where(run, xi_c, nan)
-    converged = jnp.logical_or(no_crossing, run)
-    tolerance = jnp.where(
-        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
+    run, status, converged, tolerance = classify_cell(
+        no_crossing, root_ok, increasing, err, dtype, first_ok=first_ok
     )
+    xi = jnp.where(run, xi_c, nan)
 
     from sbr_tpu.baseline.solver import _stamp_solve_time
 

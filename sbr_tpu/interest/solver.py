@@ -17,6 +17,7 @@ from flax import struct
 
 from sbr_tpu.baseline.solver import (
     _hazard_parts,
+    classify_cell,
     compute_xi,
     get_aw,
     hazard_grid_is_uniform,
@@ -26,7 +27,7 @@ from sbr_tpu.baseline.solver import (
 from sbr_tpu.core.interp import interp_guided, interp_uniform
 from sbr_tpu.interest.value_function import solve_value_function
 from sbr_tpu.models.params import EconomicParamsInterest, SolverConfig
-from sbr_tpu.models.results import EquilibriumResult, LearningSolution, Status
+from sbr_tpu.models.results import EquilibriumResult, LearningSolution
 
 
 @struct.dataclass
@@ -46,6 +47,66 @@ class EquilibriumResultInterest:
             f"bankrun={_fmt(self.base.bankrun)}, status={_fmt(self.base.status)}, "
             f"V(0)={_fmt(self.v[..., 0])}, solve_time={_fmt(self.base.solve_time, 3)}s)"
         )
+
+
+def effective_hazard_stage(
+    tau_grid,
+    hr,
+    r,
+    delta,
+    u,
+    config: SolverConfig,
+    hazard_at=None,
+    uniform: bool = True,
+    index_fn=None,
+):
+    """The interest-rate stack's hazard transformer, factored out of
+    `solve_equilibrium_interest_core` (ISSUE 14) so the composable scenario
+    engine can splice the SAME stage into any composed pipeline:
+
+    HJB value function V on the hazard grid → effective hazard h − r·V,
+    plus — when the caller supplies a continuous ``hazard_at`` — the
+    matching continuous effective-hazard evaluator (V linearly
+    interpolated; it is known only on the grid). ``uniform``/``index_fn``
+    select the V interpolation exactly as the legacy core does (uniform
+    stride, warped-index guided, or searchsorted).
+
+    Returns ``(hr_eff, hazard_eff_at, v, v_health)`` where ``v_health``
+    carries the HJB ODE flags plus the V-finiteness probe (`NAN_OUTPUT` on
+    a blown-up V) — the same flag set the legacy core merged by hand.
+    """
+    from sbr_tpu.diag.health import NAN_OUTPUT, Health
+
+    dtype = hr.dtype
+    r = jnp.asarray(r, dtype=dtype)
+    v, ode_health = solve_value_function(
+        tau_grid, hr, delta, r, u, config, uniform=uniform, index_fn=index_fn,
+        with_health=True,
+    )
+    hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
+
+    hazard_eff_at = None
+    if hazard_at is not None:
+        t0 = tau_grid[0]
+        dt = tau_grid[1] - tau_grid[0]
+        if uniform:
+            v_at = lambda tau: interp_uniform(tau, t0, dt, v)
+        elif index_fn is not None:
+            v_at = lambda tau: interp_guided(tau, tau_grid, v, index_fn(tau))
+        else:
+            v_at = lambda tau: jnp.interp(tau, tau_grid, v)
+
+        def hazard_eff_at(tau):
+            return hazard_at(tau) - r * v_at(tau)
+
+    # Value-function finiteness probe: the HJB scan has no adaptive-solver
+    # divergence exit, so a blown-up V would silently poison the effective
+    # hazard — flag it. Flags only: the HJB's attempt counts must not
+    # perturb the root-find effective-iteration statistics (ISSUE 9).
+    v_flags = jnp.where(
+        jnp.any(~jnp.isfinite(v)), jnp.int32(NAN_OUTPUT), jnp.int32(0)
+    ) | ode_health.flags
+    return hr_eff, hazard_eff_at, v, Health.of_flags(v_flags, dtype)
 
 
 def solve_equilibrium_interest_core(
@@ -86,34 +147,25 @@ def solve_equilibrium_interest_core(
         index_fn = lambda t: warped_grid_index(
             t, eta_c, ls.beta, ls.x0, config.n_grid, config.grid_warp
         )
-    with obs.span("interest.value_function") as sp:
-        v, v_health = solve_value_function(
-            tau_grid, hr, delta, r, u, config, uniform=not warped, index_fn=index_fn,
-            with_health=True,
-        )
-        sp.sync(v)
-    hr_eff = hr - r * v  # `interest_rate_solver.jl:80-83`
 
-    # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`).
-    # With closed-form Stage 1, crossings refine against the exact hazard
-    # minus r·V̂ (V linearly interpolated — it is known only on the grid);
-    # at r = 0 this is bit-identical to the baseline's refined path, the
-    # reference's r=0 fallback oracle (`interest_rate_solver.jl:89-101`).
-    hazard_eff_at = None
+    # Continuous exact hazard for crossing refinement (closed-form Stage 1
+    # only): the effective-hazard stage subtracts r·V̂ from it, so at r = 0
+    # the refined path is bit-identical to the baseline's — the reference's
+    # r=0 fallback oracle (`interest_rate_solver.jl:89-101`).
+    hazard_at = None
     if ls.closed_form and config.refine_crossings:
         from sbr_tpu.baseline.solver import _make_hazard_at
 
         hazard_at = _make_hazard_at(p, lam, ls, tau_grid, integ, int_eta, config)
-        t0 = tau_grid[0]
-        dt = tau_grid[1] - tau_grid[0]
-        if warped:
-            v_at = lambda tau: interp_guided(tau, tau_grid, v, index_fn(tau))
-        else:
-            v_at = lambda tau: interp_uniform(tau, t0, dt, v)
 
-        def hazard_eff_at(tau):
-            return hazard_at(tau) - r * v_at(tau)
+    with obs.span("interest.value_function") as sp:
+        hr_eff, hazard_eff_at, v, v_health = effective_hazard_stage(
+            tau_grid, hr, r, delta, u, config, hazard_at=hazard_at,
+            uniform=not warped, index_fn=index_fn,
+        )
+        sp.sync(v)
 
+    # Buffer crossings against the EFFECTIVE hazard (`interest_rate_solver.jl:88`).
     with obs.span("interest.buffers") as sp:
         tau_in_unc, tau_out_unc, cross_health = optimal_buffer(
             u, tau_grid, hr_eff, tspan_end, hazard_at=hazard_eff_at, with_health=True,
@@ -130,39 +182,15 @@ def solve_equilibrium_interest_core(
         )
         sp.sync(xi_c)
 
-    # Value-function finiteness probe: the HJB scan has no adaptive-solver
-    # divergence exit, so a blown-up V would silently poison the effective
-    # hazard — flag it (the crossing health already catches the NaN case
-    # via hr_eff, this adds the Inf case and attributes it to V).
-    from sbr_tpu.diag.health import NAN_OUTPUT, Health
+    # v_health carries the HJB ODE flags (ISSUE 9) plus the V-finiteness
+    # probe (see `effective_hazard_stage`); merged last, preserving the
+    # legacy merge order byte-for-byte.
+    health = cross_health.merge(xi_health, v_health)
 
-    # ODE flags ride along (ISSUE 9): under adaptive numerics this is how
-    # ODE_BUDGET — an interval that exhausted its step cap and bridged with
-    # an error-unchecked step — reaches the per-cell health; the fixed
-    # path's v_health carries zero flags by contract, so fixed-mode health
-    # bytes are unchanged. Flags only: the HJB's attempt counts must not
-    # perturb the root-find effective-iteration statistics.
-    v_flags = jnp.where(
-        jnp.any(~jnp.isfinite(v)), jnp.int32(NAN_OUTPUT), jnp.int32(0)
-    ) | v_health.flags
-    health = cross_health.merge(xi_health, Health.of_flags(v_flags, dtype))
-
-    run = jnp.logical_and(~no_crossing, jnp.logical_and(root_ok, increasing))
-    status = jnp.where(
-        no_crossing,
-        Status.NO_CROSSING,
-        jnp.where(
-            ~root_ok,
-            Status.NO_ROOT,
-            jnp.where(increasing, Status.RUN, Status.FALSE_EQ),
-        ),
-    ).astype(jnp.int32)
-
-    xi = jnp.where(run, xi_c, nan)
-    converged = jnp.logical_or(no_crossing, run)
-    tolerance = jnp.where(
-        no_crossing, jnp.zeros((), dtype), jnp.where(run, err, jnp.asarray(jnp.inf, dtype))
+    run, status, converged, tolerance = classify_cell(
+        no_crossing, root_ok, increasing, err, dtype
     )
+    xi = jnp.where(run, xi_c, nan)
 
     aw_cum, aw_out, aw_in = get_aw(xi, tau_in_unc, tau_out_unc, tau_grid, ls)
     aw_cum = jnp.where(run, aw_cum, nan)

@@ -74,7 +74,24 @@ class LearningParams:
 
 @dataclasses.dataclass(frozen=True)
 class EconomicParams:
-    """Stage-2/3 economic fundamentals (`model.jl:61-85`)."""
+    """Stage-2/3 economic fundamentals (`model.jl:61-85`) plus the policy
+    knobs of the composable scenario engine (ISSUE 14) — inert unless a
+    `scenario.ScenarioSpec` activates the matching modifier:
+
+    - insurance_cap: insured deposit fraction c ∈ [0, 1); the
+      ``insurance_cap`` hazard modifier scales the run hazard by (1 − c)
+      (insured depositors abstain from the withdrawal race).
+    - suspension_t: convertibility-suspension time s ≥ 0; the
+      ``suspension`` modifier zeroes the hazard for τ̄ ≥ s (no benefit to
+      running once withdrawals are frozen).
+    - lolr_rate: lender-of-last-resort injection rate ρ ≥ 0; the ``lolr``
+      modifier raises the effective solvency threshold to κ·(1 + ρ)
+      (injected reserves let the bank survive a larger withdrawal share).
+
+    Validation defers on traced values exactly like every other field
+    (`_check` — the PR 12 traced-scalar contract), so policy parameters
+    can flow through `jax.grad`/vmap sweeps.
+    """
 
     u: float
     p: float
@@ -82,6 +99,9 @@ class EconomicParams:
     lam: float
     eta_bar: float
     eta: float
+    insurance_cap: float = 0.0
+    suspension_t: float = 0.0
+    lolr_rate: float = 0.0
 
     def __post_init__(self):
         _check(self.u >= 0, f"Utility flow u must be non-negative, got {self.u}")
@@ -90,6 +110,24 @@ class EconomicParams:
         _check(self.lam > 0, f"Exponential rate lam must be positive, got {self.lam}")
         _check(self.eta_bar > 0, f"Raw awareness window eta_bar must be positive, got {self.eta_bar}")
         _check(self.eta > 0, f"Normalized awareness window eta must be positive, got {self.eta}")
+        # NOT a chained comparison: `0 <= x < 1` evaluates `and` on the
+        # first traced operand BEFORE _check could defer it.
+        _check(
+            self.insurance_cap >= 0,
+            f"Insured fraction insurance_cap must be in [0,1), got {self.insurance_cap}",
+        )
+        _check(
+            self.insurance_cap < 1,
+            f"Insured fraction insurance_cap must be in [0,1), got {self.insurance_cap}",
+        )
+        _check(
+            self.suspension_t >= 0,
+            f"Suspension time suspension_t must be non-negative, got {self.suspension_t}",
+        )
+        _check(
+            self.lolr_rate >= 0,
+            f"LOLR injection rate lolr_rate must be non-negative, got {self.lolr_rate}",
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,15 +146,24 @@ def make_model_params(
     lam: float = 0.01,
     tspan: Optional[Tuple[float, float]] = None,
     x0: float = 0.0001,
+    insurance_cap: float = 0.0,
+    suspension_t: float = 0.0,
+    lolr_rate: float = 0.0,
 ) -> ModelParams:
-    """Keyword constructor with the reference defaults (`model.jl:150-176`)."""
+    """Keyword constructor with the reference defaults (`model.jl:150-176`).
+    The policy knobs (ISSUE 14) default to the inert values — a params
+    struct without them is indistinguishable from the pre-scenario form."""
     if eta is None:
         eta = eta_bar / beta
     if tspan is None:
         tspan = (0.0, 2.0 * eta)
     return ModelParams(
         learning=LearningParams(beta=beta, tspan=tspan, x0=x0),
-        economic=EconomicParams(u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta),
+        economic=EconomicParams(
+            u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta,
+            insurance_cap=insurance_cap, suspension_t=suspension_t,
+            lolr_rate=lolr_rate,
+        ),
     )
 
 
@@ -136,6 +183,9 @@ def with_overrides(base: ModelParams, **kwargs) -> ModelParams:
         lam=base.economic.lam,
         tspan=base.learning.tspan,
         x0=base.learning.x0,
+        insurance_cap=base.economic.insurance_cap,
+        suspension_t=base.economic.suspension_t,
+        lolr_rate=base.economic.lolr_rate,
     )
     unknown = set(kwargs) - set(current)
     _check(not unknown, f"Unknown parameter overrides: {sorted(unknown)}")
@@ -145,9 +195,11 @@ def with_overrides(base: ModelParams, **kwargs) -> ModelParams:
 
 # The scalar leaves of a baseline ModelParams, in `solve_param_cell`
 # column order first (beta, u, p, kappa, lam, eta, t0, t1, x0) plus
-# eta_bar — the full information content of the struct.
+# eta_bar and the policy knobs (ISSUE 14) — the full information content
+# of the struct.
 PARAMS_LEAF_NAMES = (
     "beta", "u", "p", "kappa", "lam", "eta", "t0", "t1", "x0", "eta_bar",
+    "insurance_cap", "suspension_t", "lolr_rate",
 )
 
 
@@ -168,6 +220,9 @@ def params_to_pytree(params: ModelParams) -> dict:
         "t1": params.learning.tspan[1],
         "x0": params.learning.x0,
         "eta_bar": params.economic.eta_bar,
+        "insurance_cap": params.economic.insurance_cap,
+        "suspension_t": params.economic.suspension_t,
+        "lolr_rate": params.economic.lolr_rate,
     }
 
 
@@ -188,6 +243,9 @@ def pytree_to_params(tree: dict) -> ModelParams:
         economic=EconomicParams(
             u=tree["u"], p=tree["p"], kappa=tree["kappa"], lam=tree["lam"],
             eta_bar=tree["eta_bar"], eta=tree["eta"],
+            insurance_cap=tree["insurance_cap"],
+            suspension_t=tree["suspension_t"],
+            lolr_rate=tree["lolr_rate"],
         ),
     )
 
@@ -301,6 +359,9 @@ def make_interest_params(
     delta: float = 0.1,
     tspan: Optional[Tuple[float, float]] = None,
     x0: float = 0.0001,
+    insurance_cap: float = 0.0,
+    suspension_t: float = 0.0,
+    lolr_rate: float = 0.0,
 ) -> ModelParamsInterest:
     """Keyword constructor (`interest_rate_model.jl:120-150`)."""
     if eta is None:
@@ -310,7 +371,9 @@ def make_interest_params(
     return ModelParamsInterest(
         learning=LearningParams(beta=beta, tspan=tspan, x0=x0),
         economic=EconomicParamsInterest(
-            u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta, r=r, delta=delta
+            u=u, p=p, kappa=kappa, lam=lam, eta_bar=eta_bar, eta=eta, r=r, delta=delta,
+            insurance_cap=insurance_cap, suspension_t=suspension_t,
+            lolr_rate=lolr_rate,
         ),
     )
 

@@ -71,10 +71,17 @@ from typing import Optional
 # program; higher-better by the per_sec rule) and calib_steps_per_sec
 # (calibration Adam steps per second over the jitted IFT loss;
 # higher-better likewise).
+# 9 adds the composable-scenario workload (ISSUE 14, bench.py
+# bench_scenario): scenario_overhead_ratio (composed-baseline grid steady
+# time over the legacy grid program's on the same shape — lower-better by
+# the overhead rule; ~1.0 means the composition layer is free) and
+# scenario_multibank_cells_per_sec (bank-cells per second through the
+# contagion loop, dispatches × banks / wall — higher-better by the
+# per_sec rule).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1/2/3/4/5/6/7 history keeps gating new schema-8 appends.
-SCHEMA = 8
+# schema-1/2/3/4/5/6/7/8 history keeps gating new schema-9 appends.
+SCHEMA = 9
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -195,6 +202,12 @@ def bench_metrics(result: dict) -> dict:
         # calibration step rate (both higher-better by the per_sec rule)
         "grads_per_sec",
         "calib_steps_per_sec",
+        # schema 9: the composable-scenario workload (bench.py
+        # bench_scenario): composed-over-legacy grid overhead ratio
+        # (lower-better by the overhead rule) and multi-bank contagion
+        # throughput (higher-better by the per_sec rule)
+        "scenario_overhead_ratio",
+        "scenario_multibank_cells_per_sec",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
@@ -229,7 +242,7 @@ def bench_metrics(result: dict) -> dict:
 def polarity(metric: str) -> int:
     """+1 when higher is better (throughput, cache hit rates, speedups), -1
     when lower is better (durations, latencies, byte counts, divergence,
-    effective-iteration, failover/shed counts)."""
+    effective-iteration, failover/shed counts, overhead ratios)."""
     m = metric.lower()
     if (
         m.endswith("_per_sec")
@@ -250,6 +263,9 @@ def polarity(metric: str) -> int:
         or "retrace" in m
         or "shed" in m
         or "failover" in m
+        # schema 9: a composed pipeline's cost over its legacy control —
+        # growing overhead is a regression even though it's a ratio
+        or "overhead" in m
     ):
         return -1
     return 1

@@ -432,6 +432,38 @@ def render_health(run: dict) -> tuple:
         _table(["stage", "cells", "divergent", "max resid", "eff iters μ/max", "flags"], rows)
     )
 
+    # Per-scenario census (ISSUE 14): health events tagged by the composed
+    # scenario engine carry ``scenario`` (and ``bank`` for multi-bank
+    # contagion). The stage fold above already keeps them separate — the
+    # tags suffix the stage key — but this roll-up answers the operator
+    # question directly: which SCENARIO is divergent, across however many
+    # banks/stages it spanned, instead of one census mixing all banks.
+    scen_agg: dict = {}
+    for ev in events:
+        if ev.get("kind") != "health" or "scenario" not in ev:
+            continue
+        agg = scen_agg.setdefault(
+            str(ev["scenario"]),
+            {"events": 0, "cells": 0, "divergent": 0, "banks": set()},
+        )
+        agg["events"] += 1
+        agg["cells"] += int(ev.get("cells", 0))
+        agg["divergent"] += int(ev.get("divergent", 0))
+        if "bank" in ev:
+            agg["banks"].add(int(ev["bank"]))
+    if scen_agg:
+        out += ["", "SCENARIOS"]
+        out.append(
+            _table(
+                ["scenario", "events", "cells", "divergent", "banks"],
+                [
+                    [name, v["events"], v["cells"], v["divergent"],
+                     len(v["banks"]) or "-"]
+                    for name, v in sorted(scen_agg.items())
+                ],
+            )
+        )
+
     # NaN census: the poison-tracking subset of the flag counts.
     nan_rows = []
     for name, v in sorted(stages.items()):

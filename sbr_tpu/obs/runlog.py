@@ -855,20 +855,33 @@ def log_status(stage: str, status) -> None:
     run.event("status", stage=stage, total=int(arr.size), counts=status_counts(arr))
 
 
-def log_health(stage: str, health, status=None) -> None:
+def log_health(stage: str, health, status=None, scenario=None, bank=None) -> None:
     """Numerical-health census event (`sbr_tpu.diag`) for a finished
     sweep/solve: reduces the (possibly per-cell) Health pytree to flag
     counts, divergent-cell count, worst cells, and a residual histogram,
     and folds a roll-up into the run manifest. Forces a device→host fetch
     of the health leaves — only when telemetry is on; a no-op while
     tracing and when ``health`` is None (results assembled outside the
-    solvers, e.g. tile checkpoints)."""
+    solvers, e.g. tile checkpoints).
+
+    ``scenario`` / ``bank`` (ISSUE 14): composed-scenario provenance tags.
+    They ride the event as explicit fields AND suffix the fold key, so
+    `report health` groups per scenario (and per bank in a multi-bank
+    contagion run) instead of mixing banks into one census."""
     run = current_run()
     if run is None or health is None or not _trace_clean():
         return
     from sbr_tpu.diag.health import summarize
 
-    run.log_health(stage, summarize(health, status))
+    summary = summarize(health, status)
+    key = stage
+    if scenario is not None:
+        summary["scenario"] = str(scenario)
+        key = f"{key}[{scenario}]"
+    if bank is not None:
+        summary["bank"] = int(bank)
+        key = f"{key}.bank{int(bank)}"
+    run.log_health(key, summary)
 
 
 def log_fault(point: str = "?", kind: str = "?", **fields) -> None:

@@ -1,0 +1,42 @@
+"""Composable scenario engine (ISSUE 14): `ScenarioSpec` describes a
+staged solve pipeline — learning-stage transformer × ordered hazard/buffer
+modifiers × N-bank contagion coupling — and `solve(spec, params)` runs it
+through the stage algebra the four legacy stacks now share. Reducible
+specs are bit-identical to the direct stack calls; genuine compositions
+(hetero × interest × social, policy-modifier sweeps, interbank contagion)
+are cheap data, not forked solver stacks. See README "Composable
+scenarios" for the composition matrix and policy-knob table.
+"""
+
+from sbr_tpu.scenario.engine import (
+    SCENARIO_KEYS,
+    ScenarioResult,
+    scenario_grid,
+    scenario_theta,
+    solve,
+    solve_scenario_cell,
+)
+from sbr_tpu.scenario.multibank import MultiBankResult, solve_multibank
+from sbr_tpu.scenario.spec import (
+    HAZARD_MODIFIERS,
+    LEARNING_STAGES,
+    SCENARIO_PROGRAM_VERSION,
+    ScenarioSpec,
+    spec_fingerprint,
+)
+
+__all__ = [
+    "HAZARD_MODIFIERS",
+    "LEARNING_STAGES",
+    "SCENARIO_KEYS",
+    "SCENARIO_PROGRAM_VERSION",
+    "MultiBankResult",
+    "ScenarioResult",
+    "ScenarioSpec",
+    "scenario_grid",
+    "scenario_theta",
+    "solve",
+    "solve_multibank",
+    "solve_scenario_cell",
+    "spec_fingerprint",
+]

@@ -1,0 +1,664 @@
+"""Composed-scenario solve engine (ISSUE 14 tentpole).
+
+`solve(spec, params)` runs the staged pipeline a `ScenarioSpec` describes:
+
+- **Reducible specs** dispatch to the legacy stacks — the same structural
+  trick as `solve_param_cell`: one shared cell, so a baseline / hetero /
+  interest / social spec is bit-identical (ξ, status, Health) to the
+  direct stack call by construction (pinned by tests/test_scenario.py and
+  the CI ``scenario-parity`` step).
+- **Genuine compositions** run through the stage-transformer hooks the
+  four stacks now expose (`baseline.solver.solve_equilibrium_core` /
+  `hetero.solver.solve_equilibrium_hetero` ``hazard_transform`` /
+  ``kappa_transform``): policy modifiers and the interest HJB stage
+  (`interest.solver.effective_hazard_stage`) splice into ANY pipeline —
+  hetero × interest × social simultaneously is one composed program, not
+  a fifth forked stack.
+- **Multi-bank specs** (banks >= 2) route to `scenario.multibank`: the
+  single-bank composed cell vmapped over the bank axis, iterated through
+  cross-bank κ-erosion spillovers on the interbank exposure network.
+
+The vmap/jit unit is `solve_scenario_cell` (SCENARIO_KEYS columns) — the
+scenario analogue of `sweeps.baseline_sweeps.solve_param_cell`, shared by
+`scenario_grid` (policy sweeps — plain grid sweeps over the composed
+pipeline, so they inherit PR 7 elasticity tiling untouched), the
+multi-bank batch program, and the serve engine's scenario route.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sbr_tpu.baseline.learning import solve_learning
+from sbr_tpu.baseline.solver import (
+    get_aw,
+    hazard_grid_is_uniform,
+    solve_equilibrium_baseline,
+    solve_equilibrium_core,
+    warped_grid_index,
+)
+from sbr_tpu.grad.cell import BASE_KEYS
+from sbr_tpu.hetero.solver import _cdf_rows_at, solve_equilibrium_hetero
+from sbr_tpu.interest.solver import effective_hazard_stage
+from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.models.results import LearningSolutionHetero
+from sbr_tpu.obs import prof
+from sbr_tpu.scenario.spec import ScenarioSpec, spec_fingerprint
+from sbr_tpu.social.dynamics import solve_forced_learning
+from sbr_tpu.sweeps.baseline_sweeps import _TracedLearning, solve_param_cell
+
+# θ column order of one composed cell: the `solve_param_cell` columns,
+# then interest's rate/maturity, then the policy knobs.
+SCENARIO_KEYS = BASE_KEYS + ("r", "delta", "insurance_cap", "suspension_t", "lolr_rate")
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    """One solved scenario: the headline scalars plus the underlying stack
+    result in ``detail`` (an `EquilibriumResult`, `EquilibriumResultHetero`,
+    `EquilibriumResultInterest`, `SocialFixedPointResult`, a composed-social
+    dict, or a `multibank.MultiBankResult`)."""
+
+    spec: ScenarioSpec
+    fingerprint: str
+    xi: object
+    status: object
+    bankrun: object
+    health: object
+    detail: object
+
+    def __repr__(self) -> str:
+        from sbr_tpu.models.results import _fmt
+
+        return (
+            f"ScenarioResult(spec={self.spec.learning}+{list(self.spec.modifiers)}"
+            f"x{self.spec.banks}, ξ={_fmt(self.xi)}, status={_fmt(self.status)}, "
+            f"fp={self.fingerprint[:12]})"
+        )
+
+
+def scenario_theta(params, dtype) -> dict:
+    """The SCENARIO_KEYS scalar dict of one params struct (r/δ default to
+    the inert 0 / 0.1 when the economics is not interest-typed)."""
+    econ = params.economic
+    lrn = params.learning
+    vals = {
+        "beta": lrn.beta,
+        "u": econ.u,
+        "p": econ.p,
+        "kappa": econ.kappa,
+        "lam": econ.lam,
+        "eta": econ.eta,
+        "t0": lrn.tspan[0],
+        "t1": lrn.tspan[1],
+        "x0": lrn.x0,
+        "r": getattr(econ, "r", 0.0),
+        "delta": getattr(econ, "delta", 0.1),
+        "insurance_cap": econ.insurance_cap,
+        "suspension_t": econ.suspension_t,
+        "lolr_rate": econ.lolr_rate,
+    }
+    return {k: jnp.asarray(v, dtype) for k, v in vals.items()}
+
+
+def _validate_params(spec: ScenarioSpec, params) -> None:
+    """Loud spec × params compatibility checks (the composition matrix)."""
+    if "interest" in spec.modifiers and not hasattr(params.economic, "r"):
+        raise ValueError(
+            "spec activates the 'interest' modifier but params carries no "
+            "r/delta — build it with make_interest_params(...)"
+        )
+    if spec.learning == "hetero" and not hasattr(params.learning, "betas"):
+        raise ValueError(
+            "spec.learning='hetero' requires ModelParamsHetero (betas/dist "
+            "group structure) — build it with make_hetero_params(...)"
+        )
+    if spec.learning == "baseline" and not hasattr(params.learning, "beta"):
+        raise ValueError(
+            "spec.learning='baseline' requires scalar-beta params "
+            "(make_model_params / make_interest_params)"
+        )
+    if spec.learning == "social" and not (
+        hasattr(params.learning, "beta") or hasattr(params.learning, "betas")
+    ):
+        raise ValueError(
+            "spec.learning='social' requires scalar-beta params, or "
+            "ModelParamsHetero for the social × hetero composition"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Stage-transformer builders (the pluggable slots)
+# ---------------------------------------------------------------------------
+
+
+def _make_hazard_transform(spec: ScenarioSpec, theta: dict, config: SolverConfig, ls):
+    """The baseline-family hazard transformer for ``spec.modifiers`` —
+    rewrites (hr, hazard_at) in spec order; None when no hazard modifier is
+    active (the hook-free legacy path)."""
+    mods = tuple(m for m in spec.modifiers if m != "lolr")
+    if not mods:
+        return None
+    warped = not hazard_grid_is_uniform(ls, config)
+
+    def transform(tau_grid, hr, hazard_at):
+        dtype = hr.dtype
+        extra = []
+        for mod in mods:
+            if mod == "interest":
+                index_fn = None
+                if warped and ls.closed_form:
+                    index_fn = lambda t: warped_grid_index(
+                        t, theta["eta"], ls.beta, ls.x0, config.n_grid, config.grid_warp
+                    )
+                hr, hazard_at, _v, v_health = effective_hazard_stage(
+                    tau_grid, hr, theta["r"], theta["delta"], theta["u"], config,
+                    hazard_at=hazard_at, uniform=not warped, index_fn=index_fn,
+                )
+                extra.append(v_health)
+            elif mod == "insurance_cap":
+                scale = 1.0 - theta["insurance_cap"]
+                hr = scale * hr
+                if hazard_at is not None:
+                    hazard_at = (
+                        lambda prev, s: (lambda t: s * prev(t))
+                    )(hazard_at, scale)
+            elif mod == "suspension":
+                s = theta["suspension_t"]
+                zero = jnp.zeros((), dtype)
+                hr = jnp.where(tau_grid < s, hr, zero)
+                if hazard_at is not None:
+                    hazard_at = (
+                        lambda prev, s_, z: (lambda t: jnp.where(t < s_, prev(t), z))
+                    )(hazard_at, s, zero)
+        return hr, hazard_at, tuple(extra)
+
+    return transform
+
+
+def _make_hazard_transform_hetero(spec: ScenarioSpec, theta: dict, config: SolverConfig):
+    """The K-group variant: modifiers rewrite the (K, n) hazard rows; the
+    interest stage solves one HJB per row (vmapped), and its per-group
+    health flags OR-reduce into one scalar Health so the hetero stack's
+    scalar-health contract is preserved."""
+    mods = tuple(m for m in spec.modifiers if m != "lolr")
+    if not mods:
+        return None
+    uniform = not (config.grid_warp > 0.0)  # mirrors hazard_rates_hetero
+
+    def transform(tau_grid, hrs, _):
+        from sbr_tpu.diag.health import Health, or_reduce_flags
+
+        dtype = hrs.dtype
+        extra = []
+        for mod in mods:
+            if mod == "interest":
+                hrs, _none, _v, v_health = jax.vmap(
+                    lambda row: effective_hazard_stage(
+                        tau_grid, row, theta["r"], theta["delta"], theta["u"],
+                        config, hazard_at=None, uniform=uniform,
+                    )
+                )(hrs)
+                extra.append(
+                    Health.of_flags(or_reduce_flags(v_health.flags), dtype)
+                )
+            elif mod == "insurance_cap":
+                hrs = (1.0 - theta["insurance_cap"]) * hrs
+            elif mod == "suspension":
+                hrs = jnp.where(tau_grid < theta["suspension_t"], hrs, jnp.zeros((), dtype))
+        return hrs, None, tuple(extra)
+
+    return transform
+
+
+def _make_kappa_transform(spec: ScenarioSpec, theta: dict):
+    """κ_eff = κ·(1 + lolr_rate) when the LOLR modifier is active."""
+    if "lolr" not in spec.modifiers:
+        return None
+    return lambda kappa: jnp.asarray(kappa) * (1.0 + theta["lolr_rate"])
+
+
+# ---------------------------------------------------------------------------
+# The composed cell — the vmap/jit unit (scenario analogue of
+# solve_param_cell; baseline-family learning only)
+# ---------------------------------------------------------------------------
+
+
+def solve_scenario_cell(spec: ScenarioSpec, *cols, config: SolverConfig, dtype):
+    """One composed cell from 14 traced scalars (SCENARIO_KEYS order) →
+    (xi, tau_bar_in_unc, aw_max, status, health) — the lean per-cell
+    outputs every batch program shares.
+
+    Reducible specs route through the EXACT legacy cells (`solve_param_cell`
+    / `solve_equilibrium_interest_core`), so a composed grid whose spec
+    reduces is bit-identical to the legacy grid program cell for cell.
+    """
+    theta = dict(zip(SCENARIO_KEYS, cols))
+    red = spec.reduces_to()
+    if red == "baseline":
+        return solve_param_cell(*cols[:9], config, dtype)
+    ls = solve_learning(
+        _TracedLearning(beta=theta["beta"], tspan=(theta["t0"], theta["t1"]), x0=theta["x0"]),
+        config, dtype=dtype,
+    )
+    if red == "interest":
+        from sbr_tpu.interest.solver import solve_equilibrium_interest_core
+
+        res = solve_equilibrium_interest_core(
+            ls, theta["u"], theta["p"], theta["kappa"], theta["lam"],
+            theta["eta"], theta["r"], theta["delta"], theta["t1"], config,
+        ).base
+        return res.xi, res.tau_bar_in_unc, res.aw_max, res.status, res.health
+    res = solve_equilibrium_core(
+        ls, theta["u"], theta["p"], theta["kappa"], theta["lam"], theta["eta"],
+        theta["t1"], config,
+        hazard_transform=_make_hazard_transform(spec, theta, config, ls),
+        kappa_transform=_make_kappa_transform(spec, theta),
+    )
+    return res.xi, res.tau_bar_in_unc, res.aw_max, res.status, res.health
+
+
+def batch_fn(spec: ScenarioSpec, config: SolverConfig, dtype_name: str):
+    """Jitted 1-D batch program over the 14 SCENARIO_KEYS columns — the
+    multi-bank vmap unit and the serve engine's scenario dispatch. Cached
+    per (cell-program spec, config, dtype): the key is the spec PROJECTED
+    onto what the compiled program depends on (`cell_program_spec` —
+    learning + modifiers), so specs differing only in host-side knobs
+    (lgd, contagion_tol, ...) share one executable instead of compiling an
+    identical program per wire-supplied float value."""
+    return _batch_fn_cached(spec.cell_program_spec(), config, dtype_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _batch_fn_cached(spec: ScenarioSpec, config: SolverConfig, dtype_name: str):
+    dtype = jnp.dtype(dtype_name)
+
+    def fn(*cols):
+        prof.note_trace("scenario.batch")
+
+        def cell(*c):
+            return solve_scenario_cell(spec, *c, config=config, dtype=dtype)
+
+        return jax.vmap(cell)(*cols)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _grid_fn_cached(spec: ScenarioSpec, config: SolverConfig, dtype_name: str):
+    """Jitted β×u grid program over the composed cell — the scenario
+    analogue of `baseline_sweeps._grid_fn` (12 broadcast scalars). Keyed
+    on the cell-program projection (see `batch_fn`); callers normalize."""
+    dtype = jnp.dtype(dtype_name)
+
+    def cell(beta, u, *rest):
+        prof.note_trace("scenario.grid")
+        return solve_scenario_cell(spec, beta, u, *rest, config=config, dtype=dtype)
+
+    bcast = (None,) * 12
+    return jax.jit(
+        jax.vmap(jax.vmap(cell, in_axes=(None, 0) + bcast), in_axes=(0, None) + bcast)
+    )
+
+
+def scenario_grid(
+    spec: ScenarioSpec,
+    beta_values,
+    u_values,
+    base: ModelParams,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+):
+    """β×u grid sweep through the composed pipeline — policy sweeps are
+    JUST grid sweeps over a composed cell (the PR 7 remainder): same
+    copy-constructor η/tspan pinning as `sweeps.beta_u_grid`, same
+    `GridSweepResult` shape, same sweep-default config (refinement OFF).
+    With a baseline-reducible spec the cell IS `solve_param_cell`, so the
+    status grid matches `beta_u_grid` exactly (the CI ``scenario-parity``
+    gate)."""
+    from sbr_tpu import obs
+    from sbr_tpu.sweeps.baseline_sweeps import GridSweepResult
+
+    if spec.banks != 1:
+        raise ValueError("scenario_grid sweeps single-bank specs; use multibank.solve for banks > 1")
+    if spec.learning != "baseline":
+        raise ValueError(
+            f"scenario_grid requires learning='baseline' cells, got {spec.learning!r}"
+        )
+    if config is None:
+        config = SolverConfig(refine_crossings=False)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+    _validate_params(spec, base)
+
+    beta_values = jnp.asarray(beta_values, dtype=dtype)
+    u_values = jnp.asarray(u_values, dtype=dtype)
+    theta = scenario_theta(base, dtype)
+    scalars = tuple(theta[k] for k in SCENARIO_KEYS[2:])  # broadcast: all but beta, u
+    fp = spec_fingerprint(spec, None, config, dtype.name)
+    fn = _grid_fn_cached(spec.cell_program_spec(), config, dtype.name)
+    n_b, n_u = int(beta_values.shape[0]), int(u_values.shape[0])
+    with obs.span(
+        "scenario.grid", scenario=fp[:12], n_beta=n_b, n_u=n_u, dtype=dtype.name
+    ) as sp:
+        xi, tau_in, aw_max, status, health = obs.jit_call(
+            "scenario.grid", fn, beta_values, u_values, *scalars
+        )
+        sp.sync(status)
+    obs.log_status("scenario.grid", status)
+    obs.log_health("scenario.grid", health, status, scenario=fp[:12])
+    return GridSweepResult(
+        beta_values=beta_values, u_values=u_values, max_aw=aw_max, xi=xi,
+        status=status, health=health,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Composed social fixed point (social × {hetero, interest, policy})
+# ---------------------------------------------------------------------------
+
+
+class _ThetaEcon:
+    """Duck-typed economics over a traced θ dict (scenario-internal)."""
+
+    def __init__(self, theta: dict):
+        self.u = theta["u"]
+        self.p = theta["p"]
+        self.kappa = theta["kappa"]
+        self.lam = theta["lam"]
+        self.eta = theta["eta"]
+
+
+def _aw_curve_hetero(xi, tau_ins, tau_outs, grid, lsh: LearningSolutionHetero):
+    """Dist-weighted aggregate AW(t) on ``grid`` — the hetero analogue of
+    `baseline.solver.get_aw`'s cumulative curve (each branch zeroed before
+    its own start, plus the aggregate G(0) offset)."""
+    tau_in_con = jnp.minimum(tau_ins, xi)
+    tau_out_con = jnp.minimum(tau_outs, xi)
+
+    def branch(tau_con):
+        shift = grid[None, :] - xi + tau_con[:, None]
+        vals = _cdf_rows_at(lsh, jnp.maximum(shift, 0.0))
+        return jnp.where(shift >= 0, vals, 0.0)
+
+    aw = jnp.einsum("k,kn->n", lsh.dist, branch(tau_out_con) - branch(tau_in_con))
+    return aw + jnp.dot(lsh.dist, lsh.cdfs[:, 0])
+
+
+@functools.lru_cache(maxsize=None)
+def _composed_social_fn(spec: ScenarioSpec, config: SolverConfig, dtype_name: str,
+                        hetero: bool):
+    """Jitted composed social fixed point: the legacy damped iteration
+    (`social.solver`) with the INNER equilibrium generalized to any
+    composed baseline-family or hetero pipeline. Plain damping only — the
+    Anderson acceleration stays a legacy-stack specialization; the inner
+    solves still honor ``config.numerics``. Callers key on
+    `social_program_spec` (social knobs are trace-time constants here;
+    contagion/multibank fields are not)."""
+    dtype = jnp.dtype(dtype_name)
+    tol = spec.social_tol
+    max_iter = spec.social_max_iter
+    alpha = spec.social_damping
+
+    @jax.jit
+    def run(theta, betas, dist, grid):
+        prof.note_trace("scenario.social_fixed_point")
+        tol_ = jnp.asarray(tol, dtype)
+        a = jnp.asarray(alpha, dtype)
+        eta = theta["eta"]
+        x0 = theta["x0"]
+        kt = _make_kappa_transform(spec, theta)
+
+        def inner(aw):
+            if hetero:
+                from sbr_tpu.core.integrate import cumtrapz
+
+                big_a = cumtrapz(aw, dx=grid[1] - grid[0])
+                cdfs = 1.0 - (1.0 - x0) * jnp.exp(-betas[:, None] * big_a[None, :])
+                pdfs = (1.0 - cdfs) * betas[:, None] * aw[None, :]
+                lsh = LearningSolutionHetero(
+                    grid=grid, cdfs=cdfs, pdfs=pdfs, t0=grid[0],
+                    dt=grid[1] - grid[0], betas=betas, dist=dist,
+                )
+                res = solve_equilibrium_hetero(
+                    lsh, _ThetaEcon(theta), config, tspan_end=eta,
+                    hazard_transform=_make_hazard_transform_hetero(spec, theta, config),
+                    kappa_transform=kt,
+                )
+                return res, lsh
+            ls = solve_forced_learning(theta["beta"], aw, grid, x0)
+            res = solve_equilibrium_core(
+                ls, theta["u"], theta["p"], theta["kappa"], theta["lam"], eta,
+                eta, config,
+                hazard_transform=_make_hazard_transform(spec, theta, config, ls),
+                kappa_transform=kt,
+            )
+            return res, ls
+
+        def step(aw, xi_prev):
+            res, lsol = inner(aw)
+            xi_new = jnp.where(res.bankrun, res.xi, xi_prev + eta / 500.0)
+            exceeded = jnp.logical_and(~res.bankrun, xi_new > eta)
+            if hetero:
+                aw_new = _aw_curve_hetero(
+                    xi_new, res.tau_bar_in_uncs, res.tau_bar_out_uncs, grid, lsol
+                )
+            else:
+                aw_new, _, _ = get_aw(
+                    xi_new, res.tau_bar_in_unc, res.tau_bar_out_unc, grid, lsol
+                )
+            return res, xi_new, exceeded, aw_new
+
+        def cond(s):
+            return (s["it"] < max_iter) & (~s["conv"]) & (~s["abort"])
+
+        def body(s):
+            res, xi_new, exceeded, aw_new = step(s["aw"], s["xi"])
+            err = jnp.max(jnp.abs(aw_new - s["aw"]))
+            conv = jnp.logical_and(err < tol_, ~exceeded)
+            aw_next = jnp.where(conv, aw_new, (1.0 - a) * s["aw"] + a * aw_new)
+            aw_next = jnp.where(exceeded, s["aw"], aw_next)
+            return dict(
+                aw=aw_next, xi=xi_new, it=s["it"] + 1, conv=conv,
+                abort=exceeded, err=err, res=res,
+            )
+
+        from sbr_tpu.baseline.learning import logistic_cdf
+
+        aw0 = logistic_cdf(
+            grid,
+            (jnp.dot(dist, betas) if hetero else theta["beta"]),
+            x0,
+        )
+        res_shape = jax.eval_shape(lambda a: step(a, jnp.zeros((), dtype))[0], aw0)
+        res0 = jax.tree_util.tree_map(
+            lambda sh: jnp.zeros(sh.shape, sh.dtype), res_shape
+        )
+        init = dict(
+            aw=aw0,
+            xi=jnp.zeros((), dtype),
+            it=jnp.zeros((), jnp.int32),
+            conv=jnp.zeros((), bool),
+            abort=jnp.zeros((), bool),
+            err=jnp.asarray(jnp.inf, dtype),
+            res=res0,
+        )
+        final = jax.lax.while_loop(cond, body, init)
+
+        from sbr_tpu.diag.health import FP_ABORTED, FP_NOT_CONVERGED, NAN_OUTPUT, Health
+
+        not_conv = (~final["conv"]) & (~final["abort"])
+        fp_flags = (
+            jnp.where(not_conv, jnp.int32(FP_NOT_CONVERGED), jnp.int32(0))
+            | jnp.where(final["abort"], jnp.int32(FP_ABORTED), jnp.int32(0))
+            | jnp.where(
+                jnp.any(~jnp.isfinite(final["aw"])), jnp.int32(NAN_OUTPUT), jnp.int32(0)
+            )
+        )
+        nan = jnp.asarray(jnp.nan, dtype)
+        fp_health = Health(
+            residual=final["err"], bracket_width=nan,
+            iterations=final["it"], flags=fp_flags,
+        )
+        return dict(
+            equilibrium=final["res"],
+            aw=final["aw"],
+            xi=final["xi"],
+            iterations=final["it"],
+            converged=final["conv"],
+            aborted=final["abort"],
+            error=final["err"],
+            health=final["res"].health.merge(fp_health),
+        )
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+
+def solve(
+    spec: ScenarioSpec,
+    params,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> ScenarioResult:
+    """Solve one composed scenario (see module docstring for dispatch).
+
+    ``params`` is a `ModelParams` (or Hetero/Interest variant matching the
+    spec; for multi-bank, optionally a list of one params per bank). The
+    result's ``fingerprint`` keys every cache a composed scenario touches.
+    """
+    from sbr_tpu import obs
+
+    if spec.banks > 1:
+        # Dispatch BEFORE defaulting config: multibank's own default is the
+        # sweep-style SolverConfig(refine_crossings=False) (it dispatches
+        # vmapped cells), and solve()/solve_multibank must agree on the
+        # numerics — and therefore on the fingerprint — for the same call.
+        from sbr_tpu.scenario.multibank import solve_multibank
+
+        return solve_multibank(spec, params, config=config, dtype=dtype)
+
+    if config is None:
+        config = SolverConfig()
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+
+    _validate_params(spec, params)
+    fp = spec_fingerprint(spec, params, config, dtype.name)
+    red = spec.reduces_to()
+
+    with obs.span(
+        "scenario.solve", scenario=fp[:12], learning=spec.learning,
+        modifiers=",".join(spec.modifiers) or "-", banks=spec.banks,
+        reduction=red or "composed",
+    ) as sp:
+        if red == "baseline":
+            ls = solve_learning(params.learning, config, dtype=dtype)
+            res = solve_equilibrium_baseline(ls, params.economic, config)
+            out = ScenarioResult(spec, fp, res.xi, res.status, res.bankrun, res.health, res)
+        elif red == "interest":
+            from sbr_tpu.interest.solver import solve_equilibrium_interest
+
+            ls = solve_learning(params.learning, config, dtype=dtype)
+            res = solve_equilibrium_interest(ls, params.economic, config)
+            out = ScenarioResult(
+                spec, fp, res.base.xi, res.base.status, res.base.bankrun,
+                res.base.health, res,
+            )
+        elif red == "hetero":
+            from sbr_tpu.hetero.learning import solve_learning_hetero
+
+            lsh = solve_learning_hetero(params.learning, config, dtype=dtype)
+            res = solve_equilibrium_hetero(lsh, params.economic, config)
+            out = ScenarioResult(spec, fp, res.xi, res.status, res.bankrun, res.health, res)
+        elif red == "social" and hasattr(params.learning, "beta"):
+            # social × hetero params (no scalar beta) falls through to the
+            # composed fixed point even with no modifiers — the legacy
+            # social stack is scalar-beta only.
+            from sbr_tpu.social.solver import solve_equilibrium_social
+
+            res = solve_equilibrium_social(
+                params, config, tol=spec.social_tol, max_iter=spec.social_max_iter,
+                damping=spec.social_damping, dtype=dtype,
+            )
+            out = ScenarioResult(
+                spec, fp, res.equilibrium.xi, res.equilibrium.status,
+                res.equilibrium.bankrun, res.health, res,
+            )
+        elif spec.learning == "social":
+            out = _solve_composed_social(spec, params, config, dtype, fp)
+        elif spec.learning == "hetero":
+            from sbr_tpu.hetero.learning import solve_learning_hetero
+
+            theta = scenario_theta_hetero(params, dtype)
+            lsh = solve_learning_hetero(params.learning, config, dtype=dtype)
+            res = solve_equilibrium_hetero(
+                lsh, params.economic, config,
+                hazard_transform=_make_hazard_transform_hetero(spec, theta, config),
+                kappa_transform=_make_kappa_transform(spec, theta),
+            )
+            out = ScenarioResult(spec, fp, res.xi, res.status, res.bankrun, res.health, res)
+        else:  # composed baseline-family
+            theta = scenario_theta(params, dtype)
+            ls = solve_learning(params.learning, config, dtype=dtype)
+            res = solve_equilibrium_core(
+                ls, theta["u"], theta["p"], theta["kappa"], theta["lam"],
+                theta["eta"], ls.grid[-1], config,
+                hazard_transform=_make_hazard_transform(spec, theta, config, ls),
+                kappa_transform=_make_kappa_transform(spec, theta),
+            )
+            out = ScenarioResult(spec, fp, res.xi, res.status, res.bankrun, res.health, res)
+        sp.sync(out.xi)
+
+    obs.log_health("scenario.solve", out.health, out.status, scenario=fp[:12])
+    return out
+
+
+def scenario_theta_hetero(params, dtype) -> dict:
+    """θ dict for hetero-family specs: SCENARIO_KEYS minus the scalar beta
+    (group betas/dist ride the params struct into Stage 1 directly)."""
+    econ = params.economic
+    lrn = params.learning
+    vals = {
+        "u": econ.u, "p": econ.p, "kappa": econ.kappa, "lam": econ.lam,
+        "eta": econ.eta, "t0": lrn.tspan[0], "t1": lrn.tspan[1], "x0": lrn.x0,
+        "r": getattr(econ, "r", 0.0), "delta": getattr(econ, "delta", 0.1),
+        "insurance_cap": econ.insurance_cap, "suspension_t": econ.suspension_t,
+        "lolr_rate": econ.lolr_rate,
+    }
+    return {k: jnp.asarray(v, dtype) for k, v in vals.items()}
+
+
+def _solve_composed_social(spec, params, config, dtype, fp) -> ScenarioResult:
+    """Composed social fixed point: tspan overridden to (0, η) like the
+    legacy stack, inner pipeline per spec."""
+    hetero = spec.learning == "social" and hasattr(params.learning, "betas")
+    if hetero:
+        theta = scenario_theta_hetero(params, dtype)
+        betas = jnp.asarray(params.learning.betas, dtype)
+        dist = jnp.asarray(params.learning.dist, dtype)
+        inner_spec = dataclasses.replace(spec.social_program_spec(), learning="hetero")
+    else:
+        theta = scenario_theta(params, dtype)
+        betas = jnp.zeros((1,), dtype)
+        dist = jnp.ones((1,), dtype)
+        inner_spec = dataclasses.replace(spec.social_program_spec(), learning="baseline")
+    eta = params.economic.eta
+    grid = jnp.linspace(jnp.zeros((), dtype), jnp.asarray(eta, dtype), config.n_grid)
+    run = _composed_social_fn(inner_spec, config, dtype.name, hetero)
+    out = run(theta, betas, dist, grid)
+    eq = out["equilibrium"]
+    return ScenarioResult(
+        spec, fp, eq.xi, eq.status, eq.bankrun, out["health"], out,
+    )

@@ -1,0 +1,235 @@
+"""Multi-bank contagion on an interbank exposure network (ISSUE 14).
+
+N banks, each one composed single-bank cell (`engine.solve_scenario_cell`
+vmapped over the bank axis — the same vmap-over-columns shape as the serve
+engine's micro-batch program), coupled through cross-bank spillovers
+iterated with the social fixed-point discipline (damped iteration to a
+stable κ vector):
+
+1. Solve all N banks with the current effective thresholds κ_eff.
+2. Each RUN bank j inflicts a loss proportional to its peak withdrawal
+   share AW_max_j on every counterparty holding exposure to it.
+3. κ_eff_i ← clip(κ_i − lgd·Σ_{j→i} w_ij·loss_j, κ_floor, κ_i), damped by
+   ``spec.contagion_damping`` — counterparty losses erode bank i's
+   solvency buffer, so a run elsewhere makes bank i runnable at a smaller
+   withdrawal share (`Status.NO_ROOT` cells can flip to RUN: contagion).
+4. Repeat until the κ vector is stable (``contagion_tol``) or
+   ``contagion_max_iter`` is exhausted.
+
+The exposure network reuses the `social/` graph kernels: edges are
+canonicalized dst-sorted through `native.sort_edges_by_dst` (the agent
+engine's canonical layout) and the per-bank spillover aggregation is the
+same exact-prefix-sum segmented reduction as `social.agents._seg_counts`,
+generalized to weighted values — no scatter-add, TPU-friendly at any N.
+
+An EMPTY exposure network converges in one iteration with κ_eff ≡ κ
+bitwise, so N uncoupled banks are bit-identical to N independent
+single-bank solves through the same vmapped cell (the CI multi-bank
+sanity gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sbr_tpu.models.params import SolverConfig
+from sbr_tpu.models.results import Status
+from sbr_tpu.scenario.spec import ScenarioSpec, spec_fingerprint
+
+
+@dataclasses.dataclass
+class MultiBankResult:
+    """Per-bank grids plus the contagion-loop metadata."""
+
+    spec: ScenarioSpec
+    fingerprint: str
+    xi: jnp.ndarray  # (N,) NaN-masked crash times
+    tau_bar_in: jnp.ndarray  # (N,)
+    aw_max: jnp.ndarray  # (N,)
+    status: jnp.ndarray  # (N,) int32 Status codes
+    kappa_eff: jnp.ndarray  # (N,) final effective thresholds
+    spillover: jnp.ndarray  # (N,) final incoming exposure-weighted losses
+    iterations: int
+    converged: bool
+    health: object  # batched diag.Health, leaves (N,)
+
+    @property
+    def bankrun(self):
+        return self.status == jnp.int32(Status.RUN)
+
+    def __repr__(self) -> str:
+        import numpy as _np
+
+        runs = int(_np.asarray(self.status == 0).sum())
+        return (
+            f"MultiBankResult(banks={self.spec.banks}, runs={runs}, "
+            f"iterations={self.iterations}, converged={self.converged}, "
+            f"fp={self.fingerprint[:12]})"
+        )
+
+
+def _seg_weighted(values: jnp.ndarray, row_ptr: jnp.ndarray) -> jnp.ndarray:
+    """Weighted segmented sum over dst-sorted edge values — the
+    `social.agents._seg_counts` prefix-sum idiom with float payloads:
+    out[i] = Σ values[row_ptr[i] : row_ptr[i+1]]."""
+    prefix = jnp.concatenate(
+        [jnp.zeros((1,), values.dtype), jnp.cumsum(values)]
+    )
+    return prefix[row_ptr[1:]] - prefix[row_ptr[:-1]]
+
+
+def _exposure_layout(spec: ScenarioSpec):
+    """Canonical dst-sorted exposure layout via the agent engine's edge
+    sorter: (src_sorted, w_sorted, row_ptr) with edges of bank i in
+    [row_ptr[i], row_ptr[i+1]). The permutation comes from sorting edge
+    ids as payload — `sort_edges_by_dst` is stable, so weights follow
+    their edges exactly."""
+    from sbr_tpu.native import sort_edges_by_dst
+
+    if not spec.exposure:
+        return None
+    src = np.asarray([e[0] for e in spec.exposure], np.int32)
+    dst = np.asarray([e[1] for e in spec.exposure], np.int32)
+    w = np.asarray([e[2] for e in spec.exposure], np.float64)
+    eids, _dst_sorted, _indeg, row_ptr = sort_edges_by_dst(
+        np.arange(src.shape[0], dtype=np.int32), dst, spec.banks
+    )
+    return src[eids], w[eids], np.asarray(row_ptr, np.int64)
+
+
+def _bank_columns(spec: ScenarioSpec, params, dtype) -> list:
+    """(14, N) SCENARIO_KEYS columns from one shared params struct or a
+    list of one per bank."""
+    from sbr_tpu.scenario.engine import SCENARIO_KEYS, scenario_theta
+
+    if isinstance(params, (list, tuple)):
+        if len(params) != spec.banks:
+            raise ValueError(
+                f"got {len(params)} params structs for {spec.banks} banks"
+            )
+        plist = list(params)
+    else:
+        plist = [params] * spec.banks
+    thetas = [scenario_theta(p, dtype) for p in plist]
+    return [
+        jnp.stack([t[k] for t in thetas]) for k in SCENARIO_KEYS
+    ]
+
+
+def solve_multibank(
+    spec: ScenarioSpec,
+    params,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+) -> MultiBankResult:
+    """Solve an N-bank contagion scenario (module docstring).
+
+    The per-iteration solve is ONE vmapped dispatch of the composed cell;
+    the κ-erosion update runs on host between dispatches (N is small —
+    tens of banks — and the loop usually converges in a handful of
+    rounds). Health is logged per bank with the scenario/bank obs tags so
+    `report health` renders a per-bank census instead of one mixed grid.
+    """
+    from sbr_tpu import obs
+    from sbr_tpu.scenario.engine import SCENARIO_KEYS, batch_fn, _validate_params
+
+    if spec.banks < 2:
+        raise ValueError("solve_multibank requires spec.banks >= 2")
+    if config is None:
+        config = SolverConfig(refine_crossings=False)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+
+    # Normalize to exactly one params struct per bank BEFORE fingerprinting:
+    # a shared struct and an N-element list of identical structs describe
+    # the SAME solve and must key identically in every cache.
+    if isinstance(params, (list, tuple)):
+        plist = list(params)
+        if len(plist) != spec.banks:
+            raise ValueError(f"got {len(plist)} params structs for {spec.banks} banks")
+    else:
+        plist = [params] * spec.banks
+    for p in plist:
+        _validate_params(dataclasses.replace(spec, banks=1, exposure=()), p)
+    fp = spec_fingerprint(spec, tuple(plist), config, dtype.name)
+
+    cols = _bank_columns(spec, plist, dtype)
+    kappa_idx = SCENARIO_KEYS.index("kappa")
+    kappa0 = cols[kappa_idx]
+    layout = _exposure_layout(spec)
+    # batch_fn keys on the cell-program projection, so the multibank spec
+    # passes through directly — banks/exposure/contagion knobs never reach
+    # the compiled cell.
+    batch = batch_fn(spec, config, dtype.name)
+
+    def dispatch(kappa_eff):
+        args = list(cols)
+        args[kappa_idx] = kappa_eff
+        return batch(*args)
+
+    alpha = jnp.asarray(spec.contagion_damping, dtype)
+    floor = jnp.asarray(spec.kappa_floor, dtype)
+    lgd = jnp.asarray(spec.lgd, dtype)
+
+    kappa_eff = kappa0
+    spill = jnp.zeros_like(kappa0)
+    converged = False
+    iterations = 0
+    with obs.span(
+        "scenario.multibank", scenario=fp[:12], banks=spec.banks,
+        edges=len(spec.exposure),
+    ) as sp:
+        for it in range(1, spec.contagion_max_iter + 1):
+            iterations = it
+            xi, tau_in, aw_max, status, health = dispatch(kappa_eff)
+            if layout is None:
+                # No exposure: zero spillover by construction — the first
+                # round IS the fixed point, κ_eff stays the κ column object
+                # and the results are bit-identical to independent solves.
+                converged = True
+                break
+            src_sorted, w_sorted, row_ptr = layout
+            loss = jnp.where(status == jnp.int32(Status.RUN), aw_max, 0.0)
+            vals = jnp.asarray(w_sorted, dtype) * loss[jnp.asarray(src_sorted)]
+            spill = _seg_weighted(vals, jnp.asarray(row_ptr))
+            target = jnp.clip(kappa0 - lgd * spill, floor, kappa0)
+            new = (1.0 - alpha) * kappa_eff + alpha * target
+            delta = float(jnp.max(jnp.abs(new - kappa_eff)))
+            # <= so an EXACTLY stable vector converges at contagion_tol=0
+            # (an all-no-run network has delta == 0.0 after round 1)
+            if delta <= spec.contagion_tol:
+                # κ stable: the results just computed ARE the fixed point's
+                # (kappa_eff untouched, so it matches what was solved).
+                converged = True
+                break
+            if it == spec.contagion_max_iter:
+                # Budget exhausted: do NOT take the final update — the
+                # reported kappa_eff must be the vector the reported
+                # xi/status/aw_max were actually solved under (re-solving
+                # at result.kappa_eff reproduces the result even when
+                # converged=False).
+                break
+            kappa_eff = new
+        sp.sync(status)
+
+    res = MultiBankResult(
+        spec=spec, fingerprint=fp, xi=xi, tau_bar_in=tau_in, aw_max=aw_max,
+        status=status, kappa_eff=kappa_eff, spillover=spill,
+        iterations=iterations, converged=converged, health=health,
+    )
+    # Per-bank health census with scenario + bank tags (report health
+    # groups per scenario/bank instead of folding banks into one census).
+    if obs.enabled():
+        for i in range(spec.banks):
+            h_i = jax.tree_util.tree_map(lambda leaf: leaf[i], health)
+            obs.log_health(
+                "scenario.multibank", h_i, status[i], scenario=fp[:12], bank=i
+            )
+    obs.log_status("scenario.multibank", status)
+    return res

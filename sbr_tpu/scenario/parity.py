@@ -1,0 +1,99 @@
+"""Composed-vs-legacy parity smoke — the CI ``scenario-parity`` gate.
+
+    python -m sbr_tpu.scenario.parity [--n 48] [--banks 3]
+
+Two checks, both exact:
+
+1. **Grid parity**: an n×n f64 β×u grid through `scenario.scenario_grid`
+   with the baseline-reducible spec must produce a status grid EXACTLY
+   equal to `sweeps.beta_u_grid`'s (and bitwise-equal ξ where finite) —
+   the composed cell IS `solve_param_cell`, so any divergence means the
+   composition layer perturbed the shared cell.
+2. **Multi-bank sanity**: N banks with an EMPTY exposure network must be
+   bit-identical to N independent single-bank solves through the same
+   vmapped cell — zero spillover must be a structural no-op, not an
+   approximate one.
+
+Exit 0 on success; an AssertionError (exit 1) names the first divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m sbr_tpu.scenario.parity")
+    parser.add_argument("--n", type=int, default=48, help="grid side (default 48)")
+    parser.add_argument("--banks", type=int, default=3, help="banks in the sanity check")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sbr_tpu import scenario
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+    base = make_model_params()
+    config = SolverConfig(n_grid=512, bisect_iters=60, refine_crossings=False)
+    betas = np.linspace(0.25, 3.0, args.n)
+    us = np.linspace(0.01, 0.99, args.n)
+
+    spec = scenario.ScenarioSpec()  # baseline-reducible
+    composed = scenario.scenario_grid(spec, betas, us, base, config=config)
+    legacy = beta_u_grid(betas, us, base, config=config)
+
+    st_c = np.asarray(composed.status)
+    st_l = np.asarray(legacy.status)
+    assert np.array_equal(st_c, st_l), (
+        f"composed-vs-legacy status grids diverged in {np.sum(st_c != st_l)} cells"
+    )
+    xi_c = np.asarray(composed.xi)
+    xi_l = np.asarray(legacy.xi)
+    assert np.array_equal(np.isfinite(xi_c), np.isfinite(xi_l)), "finite masks diverged"
+    both = np.isfinite(xi_c)
+    assert np.array_equal(xi_c[both], xi_l[both]), (
+        "composed ξ grid is not bit-identical to the legacy grid"
+    )
+    print(f"grid parity ok: {st_c.size} cells, {int((st_c == 0).sum())} runs, "
+          f"ξ bitwise equal")
+
+    # Multi-bank sanity: empty exposure == independent solves, bitwise.
+    n_banks = args.banks
+    plist = [
+        make_model_params(beta=1.0 + 0.2 * i, u=0.05 + 0.02 * i)
+        for i in range(n_banks)
+    ]
+    mb_spec = scenario.ScenarioSpec(banks=n_banks)
+    mb = scenario.solve_multibank(mb_spec, plist, config=config)
+    assert mb.converged and mb.iterations == 1, (mb.converged, mb.iterations)
+
+    cell_batch = scenario.engine.batch_fn(
+        scenario.ScenarioSpec(), config, jnp.dtype(jnp.float64).name
+    )
+    cols = scenario.multibank._bank_columns(mb_spec, plist, jnp.float64)
+    xi_i, tau_i, aw_i, st_i, _h = cell_batch(*cols)
+    xi_mb = np.asarray(mb.xi)
+    xi_ind = np.asarray(xi_i)
+    assert np.array_equal(np.asarray(mb.status), np.asarray(st_i)), "multibank status diverged"
+    both = np.isfinite(xi_mb)
+    assert np.array_equal(both, np.isfinite(xi_ind)), "multibank finite masks diverged"
+    assert np.array_equal(xi_mb[both], xi_ind[both]), (
+        "empty-exposure multibank ξ is not bit-identical to independent solves"
+    )
+    assert np.array_equal(np.asarray(mb.kappa_eff),
+                          np.asarray(cols[scenario.SCENARIO_KEYS.index("kappa")])), (
+        "empty-exposure κ_eff drifted from the input κ column"
+    )
+    print(f"multibank sanity ok: {n_banks} banks, empty network bit-identical "
+          f"to independent solves")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

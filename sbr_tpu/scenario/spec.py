@@ -1,0 +1,251 @@
+"""`ScenarioSpec` — the frozen, hashable, fingerprintable description of a
+composed solve pipeline (ISSUE 14 tentpole).
+
+A spec is pure STRUCTURE: which learning-stage transformer runs Stage 1,
+which hazard/buffer modifiers rewrite Stage 2, and how many banks couple
+through which interbank exposure network. Parameter VALUES (β, u, κ, the
+policy knobs insurance_cap / suspension_t / lolr_rate, the hetero group
+structure betas/dist, interest's r/δ) live in the params structs
+(`models.params`) exactly as before — a spec plus a params struct fully
+determines a solve, and `spec_fingerprint` hashes the pair through the
+same `utils.checkpoint.canonicalize` machinery every cache in the repo
+keys on (serve result cache, global tile cache, AOT executables).
+
+Composition matrix (what `__post_init__` accepts vs rejects loudly):
+
+==========  ========  ============================  =====================
+learning    banks     modifiers                     notes
+==========  ========  ============================  =====================
+baseline    1         any subset, any order         reduces to the legacy
+                                                    baseline/interest
+                                                    stacks when trivial
+hetero      1         any subset                    interest V solved per
+                                                    group row
+social      1         any subset                    modifiers apply to
+                                                    every inner iterate
+baseline    >= 2      any subset                    multi-bank contagion
+hetero      >= 2      REJECTED                      per-bank group axes
+                                                    would need a ragged
+                                                    vmap — explicit error
+social      >= 2      REJECTED                      fixed point inside the
+                                                    contagion loop is not
+                                                    supported — explicit
+                                                    error
+==========  ========  ============================  =====================
+
+Modifiers (applied to the hazard in spec order; ``lolr`` acts on κ at the
+ξ stage regardless of position):
+
+- ``"interest"``       — HJB value function, effective hazard h − r·V
+  (`interest.solver.effective_hazard_stage`; requires params with r/δ).
+- ``"insurance_cap"``  — h ← (1 − insurance_cap)·h: the insured deposit
+  fraction abstains from the withdrawal race.
+- ``"suspension"``     — h ← h·1[τ̄ < suspension_t]: convertibility is
+  suspended from suspension_t on, so running past it has no value.
+- ``"lolr"``           — κ_eff = κ·(1 + lolr_rate): lender-of-last-resort
+  injections let the bank survive a larger withdrawal share.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+LEARNING_STAGES = ("baseline", "hetero", "social")
+HAZARD_MODIFIERS = ("interest", "insurance_cap", "suspension", "lolr")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """One composed scenario (see module docstring).
+
+    Plain-python frozen dataclass: hashable (a static jit argument — every
+    distinct spec compiles its own program, cached), and canonicalizable
+    (`utils.checkpoint.canonicalize` renders dataclasses by sorted field
+    name, so the spec drops into `params_fingerprint` unchanged).
+    """
+
+    learning: str = "baseline"
+    # Ordered hazard/buffer modifiers; hazard rewrites apply in this order.
+    modifiers: Tuple[str, ...] = ()
+    # Multi-bank contagion (banks >= 2): interbank exposure edges
+    # (src, dst, weight) — bank `dst` holds `weight` of exposure to bank
+    # `src` and suffers when `src` fails. () = independent banks.
+    banks: int = 1
+    exposure: Tuple[Tuple[int, int, float], ...] = ()
+    # Social fixed-point knobs (legacy `solve_equilibrium_social` defaults).
+    social_tol: float = 1e-4
+    social_max_iter: int = 250
+    social_damping: float = 0.5
+    # Contagion-loop knobs: damped κ-erosion iteration (multibank.py).
+    contagion_max_iter: int = 32
+    contagion_tol: float = 1e-10
+    contagion_damping: float = 1.0
+    # Loss-given-default on interbank exposure and the κ erosion floor.
+    lgd: float = 0.5
+    kappa_floor: float = 1e-3
+
+    def __post_init__(self):
+        if self.learning not in LEARNING_STAGES:
+            raise ValueError(
+                f"unknown learning stage {self.learning!r}; "
+                f"expected one of {LEARNING_STAGES}"
+            )
+        mods = tuple(self.modifiers)
+        object.__setattr__(self, "modifiers", mods)
+        unknown = [m for m in mods if m not in HAZARD_MODIFIERS]
+        if unknown:
+            raise ValueError(
+                f"unknown modifier(s) {unknown}; expected a subset of "
+                f"{HAZARD_MODIFIERS}"
+            )
+        if len(set(mods)) != len(mods):
+            raise ValueError(f"duplicate modifiers in {mods}")
+        if self.banks < 1:
+            raise ValueError(f"banks must be >= 1, got {self.banks}")
+        if self.banks > 1 and self.learning != "baseline":
+            # The composition matrix's loud rejections (module docstring).
+            raise ValueError(
+                f"multi-bank contagion supports learning='baseline' only "
+                f"(got learning={self.learning!r} with banks={self.banks}); "
+                f"see the composition matrix in sbr_tpu/scenario/spec.py"
+            )
+        exposure = tuple((int(s), int(d), float(w)) for s, d, w in self.exposure)
+        object.__setattr__(self, "exposure", exposure)
+        if exposure and self.banks < 2:
+            raise ValueError("exposure edges require banks >= 2")
+        for s, d, w in exposure:
+            if not (0 <= s < self.banks and 0 <= d < self.banks):
+                raise ValueError(
+                    f"exposure edge ({s}, {d}) out of range for {self.banks} banks"
+                )
+            if s == d:
+                raise ValueError(f"self-exposure edge ({s}, {d}) is not allowed")
+            if w < 0:
+                raise ValueError(f"exposure weight must be non-negative, got {w}")
+        if not (self.social_tol > 0 and self.social_max_iter >= 1):
+            raise ValueError("social_tol must be > 0 and social_max_iter >= 1")
+        if not (0 < self.social_damping <= 1):
+            raise ValueError(f"social_damping must be in (0, 1], got {self.social_damping}")
+        if not (self.contagion_max_iter >= 1 and self.contagion_tol >= 0):
+            raise ValueError("contagion_max_iter must be >= 1 and contagion_tol >= 0")
+        if not (0 < self.contagion_damping <= 1):
+            raise ValueError(
+                f"contagion_damping must be in (0, 1], got {self.contagion_damping}"
+            )
+        if not (0 <= self.lgd <= 1):
+            raise ValueError(f"lgd must be in [0, 1], got {self.lgd}")
+        if not (0 < self.kappa_floor < 1):
+            raise ValueError(f"kappa_floor must be in (0, 1), got {self.kappa_floor}")
+
+    # -- reductions ----------------------------------------------------------
+    def reduces_to(self) -> Optional[str]:
+        """The legacy stack this spec is EXACTLY, or None for a genuine
+        composition. Reducible specs route through the legacy entry points
+        — one shared cell, forward bits equal by construction (the
+        `solve_param_cell` structural trick the golden-parity suite pins).
+        """
+        if self.banks != 1:
+            return None
+        if self.learning == "baseline" and self.modifiers == ():
+            return "baseline"
+        if self.learning == "baseline" and self.modifiers == ("interest",):
+            return "interest"
+        if self.learning == "hetero" and self.modifiers == ():
+            return "hetero"
+        if self.learning == "social" and self.modifiers == ():
+            return "social"
+        return None
+
+    @property
+    def policy_modifiers(self) -> Tuple[str, ...]:
+        """The policy subset of the active modifiers."""
+        return tuple(m for m in self.modifiers if m != "interest")
+
+    def cell_program_spec(self) -> "ScenarioSpec":
+        """The spec projected onto the fields a compiled single-bank CELL
+        program actually depends on (learning + modifiers). Jit caches key
+        on this, not the full spec: the host-side knobs (contagion_*, lgd,
+        kappa_floor, social_* for non-social cells, banks/exposure) never
+        enter the traced program, and keying on them would compile one
+        identical executable per wire-supplied float value — unbounded
+        growth on a server accepting arbitrary scenario objects."""
+        return ScenarioSpec(learning=self.learning, modifiers=self.modifiers)
+
+    def social_program_spec(self) -> "ScenarioSpec":
+        """Like `cell_program_spec`, for the composed social fixed-point
+        program — the social knobs ARE baked into that while_loop (tol,
+        max_iter, damping are trace-time constants), so they stay in the
+        key; only the contagion/multibank fields are projected away."""
+        return ScenarioSpec(
+            learning=self.learning, modifiers=self.modifiers,
+            social_tol=self.social_tol, social_max_iter=self.social_max_iter,
+            social_damping=self.social_damping,
+        )
+
+    def grad_reduction(self) -> Optional[str]:
+        """Which grad-covered stack this spec reduces to ("baseline" /
+        "interest"), or None — the gradient-coverage matrix for
+        `grad.api.scenario_xi_and_grad` (see README "Composable
+        scenarios")."""
+        red = self.reduces_to()
+        return red if red in ("baseline", "interest") else None
+
+    # -- wire form -----------------------------------------------------------
+    def to_doc(self) -> dict:
+        """JSON-ready document (the `POST /query` ``scenario`` field)."""
+        doc = {"learning": self.learning, "modifiers": list(self.modifiers)}
+        if self.banks != 1:
+            doc["banks"] = self.banks
+            doc["exposure"] = [list(e) for e in self.exposure]
+        for f in (
+            "social_tol", "social_max_iter", "social_damping",
+            "contagion_max_iter", "contagion_tol", "contagion_damping",
+            "lgd", "kappa_floor",
+        ):
+            if getattr(self, f) != getattr(type(self), "__dataclass_fields__")[f].default:
+                doc[f] = getattr(self, f)
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "ScenarioSpec":
+        """Parse the wire form; unknown keys are a loud error (a typo like
+        ``"modfiers"`` must not silently serve the default pipeline)."""
+        if not isinstance(doc, dict):
+            raise ValueError(f"scenario must be a JSON object, got {type(doc).__name__}")
+        known = set(cls.__dataclass_fields__)
+        unknown = set(doc) - known
+        if unknown:
+            raise ValueError(f"unknown scenario field(s): {sorted(unknown)}")
+        kw = dict(doc)
+        if "modifiers" in kw:
+            kw["modifiers"] = tuple(str(m) for m in kw["modifiers"])
+        if "exposure" in kw:
+            kw["exposure"] = tuple(tuple(e) for e in kw["exposure"])
+        return cls(**kw)
+
+
+# Bump when a composed cell's NUMERICS change (the scenario analogue of
+# `sweeps.baseline_sweeps.GRID_PROGRAM_VERSION`): part of every scenario
+# fingerprint, so caches can never serve bytes from older pipeline math.
+SCENARIO_PROGRAM_VERSION = 1
+
+
+def spec_fingerprint(spec: ScenarioSpec, params=None, config=None, dtype=None) -> str:
+    """Stable sha256 of (spec[, params, config, dtype]) — THE key composed
+    scenarios are cached and served under. Rides the exact canonical
+    machinery of `utils.checkpoint.params_fingerprint`, so a scenario
+    fingerprint can never collide with a plain params fingerprint (the
+    dataclass name is part of the canonical form)."""
+    from sbr_tpu.utils.checkpoint import params_fingerprint
+
+    payload = [spec, SCENARIO_PROGRAM_VERSION]
+    if params is not None:
+        payload.append(params)
+    if config is not None:
+        payload.append(config)
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        payload.append(jnp.dtype(dtype).name)
+    return params_fingerprint(tuple(payload))

@@ -42,8 +42,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 # The make_model_params keywords a /query document may carry (everything
-# else is 400 — a typo like "bta" must not silently serve defaults).
-_PARAM_KEYS = ("beta", "eta", "eta_bar", "u", "p", "kappa", "lam", "tspan", "x0")
+# else is 400 — a typo like "bta" must not silently serve defaults),
+# including the ISSUE-14 policy knobs; ``r``/``delta`` route the document
+# through make_interest_params so scenario queries with the "interest"
+# modifier are expressible over the wire.
+_PARAM_KEYS = (
+    "beta", "eta", "eta_bar", "u", "p", "kappa", "lam", "tspan", "x0",
+    "insurance_cap", "suspension_t", "lolr_rate", "r", "delta",
+)
 
 
 def _json_safe(value):
@@ -122,8 +128,39 @@ class ServeEndpoint:
                     except (TypeError, ValueError):
                         self._send(400, b'{"error": "bad deadline"}', "application/json")
                         return
-                    scenario = str(doc.get("scenario", "default"))
+                    # ``scenario`` is a free-form tag (legacy) OR a composed
+                    # ScenarioSpec document (ISSUE 14) — a JSON object routes
+                    # the query through the scenario engine, answered and
+                    # cached by spec fingerprint.
+                    scenario_doc = doc.get("scenario")
+                    spec = None
+                    if isinstance(scenario_doc, dict):
+                        from sbr_tpu.scenario import ScenarioSpec
+
+                        try:
+                            spec = ScenarioSpec.from_doc(scenario_doc)
+                        except (TypeError, ValueError) as err:
+                            self._send(
+                                400,
+                                json.dumps({"error": f"bad scenario: {err}"}).encode(),
+                                "application/json",
+                            )
+                            return
+                    scenario = (
+                        "default" if spec is not None else str(scenario_doc or "default")
+                    )
                     grads = bool(doc.get("grads", False))
+                    if spec is not None and grads:
+                        # Gradient coverage is part of the composition
+                        # matrix (grad.scenario_xi_and_grad); the serve
+                        # grads route covers plain queries only — reject
+                        # rather than silently dropping the request.
+                        self._send(
+                            400,
+                            b'{"error": "grads are not supported on scenario queries"}',
+                            "application/json",
+                        )
+                        return
                     unknown = (
                         set(doc) - set(_PARAM_KEYS) - {"scenario", "deadline_ms", "grads"}
                     )
@@ -136,14 +173,44 @@ class ServeEndpoint:
                             "application/json",
                         )
                         return
-                    from sbr_tpu.models.params import make_model_params
+                    from sbr_tpu.models.params import (
+                        make_interest_params,
+                        make_model_params,
+                    )
                     from sbr_tpu.serve.engine import DeadlineExceeded
 
                     try:
                         kw = {k: doc[k] for k in _PARAM_KEYS if k in doc}
                         if "tspan" in kw:
                             kw["tspan"] = tuple(float(v) for v in kw["tspan"])
-                        params = make_model_params(**kw)
+                        # Modifier-gated parameters are consumed ONLY by
+                        # their modifier; on any other query the pipeline
+                        # would silently ignore them while fingerprinting
+                        # them — so a knob without its modifier is a loud
+                        # 400, never a 200 carrying the unmodified answer.
+                        gated = {
+                            "r": "interest", "delta": "interest",
+                            "insurance_cap": "insurance_cap",
+                            "suspension_t": "suspension",
+                            "lolr_rate": "lolr",
+                        }
+                        active = spec.modifiers if spec is not None else ()
+                        orphaned = sorted(
+                            k for k, mod in gated.items()
+                            if k in kw and mod not in active
+                        )
+                        if orphaned:
+                            raise ValueError(
+                                f"parameter(s) {orphaned} require a scenario "
+                                "object with the matching modifier(s) "
+                                f"({sorted({gated[k] for k in orphaned})})"
+                            )
+                        maker = (
+                            make_interest_params
+                            if ("r" in kw or "delta" in kw)
+                            else make_model_params
+                        )
+                        params = maker(**kw)
                     except (TypeError, ValueError) as err:
                         self._send(
                             400, json.dumps({"error": f"bad parameters: {err}"}).encode(),
@@ -151,6 +218,29 @@ class ServeEndpoint:
                         )
                         return
                     try:
+                        if spec is not None:
+                            try:
+                                rec = endpoint.engine.query_scenario(
+                                    params, spec, deadline_ms=deadline_ms
+                                )
+                            except (TypeError, ValueError) as err:
+                                # Spec × params incompatibility (the
+                                # composition matrix): a CLIENT error —
+                                # 400, never a retryable 503 the router
+                                # would fail over on (no worker can ever
+                                # serve it).
+                                self._send(
+                                    400,
+                                    json.dumps(
+                                        {"error": f"unservable scenario: {err}"}
+                                    ).encode(),
+                                    "application/json",
+                                )
+                                return
+                            self._send(
+                                200, json.dumps(rec).encode(), "application/json"
+                            )
+                            return
                         result = endpoint.engine.query(
                             params, scenario=scenario, deadline_ms=deadline_ms,
                             grads=grads,

@@ -983,6 +983,92 @@ class Engine:
             records.append(rec)
         return records
 
+    # -- composed scenarios (ISSUE 14) ---------------------------------------
+    def query_scenario(self, params, spec, deadline_ms: Optional[float] = None) -> dict:
+        """Serve one composed-scenario query (`scenario.ScenarioSpec`) —
+        the `POST /query` ``scenario``-object route.
+
+        Runs in the calling thread (scenario programs are per-spec compiled
+        and cached; arbitrary specs don't micro-batch across each other),
+        with the engine's admission control and the same LRU + on-disk
+        result cache — keyed by `scenario.spec_fingerprint(spec, params,
+        config, dtype)`, which bakes in SCENARIO_PROGRAM_VERSION, so stale
+        pipeline math can never be replayed. Returns a JSON-ready record:
+        per-bank lists for multi-bank specs, scalars otherwise, plus
+        ``scenario_fingerprint`` / ``source`` / ``latency_ms``."""
+        from sbr_tpu.scenario import spec_fingerprint
+
+        self._admit(deadline_ms)
+        t0 = time.monotonic()
+        key = spec_fingerprint(spec, (params, self._cfg_tag), self.config,
+                               self.dtype.name)
+        rec, source = self._scenario_lookup(key)
+        if rec is None:
+            rec = self._solve_scenario(params, spec, key)
+            self._scenario_store(key, rec)
+            source = "computed"
+        latency = time.monotonic() - t0
+        self.live.record_query(latency, source, scenario=f"spec:{key[:12]}")
+        return {**rec, "source": source, "latency_ms": round(latency * 1e3, 3)}
+
+    def _solve_scenario(self, params, spec, key: str) -> dict:
+        """One composed solve → the cacheable JSON record (non-finite
+        floats encoded as None, the wire convention)."""
+        import math as math_
+
+        import numpy as np_
+
+        from sbr_tpu import scenario as scen
+
+        res = scen.solve(spec, params, config=self.config, dtype=self.dtype)
+
+        def safe(v):
+            v = float(v)
+            return v if math_.isfinite(v) else None
+
+        if spec.banks > 1:
+            h = res.health
+            rec = {
+                "xi": [safe(v) for v in np_.asarray(res.xi)],
+                "status": [int(v) for v in np_.asarray(res.status)],
+                "aw_max": [safe(v) for v in np_.asarray(res.aw_max)],
+                "flags": [int(v) for v in np_.asarray(h.flags)],
+                "kappa_eff": [safe(v) for v in np_.asarray(res.kappa_eff)],
+                "iterations": int(res.iterations),
+                "converged": bool(res.converged),
+                "banks": spec.banks,
+            }
+        else:
+            rec = {
+                "xi": safe(res.xi),
+                "status": int(np_.asarray(res.status)),
+                "flags": int(np_.asarray(res.health.flags)),
+                "residual": safe(np_.asarray(res.health.residual)),
+                "banks": 1,
+            }
+        rec["scenario_fingerprint"] = key
+        return rec
+
+    def _scenario_lookup(self, key: str) -> tuple:
+        """LRU + verified-disk lookup for scenario records (stored
+        verbatim — their shape varies by spec, unlike plain-query
+        records). Same probe skeleton as `_lookup` (`_cache_probe`), so
+        the verify-on-read/quarantine discipline can never drift between
+        the two record kinds."""
+        return self._cache_probe(key, self._parse_scenario_record)
+
+    @staticmethod
+    def _parse_scenario_record(path: Path):
+        import json
+
+        rec = json.loads(path.read_text())
+        if not isinstance(rec, dict) or "scenario_fingerprint" not in rec:
+            return None
+        return rec
+
+    def _scenario_store(self, key: str, rec: dict, write_disk: bool = True) -> None:
+        self._store(key, rec, write_disk=write_disk)
+
     # -- result cache --------------------------------------------------------
     def _result_key(self, params: ModelParams, grads: bool = False) -> str:
         # Grads records carry grad_flags computed under the resolved
@@ -997,7 +1083,17 @@ class Engine:
             return None
         return Path(self.serve.cache_dir) / "results" / key[:2] / f"{key}.json"
 
-    def _lookup(self, key: str) -> tuple:
+    def _cache_probe(self, key: str, parse_disk) -> tuple:
+        """Shared LRU + verified-disk probe (plain AND scenario records):
+        LRU hit first; else sha256 verify-on-read (ISSUE 11 — a digest
+        mismatch is quarantined beside the cache as evidence, never
+        silently deleted, and the query recomputes; sidecar-less entries
+        from pre-sidecar builds verify as "legacy" and stay trusted), then
+        ``parse_disk(path)``. A parser returning None — or raising
+        OSError/ValueError/KeyError/TypeError (unreadable OR
+        parseable-but-wrong-shape: a torn write can leave valid non-dict
+        JSON, which must not kill the batcher thread) — rejects the entry
+        and the query recomputes."""
         with self._lru_lock:
             rec = self._lru.get(key)
             if rec is not None:
@@ -1005,16 +1101,8 @@ class Engine:
                 return dict(rec), "lru"
         path = self._result_path(key)
         if path is not None and path.exists():
-            import json
-
             from sbr_tpu.resilience import heal
 
-            # Verify-on-read (ISSUE 11 satellite): the tile cache has had
-            # sha256 sidecars since PR 7 while the serve cache trusted its
-            # bytes blindly. Same contract now: a digest mismatch is
-            # quarantined beside the cache (evidence, never silently
-            # deleted) and the query recomputes; sidecar-less entries from
-            # pre-sidecar builds verify as "legacy" and stay trusted.
             try:
                 if heal.verify_file(path) == "mismatch":
                     heal.quarantine(path, reason="serve-cache-mismatch")
@@ -1022,30 +1110,39 @@ class Engine:
             except OSError:
                 return None, None
             try:
-                raw = json.loads(path.read_text())
-                rec = {
-                    "xi": float(raw["xi"]),
-                    "tau_bar_in": float(raw["tau_bar_in"]),
-                    "aw_max": float(raw["aw_max"]),
-                    "status": int(raw["status"]),
-                    "flags": int(raw["flags"]),
-                    "residual": float(raw["residual"]),
-                }
-                # Grad records are a superset (ISSUE 13): a grads=true
-                # entry restored from disk must keep its sensitivities.
-                for k in ("dxi_dbeta", "dxi_du", "dxi_dkappa"):
-                    if k in raw:
-                        rec[k] = float(raw[k])
-                if "grad_flags" in raw:
-                    rec["grad_flags"] = int(raw["grad_flags"])
+                rec = parse_disk(path)
             except (OSError, ValueError, KeyError, TypeError):
-                # Unreadable OR parseable-but-wrong-shape (a torn write can
-                # leave valid non-dict JSON; rec["xi"] then raises TypeError,
-                # which must not kill the batcher thread): recompute.
+                return None, None
+            if rec is None:
                 return None, None
             self._store(key, rec, write_disk=False)
             return dict(rec), "disk"
         return None, None
+
+    def _lookup(self, key: str) -> tuple:
+        return self._cache_probe(key, self._parse_plain_record)
+
+    @staticmethod
+    def _parse_plain_record(path: Path) -> dict:
+        import json
+
+        raw = json.loads(path.read_text())
+        rec = {
+            "xi": float(raw["xi"]),
+            "tau_bar_in": float(raw["tau_bar_in"]),
+            "aw_max": float(raw["aw_max"]),
+            "status": int(raw["status"]),
+            "flags": int(raw["flags"]),
+            "residual": float(raw["residual"]),
+        }
+        # Grad records are a superset (ISSUE 13): a grads=true entry
+        # restored from disk must keep its sensitivities.
+        for k in ("dxi_dbeta", "dxi_du", "dxi_dkappa"):
+            if k in raw:
+                rec[k] = float(raw[k])
+        if "grad_flags" in raw:
+            rec["grad_flags"] = int(raw["grad_flags"])
+        return rec
 
     def _store(self, key: str, rec: dict, write_disk: bool = True) -> None:
         with self._lru_lock:

@@ -1,0 +1,674 @@
+"""Composable scenario engine (ISSUE 14): golden legacy parity, the
+composition matrix, policy-modifier semantics, multi-bank contagion, spec
+fingerprints, serve integration, and history schema 9."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu import scenario
+from sbr_tpu.baseline.learning import solve_learning
+from sbr_tpu.baseline.solver import solve_equilibrium_baseline
+from sbr_tpu.models.params import (
+    EconomicParamsInterest,
+    ModelParamsHetero,
+    SolverConfig,
+    make_hetero_params,
+    make_interest_params,
+    make_model_params,
+    params_to_pytree,
+    pytree_to_params,
+    with_overrides,
+)
+from sbr_tpu.models.results import Status
+from sbr_tpu.scenario import ScenarioSpec, spec_fingerprint
+
+CFG_KW = dict(n_grid=96, bisect_iters=40)
+
+
+def _cfg(numerics="fixed", **kw):
+    merged = {**CFG_KW, **kw}
+    return SolverConfig(numerics=numerics, **merged)
+
+
+def _health_equal(a, b):
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        if not np.array_equal(np.asarray(leaf_a), np.asarray(leaf_b), equal_nan=True):
+            return False
+    return True
+
+
+def _assert_bitwise(res, xi, status, health=None, health_ref=None):
+    assert np.array_equal(np.asarray(res.xi), np.asarray(xi), equal_nan=True)
+    assert np.array_equal(np.asarray(res.status), np.asarray(status))
+    if health_ref is not None:
+        assert _health_equal(health, health_ref)
+
+
+# ---------------------------------------------------------------------------
+# Golden parity: each legacy stack through its equivalent ScenarioSpec is
+# bit-identical (ξ, status, Health) under both numerics modes.
+# ---------------------------------------------------------------------------
+
+
+class TestGoldenParity:
+    @pytest.mark.parametrize("numerics", ["fixed", "adaptive"])
+    def test_baseline_reduction_bit_identical(self, numerics):
+        cfg = _cfg(numerics)
+        base = make_model_params(beta=1.2, u=0.08)
+        ls = solve_learning(base.learning, cfg)
+        direct = solve_equilibrium_baseline(ls, base.economic, cfg)
+        res = scenario.solve(ScenarioSpec(), base, config=cfg)
+        _assert_bitwise(res, direct.xi, direct.status, res.health, direct.health)
+
+    @pytest.mark.parametrize("numerics", ["fixed", "adaptive"])
+    def test_interest_reduction_bit_identical(self, numerics):
+        from sbr_tpu.interest.solver import solve_equilibrium_interest
+
+        cfg = _cfg(numerics)
+        params = make_interest_params(beta=1.0, u=0.05, r=0.02, delta=0.1)
+        ls = solve_learning(params.learning, cfg)
+        direct = solve_equilibrium_interest(ls, params.economic, cfg)
+        res = scenario.solve(ScenarioSpec(modifiers=("interest",)), params, config=cfg)
+        _assert_bitwise(
+            res, direct.base.xi, direct.base.status, res.health, direct.base.health
+        )
+
+    @pytest.mark.parametrize("numerics", ["fixed", "adaptive"])
+    def test_hetero_reduction_bit_identical(self, numerics):
+        from sbr_tpu.hetero.learning import solve_learning_hetero
+        from sbr_tpu.hetero.solver import solve_equilibrium_hetero
+
+        cfg = _cfg(numerics)
+        params = make_hetero_params(betas=(0.6, 1.4), dist=(0.4, 0.6), u=0.05)
+        lsh = solve_learning_hetero(params.learning, cfg)
+        direct = solve_equilibrium_hetero(lsh, params.economic, cfg)
+        res = scenario.solve(ScenarioSpec(learning="hetero"), params, config=cfg)
+        _assert_bitwise(res, direct.xi, direct.status, res.health, direct.health)
+
+    @pytest.mark.parametrize("numerics", ["fixed", "adaptive"])
+    def test_social_reduction_bit_identical(self, numerics):
+        from sbr_tpu.social.solver import solve_equilibrium_social
+
+        cfg = _cfg(numerics)
+        base = make_model_params(beta=1.0, u=0.1)
+        direct = solve_equilibrium_social(base, cfg, max_iter=120)
+        res = scenario.solve(
+            ScenarioSpec(learning="social", social_max_iter=120), base, config=cfg
+        )
+        _assert_bitwise(
+            res, direct.equilibrium.xi, direct.equilibrium.status,
+            res.health, direct.health,
+        )
+        assert np.asarray(res.detail.iterations) == np.asarray(direct.iterations)
+
+    def test_hook_free_core_untouched_by_refactor(self):
+        """The extracted classify_cell + hook plumbing must leave the
+        hook-free call signature working exactly as before (positional)."""
+        from sbr_tpu.baseline.solver import solve_equilibrium_core
+
+        cfg = _cfg()
+        base = make_model_params()
+        ls = solve_learning(base.learning, cfg)
+        e = base.economic
+        res = solve_equilibrium_core(ls, e.u, e.p, e.kappa, e.lam, e.eta, ls.grid[-1], cfg)
+        assert int(res.status) == Status.RUN
+
+
+# ---------------------------------------------------------------------------
+# Spec validation: the composition matrix rejects loudly.
+# ---------------------------------------------------------------------------
+
+
+class TestSpecValidation:
+    def test_unknown_learning_and_modifier(self):
+        with pytest.raises(ValueError, match="unknown learning"):
+            ScenarioSpec(learning="bayesian")
+        with pytest.raises(ValueError, match="unknown modifier"):
+            ScenarioSpec(modifiers=("taxes",))
+        with pytest.raises(ValueError, match="duplicate"):
+            ScenarioSpec(modifiers=("lolr", "lolr"))
+
+    def test_multibank_matrix_rejections(self):
+        with pytest.raises(ValueError, match="baseline"):
+            ScenarioSpec(learning="hetero", banks=3)
+        with pytest.raises(ValueError, match="baseline"):
+            ScenarioSpec(learning="social", banks=2)
+        with pytest.raises(ValueError, match="banks >= 2"):
+            ScenarioSpec(exposure=((0, 1, 0.5),))
+        with pytest.raises(ValueError, match="out of range"):
+            ScenarioSpec(banks=2, exposure=((0, 5, 0.5),))
+        with pytest.raises(ValueError, match="self-exposure"):
+            ScenarioSpec(banks=2, exposure=((1, 1, 0.5),))
+
+    def test_params_compat_rejections(self):
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="r/delta"):
+            scenario.solve(
+                ScenarioSpec(modifiers=("interest",)), make_model_params(), config=cfg
+            )
+        with pytest.raises(ValueError, match="ModelParamsHetero"):
+            scenario.solve(
+                ScenarioSpec(learning="hetero"), make_model_params(), config=cfg
+            )
+
+    def test_reductions(self):
+        assert ScenarioSpec().reduces_to() == "baseline"
+        assert ScenarioSpec(modifiers=("interest",)).reduces_to() == "interest"
+        assert ScenarioSpec(learning="hetero").reduces_to() == "hetero"
+        assert ScenarioSpec(learning="social").reduces_to() == "social"
+        assert ScenarioSpec(modifiers=("lolr",)).reduces_to() is None
+        assert ScenarioSpec(banks=2).reduces_to() is None
+        assert ScenarioSpec(modifiers=("interest", "lolr")).reduces_to() is None
+
+    def test_doc_round_trip(self):
+        spec = ScenarioSpec(
+            learning="baseline", modifiers=("insurance_cap", "lolr"),
+            banks=3, exposure=((0, 1, 0.5), (1, 2, 0.25)), lgd=0.4,
+        )
+        assert ScenarioSpec.from_doc(spec.to_doc()) == spec
+        with pytest.raises(ValueError, match="unknown scenario field"):
+            ScenarioSpec.from_doc({"modfiers": ["lolr"]})
+
+
+# ---------------------------------------------------------------------------
+# Policy modifiers: economic semantics.
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyModifiers:
+    def test_policy_params_round_trip(self):
+        p = make_model_params(insurance_cap=0.25, suspension_t=4.0, lolr_rate=0.3)
+        tree = params_to_pytree(p)
+        assert tree["insurance_cap"] == 0.25
+        assert tree["suspension_t"] == 4.0
+        assert tree["lolr_rate"] == 0.3
+        assert pytree_to_params(tree) == p
+        q = with_overrides(p, lolr_rate=0.5)
+        assert q.economic.lolr_rate == 0.5
+        assert q.economic.insurance_cap == 0.25  # carried, not reset
+
+    def test_policy_param_validation(self):
+        with pytest.raises(ValueError, match="insurance_cap"):
+            make_model_params(insurance_cap=1.5)
+        with pytest.raises(ValueError, match="suspension_t"):
+            make_model_params(suspension_t=-1.0)
+        with pytest.raises(ValueError, match="lolr_rate"):
+            make_model_params(lolr_rate=-0.1)
+
+    def test_policy_params_accept_traced_scalars(self):
+        """The PR 12 traced-scalar deferral covers the policy fields."""
+
+        def build(c):
+            tree = params_to_pytree(make_model_params())
+            tree["insurance_cap"] = c
+            return pytree_to_params(tree).economic.insurance_cap * 2.0
+
+        out = jax.jit(build)(0.25)
+        assert float(out) == 0.5
+
+    def test_inert_knobs_leave_solve_unchanged(self):
+        """Default (inert) policy values + active modifiers ≈ no modifiers
+        where the math degenerates: cap=0 scales by 1, lolr=0 keeps κ."""
+        cfg = _cfg()
+        base = make_model_params(u=0.08)
+        plain = scenario.solve(ScenarioSpec(), base, config=cfg)
+        inert = scenario.solve(
+            ScenarioSpec(modifiers=("insurance_cap", "lolr")), base, config=cfg
+        )
+        assert int(inert.status) == int(plain.status)
+        np.testing.assert_allclose(
+            float(inert.xi), float(plain.xi), rtol=0, atol=1e-12
+        )
+
+    def test_insurance_cap_weakens_runs(self):
+        cfg = _cfg()
+        base = make_model_params(u=0.08)
+        uncapped = scenario.solve(
+            ScenarioSpec(modifiers=("insurance_cap",)), base, config=cfg
+        )
+        assert int(uncapped.status) == Status.RUN
+        capped = scenario.solve(
+            ScenarioSpec(modifiers=("insurance_cap",)),
+            with_overrides(base, insurance_cap=0.9), config=cfg,
+        )
+        # With 90% of deposits insured the hazard collapses below u:
+        # no crossing, no run.
+        assert int(capped.status) != Status.RUN
+
+    def test_suspension_blocks_late_runs(self):
+        cfg = _cfg()
+        base = make_model_params(u=0.08)
+        free = scenario.solve(ScenarioSpec(modifiers=("suspension",)),
+                              with_overrides(base, suspension_t=1e6), config=cfg)
+        assert int(free.status) == Status.RUN
+        frozen = scenario.solve(
+            ScenarioSpec(modifiers=("suspension",)),
+            with_overrides(base, suspension_t=1e-3), config=cfg,
+        )
+        # Convertibility suspended from t≈0: hazard identically 0, no run.
+        assert int(frozen.status) == Status.NO_CROSSING
+
+    def test_lolr_raises_threshold(self):
+        cfg = _cfg()
+        base = make_model_params(u=0.08)
+        plain = scenario.solve(ScenarioSpec(), base, config=cfg)
+        assert int(plain.status) == Status.RUN
+        rescued = scenario.solve(
+            ScenarioSpec(modifiers=("lolr",)),
+            with_overrides(base, lolr_rate=5.0), config=cfg,
+        )
+        # κ_eff = 0.6·6 = 3.6 > max AW ≤ 1: no root — the injection
+        # outruns any feasible withdrawal share.
+        assert int(rescued.status) == Status.NO_ROOT
+
+
+# ---------------------------------------------------------------------------
+# Genuine compositions.
+# ---------------------------------------------------------------------------
+
+
+class TestCompositions:
+    def test_hetero_interest_social_combined(self):
+        """The scenario the paper never touched: all three extension axes
+        in ONE composed pipeline, converged with Health clean."""
+        cfg = _cfg()
+        hp = make_hetero_params(betas=(0.8, 1.6), dist=(0.5, 0.5), u=0.05)
+        econ = EconomicParamsInterest(
+            u=hp.economic.u, p=hp.economic.p, kappa=hp.economic.kappa,
+            lam=hp.economic.lam, eta_bar=hp.economic.eta_bar, eta=hp.economic.eta,
+            r=0.01, delta=0.1, insurance_cap=0.1, lolr_rate=0.05,
+        )
+        params = ModelParamsHetero(learning=hp.learning, economic=econ)
+        spec = ScenarioSpec(
+            learning="social", modifiers=("interest", "insurance_cap", "lolr"),
+            social_max_iter=150,
+        )
+        res = scenario.solve(spec, params, config=cfg)
+        assert bool(np.asarray(res.detail["converged"]))
+        assert int(res.status) == Status.RUN
+        assert np.isfinite(float(res.xi))
+        from sbr_tpu.diag.health import DIVERGENT_MASK
+
+        assert int(np.asarray(res.health.flags)) & DIVERGENT_MASK == 0
+
+    def test_hetero_x_interest(self):
+        cfg = _cfg()
+        hp = make_hetero_params(betas=(0.8, 1.6), dist=(0.5, 0.5), u=0.05)
+        econ = EconomicParamsInterest(
+            u=hp.economic.u, p=hp.economic.p, kappa=hp.economic.kappa,
+            lam=hp.economic.lam, eta_bar=hp.economic.eta_bar, eta=hp.economic.eta,
+            r=0.02, delta=0.1,
+        )
+        params = ModelParamsHetero(learning=hp.learning, economic=econ)
+        res = scenario.solve(
+            ScenarioSpec(learning="hetero", modifiers=("interest",)), params, config=cfg
+        )
+        # A positive rate lowers the effective hazard → the run regime
+        # shrinks vs the pure hetero solve at the same params.
+        pure = scenario.solve(ScenarioSpec(learning="hetero"), params, config=cfg)
+        assert res.detail.xi.shape == pure.detail.xi.shape
+        if int(pure.status) == Status.RUN and int(res.status) == Status.RUN:
+            assert float(res.xi) >= float(pure.xi) - 1e-9
+
+    def test_scenario_grid_matches_legacy_on_reduction(self):
+        from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        base = make_model_params()
+        betas = np.linspace(0.5, 2.0, 6)
+        us = np.linspace(0.02, 0.5, 5)
+        composed = scenario.scenario_grid(ScenarioSpec(), betas, us, base, config=cfg)
+        legacy = beta_u_grid(betas, us, base, config=cfg)
+        assert np.array_equal(np.asarray(composed.status), np.asarray(legacy.status))
+        assert np.array_equal(
+            np.asarray(composed.xi), np.asarray(legacy.xi), equal_nan=True
+        )
+
+    def test_policy_sweep_grid(self):
+        """A policy-modifier sweep is just a grid sweep over the composed
+        pipeline: higher insured fraction ⇒ no more runs than baseline."""
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        betas = np.linspace(0.5, 2.0, 5)
+        us = np.linspace(0.02, 0.5, 5)
+        spec = ScenarioSpec(modifiers=("insurance_cap",))
+        g0 = scenario.scenario_grid(
+            spec, betas, us, make_model_params(insurance_cap=0.0), config=cfg
+        )
+        g1 = scenario.scenario_grid(
+            spec, betas, us, make_model_params(insurance_cap=0.6), config=cfg
+        )
+        runs0 = int((np.asarray(g0.status) == Status.RUN).sum())
+        runs1 = int((np.asarray(g1.status) == Status.RUN).sum())
+        assert runs1 <= runs0
+        assert runs0 > 0
+
+
+# ---------------------------------------------------------------------------
+# Multi-bank contagion.
+# ---------------------------------------------------------------------------
+
+
+class TestMultiBank:
+    def test_empty_network_equals_independent(self):
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        plist = [make_model_params(beta=1.0 + 0.3 * i, u=0.05 + 0.02 * i)
+                 for i in range(3)]
+        mb = scenario.solve_multibank(ScenarioSpec(banks=3), plist, config=cfg)
+        assert mb.converged and mb.iterations == 1
+        batch = scenario.engine.batch_fn(
+            ScenarioSpec(), cfg,
+            jnp.dtype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32).name,
+        )
+        cols = scenario.multibank._bank_columns(
+            ScenarioSpec(banks=3), plist,
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32,
+        )
+        xi_i, _t, _a, st_i, _h = batch(*cols)
+        assert np.array_equal(np.asarray(mb.status), np.asarray(st_i))
+        assert np.array_equal(np.asarray(mb.xi), np.asarray(xi_i), equal_nan=True)
+        assert np.array_equal(
+            np.asarray(mb.kappa_eff),
+            np.asarray(cols[scenario.SCENARIO_KEYS.index("kappa")]),
+        )
+
+    def test_contagion_flips_a_sound_bank(self):
+        """A bank with no run equilibrium on its own (κ above its peak
+        withdrawal share) becomes runnable once counterparty losses erode
+        κ_eff — the contagion mechanism itself."""
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        fragile = make_model_params(beta=1.0, u=0.05)
+        sound = make_model_params(beta=1.0, u=0.05, kappa=0.93)
+        plist = [fragile, sound, sound]
+        no_net = scenario.solve_multibank(ScenarioSpec(banks=3), plist, config=cfg)
+        assert int(np.asarray(no_net.status)[0]) == Status.RUN
+        assert int(np.asarray(no_net.status)[1]) != Status.RUN
+
+        spec = ScenarioSpec(
+            banks=3, exposure=((0, 1, 1.0), (0, 2, 1.0), (1, 2, 0.5)), lgd=0.9
+        )
+        mb = scenario.solve_multibank(spec, plist, config=cfg)
+        st = np.asarray(mb.status)
+        assert int(st[0]) == Status.RUN  # the fragile bank still runs
+        assert int(st[1]) == Status.RUN  # ...and drags its counterparty down
+        assert float(np.asarray(mb.kappa_eff)[1]) < 0.93
+        assert float(np.asarray(mb.spillover)[1]) > 0
+
+    def test_exactly_stable_network_converges_at_tol_zero(self):
+        """A no-run network is a fixed point after round 1 (delta == 0.0
+        exactly); `<=` must declare it converged even at contagion_tol=0
+        instead of burning the whole iteration budget."""
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        calm = make_model_params(u=5.0)  # u above the hazard: no run anywhere
+        spec = ScenarioSpec(banks=2, exposure=((0, 1, 0.5),), contagion_tol=0.0)
+        mb = scenario.solve_multibank(spec, calm, config=cfg)
+        assert mb.converged and mb.iterations == 1
+        assert not bool(np.asarray(mb.bankrun).any())
+
+    def test_solve_and_solve_multibank_agree_on_defaults(self):
+        """The same multi-bank call through scenario.solve and
+        solve_multibank must use the same default numerics — same
+        fingerprint, same bytes."""
+        plist = [make_model_params(u=0.05)] * 2
+        spec = ScenarioSpec(banks=2, exposure=((0, 1, 0.5),))
+        a = scenario.solve(spec, plist)
+        b = scenario.solve_multibank(spec, plist)
+        assert a.fingerprint == b.fingerprint
+        assert np.array_equal(np.asarray(a.xi), np.asarray(b.xi), equal_nan=True)
+
+    def test_multibank_per_bank_health_tagged(self, tmp_path):
+        from sbr_tpu import obs
+
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        plist = [make_model_params(u=0.05)] * 2
+        spec = ScenarioSpec(banks=2, exposure=((0, 1, 0.5),))
+        with obs.run_context(run_dir=str(tmp_path / "run")):
+            scenario.solve_multibank(spec, plist, config=cfg)
+        import json
+
+        events = [
+            json.loads(line)
+            for line in (tmp_path / "run" / "events.jsonl").read_text().splitlines()
+        ]
+        health = [e for e in events if e.get("kind") == "health" and "bank" in e]
+        assert {e["bank"] for e in health} == {0, 1}
+        assert all("scenario" in e for e in health)
+        # the fold key keeps banks separate in the per-stage census
+        assert len({e["stage"] for e in health}) == 2
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints & serving.
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprintsAndServe:
+    def test_fingerprint_sensitivity(self):
+        base = make_model_params()
+        cfg = _cfg()
+        fp0 = spec_fingerprint(ScenarioSpec(), base, cfg, "float64")
+        assert fp0 == spec_fingerprint(ScenarioSpec(), base, cfg, "float64")
+        assert fp0 != spec_fingerprint(
+            ScenarioSpec(modifiers=("lolr",)), base, cfg, "float64"
+        )
+        assert fp0 != spec_fingerprint(
+            ScenarioSpec(), with_overrides(base, lolr_rate=0.1), cfg, "float64"
+        )
+        assert fp0 != spec_fingerprint(ScenarioSpec(), base, cfg, "float32")
+
+    def test_served_scenario_query_cached_by_fingerprint(self):
+        from sbr_tpu.serve.engine import Engine
+
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        eng = Engine(config=cfg)
+        try:
+            spec = ScenarioSpec(modifiers=("insurance_cap", "lolr"))
+            params = make_model_params(u=0.08, insurance_cap=0.2, lolr_rate=0.1)
+            first = eng.query_scenario(params, spec)
+            again = eng.query_scenario(params, spec)
+            assert first["source"] == "computed"
+            assert again["source"] == "lru"
+            assert first["scenario_fingerprint"] == again["scenario_fingerprint"]
+            assert first["xi"] == again["xi"]
+            other = eng.query_scenario(
+                with_overrides(params, lolr_rate=0.2), spec
+            )
+            assert other["scenario_fingerprint"] != first["scenario_fingerprint"]
+        finally:
+            eng.close()
+
+    def test_program_cache_ignores_host_only_knobs(self):
+        """Specs differing only in host-side knobs (lgd, contagion_tol,
+        ...) must share one compiled cell program — a server accepting
+        arbitrary scenario objects cannot compile one executable per
+        wire-supplied float value."""
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        dtype_name = jnp.dtype(
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        ).name
+        a = scenario.engine.batch_fn(
+            ScenarioSpec(banks=2, exposure=((0, 1, 0.5),), lgd=0.5), cfg, dtype_name
+        )
+        b = scenario.engine.batch_fn(
+            ScenarioSpec(banks=3, lgd=0.6, contagion_tol=1e-4), cfg, dtype_name
+        )
+        assert a is b  # same cell-program projection → same cached program
+        assert ScenarioSpec(lgd=0.9).cell_program_spec() == ScenarioSpec()
+
+    def test_multibank_fingerprint_normalizes_shared_params(self):
+        """One shared struct vs an N-list of the same struct is the SAME
+        solve — same fingerprint, same cache key."""
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        p = make_model_params(u=0.05)
+        spec = ScenarioSpec(banks=3)
+        shared = scenario.solve_multibank(spec, p, config=cfg)
+        listed = scenario.solve_multibank(spec, [p, p, p], config=cfg)
+        assert shared.fingerprint == listed.fingerprint
+        with pytest.raises(ValueError, match="params structs"):
+            scenario.solve_multibank(spec, [p, p], config=cfg)
+
+    def test_served_multibank_query(self):
+        from sbr_tpu.serve.engine import Engine
+
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        eng = Engine(config=cfg)
+        try:
+            spec = ScenarioSpec(banks=3, exposure=((0, 1, 0.5), (0, 2, 0.5)))
+            rec = eng.query_scenario(make_model_params(u=0.05), spec)
+            assert rec["banks"] == 3
+            assert len(rec["xi"]) == 3 and len(rec["status"]) == 3
+            assert rec["converged"] in (True, False)
+        finally:
+            eng.close()
+
+    def test_endpoint_policy_knobs_and_interest_over_http(self):
+        """The wire surface: policy knobs are accepted /query parameters,
+        an active modifier actually changes the served answer, r/δ route
+        through interest-typed params for the 'interest' modifier, and an
+        unservable spec × params combination is a 400 (client error), not
+        a retryable 503."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+        from sbr_tpu.serve.engine import Engine
+
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        eng = Engine(config=cfg)
+
+        def post(doc):
+            body = json.dumps(doc).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ep.port}/query", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            return json.loads(urllib.request.urlopen(req).read())
+
+        try:
+            with ServeEndpoint(eng) as ep:
+                plain = post({"u": 0.08, "scenario": {"modifiers": ["insurance_cap"]}})
+                capped = post({
+                    "u": 0.08, "insurance_cap": 0.9,
+                    "scenario": {"modifiers": ["insurance_cap"]},
+                })
+                assert plain["status"] == Status.RUN
+                assert capped["status"] != Status.RUN  # the knob reached the solver
+                assert capped["scenario_fingerprint"] != plain["scenario_fingerprint"]
+
+                interest = post({
+                    "u": 0.05, "r": 0.02, "delta": 0.1,
+                    "scenario": {"modifiers": ["interest"]},
+                })
+                assert "scenario_fingerprint" in interest
+
+                # unservable combination: interest modifier without r/delta
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    post({"u": 0.05, "scenario": {"modifiers": ["interest"]}})
+                assert exc.value.code == 400
+                # r/delta on a PLAIN query would be silently ignored: 400
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    post({"u": 0.05, "r": 0.02})
+                assert exc.value.code == 400
+                # ...and likewise on a scenario WITHOUT the interest
+                # modifier (the composed pipeline would ignore r while
+                # fingerprinting it)
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    post({"u": 0.05, "r": 0.02,
+                          "scenario": {"modifiers": ["insurance_cap"]}})
+                assert exc.value.code == 400
+                # a policy knob without its modifier is equally inert —
+                # equally loud
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    post({"u": 0.05, "insurance_cap": 0.5})
+                assert exc.value.code == 400
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    post({"u": 0.05, "lolr_rate": 0.2,
+                          "scenario": {"modifiers": ["suspension"]}})
+                assert exc.value.code == 400
+        finally:
+            eng.close()
+
+    def test_multibank_exhaustion_reports_solved_kappa(self):
+        """converged=False must still pair kappa_eff with the xi/status it
+        was solved under (re-dispatching at result.kappa_eff reproduces
+        the reported grids)."""
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        plist = [make_model_params(u=0.05), make_model_params(u=0.05, kappa=0.93)]
+        spec = ScenarioSpec(
+            banks=2, exposure=((0, 1, 1.0), (1, 0, 1.0)), lgd=0.9,
+            contagion_max_iter=1,  # force exhaustion after one round
+        )
+        mb = scenario.solve_multibank(spec, plist, config=cfg)
+        assert not mb.converged
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        batch = scenario.engine.batch_fn(ScenarioSpec(), SolverConfig(
+            n_grid=96, bisect_iters=40, refine_crossings=False), jnp.dtype(dtype).name)
+        cols = scenario.multibank._bank_columns(spec, plist, dtype)
+        cols[scenario.SCENARIO_KEYS.index("kappa")] = mb.kappa_eff
+        xi_re, _t, _a, st_re, _h = batch(*cols)
+        assert np.array_equal(np.asarray(mb.status), np.asarray(st_re))
+        assert np.array_equal(np.asarray(mb.xi), np.asarray(xi_re), equal_nan=True)
+
+    def test_scenario_grad_coverage_matrix(self):
+        from sbr_tpu.grad import scenario_xi_and_grad
+
+        cfg = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+        base = make_model_params(u=0.08)
+        g = scenario_xi_and_grad(ScenarioSpec(), base, config=cfg)
+        assert np.isfinite(float(g.grads["beta"]))
+        with pytest.raises(NotImplementedError, match="gradient coverage"):
+            scenario_xi_and_grad(ScenarioSpec(modifiers=("lolr",)), base, config=cfg)
+        with pytest.raises(NotImplementedError, match="gradient coverage"):
+            scenario_xi_and_grad(ScenarioSpec(banks=2), base, config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# History schema 9.
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema9:
+    def test_schema_bumped_and_keys_harvested(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        assert history.SCHEMA == 9
+        result = {
+            "metric": "beta_u_grid_equilibria_per_sec", "value": 100.0,
+            "extra": {
+                "scenario_overhead_ratio": 1.02,
+                "scenario_multibank_cells_per_sec": 512.5,
+            },
+        }
+        m = history.bench_metrics(result)
+        assert m["scenario_overhead_ratio"] == 1.02
+        assert m["scenario_multibank_cells_per_sec"] == 512.5
+        # polarity: overhead is lower-better, throughput higher-better
+        assert history.polarity("scenario_overhead_ratio") == -1
+        assert history.polarity("scenario_multibank_cells_per_sec") == 1
+
+    def test_old_schemas_still_load_and_gate(self, tmp_path):
+        import json
+
+        from sbr_tpu.obs import history
+
+        p = tmp_path / "hist.jsonl"
+        lines = []
+        # one line per historical schema, 1..8, plus a schema-less legacy line
+        lines.append({"metrics": {"eq_per_sec": 10.0}})
+        for s in range(1, 9):
+            lines.append({"schema": s, "platform": "cpu",
+                          "metrics": {"eq_per_sec": 10.0 + s}})
+        p.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+        history.append({"eq_per_sec": 18.5, "scenario_overhead_ratio": 1.0},
+                       platform="cpu", path=p)
+        records = history.load(p)
+        assert len(records) == 10
+        assert records[0]["schema"] == 1  # schema-less stamped as 1
+        assert records[-1]["schema"] == 9
+        verdicts, status = history.check(records)
+        assert status == "ok"
+        assert verdicts["eq_per_sec"]["status"] == "ok"
