@@ -1514,6 +1514,96 @@ def bench_scenario(platform: str) -> dict:
     }
 
 
+def bench_infomodels(platform: str) -> dict:
+    """Information-model workload (ISSUE 15): fused Bayesian belief-update
+    throughput + population what-if query rate.
+
+    Part 1 runs the bayes observer kernel (per-step `_seg_counts` recount
+    + fused `belief_update`) on a device-generated ER graph and reports
+    steady belief-updates/sec (= agent-steps/sec of the bayes channel).
+    Part 2 times end-to-end population ξ-distribution queries (mean-field
+    fixed point shared, S member sims + crossing reduction per query) at
+    the serving query shape. History schema 10; tiny dry-run shapes zero
+    the gated keys so reduced-shape stats never seed a baseline."""
+    import numpy as np
+
+    from sbr_tpu import obs
+    from sbr_tpu.infomodels import InfoModelSpec, population_query
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.social.agents import AgentSimConfig
+    from sbr_tpu.social.graphgen import ErdosRenyiSpec
+
+    if _tiny():
+        n_agents, deg, n_steps = 2_000, 8.0, 20
+        pop_n, pop_seeds, pop_queries = 1_000, 2, 1
+    elif platform == "cpu":
+        n_agents, deg, n_steps = 200_000, 10.0, 60
+        pop_n, pop_seeds, pop_queries = 5_000, 8, 3
+    else:
+        n_agents, deg, n_steps = 2_000_000, 10.0, 100
+        pop_n, pop_seeds, pop_queries = 20_000, 16, 3
+
+    from sbr_tpu.infomodels import simulate_info
+
+    spec = InfoModelSpec(channel="bayes")
+    graph = ErdosRenyiSpec(n=n_agents, avg_degree=deg)
+    cfg = AgentSimConfig(n_steps=n_steps, dt=0.05, reentry_delay=3.0)
+
+    def sim():
+        r = simulate_info(spec, graph, x0=0.01, config=cfg, seed=1)
+        float(np.asarray(r.informed_frac)[-1])  # device→host fence
+        return r
+
+    t0 = time.perf_counter()
+    sim()  # compile + graph build
+    first_s = time.perf_counter() - t0
+    with obs.suspended(), obs.mem.live_disabled():
+        steady_s = min(_timed(sim) for _ in range(2))
+    updates_per_sec = n_agents * n_steps / steady_s if steady_s > 0 else 0.0
+
+    # Population queries: distinct seeds so no layer can answer from a
+    # warm record — this times the full solve+simulate+reduce path.
+    model = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99,
+                              kappa=0.25, lam=0.25)
+    pop_graph = ErdosRenyiSpec(n=pop_n, avg_degree=10.0)
+    pop_cfg = SolverConfig(n_grid=256)
+    rec0 = population_query(  # warm-up: compiles + the shared fixed point
+        spec, pop_graph, model, seeds=pop_seeds, vary="sim", g0=None,
+        config=pop_cfg,
+    )
+    with obs.suspended(), obs.mem.live_disabled():
+        t0 = time.perf_counter()
+        for q in range(pop_queries):
+            population_query(
+                spec, pop_graph, model, seeds=pop_seeds, vary="sim",
+                seed=10_000 + q, g0=None, config=pop_cfg,
+            )
+        pop_s = time.perf_counter() - t0
+    queries_per_sec = pop_queries / pop_s if pop_s > 0 else 0.0
+
+    _log(
+        f"infomodels: {n_agents} agents x {n_steps} belief steps in "
+        f"{steady_s:.3f}s steady ({updates_per_sec:.0f} updates/s, "
+        f"{first_s:.1f}s first incl. compile); {pop_queries} population "
+        f"quer(ies) x {pop_seeds} seeds @ {pop_n} agents in {pop_s:.3f}s "
+        f"({queries_per_sec:.2f} q/s, run_p={rec0['run_probability']:.2f})"
+    )
+    return {
+        "infomodel_agents": n_agents,
+        "infomodel_steps": n_steps,
+        "infomodel_first_call_s": round(first_s, 2),
+        "infomodel_steady_s": round(steady_s, 4),
+        "infomodel_belief_updates_per_sec": (
+            0.0 if _tiny() else round(updates_per_sec, 1)
+        ),
+        "infomodel_population_queries_per_sec": (
+            0.0 if _tiny() else round(queries_per_sec, 4)
+        ),
+        "infomodel_population_seeds": pop_seeds,
+        "infomodel_population_run_probability": rec0["run_probability"],
+    }
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -1635,6 +1725,20 @@ def _measure_inner(platform: str) -> None:
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in scen.items() if v is not None},
         )
+    try:
+        with obs.span("bench.infomodels"):
+            info = bench_infomodels(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the information-model workload fails.
+        _log(f"infomodels bench failed: {err!r}")
+        info = None
+    if info is not None:
+        obs.event(
+            "bench_infomodels",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in info.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -1744,6 +1848,21 @@ def _measure_inner(platform: str) -> None:
         out["extra"]["scenario_multibank_banks"] = scen["scenario_multibank_banks"]
         out["extra"]["scenario_multibank_converged"] = scen[
             "scenario_multibank_converged"
+        ]
+    if info is not None:
+        # Schema-10 history metrics (ISSUE 15): fused belief-update
+        # throughput + population what-if query rate. Tiny shapes zero
+        # the gated keys (falsy → dropped here) so reduced-shape stats
+        # never seed baselines.
+        for k in (
+            "infomodel_belief_updates_per_sec",
+            "infomodel_population_queries_per_sec",
+        ):
+            if info.get(k):
+                out["extra"][k] = info[k]
+        out["extra"]["infomodel_agents"] = info["infomodel_agents"]
+        out["extra"]["infomodel_population_run_probability"] = info[
+            "infomodel_population_run_probability"
         ]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()

@@ -1,0 +1,46 @@
+"""Information-model engine (ISSUE 15): Bayesian withdrawal-observers,
+panic rewiring, per-agent heterogeneity — an information-model algebra
+mirroring `sbr_tpu.scenario`'s stage algebra, with every model honoring
+the close-the-loop contract and servable as a population-level what-if
+product. See `infomodels.spec` for the axes."""
+
+from sbr_tpu.infomodels.engine import InfoSimResult, simulate_info
+from sbr_tpu.infomodels.meanfield import (
+    info_learning_curve,
+    observed_fraction,
+    solve_fixed_point_info,
+)
+from sbr_tpu.infomodels.population import (
+    MAX_POP_SEEDS,
+    crossing_times,
+    parse_population_doc,
+    population_fingerprint,
+    population_query,
+)
+from sbr_tpu.infomodels.spec import (
+    CHANNELS,
+    DYNAMICS,
+    INFOMODEL_PROGRAM_VERSION,
+    InfoModelSpec,
+    default_spec,
+    infomodel_fingerprint,
+)
+
+__all__ = [
+    "CHANNELS",
+    "DYNAMICS",
+    "INFOMODEL_PROGRAM_VERSION",
+    "InfoModelSpec",
+    "InfoSimResult",
+    "MAX_POP_SEEDS",
+    "crossing_times",
+    "default_spec",
+    "infomodel_fingerprint",
+    "info_learning_curve",
+    "observed_fraction",
+    "parse_population_doc",
+    "population_fingerprint",
+    "population_query",
+    "simulate_info",
+    "solve_fixed_point_info",
+]

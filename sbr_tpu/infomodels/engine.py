@@ -1,0 +1,413 @@
+"""Agent-level simulation of information models (ISSUE 15 tentpole).
+
+`simulate_info(spec, graph, ...)` runs N explicit agents under an
+`InfoModelSpec` on a device-generated graph (`social.graphgen` spec):
+
+- **gossip × static** delegates to the legacy engines wholesale
+  (`prepare_generated_graph` + `simulate_agents`) — the group-free spec
+  is BIT-IDENTICAL to the pre-0.10 `social.agents` trajectory by
+  construction (the CI ``infomodel-parity`` gate pins it across
+  {gather, incremental} × {f32, f64} × {lax, interpret} fused modes),
+  and K-group specs ride the same engines with per-agent β_i drawn from
+  the group table (per-agent β was always the engines' native form).
+- **bayes** runs the belief kernel: one `lax.scan` whose per-step tail
+  is `social.fused.belief_update` (lax / Pallas / interpret lowerings —
+  the fused step path at mega-agent shapes), with the withdrawn-neighbor
+  counts from the same `_seg_counts` prefix-sum reduction the gossip
+  engines use. Beliefs are deterministic given the per-agent threshold
+  draws, so there is no per-step RNG at all — the whole run's randomness
+  is the graph + seeds + one counter-RNG block per agent at init
+  (`_agent_fields`).
+- **dynamics="rewire"** wraps either channel in a host-level epoch loop:
+  each epoch regenerates the edge set born-dst-sorted via
+  `graphgen.generate_tilted_sources` with the source conditional tilted
+  toward the CURRENT withdrawing agents (`tilt_threshold_table`), then
+  runs ``epoch_steps`` simulation steps carrying (informed, t_inf[,
+  belief]) across the boundary. Step indices are global, so the gossip
+  RNG stream continues across epochs exactly like launch chunking.
+
+Per-agent heterogeneity (thresholds / awareness / group ids) is drawn on
+device from the counter RNG keyed by SeedSequence((seed, 31)) — pure in
+(seed, agent id): deterministic in-process, cross-process, and identical
+under any future sharding of the belief kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sbr_tpu.infomodels.spec import InfoModelSpec
+from sbr_tpu.social.agents import (
+    AgentSimConfig,
+    PreparedAgentGraph,
+    _draw_seeds,
+    _seg_counts,
+    _withdrawn,
+    simulate_agents,
+)
+from sbr_tpu.social.fused import belief_update, resolve_belief_mode
+from sbr_tpu.social.graphgen import (
+    ErdosRenyiSpec,
+    ScaleFreeSpec,
+    _check_edges,
+    epoch_indegrees,
+    epoch_key_words,
+    generate_tilted_sources,
+    prepare_generated_graph,
+    tilt_threshold_table,
+)
+from sbr_tpu.social.rng import _threefry2x32, _uniform_from_bits
+
+
+@dataclasses.dataclass(frozen=True, eq=False)  # eq=False: device-array fields
+class InfoSimResult:
+    """Population trajectories + final per-agent state of one info-model
+    run — the `AgentSimResult` shape plus the belief channel's state and
+    the rewiring epoch count."""
+
+    t_grid: object  # (n_steps,)
+    informed_frac: object  # (n_steps,)
+    withdrawn_frac: object  # (n_steps,)
+    informed: object  # (N,) bool, final
+    t_inf: object  # (N,) informed times
+    belief: Optional[object] = None  # (N,) final log-odds evidence (bayes)
+    epochs: int = 1  # distinct graphs the run saw (1 = static)
+    agent_steps: int = 0
+    belief_updates: int = 0  # N·steps through belief_update (bayes only)
+
+    def __repr__(self) -> str:
+        from sbr_tpu.models.results import _fmt
+
+        return (
+            f"InfoSimResult(N={self.informed.shape[-1]}, "
+            f"steps={self.t_grid.shape[-1]}, epochs={self.epochs}, "
+            f"final_G={_fmt(self.informed_frac[-1], 4)}, "
+            f"final_AW={_fmt(self.withdrawn_frac[-1], 4)})"
+        )
+
+
+def _agent_fields(spec: InfoModelSpec, n: int, seed: int, beta: float, dtype):
+    """Per-agent (betas, thresholds, awareness) device arrays from the
+    K-group table — one Threefry block per agent keyed by
+    SeedSequence((seed, 31)): word 0 draws the group, word 1 the private
+    logistic threshold offset. Homogeneous specs skip the group draw but
+    keep the threshold noise (it is what smooths the population curve
+    into the mean-field CDF)."""
+    weights, thresholds, awareness = spec.group_table()
+    k0, k1 = np.random.SeedSequence((seed, 31)).generate_state(2, np.uint32)
+    ids = jnp.arange(n, dtype=jnp.uint32)
+    x0w, x1w = _threefry2x32(jnp.uint32(k0), jnp.uint32(k1), ids, jnp.zeros_like(ids))
+    u_thr = _uniform_from_bits(x1w, x0w, jnp.float32)
+    if len(weights) > 1:
+        u_grp = _uniform_from_bits(x0w, x1w, jnp.float32)
+        cum = jnp.asarray(np.cumsum(weights[:-1]), jnp.float32)
+        grp = jnp.searchsorted(cum, u_grp, side="right").astype(jnp.int32)
+    else:
+        grp = jnp.zeros(n, jnp.int32)
+    thr_g = jnp.asarray(np.asarray(thresholds), dtype)
+    a_g = jnp.asarray(np.asarray(awareness), dtype)
+    eps = jnp.float32(2.0**-23)
+    u_c = jnp.clip(u_thr, eps, 1.0 - eps)
+    noise = jnp.log(u_c / (1.0 - u_c)).astype(dtype)
+    thr = thr_g[grp] + jnp.asarray(spec.threshold_scale, dtype) * noise
+    aware = a_g[grp]
+    # Gossip β scaling is RELATIVE: a_k/⟨a⟩ (dist-weighted mean), so the
+    # homogeneous scalar awareness — a bayes evidence-rate knob whose
+    # default is calibrated for the observer cascade — cancels entirely
+    # and a bias-0 rewire of the default spec matches the static
+    # trajectory in distribution instead of silently tripling β. Group
+    # specs keep their relative intake (`from_hetero_params` emits
+    # mean-1 awareness already). The bayes channel consumes ``aware``
+    # raw — there the scalar IS the evidence rate.
+    mean_a = float(sum(w * a for w, a in zip(weights, awareness)))
+    betas = (jnp.asarray(beta, dtype) * aware / mean_a).astype(dtype)
+    return betas, thr, aware
+
+
+@functools.lru_cache(maxsize=None)
+def _bayes_sim(config: AgentSimConfig, mode: str):
+    """Event-free Bayesian observer kernel (single device): per step one
+    `_seg_counts` recount over the dst-sorted edges plus one fused
+    `belief_update`. The scan carry is (informed, t_inf, belief)."""
+    dt = config.dt
+
+    @jax.jit
+    def run(src, row_ptr, indeg, awareness, thr, llr01, informed0, t_init,
+            belief0, k0):
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("infomodels.bayes_sim")
+        dtype = awareness.dtype
+        t_inf0 = jnp.where(informed0, t_init, jnp.inf).astype(dtype)
+        safe_deg = jnp.maximum(indeg, 1.0)
+
+        def step(carry, k):
+            informed, t_inf, belief = carry
+            t = k.astype(dtype) * dt
+            wd = _withdrawn(informed, t_inf, t, config.exit_delay, config.reentry_delay)
+            counts = _seg_counts(wd[src], row_ptr)
+            informed2, t_inf2, belief2 = belief_update(
+                informed, t_inf, belief, counts, awareness, safe_deg, thr,
+                t, dt, llr01[0], llr01[1], mode,
+            )
+            obs_t = (jnp.mean(informed.astype(dtype)), jnp.mean(wd.astype(dtype)))
+            return (informed2, t_inf2, belief2), obs_t
+
+        (informed, t_inf, belief), (gs, aws) = lax.scan(
+            step, (informed0, t_inf0, belief0), jnp.arange(config.n_steps) + k0
+        )
+        return gs, aws, informed, t_inf, belief
+
+    return run
+
+
+def _gather_prepared(n, e, betas, src, row_ptr, indeg, dtype) -> PreparedAgentGraph:
+    """Package epoch arrays as a gather-engine `PreparedAgentGraph` so the
+    rewired gossip epochs ride `simulate_agents` (and every fused-mode /
+    resume contract it carries) unchanged."""
+    return PreparedAgentGraph(
+        n=n, n_gl=n, n_pad=0, n_edges=e, dtype=np.dtype(dtype), mesh=None,
+        mesh_axis="agents", comm="scatter", engine="gather", budget=0,
+        max_degree=64, betas=betas, src=src, row_ptr=row_ptr,
+        indeg=indeg, inc=None,
+    )
+
+
+def _base_source_weights(graph, dtype):
+    """The base SOURCE marginal the panic tilt multiplies: uniform for
+    Erdős–Rényi, the Chung–Lu power-law weights for scale-free. SBM's
+    source law conditions on the destination's block — not expressible
+    as a marginal table — so rewiring rejects it loudly."""
+    if isinstance(graph, ErdosRenyiSpec):
+        return jnp.ones(graph.n, dtype)
+    if isinstance(graph, ScaleFreeSpec):
+        w = np.arange(1, graph.n + 1, dtype=np.float64) ** (
+            -1.0 / (graph.gamma - 1.0)
+        )
+        return jnp.asarray(w / w.sum(), dtype)
+    raise ValueError(
+        f"dynamics='rewire' supports ErdosRenyiSpec/ScaleFreeSpec base "
+        f"graphs (the SBM source law conditions on the destination block); "
+        f"got {type(graph).__name__}"
+    )
+
+
+def simulate_info(
+    spec: InfoModelSpec,
+    graph,
+    beta: float = 0.9,
+    x0: float = 1e-4,
+    config: AgentSimConfig = AgentSimConfig(),
+    seed: int = 0,
+    dtype=np.float32,
+    engine: str = "auto",
+    exact_seeds: bool = False,
+    informed0=None,
+    t_inf0=None,
+    chunk_edges=None,
+    prepared: Optional[PreparedAgentGraph] = None,
+    belief0=None,
+) -> InfoSimResult:
+    """Simulate N explicit agents under information model ``spec`` on the
+    device-generated graph ``graph`` (a `social.graphgen` spec).
+
+    ``beta`` is the gossip learning rate (per-agent β_i = β·awareness_i
+    under K-group heterogeneity); the bayes channel ignores it (evidence
+    rates live in the spec). ``config`` carries the step grid and the
+    equilibrium withdrawal window exactly as for `simulate_agents`;
+    ``config.fused`` selects the fused lowering for BOTH channels (the
+    belief kernel maps "unfused" to its lax form — same arithmetic).
+
+    ``prepared`` (static dynamics only — rewiring regenerates per epoch
+    by design and rejects it loudly): a `PreparedAgentGraph` to reuse
+    across calls — the seeds-axis population sweep's way of not
+    re-preparing the graph per member (`closure.close_loop(seeds=...)`).
+    For the gossip channel it must carry the spec's per-agent β (the
+    caller prepares with `_agent_fields` betas; `close_loop` does).
+
+    Returns an `InfoSimResult`; for the gossip-reducible spec the
+    (t_grid, fractions, informed, t_inf) fields are bit-identical to
+    `simulate_agents` on the same prepared graph (tested)."""
+    n = graph.n
+    dtype = np.dtype(dtype)
+    weights, thresholds, awareness = spec.group_table()
+    hetero = len(weights) > 1
+    if prepared is not None and spec.dynamics == "rewire":
+        raise ValueError(
+            "prepared= conflicts with dynamics='rewire': rewiring "
+            "regenerates the edge set per epoch — there is no reusable "
+            "graph object"
+        )
+    if belief0 is not None and spec.channel != "bayes":
+        raise ValueError("belief0= only applies to channel='bayes'")
+
+    from sbr_tpu import obs
+
+    if spec.channel == "gossip" and spec.dynamics == "static":
+        if prepared is not None:
+            pg = prepared
+        else:
+            if hetero:
+                betas_d, _, _ = _agent_fields(spec, n, seed, beta, dtype)
+                betas_arg = np.asarray(betas_d)
+            else:
+                betas_arg = beta
+            pg = prepare_generated_graph(
+                graph, seed=seed, betas=betas_arg, config=config, dtype=dtype,
+                engine=engine, chunk_edges=chunk_edges,
+            )
+        r = simulate_agents(
+            prepared=pg, x0=x0, config=config, seed=seed,
+            exact_seeds=exact_seeds, informed0=informed0, t_inf0=t_inf0,
+        )
+        return InfoSimResult(
+            t_grid=r.t_grid, informed_frac=r.informed_frac,
+            withdrawn_frac=r.withdrawn_frac, informed=r.informed,
+            t_inf=r.t_inf, belief=None, epochs=1,
+            agent_steps=r.agent_steps,
+        )
+
+    betas_d, thr_d, aware_d = _agent_fields(spec, n, seed, beta, dtype)
+    mode = resolve_belief_mode(config.fused, dtype)
+    llr01 = jnp.asarray(spec.llr, dtype)
+
+    if informed0 is None:
+        informed0 = _draw_seeds(np.random.default_rng(seed), n, x0, exact_seeds)
+    informed_c = jnp.asarray(np.asarray(informed0, dtype=bool))
+    if t_inf0 is None:
+        t_init_c = jnp.zeros(n, dtype)
+    else:
+        t_init_c = jnp.asarray(np.asarray(t_inf0, dtype=dtype))
+
+    if spec.dynamics == "static":
+        pg = prepared
+        if pg is None:
+            pg = prepare_generated_graph(
+                graph, seed=seed, betas=1.0, config=config, dtype=dtype,
+                engine="gather", chunk_edges=chunk_edges,
+            )
+        belief_init = (
+            jnp.asarray(np.broadcast_to(np.asarray(belief0, dtype), (n,)))
+            if belief0 is not None
+            else jnp.zeros(n, dtype)
+        )
+        run = _bayes_sim(_normalize(config), mode)
+        gs, aws, informed, t_inf, belief = run(
+            pg.src, pg.row_ptr, pg.indeg, aware_d, thr_d, llr01,
+            informed_c, t_init_c, belief_init, jnp.int32(0),
+        )
+        t_grid = jnp.arange(config.n_steps).astype(gs.dtype) * config.dt
+        if obs.enabled():
+            obs.log_infomodel(
+                "belief_census", channel="bayes", dynamics="static",
+                crossed=int(jnp.sum(informed)) - int(jnp.sum(informed_c)),
+                mean_belief=float(jnp.mean(belief)),
+                max_belief=float(jnp.max(belief)),
+            )
+        return InfoSimResult(
+            t_grid=t_grid, informed_frac=gs, withdrawn_frac=aws,
+            informed=informed, t_inf=t_inf, belief=belief, epochs=1,
+            agent_steps=n * config.n_steps,
+            belief_updates=n * config.n_steps,
+        )
+
+    # -- panic rewiring: host epoch loop over regenerated graphs ------------
+    e = _check_edges(graph.edge_count(seed))
+    base_w = _base_source_weights(graph, jnp.float32)
+    n_steps = config.n_steps
+    epoch_steps = spec.epoch_steps
+    belief_c = (
+        jnp.asarray(np.broadcast_to(np.asarray(belief0, dtype), (n,)))
+        if belief0 is not None
+        else jnp.zeros(n, dtype)
+    )
+    t_inf_c = jnp.where(informed_c, t_init_c, jnp.inf).astype(dtype)
+    gs_parts, aws_parts = [], []
+    done = 0
+    n_epochs = 0
+    bayes_run = _bayes_sim if spec.channel == "bayes" else None
+    while done < n_steps:
+        this_len = min(epoch_steps, n_steps - done)
+        t_now = done * config.dt
+        wd_now = _withdrawn(
+            informed_c, t_inf_c, jnp.asarray(t_now, dtype),
+            config.exit_delay, config.reentry_delay,
+        )
+        thr_table = tilt_threshold_table(base_w, wd_now, spec.rewire_bias)
+        src_ep = generate_tilted_sources(
+            n, e, epoch_key_words(seed, n_epochs), thr_table, chunk_edges
+        )
+        indeg_h = epoch_indegrees(graph, seed, n_epochs, e)
+        row_ptr = jnp.asarray(
+            np.concatenate([[0], np.cumsum(indeg_h)]).astype(np.int32)
+        )
+        indeg_d = jnp.asarray(indeg_h.astype(dtype))
+        cfg_ep = dataclasses.replace(
+            config, n_steps=this_len, max_steps_per_launch=None
+        )
+        if spec.channel == "gossip":
+            pg = _gather_prepared(n, e, betas_d, src_ep, row_ptr, indeg_d, dtype)
+            part = simulate_agents(
+                prepared=pg, config=cfg_ep, seed=seed, informed0=informed_c,
+                t_inf0=jnp.where(jnp.isfinite(t_inf_c), t_inf_c, 0.0).astype(dtype),
+                step_offset=done,
+            )
+            # carry: simulate_agents returns t_inf with inf for never-informed
+            informed_c, t_inf_c = part.informed, part.t_inf
+            gs_parts.append(part.informed_frac)
+            aws_parts.append(part.withdrawn_frac)
+        else:
+            run = bayes_run(_normalize(cfg_ep), mode)
+            gs, aws, informed_c, t_inf_full, belief_c = run(
+                src_ep, row_ptr, indeg_d, aware_d, thr_d, llr01,
+                informed_c, jnp.where(jnp.isfinite(t_inf_c), t_inf_c, 0.0).astype(dtype),
+                belief_c, jnp.int32(done),
+            )
+            t_inf_c = t_inf_full
+            gs_parts.append(gs)
+            aws_parts.append(aws)
+        if obs.enabled():
+            obs.log_infomodel(
+                "rewire_epoch", epoch=n_epochs, channel=spec.channel,
+                steps=this_len, edges=e,
+                withdrawing=int(jnp.sum(wd_now)),
+            )
+        done += this_len
+        n_epochs += 1
+        # scalar fence per epoch boundary (the launch-chunking discipline)
+        float(gs_parts[-1][-1])
+    t_grid = jnp.arange(n_steps).astype(gs_parts[0].dtype) * config.dt
+    if spec.channel == "bayes" and obs.enabled():
+        obs.log_infomodel(
+            "belief_census", channel="bayes", dynamics="rewire",
+            crossed=int(jnp.sum(informed_c)),
+            mean_belief=float(jnp.mean(belief_c)),
+            max_belief=float(jnp.max(belief_c)),
+        )
+    return InfoSimResult(
+        t_grid=t_grid,
+        informed_frac=jnp.concatenate(gs_parts),
+        withdrawn_frac=jnp.concatenate(aws_parts),
+        informed=informed_c,
+        t_inf=t_inf_c,
+        belief=belief_c if spec.channel == "bayes" else None,
+        epochs=n_epochs,
+        agent_steps=n * n_steps,
+        belief_updates=n * n_steps if spec.channel == "bayes" else 0,
+    )
+
+
+def _normalize(config: AgentSimConfig) -> AgentSimConfig:
+    """Drop fields the bayes kernel ignores from the lru key (a non-None
+    launch cap or engine knobs must not compile duplicate programs)."""
+    return dataclasses.replace(
+        config, max_steps_per_launch=None, compact_impl="searchsorted",
+        rng_stream="counter", fused="auto",
+    )

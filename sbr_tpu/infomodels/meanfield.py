@@ -1,0 +1,315 @@
+"""Mean-field fixed points for information models (ISSUE 15).
+
+The tier-1 contract: EVERY information model closes the loop against a
+solver curve the way the gossip channel always has — the agent
+simulation's (G, AW) trajectories converge (in N, dense-graph limit) to
+the curves of a mean-field fixed point. The gossip channel's fixed point
+IS `social.solver.solve_equilibrium_social` (exact reduction, reused
+verbatim); the other cells of the (channel × dynamics × heterogeneity)
+matrix get their fixed point here, built from the same damped outer
+iteration with the Stage-1 learning law generalized:
+
+- **gossip × K-groups**: the forced ODE dG_k/dt = (1−G_k)·β·a_k·AW(t)
+  is separable per group exactly like the homogeneous law, so
+  G(t) = Σ_k w_k·[1 − (1−x0)·exp(−β·a_k·A(t))] with A = ∫AW.
+- **bayes**: the evidence integral Λ(t) = ∫ llr(w_obs(s)) ds is shared
+  by every agent in the dense limit (the observed withdrawn-neighbor
+  fraction concentrates on the population value); an agent crosses when
+  awareness·Λ first exceeds its private threshold, so with
+  θ ~ Logistic(θ_k, s) the informed share is the closed form
+  G(t) = x0 + (1−x0)·Σ_k w_k·σ((a_k·M(t) − θ_k)/s), M = running max Λ
+  (first crossing ⟺ running-max threshold — crossing is absorbing).
+- **rewire dynamics**: per-epoch regeneration with source tilt
+  p(src=j) ∝ (1 + b·wd_j) biases the OBSERVED withdrawal fraction to
+  w_obs = AW·(1+b)/(1 + b·AW) > AW — the mean-field face of "attention
+  concentrates on withdrawing neighbors". Static specs have
+  w_obs = AW. The tilt is exact in the dense limit for per-epoch
+  redraws; within an epoch it is frozen at the epoch-start mask, so
+  this curve is the EPOCH→0 limit and closure error scales with
+  epoch_steps·dt relative to the run window (measured: gossip rewire
+  sup 0.15→0.34 from epoch 0.2→1.0 time units at window ~1.5; the
+  bayes window is short — ξ≈0.4 at the defaults — so bayes rewire
+  needs epoch_steps·dt ≲ 0.05, where it closes to err_g_rms ~0.006;
+  a stale-epoch bayes cascade can genuinely die where this curve
+  runs).
+
+Everything downstream of Stage 1 — the inner baseline equilibrium, the
+no-run ξ-march, `get_aw`, damping, history ring, health — is the social
+solver's structure unchanged, so the returned `SocialFixedPointResult`
+drops into `closure.close_loop`'s comparison machinery as-is.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sbr_tpu.baseline.solver import get_aw, solve_equilibrium_core
+from sbr_tpu.core.integrate import cumtrapz
+from sbr_tpu.infomodels.spec import InfoModelSpec
+from sbr_tpu.models.params import ModelParams, SolverConfig
+from sbr_tpu.models.results import LearningSolution
+from sbr_tpu.social.solver import (
+    HISTORY_LEN,
+    SocialFixedPointResult,
+    _log_fixed_point,
+    _LoopState,
+)
+
+
+def observed_fraction(aw, spec: InfoModelSpec):
+    """The withdrawn fraction an agent OBSERVES among its in-neighbors,
+    given the population fraction ``aw`` — identity for static graphs,
+    the attention tilt AW·(1+b)/(1+b·AW) under panic rewiring."""
+    if spec.dynamics != "rewire" or spec.rewire_bias == 0.0:
+        return aw
+    b = spec.rewire_bias
+    return aw * (1.0 + b) / (1.0 + b * aw)
+
+
+def info_learning_curve(
+    spec: InfoModelSpec, beta, aw_samples, grid, x0
+) -> LearningSolution:
+    """Stage 1 of the info fixed point: the population learning curve
+    (CDF/PDF on ``grid``) induced by forcing ``aw_samples`` under
+    ``spec``'s channel/heterogeneity/dynamics (module docstring for the
+    laws). The gossip × homogeneous × static case is algebraically
+    `social.dynamics.solve_forced_learning` (same cumtrapz + exp)."""
+    dtype = jnp.asarray(aw_samples).dtype
+    beta = jnp.asarray(beta, dtype)
+    x0 = jnp.asarray(x0, dtype)
+    dt = grid[1] - grid[0]
+    weights, thresholds, awareness = spec.group_table()
+    w_obs = observed_fraction(aw_samples, spec)
+    if spec.channel == "gossip":
+        big_a = cumtrapz(w_obs, dx=dt)
+        cdf = jnp.zeros_like(w_obs)
+        pdf = jnp.zeros_like(w_obs)
+        # relative intake a_k/⟨a⟩ — the scalar awareness cancels in the
+        # gossip channel (see `engine._agent_fields`), so the homogeneous
+        # law is EXACTLY the legacy forced ODE at β regardless of the
+        # bayes-calibrated awareness default
+        mean_a = sum(w * a for w, a in zip(weights, awareness))
+        for wk, _, ak in zip(weights, thresholds, awareness):
+            g_k = 1.0 - (1.0 - x0) * jnp.exp(-beta * (ak / mean_a) * big_a)
+            cdf = cdf + wk * g_k
+            pdf = pdf + wk * (1.0 - g_k) * beta * (ak / mean_a) * w_obs
+        eff_beta = beta
+    else:
+        llr0, llr1 = spec.llr
+        llr = w_obs * llr1 + (1.0 - w_obs) * llr0
+        lam = cumtrapz(llr, dx=dt)
+        m = lax.cummax(lam)
+        # dM/dt: positive llr while the integral sits at its running max
+        mdot = jnp.where(lam >= m, jnp.maximum(llr, 0.0), 0.0)
+        s = spec.threshold_scale
+        cdf = jnp.zeros_like(w_obs)
+        pdf = jnp.zeros_like(w_obs)
+        for wk, tk, ak in zip(weights, thresholds, awareness):
+            z = (ak * m - tk) / s
+            sig = jax.nn.sigmoid(z)
+            cdf = cdf + wk * sig
+            pdf = pdf + wk * sig * (1.0 - sig) * (ak / s) * mdot
+        cdf = x0 + (1.0 - x0) * cdf
+        pdf = (1.0 - x0) * pdf
+        eff_beta = jnp.asarray(spec.awareness, dtype)
+    return LearningSolution(
+        grid=grid, cdf=cdf, pdf=pdf, t0=grid[0], dt=dt, beta=eff_beta,
+        x0=x0, closed_form=False,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_info_fixed_point(
+    spec: InfoModelSpec, config: SolverConfig, tol: float, max_iter: int,
+    damping: float,
+):
+    """Jitted info fixed point, cached per (spec, numerics config) — the
+    social solver's damped while_loop with Stage 1 swapped for
+    `info_learning_curve` (plain damping; the Anderson acceleration stays
+    a legacy-stack specialization, same policy as the composed-scenario
+    fixed point)."""
+
+    @jax.jit
+    def run(beta, x0, u, p, kappa, lam_, eta, grid):
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("infomodels.fixed_point")
+        dtype = grid.dtype
+        tol_ = jnp.asarray(tol, dtype=dtype)
+        alpha = jnp.asarray(damping, dtype=dtype)
+
+        def step(aw, xi_prev):
+            ls = info_learning_curve(spec, beta, aw, grid, x0)
+            res = solve_equilibrium_core(ls, u, p, kappa, lam_, eta, eta, config)
+            xi_new = jnp.where(res.bankrun, res.xi, xi_prev + eta / 500.0)
+            exceeded = jnp.logical_and(~res.bankrun, xi_new > eta)
+            aw_new, _, _ = get_aw(
+                xi_new, res.tau_bar_in_unc, res.tau_bar_out_unc, grid, ls
+            )
+            # `get_aw` adds the reference's permanent +G(0) "initial
+            # withdrawals" offset — a documented O(x0) bias in the legacy
+            # model (closure module docstring) that the branch terms
+            # already cover WITH re-entry. For observer models the t=0
+            # cohort is the panic-prone threshold tail (G(0) ~ 0.1, not
+            # 1e-4): keeping the offset both double-counts the cohort
+            # while its window is open and parks it in AW forever after —
+            # inflating the fixed point's own forcing. Drop it: the info
+            # fixed point iterates on the honestly WINDOWED aggregate,
+            # which is also the quantity the agent population realizes.
+            aw_new = aw_new - ls.cdf[0]
+            return ls, res, xi_new, exceeded, aw_new
+
+        def cond(s: _LoopState):
+            return (s.it < max_iter) & (~s.converged) & (~s.aborted)
+
+        def body(s: _LoopState):
+            ls, res, xi_new, exceeded, aw_new = step(s.aw, s.xi)
+            err = jnp.max(jnp.abs(aw_new - s.aw))
+            conv = jnp.logical_and(err < tol_, ~exceeded)
+            aw_next = jnp.where(conv, aw_new, (1.0 - alpha) * s.aw + alpha * aw_new)
+            aw_next = jnp.where(exceeded, s.aw, aw_next)
+            slot = jnp.mod(s.it, HISTORY_LEN)
+            return _LoopState(
+                aw=aw_next, xi=xi_new, it=s.it + 1, converged=conv,
+                aborted=exceeded, err=err,
+                hist_err=s.hist_err.at[slot].set(err),
+                hist_xi=s.hist_xi.at[slot].set(xi_new),
+                res=res, ls=ls, prev_aw=s.prev_aw, prev_r=s.prev_r,
+            )
+
+        # Channel-matched bootstrap. Gossip: the word-of-mouth logistic at
+        # the awareness-weighted rate — the legacy solver's init; a
+        # zero-forcing init would be the DEGENERATE no-information fixed
+        # point (AW ≡ x0 reproduces itself exactly and the loop "converges"
+        # at iteration 1 with no run — observed, not hypothetical). Bayes:
+        # the zero-evidence curve, which already carries the panic-prone
+        # instant cohort (σ(−θ_k/s) mass) — exactly the bootstrap seed the
+        # observer cascade needs, where word-of-mouth has no meaning.
+        if spec.channel == "gossip":
+            from sbr_tpu.baseline.learning import logistic_cdf
+
+            # awareness is relative in the gossip channel (mean 1), so
+            # the word-of-mouth bootstrap runs at the bare β
+            aw0 = logistic_cdf(grid, beta, x0)
+        else:
+            aw0 = info_learning_curve(spec, beta, jnp.zeros_like(grid), grid, x0).cdf
+        shapes = jax.eval_shape(lambda a, x: step(a, x)[:2], aw0, jnp.zeros((), dtype))
+        ls0, res0 = jax.tree_util.tree_map(
+            lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes
+        )
+        init = _LoopState(
+            aw=aw0,
+            xi=jnp.zeros((), dtype),
+            it=jnp.zeros((), jnp.int32),
+            converged=jnp.zeros((), bool),
+            aborted=jnp.zeros((), bool),
+            err=jnp.asarray(jnp.inf, dtype),
+            hist_err=jnp.full((HISTORY_LEN,), jnp.nan, dtype),
+            hist_xi=jnp.full((HISTORY_LEN,), jnp.nan, dtype),
+            res=res0,
+            ls=ls0,
+            prev_aw=jnp.zeros_like(aw0),
+            prev_r=jnp.zeros_like(aw0),
+        )
+        final = jax.lax.while_loop(cond, body, init)
+
+        from sbr_tpu.diag.health import FP_ABORTED, FP_NOT_CONVERGED, NAN_OUTPUT, Health
+
+        not_conv = (~final.converged) & (~final.aborted)
+        fp_flags = (
+            jnp.where(not_conv, jnp.int32(FP_NOT_CONVERGED), jnp.int32(0))
+            | jnp.where(final.aborted, jnp.int32(FP_ABORTED), jnp.int32(0))
+            | jnp.where(
+                jnp.any(~jnp.isfinite(final.aw)), jnp.int32(NAN_OUTPUT), jnp.int32(0)
+            )
+        )
+        nan = jnp.asarray(jnp.nan, dtype)
+        fp_health = Health(
+            residual=final.err, bracket_width=nan,
+            iterations=final.it, flags=fp_flags,
+        )
+        return SocialFixedPointResult(
+            equilibrium=final.res,
+            learning=final.ls,
+            aw=final.aw,
+            grid=grid,
+            xi=final.xi,
+            iterations=final.it,
+            converged=final.converged,
+            aborted=final.aborted,
+            error=final.err,
+            history_err=final.hist_err,
+            history_xi=final.hist_xi,
+            health=final.res.health.merge(fp_health),
+        )
+
+    return run
+
+
+def solve_fixed_point_info(
+    spec: InfoModelSpec,
+    model: ModelParams,
+    config: SolverConfig | None = None,
+    tol: float = 1e-4,
+    max_iter: int = 250,
+    damping: float = 0.5,
+    dtype=None,
+) -> SocialFixedPointResult:
+    """Solve the mean-field fixed point of ``spec`` at ``model``'s
+    economics — the solver curve every `close_loop(infomodel=spec)` run
+    compares against. A gossip-reducible spec dispatches to the legacy
+    `solve_equilibrium_social` (EXACT reduction — same program, same
+    bits); everything else runs the generalized Stage-1 iteration."""
+    from sbr_tpu.social.solver import solve_equilibrium_social
+
+    if spec.reduces_to_gossip():
+        return solve_equilibrium_social(
+            model, config=config, tol=tol, max_iter=max_iter, damping=damping,
+            dtype=dtype,
+        )
+    if config is None:
+        config = SolverConfig()
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    import time
+
+    from sbr_tpu import obs
+    from sbr_tpu.baseline.solver import _stamp_solve_time
+
+    dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+    econ = model.economic
+    eta = econ.eta
+    grid = jnp.linspace(jnp.zeros((), dtype), jnp.asarray(eta, dtype), config.n_grid)
+    run = _build_info_fixed_point(
+        spec, config, float(tol), int(max_iter), float(damping)
+    )
+    args = (
+        jnp.asarray(model.learning.beta, dtype),
+        jnp.asarray(model.learning.x0, dtype),
+        jnp.asarray(econ.u, dtype),
+        jnp.asarray(econ.p, dtype),
+        jnp.asarray(econ.kappa, dtype),
+        jnp.asarray(econ.lam, dtype),
+        jnp.asarray(eta, dtype),
+        grid,
+    )
+    t0 = time.perf_counter()
+    with obs.span(
+        "infomodels.fixed_point", channel=spec.channel, dynamics=spec.dynamics,
+        n_grid=config.n_grid, max_iter=int(max_iter),
+    ) as sp:
+        res = obs.jit_call("infomodels.fixed_point", run, *args)
+        sp.sync(res.aw, res.xi)
+    res = _stamp_solve_time(res, t0)
+    _log_fixed_point(res)
+    obs.log_infomodel(
+        "fixed_point", channel=spec.channel, dynamics=spec.dynamics,
+        groups=len(spec.groups) or 1, converged=bool(res.converged),
+        aborted=bool(res.aborted), iterations=int(res.iterations),
+        xi=float(res.xi), bankrun=bool(res.equilibrium.bankrun),
+    )
+    return res

@@ -1,0 +1,139 @@
+"""Information-model parity smoke — the CI ``infomodel-parity`` gate.
+
+    python -m sbr_tpu.infomodels.parity [--n 600] [--obs-dir DIR]
+
+Three checks:
+
+1. **Gossip bitwise reduction**: the group-free static gossip
+   `InfoModelSpec` must reproduce the legacy `simulate_agents` trajectory
+   BYTE FOR BYTE across {gather, incremental} × {f32, f64} ×
+   {lax, interpret} fused modes — `simulate_info` delegates to the same
+   engines, so any divergence means the info layer perturbed the step.
+2. **Bayes close-the-loop**: a tiny Bayesian-observer population must
+   close against its mean-field fixed point (converged, bank run, errors
+   inside the smoke tolerance) — the tier-1 contract at smoke scale.
+3. **Population determinism**: the same population query twice must
+   produce identical records and identical fingerprints (the cache key
+   the serving layer relies on).
+
+With ``--obs-dir`` the battery runs inside an obs run whose directory is
+printed (CI feeds it to ``report infomodel`` as the exit-code gate).
+Exit 0 on success; an AssertionError (exit 1) names the first divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m sbr_tpu.infomodels.parity")
+    parser.add_argument("--n", type=int, default=600, help="agents (default 600)")
+    parser.add_argument(
+        "--obs-dir", default=None,
+        help="run the battery inside an obs run rooted here (dir printed)",
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from sbr_tpu import obs
+    from sbr_tpu.infomodels import (
+        InfoModelSpec,
+        population_fingerprint,
+        population_query,
+    )
+    from sbr_tpu.models.params import SolverConfig, make_model_params
+    from sbr_tpu.social.agents import AgentSimConfig, simulate_agents
+    from sbr_tpu.social.closure import close_loop
+    from sbr_tpu.social.graphgen import ErdosRenyiSpec, prepare_generated_graph
+
+    run = None
+    if args.obs_dir:
+        run = obs.start_run(label="infomodel-parity", run_dir=args.obs_dir)
+        print(f"obs run dir: {run.run_dir}")
+
+    try:
+        n = args.n
+        graph = ErdosRenyiSpec(n=n, avg_degree=8.0)
+        spec = InfoModelSpec()  # gossip, static, homogeneous — the reduction
+        from sbr_tpu.infomodels import simulate_info
+
+        for engine in ("gather", "incremental"):
+            for dtype in (np.float32, np.float64):
+                for fused in ("lax", "interpret"):
+                    cfg = AgentSimConfig(n_steps=25, dt=0.1, fused=fused)
+                    r_info = simulate_info(
+                        spec, graph, beta=1.2, x0=0.02, config=cfg, seed=7,
+                        dtype=dtype, engine=engine,
+                    )
+                    pg = prepare_generated_graph(
+                        graph, seed=7, betas=1.2, config=cfg, dtype=dtype,
+                        engine=engine,
+                    )
+                    r_leg = simulate_agents(
+                        prepared=pg, x0=0.02, config=cfg, seed=7
+                    )
+                    label = f"{engine}/{np.dtype(dtype).name}/{fused}"
+                    for f in ("informed", "t_inf", "informed_frac", "withdrawn_frac"):
+                        a = np.asarray(getattr(r_info, f))
+                        b = np.asarray(getattr(r_leg, f))
+                        assert np.array_equal(a, b), (
+                            f"gossip reduction diverged at {label}.{f}"
+                        )
+        print("gossip reduction ok: bitwise across "
+              "{gather,incremental} x {f32,f64} x {lax,interpret}")
+
+        # Bayes close-the-loop at smoke scale.
+        model = make_model_params(
+            beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25
+        )
+        bayes = InfoModelSpec(channel="bayes")
+        tol = 0.25
+        comp = close_loop(
+            model=model, infomodel=bayes, n_agents=4000, avg_degree=15.0,
+            dt=0.05, g0=0.2, t_max=8.0, n_reps=2,
+            config=SolverConfig(n_grid=512), tolerance=tol,
+        )
+        assert bool(comp.fp.converged), "bayes fixed point did not converge"
+        assert bool(comp.fp.equilibrium.bankrun), "bayes fixed point has no run"
+        assert comp.err_aw_sup < tol, (
+            f"bayes closure err_aw_sup {comp.err_aw_sup:.4f} over {tol}"
+        )
+        print(f"bayes close-the-loop ok: err_aw_sup {comp.err_aw_sup:.4f} "
+              f"(< {tol}), xi {float(comp.fp.xi):.4f}")
+
+        # Population determinism + fingerprint stability.
+        pop_graph = ErdosRenyiSpec(n=1500, avg_degree=10.0)
+        rec1 = population_query(
+            bayes, pop_graph, model, seeds=3, vary="sim", g0=None,
+            config=SolverConfig(n_grid=256),
+        )
+        rec2 = population_query(
+            bayes, pop_graph, model, seeds=3, vary="sim", g0=None,
+            config=SolverConfig(n_grid=256),
+        )
+        assert rec1 == rec2, "population query is not deterministic"
+        kw = {"spec": bayes, "graph": pop_graph, "seeds": 3, "vary": "sim",
+              "seed": 0, "dt": 0.1}
+        f1 = population_fingerprint(kw, model, SolverConfig(n_grid=256), "float64")
+        f2 = population_fingerprint(kw, model, SolverConfig(n_grid=256), "float64")
+        assert f1 == f2, "population fingerprint unstable"
+        kw2 = {**kw, "seeds": 4}
+        assert population_fingerprint(
+            kw2, model, SolverConfig(n_grid=256), "float64"
+        ) != f1, "population fingerprint ignores the seed count"
+        print(f"population determinism ok: run_p {rec1['run_probability']:.2f}, "
+              f"fingerprint {f1[:12]}")
+    finally:
+        if run is not None:
+            obs.end_run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

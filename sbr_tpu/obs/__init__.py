@@ -75,6 +75,7 @@ from sbr_tpu.obs.runlog import (
     log_fault,
     log_fleet,
     log_health,
+    log_infomodel,
     log_repair,
     log_retry,
     log_scheduler,
@@ -107,6 +108,7 @@ __all__ = [
     "log_fault",
     "log_fleet",
     "log_health",
+    "log_infomodel",
     "log_repair",
     "log_retry",
     "log_scheduler",

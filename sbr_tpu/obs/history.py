@@ -78,10 +78,17 @@ from typing import Optional
 # scenario_multibank_cells_per_sec (bank-cells per second through the
 # contagion loop, dispatches × banks / wall — higher-better by the
 # per_sec rule).
+# 10 adds the information-model workload (ISSUE 15, bench.py
+# bench_infomodels): infomodel_belief_updates_per_sec (fused Bayesian
+# belief-update throughput through the observer kernel — agent-steps per
+# second of the bayes channel; higher-better by the per_sec rule) and
+# infomodel_population_queries_per_sec (end-to-end population what-if
+# queries per second at the query shape — fixed point + S member sims +
+# crossing reduction; higher-better likewise).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1/2/3/4/5/6/7/8 history keeps gating new schema-9 appends.
-SCHEMA = 9
+# schema-1/2/3/4/5/6/7/8/9 history keeps gating new schema-10 appends.
+SCHEMA = 10
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -208,6 +215,11 @@ def bench_metrics(result: dict) -> dict:
         # throughput (higher-better by the per_sec rule)
         "scenario_overhead_ratio",
         "scenario_multibank_cells_per_sec",
+        # schema 10: the information-model workload (bench.py
+        # bench_infomodels): fused belief-update throughput and population
+        # what-if query rate (both higher-better by the per_sec rule)
+        "infomodel_belief_updates_per_sec",
+        "infomodel_population_queries_per_sec",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):

@@ -1052,6 +1052,215 @@ def _main_fleet(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Infomodel report (`infomodel` subcommand — information-model gate)
+# ---------------------------------------------------------------------------
+
+
+def infomodel_doc(run_dir) -> tuple:
+    """Machine-readable information-model report (`sbr_tpu.infomodels`):
+    the manifest ``infomodel`` roll-up plus the per-event fold (rewire
+    epochs, belief censuses, fixed-point solves, closure comparisons,
+    population queries). Returns (doc, exit_code).
+
+    Exit codes: 0 healthy; 1 when a mean-field fixed point failed to
+    converge (``nonconverged``) or a closure comparison exceeded its
+    RECORDED tolerance (``breaches`` — closure events carry err_aw_sup +
+    tolerance when the caller supplied one); 3 when the run recorded no
+    infomodel data at all (a gate with nothing to read must not pass
+    silently); 2 when ``run_dir`` is not a directory."""
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    try:
+        run = load_run(run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        return {"dir": str(run_dir), "error": str(err), "exit": 2}, 2
+    manifest_blk = run["manifest"].get("infomodel") or {}
+    events = [ev for ev in run["events"] if ev.get("kind") == "infomodel"]
+    if not manifest_blk and not events:
+        return {
+            "dir": str(run_dir),
+            "error": "no infomodel data (no manifest roll-up, no infomodel events)",
+            "exit": 3,
+        }, 3
+    # The event fold is the kill -9 fallback (a process that died before
+    # finalize wrote no manifest roll-up): take the max of the two views
+    # per action, the `report fleet` discipline.
+    fold: dict = {}
+    fixed_points = []
+    closures = []
+    populations = []
+    epochs_by_channel: dict = {}
+    for ev in events:
+        action = str(ev.get("action", "?"))
+        fold[action] = fold.get(action, 0) + 1
+        if action == "fixed_point":
+            fixed_points.append(
+                {k: ev.get(k) for k in (
+                    "channel", "dynamics", "groups", "converged", "aborted",
+                    "iterations", "xi", "bankrun",
+                )}
+            )
+            if ev.get("converged") is False:
+                fold["nonconverged"] = fold.get("nonconverged", 0) + 1
+        elif action == "closure":
+            rec = {k: ev.get(k) for k in (
+                "channel", "dynamics", "n_agents", "n_reps", "err_aw_sup",
+                "err_g_rms", "tolerance",
+            )}
+            err, tol = rec.get("err_aw_sup"), rec.get("tolerance")
+            rec["breach"] = (
+                isinstance(err, (int, float))
+                and isinstance(tol, (int, float))
+                and err > tol
+            )
+            if rec["breach"]:
+                fold["breaches"] = fold.get("breaches", 0) + 1
+            closures.append(rec)
+        elif action == "population_query":
+            populations.append(
+                {k: ev.get(k) for k in (
+                    "channel", "dynamics", "vary", "seeds", "n_agents",
+                    "run_probability",
+                )}
+            )
+        elif action == "rewire_epoch":
+            ch = str(ev.get("channel", "?"))
+            epochs_by_channel[ch] = epochs_by_channel.get(ch, 0) + 1
+    counts = {
+        k: max(int(manifest_blk.get(k, 0)), int(fold.get(k, 0)))
+        for k in set(manifest_blk) | set(fold)
+    }
+    nonconverged = counts.get("nonconverged", 0)
+    breaches = counts.get("breaches", 0)
+    breach_msgs = []
+    if nonconverged:
+        breach_msgs.append(f"{nonconverged} non-converged fixed point(s)")
+    if breaches:
+        breach_msgs.append(f"{breaches} closure comparison(s) over tolerance")
+    code = 1 if breach_msgs else 0
+    doc = {
+        "dir": str(run_dir),
+        "counts": counts,
+        "manifest_infomodel": manifest_blk or None,
+        "fixed_points": fixed_points,
+        "closures": closures,
+        "population_queries": populations,
+        "rewire_epochs": epochs_by_channel,
+        "nonconverged": nonconverged,
+        "breaches_count": breaches,
+        "breaches": breach_msgs,
+        "bad_event_lines": run.get("bad_event_lines", 0),
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_infomodel(doc: dict) -> str:
+    """Human-readable information-model report; same exit contract as
+    `infomodel_doc`."""
+    out = [f"run      {doc['dir']}"]
+    if doc["exit"] in (2, 3):
+        out.append(doc.get("error", "no infomodel data"))
+        if doc["exit"] == 3:
+            out.append(
+                "was the run produced with sbr_tpu.infomodels telemetry on "
+                "(fixed points / simulate_info / close_loop emit infomodel "
+                "events)?"
+            )
+        return "\n".join(out)
+    c = doc["counts"]
+    out.append(
+        "infomodel "
+        + ", ".join(
+            f"{int(c.get(k, 0))} {k}" for k in (
+                "fixed_point", "closure", "population_query", "rewire_epoch",
+                "belief_census",
+            ) if c.get(k)
+        )
+    )
+    if doc["fixed_points"]:
+        out += ["", "FIXED POINTS"]
+        out.append(
+            _table(
+                ["channel", "dynamics", "groups", "converged", "iters", "xi", "bankrun"],
+                [
+                    [
+                        fp.get("channel", "?"), fp.get("dynamics", "?"),
+                        fp.get("groups", 1),
+                        fp.get("converged"), fp.get("iterations"),
+                        "-" if fp.get("xi") is None else f"{fp['xi']:.4f}",
+                        fp.get("bankrun"),
+                    ]
+                    for fp in doc["fixed_points"]
+                ],
+            )
+        )
+    if doc["closures"]:
+        out += ["", "CLOSURES"]
+        out.append(
+            _table(
+                ["channel", "dynamics", "agents", "reps", "err_aw_sup", "tol", "ok"],
+                [
+                    [
+                        cl.get("channel", "?"), cl.get("dynamics", "?"),
+                        cl.get("n_agents"), cl.get("n_reps"),
+                        "-" if cl.get("err_aw_sup") is None else f"{cl['err_aw_sup']:.4f}",
+                        "-" if cl.get("tolerance") is None else f"{cl['tolerance']:g}",
+                        "BREACH" if cl.get("breach") else "ok",
+                    ]
+                    for cl in doc["closures"]
+                ],
+            )
+        )
+    if doc["population_queries"]:
+        out += ["", "POPULATION QUERIES"]
+        out.append(
+            _table(
+                ["channel", "dynamics", "vary", "seeds", "agents", "run_p"],
+                [
+                    [
+                        p.get("channel", "?"), p.get("dynamics", "?"),
+                        p.get("vary", "?"), p.get("seeds"), p.get("n_agents"),
+                        "-" if p.get("run_probability") is None
+                        else f"{p['run_probability']:.3f}",
+                    ]
+                    for p in doc["population_queries"]
+                ],
+            )
+        )
+    if doc["rewire_epochs"]:
+        out.append(
+            "epochs   "
+            + ", ".join(f"{ch}: {n}" for ch, n in sorted(doc["rewire_epochs"].items()))
+        )
+    if doc["breaches"]:
+        out += [""] + [f"BREACH   {b}" for b in doc["breaches"]]
+    if doc.get("bad_event_lines"):
+        out.append(f"warning  {doc['bad_event_lines']} unparseable event line(s)")
+    return "\n".join(out)
+
+
+def _main_infomodel(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report infomodel",
+        description="Information-model report for one run (fixed points, "
+        "closure comparisons, rewire epochs, population queries); exit 1 "
+        "on a non-converged fixed point or a closure comparison over its "
+        "recorded tolerance, 3 when no infomodel data was recorded",
+    )
+    parser.add_argument("run_dir", help="run directory (contains events.jsonl)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = infomodel_doc(args.run_dir)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_infomodel(doc))
+    return code
+
+
+# ---------------------------------------------------------------------------
 # Memory report (`memory` subcommand — the obs.mem attribution renderer/gate)
 # ---------------------------------------------------------------------------
 
@@ -1779,6 +1988,8 @@ def main(argv=None) -> int:
         return _main_fleet(argv[1:])
     if argv and argv[0] == "grad":
         return _main_grad(argv[1:])
+    if argv and argv[0] == "infomodel":
+        return _main_infomodel(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -1791,7 +2002,7 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'grad' / 'trend' / 'gc' subcommands",
+        "'grad' / 'infomodel' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -192,6 +192,11 @@ class RunContext:
         # ladder answers, breaker transitions, worker joins/losses) — what
         # `report fleet` gates on.
         self.fleet: dict = {}
+        # Information-model roll-up (sbr_tpu.infomodels): per-action counts
+        # of infomodel events (rewire epochs, belief censuses, fixed-point
+        # solves, closure comparisons, population queries) plus the
+        # nonconverged/breach tallies `report infomodel` gates on.
+        self.infomodel: dict = {}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -590,6 +595,7 @@ class RunContext:
             "resilience": self._resilience_manifest(),
             "elastic": self._elastic_manifest(),
             "fleet": self.fleet or None,
+            "infomodel": self.infomodel or None,
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -684,6 +690,29 @@ class RunContext:
         roll-up (`report fleet` gates on these counts)."""
         self.event("fleet", action=action, **fields)
         self.fleet[action] = self.fleet.get(action, 0) + 1
+
+    def log_infomodel(self, action: str = "?", **fields) -> None:
+        """Emit one information-model ``infomodel`` event
+        (`sbr_tpu.infomodels`: rewire epochs, belief censuses, fixed-point
+        solves, closure comparisons, population queries) and fold it into
+        the manifest roll-up. Besides the per-action count, two gate
+        tallies accumulate: ``nonconverged`` (fixed_point events with
+        ``converged=False``) and ``breaches`` (closure events whose
+        recorded error exceeds their recorded tolerance) — what
+        `report infomodel` exits 1 on."""
+        self.event("infomodel", action=action, **fields)
+        self.infomodel[action] = self.infomodel.get(action, 0) + 1
+        if action == "fixed_point" and fields.get("converged") is False:
+            self.infomodel["nonconverged"] = self.infomodel.get("nonconverged", 0) + 1
+        if action == "closure":
+            err = fields.get("err_aw_sup")
+            tol = fields.get("tolerance")
+            if (
+                isinstance(err, (int, float))
+                and isinstance(tol, (int, float))
+                and err > tol
+            ):
+                self.infomodel["breaches"] = self.infomodel.get("breaches", 0) + 1
 
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
@@ -946,6 +975,14 @@ def log_fleet(action: str = "?", **fields) -> None:
     run = current_run()
     if run is not None and _trace_clean():
         run.log_fleet(action, **fields)
+
+
+def log_infomodel(action: str = "?", **fields) -> None:
+    """Information-model event + manifest roll-up (no-op when telemetry is
+    off or while tracing) — the `sbr_tpu.infomodels` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_infomodel(action, **fields)
 
 
 def interrupt_all() -> int:

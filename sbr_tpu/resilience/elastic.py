@@ -668,6 +668,7 @@ def run_elastic_grid(
     heartbeat_ttl_s: Optional[float] = None,
     tile_cache_dir=None,
     max_retries: int = 2,
+    scenario_spec=None,
 ):
     """Elastic β×u sweep over a shared checkpoint dir (the scheduler behind
     `parallel.run_tiled_grid_multihost` when elastic mode is on).
@@ -707,7 +708,7 @@ def run_elastic_grid(
     runner = ckpt_mod.tile_runner(
         beta_values, u_values, base, checkpoint_dir, config=config,
         tile_shape=tile_shape, dtype=dtype, max_retries=max_retries,
-        tile_cache=cache, verbose=verbose,
+        tile_cache=cache, verbose=verbose, scenario_spec=scenario_spec,
     )
     ckpt = runner.ckpt
     tiles = ckpt_mod.tile_origins(runner.nb, runner.nu, (runner.tb, runner.tu))
@@ -866,5 +867,5 @@ def run_elastic_grid(
     return ckpt_mod.run_tiled_grid(
         beta_values, u_values, base, config=config, tile_shape=tile_shape,
         checkpoint_dir=checkpoint_dir, dtype=dtype, verbose=verbose,
-        tile_cache=cache,
+        tile_cache=cache, scenario_spec=scenario_spec,
     )

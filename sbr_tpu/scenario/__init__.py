@@ -11,6 +11,7 @@ scenarios" for the composition matrix and policy-knob table.
 from sbr_tpu.scenario.engine import (
     SCENARIO_KEYS,
     ScenarioResult,
+    run_tiled_scenario_grid,
     scenario_grid,
     scenario_theta,
     solve,
@@ -33,6 +34,7 @@ __all__ = [
     "MultiBankResult",
     "ScenarioResult",
     "ScenarioSpec",
+    "run_tiled_scenario_grid",
     "scenario_grid",
     "scenario_theta",
     "solve",

@@ -359,6 +359,55 @@ def scenario_grid(
     )
 
 
+def run_tiled_scenario_grid(
+    spec: ScenarioSpec,
+    beta_values,
+    u_values,
+    base: ModelParams,
+    checkpoint_dir: Optional[str] = None,
+    config: Optional[SolverConfig] = None,
+    dtype=None,
+    tile_shape=(256, 256),
+    **kw,
+):
+    """β×u scenario sweep through the tiled elastic checkpoint driver
+    (ISSUE 15 satellite — the PR 13 remainder): `scenario_grid` cells with
+    `utils.checkpoint.run_tiled_grid`'s whole production stack — local
+    checkpoint resume, the cross-run global tile cache, retry policy +
+    budget, per-tile leases under the elastic scheduler
+    (`resilience.elastic.run_elastic_grid(scenario_spec=spec, ...)` for
+    multi-host sweeps), and degrade-ladder healing on baseline-reducible
+    specs. The spec joins the sweep fingerprint and every tile-cache key,
+    so composed and plain sweeps never share bytes — EXCEPT the exact
+    baseline reduction, which is deliberately keyed as a plain sweep
+    (cells are bit-identical by the parity contract, so a warm legacy
+    cache answers it for free).
+
+    Same spec constraints as `scenario_grid` (single bank, baseline-family
+    learning); ``**kw`` passes through to `run_tiled_grid`
+    (max_retries / tile_cache / heal_divergent / verbose / ...).
+    """
+    from sbr_tpu.utils.checkpoint import run_tiled_grid
+
+    if spec.banks != 1:
+        raise ValueError(
+            "run_tiled_scenario_grid sweeps single-bank specs; use "
+            "multibank.solve for banks > 1"
+        )
+    if spec.learning != "baseline":
+        raise ValueError(
+            f"run_tiled_scenario_grid requires learning='baseline' cells, "
+            f"got {spec.learning!r}"
+        )
+    _validate_params(spec, base)
+    passthrough = None if spec.reduces_to() == "baseline" else spec
+    return run_tiled_grid(
+        beta_values, u_values, base, config=config, tile_shape=tile_shape,
+        checkpoint_dir=checkpoint_dir, dtype=dtype, scenario_spec=passthrough,
+        **kw,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Composed social fixed point (social × {hetero, interest, policy})
 # ---------------------------------------------------------------------------

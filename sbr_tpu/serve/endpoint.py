@@ -149,7 +149,26 @@ class ServeEndpoint:
                     scenario = (
                         "default" if spec is not None else str(scenario_doc or "default")
                     )
+                    # ``population`` (ISSUE 15): a population-level what-if
+                    # object — S seeds of an information model on a graph
+                    # spec, answered as quantiles + run-probability, cached
+                    # by infomodel fingerprint.
+                    population_doc = doc.get("population")
+                    if population_doc is not None and spec is not None:
+                        self._send(
+                            400,
+                            b'{"error": "population and scenario are mutually exclusive"}',
+                            "application/json",
+                        )
+                        return
                     grads = bool(doc.get("grads", False))
+                    if population_doc is not None and grads:
+                        self._send(
+                            400,
+                            b'{"error": "grads are not supported on population queries"}',
+                            "application/json",
+                        )
+                        return
                     if spec is not None and grads:
                         # Gradient coverage is part of the composition
                         # matrix (grad.scenario_xi_and_grad); the serve
@@ -162,7 +181,8 @@ class ServeEndpoint:
                         )
                         return
                     unknown = (
-                        set(doc) - set(_PARAM_KEYS) - {"scenario", "deadline_ms", "grads"}
+                        set(doc) - set(_PARAM_KEYS)
+                        - {"scenario", "deadline_ms", "grads", "population"}
                     )
                     if unknown:
                         self._send(
@@ -218,6 +238,27 @@ class ServeEndpoint:
                         )
                         return
                     try:
+                        if population_doc is not None:
+                            try:
+                                rec = endpoint.engine.query_population(
+                                    params, population_doc, deadline_ms=deadline_ms
+                                )
+                            except (TypeError, ValueError) as err:
+                                # Malformed population/graph/infomodel
+                                # objects are CLIENT errors — 400, never a
+                                # retryable 503.
+                                self._send(
+                                    400,
+                                    json.dumps(
+                                        {"error": f"bad population query: {err}"}
+                                    ).encode(),
+                                    "application/json",
+                                )
+                                return
+                            self._send(
+                                200, json.dumps(rec).encode(), "application/json"
+                            )
+                            return
                         if spec is not None:
                             try:
                                 rec = endpoint.engine.query_scenario(

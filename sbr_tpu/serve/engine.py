@@ -1069,6 +1069,55 @@ class Engine:
     def _scenario_store(self, key: str, rec: dict, write_disk: bool = True) -> None:
         self._store(key, rec, write_disk=write_disk)
 
+    # -- population what-ifs (ISSUE 15) --------------------------------------
+    def query_population(self, params, pop_doc: dict,
+                         deadline_ms: Optional[float] = None) -> dict:
+        """Serve one population-level what-if query (`POST /query` with a
+        ``population`` object): "the ξ distribution over S seeds at these
+        params" — S agent populations under an `infomodels.InfoModelSpec`
+        on a graphgen spec, reduced to crossing-time quantiles + run
+        probability against the model's mean-field fixed point.
+
+        Runs in the calling thread (population programs are per-spec
+        compiled; arbitrary specs don't micro-batch), under the engine's
+        admission control, cached in the same LRU + verified-disk layers
+        keyed by `infomodels.population_fingerprint` — which bakes in
+        INFOMODEL_PROGRAM_VERSION, so stale engine math can never be
+        replayed. Returns a JSON-ready record with ``source`` /
+        ``population_fingerprint`` / ``latency_ms``."""
+        from sbr_tpu.infomodels import population as pop
+
+        kw = pop.parse_population_doc(pop_doc)
+        self._admit(deadline_ms)
+        t0 = time.monotonic()
+        key = pop.population_fingerprint(
+            kw, (params, self._cfg_tag), self.config, self.dtype.name
+        )
+        rec, source = self._cache_probe(key, self._parse_population_record)
+        if rec is None:
+            rec = pop.population_query(
+                kw["spec"], kw["graph"], params, seeds=kw["seeds"],
+                vary=kw["vary"], seed=kw["seed"], dt=kw["dt"],
+                config=self.config, **(
+                    {"g0": kw["g0"]} if "g0" in kw else {}
+                ),
+            )
+            rec["population_fingerprint"] = key
+            self._store(key, rec)
+            source = "computed"
+        latency = time.monotonic() - t0
+        self.live.record_query(latency, source, scenario=f"pop:{key[:12]}")
+        return {**rec, "source": source, "latency_ms": round(latency * 1e3, 3)}
+
+    @staticmethod
+    def _parse_population_record(path: Path):
+        import json
+
+        rec = json.loads(path.read_text())
+        if not isinstance(rec, dict) or "population_fingerprint" not in rec:
+            return None
+        return rec
+
     # -- result cache --------------------------------------------------------
     def _result_key(self, params: ModelParams, grads: bool = False) -> str:
         # Grads records carry grad_flags computed under the resolved

@@ -38,7 +38,7 @@ are ~1e-4 at the default x0 and are absorbed by the 0.03 test tolerances.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -79,6 +79,12 @@ class LoopComparison:
     err_aw_sup: float
     err_aw_rms: float
     err_g_rms: float
+    # Per-member AW trajectories, (n_reps, n_steps) — populated when the
+    # ``seeds`` axis is used (ISSUE 15): the raw material of population-
+    # level ξ-distribution queries (`infomodels.population`).
+    aw_seeds: Optional[np.ndarray] = None
+    # The information model the members ran under (None = legacy gossip).
+    infomodel: Optional[object] = None
 
 
 def close_loop(
@@ -96,6 +102,9 @@ def close_loop(
     mesh=None,
     fp: Optional[SocialFixedPointResult] = None,
     graph=None,
+    infomodel=None,
+    seeds: Optional[Sequence[int]] = None,
+    tolerance: Optional[float] = None,
 ) -> LoopComparison:
     """Solve the fixed point, feed its window to the agent sim, compare.
 
@@ -129,6 +138,33 @@ def close_loop(
 
     ``fp`` supplies a precomputed fixed point (skipping the solve — the most
     expensive step); it must come from the same ``model``.
+
+    ``infomodel`` (ISSUE 15): an `infomodels.InfoModelSpec` — the loop
+    then closes THAT model against ITS mean-field fixed point
+    (`infomodels.meanfield.solve_fixed_point_info`): gossip-reducible
+    specs run exactly the legacy path; bayes specs compare the
+    belief-threshold population against the closed-form observer curves
+    (mid-start initializes the informed set as the threshold-ordered
+    prefix the mean-field mass prescribes, plus the shared evidence
+    level Λ(t0) — uniform seeding would double-count the panic-prone
+    tail); rewire specs compare against the attention-tilted curves.
+    Requires a graphgen ``graph`` spec (defaulted to Erdős–Rényi at
+    (n_agents, avg_degree)); ``mesh`` is rejected (the info engines are
+    single-device).
+
+    ``seeds`` (ISSUE 15 satellite): an explicit sequence of member seeds
+    replacing the ``n_reps`` ladder — the GRAPH IS PREPARED ONCE (at
+    ``seed``) and reused for every member, so an S-member population
+    sweep pays one canonicalization/H2D instead of S (static dynamics;
+    rewiring regenerates per epoch by design). Per-member randomness
+    (initial seeds, RNG streams, bayes thresholds) still varies by
+    member seed via the counter RNG. The per-member AW trajectories land
+    on ``LoopComparison.aw_seeds`` — the raw material of population
+    ξ-distribution queries.
+
+    ``tolerance``: optional — recorded on the obs ``closure`` event so
+    `report infomodel` can gate err_aw_sup against it; no behavior
+    change.
     """
     if config is None:
         config = SolverConfig()
@@ -136,7 +172,24 @@ def close_loop(
         model = make_model_params(
             beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25
         )
-    if fp is None:
+    if infomodel is not None:
+        if mesh is not None:
+            raise ValueError(
+                "infomodel= runs the single-device info engines; mesh= is "
+                "not supported (shard the gossip path via graph=/mesh= "
+                "without an infomodel)"
+            )
+        if graph is None:
+            from sbr_tpu.social.graphgen import ErdosRenyiSpec
+
+            graph = ErdosRenyiSpec(n=n_agents, avg_degree=avg_degree)
+        if fp is None:
+            from sbr_tpu.infomodels.meanfield import solve_fixed_point_info
+
+            fp = solve_fixed_point_info(
+                infomodel, model, config=config, tol=tol, max_iter=max_iter
+            )
+    elif fp is None:
         fp = solve_equilibrium_social(model, config=config, tol=tol, max_iter=max_iter)
     exit_delay, reentry_delay = equilibrium_window(fp.equilibrium)
 
@@ -146,8 +199,10 @@ def close_loop(
     beta = float(model.learning.beta)
     x0 = float(model.learning.x0)
 
+    bayes = infomodel is not None and infomodel.channel == "bayes"
     t0 = 0.0
     informed0 = t_inf0 = None
+    m_curve = None
     if g0 is not None:
         if not (x0 < g0 < float(g_curve[-1])):
             raise ValueError(f"g0={g0} outside the fixed point's G range")
@@ -156,6 +211,28 @@ def close_loop(
         k = max(1, int(round(g0 * n_agents)))
         quantiles = (np.arange(k) + 0.5) * (g0 / k)
         s = np.interp(quantiles, g_curve, grid)  # informed times in [0, t0]
+        if bayes:
+            # Bayes mid-start is NOT exchangeable: the informed set at t0
+            # is exactly the agents whose private threshold the shared
+            # evidence level has crossed — the threshold-ordered prefix —
+            # so seeding uniformly chosen agents would double-count the
+            # panic-prone tail (the unseeded low-θ agents would all cross
+            # again at t0+). Build the mean-field evidence curve
+            # M(t) = cummax ∫ llr(w_obs(AW)) once; each member below
+            # seeds {i: a_i·M(t0) ≥ θ_i} with crossing times M⁻¹(θ_i/a_i)
+            # and starts every belief at the shared level Λ(t0).
+            from sbr_tpu.infomodels.meanfield import observed_fraction
+
+            llr0_c, llr1_c = infomodel.llr
+            w_obs = np.asarray(
+                observed_fraction(np.asarray(fp.aw, np.float64), infomodel)
+            )
+            llr_curve = w_obs * llr1_c + (1.0 - w_obs) * llr0_c
+            dt_grid = float(grid[1] - grid[0])
+            lam_curve = np.concatenate(
+                [[0.0], np.cumsum((llr_curve[1:] + llr_curve[:-1]) * 0.5 * dt_grid)]
+            )
+            m_curve = np.maximum.accumulate(lam_curve)
 
     t_end = eta if t_max is None else float(t_max)
     n_steps = max(int(round((t_end - t0) / dt)), 2)
@@ -168,25 +245,110 @@ def close_loop(
             f"graph spec n={graph.n} does not match n_agents={n_agents}"
         )
 
+    member_seeds = (
+        [int(sd) for sd in seeds]
+        if seeds is not None
+        else [seed + 1000 * rep for rep in range(n_reps)]
+    )
+    if not member_seeds:
+        raise ValueError("seeds must be non-empty")
+    n_reps = len(member_seeds)
+
+    # Seeds axis (ISSUE 15 satellite): the graph-side work happens ONCE
+    # (prepared at the base ``seed``) and every member reuses the device
+    # arrays; only per-member state (initial seeds, RNG streams, bayes
+    # thresholds) varies. Rewiring specs regenerate per epoch by design —
+    # there is nothing to share.
+    shared_pg = None
+    if seeds is not None and (infomodel is None or infomodel.dynamics == "static"):
+        if infomodel is not None:
+            from sbr_tpu.infomodels.engine import _agent_fields
+            from sbr_tpu.social.graphgen import prepare_generated_graph
+
+            if infomodel.channel == "gossip":
+                betas_arg = (
+                    np.asarray(
+                        _agent_fields(infomodel, n_agents, seed, beta, np.float32)[0]
+                    )
+                    if infomodel.groups
+                    else beta
+                )
+                shared_pg = prepare_generated_graph(
+                    graph, seed=seed, betas=betas_arg, config=sim_cfg
+                )
+            else:
+                shared_pg = prepare_generated_graph(
+                    graph, seed=seed, betas=1.0, config=sim_cfg, engine="gather"
+                )
+        elif graph is not None:
+            from sbr_tpu.social.graphgen import prepare_generated_graph
+
+            shared_pg = prepare_generated_graph(
+                graph, seed=seed, betas=beta, config=sim_cfg, mesh=mesh
+            )
+        else:
+            from sbr_tpu.social.agents import prepare_agent_graph
+
+            src, dst = erdos_renyi_edges(n_agents, avg_degree, seed=seed)
+            shared_pg = prepare_agent_graph(
+                beta, src, dst, n_agents, config=sim_cfg, mesh=mesh
+            )
+
     aw_acc = g_acc = None
+    aw_rows = [] if seeds is not None else None
     t = None
-    for rep in range(n_reps):
-        rep_seed = seed + 1000 * rep
-        if g0 is not None:
+    for rep_seed in member_seeds:
+        belief0 = None
+        if g0 is not None and not bayes:
             rng = np.random.default_rng(rep_seed + 17)
             informed0 = np.zeros(n_agents, dtype=bool)
             chosen = rng.choice(n_agents, size=len(s), replace=False)
             informed0[chosen] = True
             t_inf0 = np.zeros(n_agents)
             t_inf0[chosen] = s - t0  # sim clock starts at t0: seeds are ≤ 0
-        if graph is not None:
+        if infomodel is not None:
+            from sbr_tpu.infomodels.engine import _agent_fields, simulate_info
+
+            if g0 is not None and bayes:
+                _, thr_d, aware_d = _agent_fields(
+                    infomodel, n_agents, rep_seed, beta, np.float32
+                )
+                ratio = np.asarray(thr_d, np.float64) / np.asarray(aware_d, np.float64)
+                m0 = float(np.interp(t0, np.asarray(grid), m_curve))
+                informed0 = ratio <= m0
+                t_inf0 = np.zeros(n_agents)
+                # crossing times M⁻¹(θ/a) on [0, t0], sim clock at t0
+                t_inf0[informed0] = (
+                    np.interp(ratio[informed0], m_curve, np.asarray(grid)) - t0
+                )
+                belief0 = m0
+            sim = simulate_info(
+                infomodel, graph, beta=beta, x0=x0, config=sim_cfg,
+                seed=rep_seed, exact_seeds=True, informed0=informed0,
+                t_inf0=t_inf0, prepared=shared_pg, belief0=belief0,
+            )
+        elif graph is not None:
             from sbr_tpu.social.graphgen import prepare_generated_graph
 
-            pg = prepare_generated_graph(
-                graph, seed=rep_seed, betas=beta, config=sim_cfg, mesh=mesh
+            pg = (
+                shared_pg
+                if shared_pg is not None
+                else prepare_generated_graph(
+                    graph, seed=rep_seed, betas=beta, config=sim_cfg, mesh=mesh
+                )
             )
             sim = simulate_agents(
                 prepared=pg,
+                x0=x0,
+                config=sim_cfg,
+                seed=rep_seed,
+                exact_seeds=True,
+                informed0=informed0,
+                t_inf0=t_inf0,
+            )
+        elif shared_pg is not None:
+            sim = simulate_agents(
+                prepared=shared_pg,
                 x0=x0,
                 config=sim_cfg,
                 seed=rep_seed,
@@ -211,6 +373,8 @@ def close_loop(
             )
         aw = np.asarray(sim.withdrawn_frac, dtype=np.float64)
         g = np.asarray(sim.informed_frac, dtype=np.float64)
+        if aw_rows is not None:
+            aw_rows.append(aw)
         aw_acc = aw if aw_acc is None else aw_acc + aw
         g_acc = g if g_acc is None else g_acc + g
         if t is None:
@@ -222,7 +386,7 @@ def close_loop(
 
     d = aw_sim - aw_fp
     dg = g_sim - g_fp
-    return LoopComparison(
+    comp = LoopComparison(
         fp=fp,
         exit_delay=exit_delay,
         reentry_delay=reentry_delay,
@@ -236,4 +400,23 @@ def close_loop(
         err_aw_sup=float(np.max(np.abs(d))),
         err_aw_rms=float(np.sqrt(np.mean(d**2))),
         err_g_rms=float(np.sqrt(np.mean(dg**2))),
+        aw_seeds=np.stack(aw_rows) if aw_rows else None,
+        infomodel=infomodel,
     )
+    from sbr_tpu import obs
+
+    # Infomodel runs ONLY: a legacy close_loop emitting these events would
+    # defeat `report infomodel`'s exit-3 no-data guard — a gate pointed at
+    # a run whose info battery never executed must not read green.
+    if infomodel is not None and obs.enabled():
+        obs.log_infomodel(
+            "closure",
+            channel=infomodel.channel,
+            dynamics=infomodel.dynamics,
+            n_agents=n_agents,
+            n_reps=n_reps,
+            err_aw_sup=comp.err_aw_sup,
+            err_g_rms=comp.err_g_rms,
+            tolerance=tolerance,
+        )
+    return comp

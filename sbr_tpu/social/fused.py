@@ -167,6 +167,155 @@ def _pallas_update(n: int, dtype_name: str, dt: float, interpret: bool):
     return run
 
 
+def _belief_lax(informed, t_inf, belief, counts, awareness, safe_deg,
+                thresholds, t, dt, llr0, llr1):
+    """The Bayesian belief-update arithmetic — the ONE definition the lax
+    and Pallas belief paths share (ISSUE 15).
+
+    Per agent: the naive-Bayes log-likelihood-ratio rate of the observed
+    withdrawn-neighbor fraction w = counts/deg,
+
+        llr(w) = w·llr1 + (1−w)·llr0,   llr1 = log(q_run/q_calm) > 0,
+                                        llr0 = log((1−q_run)/(1−q_calm)) < 0,
+
+    accumulates into the per-agent belief Λ (the log-odds evidence
+    integral); an uninformed agent joins the run the FIRST time
+    a_i·Λ_i(t) crosses its private threshold θ_i. Crossing is absorbing
+    (informed stays informed — the framework's infection semantics), so
+    the first-crossing rule equals thresholding the running max of a·Λ,
+    which is what the mean-field curve integrates
+    (`infomodels.meanfield`)."""
+    dtype = belief.dtype
+    w = counts.astype(dtype) / safe_deg
+    belief2 = belief + dt * (w * llr1 + (1.0 - w) * llr0)
+    newly = (~informed) & (awareness * belief2 >= thresholds)
+    informed2 = informed | newly
+    t_inf2 = jnp.where(newly, t + dt, t_inf)
+    return informed2, t_inf2, belief2
+
+
+BELIEF_MODES = ("auto", "lax", "pallas", "interpret")
+
+
+def resolve_belief_mode(mode: str, dtype) -> str:
+    """Concrete lowering for the belief kernel. Unlike `resolve_mode`
+    there is no RNG stream to respect (the Bayesian update is
+    deterministic given the per-agent threshold draws), so "unfused"
+    requests and foldin streams simply run the lax form — same
+    arithmetic, one fewer name. f64 pallas degrades to lax exactly like
+    the infection kernel (no uint64 in compiled TPU Pallas is moot here,
+    but the f64 exp/compare path is untested on hardware — keep the
+    conservative rule the infection kernel established)."""
+    if mode == "unfused":
+        mode = "lax"
+    if mode not in BELIEF_MODES:
+        raise ValueError(f"belief mode must be one of {BELIEF_MODES}, got {mode!r}")
+    if mode == "auto":
+        env = os.environ.get("SBR_FUSED", "").strip().lower()
+        if env == "unfused":
+            env = "lax"
+        if env and env not in BELIEF_MODES:
+            raise ValueError(
+                f"SBR_FUSED must be one of {BELIEF_MODES} for the belief "
+                f"kernel, got {env!r}"
+            )
+        mode = env if env and env != "auto" else "auto"
+    if mode == "auto":
+        mode = "pallas" if jax.default_backend() in ("tpu", "gpu") else "lax"
+    if mode == "pallas" and np.dtype(dtype) == np.float64:
+        return "lax"
+    return mode
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_belief(n: int, dtype_name: str, dt: float, interpret: bool):
+    """Pallas belief-update kernel for a fixed (N, dtype, dt): each
+    1024-agent block loads (informed, t_inf, belief, counts, awareness,
+    deg, θ) once, runs the llr accumulation and threshold crossing in
+    VMEM, and writes (informed', t_inf', belief') — no materialized
+    fraction/llr intermediates. Mirrors `_pallas_update`'s structure so
+    the block/pad discipline stays in one idiom."""
+    from jax.experimental import pallas as pl
+
+    dtype = jnp.dtype(dtype_name)
+    n_pad = (-n) % _BLOCK
+    n_b = (n + n_pad) // _BLOCK
+
+    def kernel(sc_ref, informed_ref, tinf_ref, belief_ref, counts_ref,
+               aw_ref, deg_ref, thr_ref, inf2_ref, tinf2_ref, bel2_ref):
+        informed2, t_inf2, belief2 = _belief_lax(
+            informed_ref[...], tinf_ref[...], belief_ref[...], counts_ref[...],
+            aw_ref[...], deg_ref[...], thr_ref[...],
+            sc_ref[0], dt, sc_ref[1], sc_ref[2],
+        )
+        inf2_ref[...] = informed2
+        tinf2_ref[...] = t_inf2
+        bel2_ref[...] = belief2
+
+    block = pl.BlockSpec((_BLOCK,), lambda i: (i,))
+    scalar3 = pl.BlockSpec((3,), lambda i: (0,))
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_b,),
+        in_specs=[scalar3] + [block] * 7,
+        out_specs=[block, block, block],
+        out_shape=[
+            jax.ShapeDtypeStruct((n + n_pad,), jnp.bool_),
+            jax.ShapeDtypeStruct((n + n_pad,), dtype),
+            jax.ShapeDtypeStruct((n + n_pad,), dtype),
+        ],
+        interpret=interpret,
+    )
+
+    def run(sc, informed, t_inf, belief, counts, awareness, safe_deg, thr):
+        if n_pad:
+            # inert pad lanes: awareness 0 and threshold +inf ⇒ never cross
+            informed = jnp.concatenate([informed, jnp.zeros(n_pad, jnp.bool_)])
+            t_inf = jnp.concatenate([t_inf, jnp.zeros(n_pad, t_inf.dtype)])
+            belief = jnp.concatenate([belief, jnp.zeros(n_pad, belief.dtype)])
+            counts = jnp.concatenate([counts, jnp.zeros(n_pad, counts.dtype)])
+            awareness = jnp.concatenate([awareness, jnp.zeros(n_pad, awareness.dtype)])
+            safe_deg = jnp.concatenate([safe_deg, jnp.ones(n_pad, safe_deg.dtype)])
+            thr = jnp.concatenate([thr, jnp.full(n_pad, jnp.inf, thr.dtype)])
+        informed2, t_inf2, belief2 = call(
+            sc, informed, t_inf, belief, counts, awareness, safe_deg, thr
+        )
+        return informed2[:n], t_inf2[:n], belief2[:n]
+
+    return run
+
+
+def belief_update(informed, t_inf, belief, counts, awareness, safe_deg,
+                  thresholds, t, dt, llr0, llr1, mode: str):
+    """One fused Bayesian observation step (ISSUE 15) — the belief-channel
+    analogue of `infection_update`. Pure function of (state, counts,
+    per-agent awareness/thresholds, llr constants); lax and Pallas
+    lowerings share `_belief_lax`. Unlike the infection kernel — whose
+    draw is integer Threefry, so every lowering is bit-identical — the
+    belief accumulator is FLOAT arithmetic, and the interpreter's
+    per-block programs may fuse the llr chain differently from the
+    full-array XLA program (FMA/reassociation): lowerings agree to ≤1 ulp
+    on beliefs (tested), and crossing decisions agree except at exact
+    ulp-boundary thresholds (measure-zero under the logistic threshold
+    draw). Deterministic runs pin ONE lowering via ``config.fused``.
+    Returns (informed', t_inf', belief')."""
+    dtype = belief.dtype
+    mode = resolve_belief_mode(mode, dtype)
+    if mode == "lax":
+        return _belief_lax(
+            informed, t_inf, belief, counts, awareness, safe_deg, thresholds,
+            t, dt, llr0, llr1,
+        )
+    run = _pallas_belief(
+        int(informed.shape[0]), jnp.dtype(dtype).name, float(dt),
+        interpret=(mode == "interpret"),
+    )
+    sc = jnp.stack([
+        jnp.asarray(t, dtype), jnp.asarray(llr0, dtype), jnp.asarray(llr1, dtype)
+    ])
+    return run(sc, informed, t_inf, belief, counts, awareness, safe_deg, thresholds)
+
+
 def infection_update(informed, t_inf, counts, betas, safe_deg, key, step_k,
                      ids, t, dt, rng_stream: str, mode: str):
     """One fused infection step for every engine's per-agent tail.

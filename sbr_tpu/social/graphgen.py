@@ -92,9 +92,13 @@ __all__ = [
     "ErdosRenyiSpec",
     "ScaleFreeSpec",
     "StochasticBlockSpec",
+    "epoch_key_words",
+    "epoch_indegrees",
     "generate_edges",
+    "generate_tilted_sources",
     "plan_chunk_edges",
     "prepare_generated_graph",
+    "tilt_threshold_table",
 ]
 
 
@@ -214,6 +218,107 @@ def _spec_weights(spec) -> Optional[np.ndarray]:
             -1.0 / (spec.gamma - 1.0)
         )
     return None
+
+
+def epoch_key_words(seed: int, epoch: int) -> Tuple[np.uint32, np.uint32]:
+    """Threefry key words for one panic-rewiring EPOCH (ISSUE 15): derived
+    via SeedSequence((seed, 23, epoch)) — a distinct stream per epoch,
+    independent of the base generation stream (salt 23 collides with
+    neither `_spec_key_words`'s bare seed nor `_indeg_host`'s salt-1
+    tuple), deterministic across processes like every graphgen stream."""
+    k0, k1 = np.random.SeedSequence((seed, 23, epoch)).generate_state(2, np.uint32)
+    return np.uint32(k0), np.uint32(k1)
+
+
+def epoch_indegrees(spec, seed: int, epoch: int, e: int) -> np.ndarray:
+    """Per-epoch in-degree vector for panic rewiring: the SAME destination
+    marginal as the base spec (attention re-aims at sources, not at who
+    listens — see `tilt_threshold_table`), redrawn per epoch from
+    SeedSequence((seed, 1, epoch)) so epoch graphs are independent
+    realizations yet deterministic in (seed, epoch) across processes."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 1, epoch)))
+    w = _spec_weights(spec)
+    if w is None:
+        indeg = np.zeros(spec.n, np.int64)
+        done = 0
+        while done < e:
+            take = min(1 << 24, e - done)
+            indeg += np.bincount(
+                rng.integers(0, spec.n, size=take), minlength=spec.n
+            )
+            done += take
+        return indeg.astype(np.int32)
+    return rng.multinomial(e, w / w.sum()).astype(np.int32)
+
+
+def tilt_threshold_table(base_weights, wd, bias):
+    """uint32-quantized inverse CDF of the panic-tilted SOURCE marginal
+    (ISSUE 15): p(src = j) ∝ w_j · (1 + bias·wd_j), where ``wd`` is the
+    current withdrawn mask. This is the "attention concentrates on
+    withdrawing neighbors" law factored the graphgen way — the
+    destination marginal (who has how many in-edges) is untouched, so
+    the epoch stream is still BORN dst-sorted and no device sort ever
+    runs; only the per-edge source draw consults this table.
+
+    Traced (wd is simulation state): one device cumsum + normalize per
+    epoch, O(N). Quantization to 2^32 buckets carries the same ≤2^-24
+    relative bias as `_mulhi32`'s range map under f32 accumulation —
+    vanishing against the generative model's own sampling noise,
+    documented like the base generators'."""
+    w = jnp.asarray(base_weights)
+    t = w * (1.0 + jnp.asarray(bias, w.dtype) * wd.astype(w.dtype))
+    cdf = jnp.cumsum(t)
+    cdf = cdf / cdf[-1]
+    thr = jnp.minimum(cdf * 4294967296.0, 4294967295.0)
+    return thr.astype(jnp.uint32)
+
+
+@functools.lru_cache(maxsize=32)
+def _tilted_src_program(n: int, e: int, chunk: int, n_chunks: int):
+    """Jitted chunked source-assembly for one (n, E, chunk plan): draws
+    the dst-sorted source array of a rewired epoch from a tilted
+    threshold TABLE argument (the wd-dependent part stays traced, so one
+    program serves every epoch of a run — no per-epoch recompiles)."""
+
+    @jax.jit
+    def assemble(thr_table, k0, k1):
+        from sbr_tpu.obs import prof
+
+        prof.note_trace("social.graphgen.tilted_src")
+
+        def body(c, out):
+            c0 = c * jnp.int32(chunk)
+            eid = (c0 + jnp.arange(chunk, dtype=jnp.int32)).astype(jnp.uint32)
+            x0, _ = _threefry2x32(k0, k1, eid, jnp.zeros_like(eid))
+            s = jnp.minimum(_searchsorted32(thr_table, x0, "right"), n - 1)
+            return lax.dynamic_update_slice(out, s, (c0,))
+
+        out = jnp.zeros(n_chunks * chunk, jnp.int32)
+        return lax.fori_loop(0, n_chunks, body, out)[:e]
+
+    return assemble
+
+
+def generate_tilted_sources(n: int, e: int, key_words, thr_table,
+                            chunk_edges=None):
+    """dst-sorted SOURCE array of one rewired epoch: E counter-Threefry
+    draws against the tilted inverse-CDF table, chunked under the same
+    capacity plan as the base builds (``SBR_GRAPHGEN_BUDGET_BYTES`` et
+    al. via `plan_chunk_edges`). Positions are pure functions of
+    (epoch key, edge id), so the result is chunk-invariant and
+    deterministic in-process and across processes (tested)."""
+    if e == 0:
+        return jnp.zeros(0, jnp.int32)
+    chunk = (
+        plan_chunk_edges(e, n)
+        if chunk_edges in (None, "auto")
+        else int(chunk_edges)
+    )
+    chunk = max(1, min(chunk, max(e, 1), _MAX_CHUNK))
+    n_chunks = max(1, -(-e // chunk))
+    k0, k1 = key_words
+    run = _tilted_src_program(n, e, chunk, n_chunks)
+    return run(thr_table, jnp.uint32(k0), jnp.uint32(k1))
 
 
 def _indeg_host(spec, seed: int, e: int) -> np.ndarray:

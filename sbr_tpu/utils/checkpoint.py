@@ -403,11 +403,18 @@ class TileRunner:
         self, beta_values, u_values, base, config, tile_shape, ckpt,
         mesh=None, dtype=None, policy=None, retry_budget=None,
         heal_divergent: bool = True, tile_cache=None, verbose: bool = False,
+        scenario_spec=None,
     ) -> None:
         self.beta_values = np.asarray(beta_values)
         self.u_values = np.asarray(u_values)
         self.base = base
         self.config = config
+        # Composed-scenario tiling (ISSUE 15 satellite, the PR 13
+        # remainder): a non-None `scenario.ScenarioSpec` routes each
+        # tile's compute through `scenario_grid` instead of `beta_u_grid`
+        # and joins every fingerprint/cache key (see `_payload_base`), so
+        # scenario sweeps ride the same leases / tile cache / retry stack.
+        self.scenario_spec = scenario_spec
         self.tb, self.tu = (int(t) for t in tile_shape)
         self.nb, self.nu = len(self.beta_values), len(self.u_values)
         self.ckpt = Path(ckpt) if ckpt is not None else None
@@ -443,12 +450,22 @@ class TileRunner:
             return None
         return _load_tile_verified(path, may_quarantine=may_quarantine)
 
+    def _payload_base(self):
+        """What the fingerprint/cache machinery hashes as "the model": the
+        bare params for legacy sweeps (existing checkpoints and cache
+        entries stay valid), the (params, spec) pair for scenario sweeps —
+        `canonicalize` renders the tuple with the spec's dataclass name,
+        so a composed tile can never collide with a plain one."""
+        if self.scenario_spec is None:
+            return self.base
+        return (self.base, self.scenario_spec)
+
     def cache_key(self, bi: int, ui: int) -> Optional[str]:
         if self.tile_cache is None:
             return None
         bs, us = self.slices(bi, ui)
         return self.tile_cache.key(
-            self.base, self.config, self.dtype,
+            self._payload_base(), self.config, self.dtype,
             self.beta_values[bs], self.u_values[us],
         )
 
@@ -485,16 +502,22 @@ class TileRunner:
             # written from arrays that also landed (atomically) locally.
             # The meta sidecar makes the whole-tile entry per-cell
             # addressable for the serving fleet's degradation ladder
-            # (resilience.elastic.tile_meta / serve.fleet.TileCacheBridge).
-            from sbr_tpu.resilience.elastic import tile_meta
+            # (resilience.elastic.tile_meta / serve.fleet.TileCacheBridge)
+            # — PLAIN sweeps only: a scenario tile's cells answer a
+            # different pipeline, and `cell_tag` hashes bare params, so a
+            # sidecar here would let the ladder serve composed cells as
+            # plain answers. Scenario entries stay whole-tile addressable.
+            meta = None
+            if self.scenario_spec is None:
+                from sbr_tpu.resilience.elastic import tile_meta
 
-            bs, us = self.slices(bi, ui)
-            self.tile_cache.store(
-                key, arrays, tile=self.tile_id(bi, ui),
-                meta=tile_meta(
+                bs, us = self.slices(bi, ui)
+                meta = tile_meta(
                     self.base, self.config, self.dtype,
                     self.beta_values[bs], self.u_values[us], key,
-                ),
+                )
+            self.tile_cache.store(
+                key, arrays, tile=self.tile_id(bi, ui), meta=meta,
             )
         return "computed", arrays
 
@@ -507,10 +530,19 @@ class TileRunner:
 
         def compute_tile():
             faults.fire("tile.compute", target=tile_id)
-            tile = beta_u_grid(
-                self.beta_values[bs], self.u_values[us], self.base,
-                config=self.config, mesh=self.mesh, dtype=self.dtype,
-            )
+            if self.scenario_spec is not None:
+                from sbr_tpu.scenario import scenario_grid
+
+                tile = scenario_grid(
+                    self.scenario_spec, self.beta_values[bs],
+                    self.u_values[us], self.base, config=self.config,
+                    dtype=self.dtype,
+                )
+            else:
+                tile = beta_u_grid(
+                    self.beta_values[bs], self.u_values[us], self.base,
+                    config=self.config, mesh=self.mesh, dtype=self.dtype,
+                )
             arrays = {f: np.asarray(getattr(tile, f)).copy() for f in _FIELDS}
             tile_flags = (
                 np.asarray(tile.health.flags).copy()
@@ -551,7 +583,15 @@ class TileRunner:
         if inj is not None and inj.kind == "nan":
             _poison_tile(inj, arrays, tile_flags, tile_id)
 
-        if self.heal_divergent and (tile_flags != 0).any():
+        # The degrade ladder recomputes cells through the BASELINE path
+        # (`heal.repair_divergent`): valid for plain sweeps and for
+        # baseline-reducible specs (bit-identical cells by the scenario
+        # parity contract), meaningless for genuine compositions — those
+        # keep their original values, flags intact.
+        heal_ok = self.scenario_spec is None or (
+            self.scenario_spec.reduces_to() == "baseline"
+        )
+        if self.heal_divergent and heal_ok and (tile_flags != 0).any():
             tile_report = heal.repair_divergent(
                 self.beta_values[bs], self.u_values[us], self.base,
                 self.config, self.dtype, arrays, tile_flags, scope=tile_id,
@@ -599,15 +639,28 @@ def tile_runner(
     retry_budget: Optional[retry.RetryBudget] = None,
     tile_cache=None,
     verbose: bool = False,
+    scenario_spec=None,
 ) -> TileRunner:
     """Build a ready `TileRunner` for one sweep: resolves the config/tile-
     shape defaults exactly like `run_tiled_grid` (so fingerprints agree),
     creates+checks the checkpoint dir, and runs the OOM preflight once.
     ``tile_shape`` must already be resolved when it was "auto" upstream —
     pass the resolved pair (the elastic scheduler resolves before the
-    claim loop, like the multihost ownership split always has)."""
+    claim loop, like the multihost ownership split always has).
+
+    ``scenario_spec`` (ISSUE 15 satellite): a single-bank baseline-family
+    `scenario.ScenarioSpec` routes tile compute through `scenario_grid`;
+    the spec joins the sweep fingerprint and every tile-cache key, so
+    composed sweeps and plain sweeps can never share bytes. Use
+    `scenario.run_tiled_scenario_grid` rather than passing it here
+    directly (it runs the spec×params validation)."""
     if config is None:  # sweep default: refinement off (see beta_u_grid)
         config = SolverConfig(refine_crossings=False)
+    if scenario_spec is not None and mesh is not None:
+        raise ValueError(
+            "scenario_spec tiles compute through scenario_grid, which is "
+            "single-device — mesh= is not supported on scenario sweeps"
+        )
     beta_values = np.asarray(beta_values)
     u_values = np.asarray(u_values)
     nb, nu = len(beta_values), len(u_values)
@@ -633,12 +686,13 @@ def tile_runner(
     if heal_divergent is None:
         heal_divergent = os.environ.get("SBR_HEAL", "").strip() != "0"
     ckpt = None
+    fp_base = base if scenario_spec is None else (base, scenario_spec)
     if checkpoint_dir is not None:
         ckpt = Path(checkpoint_dir)
         ckpt.mkdir(parents=True, exist_ok=True)
         _check_fingerprint(
             ckpt,
-            _sweep_fingerprint(beta_values, u_values, base, config, tile_shape, dtype),
+            _sweep_fingerprint(beta_values, u_values, fp_base, config, tile_shape, dtype),
             tile_shape=tile_shape,
         )
     _preflight_tile(nb, nu, tile_shape[0], tile_shape[1], config, dtype, mesh, plan=_plan)
@@ -648,7 +702,7 @@ def tile_runner(
         beta_values, u_values, base, config, tile_shape, ckpt,
         mesh=mesh, dtype=dtype, policy=default_tile_policy(max_retries),
         retry_budget=retry_budget, heal_divergent=heal_divergent,
-        tile_cache=tile_cache, verbose=verbose,
+        tile_cache=tile_cache, verbose=verbose, scenario_spec=scenario_spec,
     )
 
 
@@ -667,6 +721,7 @@ def run_tiled_grid(
     heal_divergent: Optional[bool] = None,
     retry_budget: Optional[retry.RetryBudget] = None,
     tile_cache=None,
+    scenario_spec=None,
 ) -> GridSweepResult:
     """β×u grid in tiles with optional on-disk resume.
     NOTE ``config=None`` ≠ ``config=SolverConfig()``: None selects the sweep
@@ -726,7 +781,7 @@ def run_tiled_grid(
         beta_values, u_values, base, checkpoint_dir, config=config,
         tile_shape=tile_shape, mesh=mesh, dtype=dtype, max_retries=max_retries,
         heal_divergent=heal_divergent, retry_budget=retry_budget,
-        tile_cache=tile_cache, verbose=verbose,
+        tile_cache=tile_cache, verbose=verbose, scenario_spec=scenario_spec,
     )
     beta_values, u_values = runner.beta_values, runner.u_values
     nb, nu, tb, tu = runner.nb, runner.nu, runner.tb, runner.tu

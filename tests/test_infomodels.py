@@ -1,0 +1,870 @@
+"""Information-model engine (ISSUE 15): spec algebra, the gossip bitwise
+reduction, the fused belief kernel, panic rewiring determinism, mean-field
+fixed points, the close-the-loop contract, seeds-axis population sweeps,
+population serving, tiled scenario grids, report infomodel gating, and
+history schema 10."""
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sbr_tpu import obs
+from sbr_tpu.infomodels import (
+    InfoModelSpec,
+    crossing_times,
+    default_spec,
+    infomodel_fingerprint,
+    info_learning_curve,
+    observed_fraction,
+    parse_population_doc,
+    population_fingerprint,
+    population_query,
+    simulate_info,
+    solve_fixed_point_info,
+)
+from sbr_tpu.models.params import SolverConfig, make_hetero_params, make_model_params
+from sbr_tpu.social.agents import AgentSimConfig, simulate_agents
+from sbr_tpu.social.closure import close_loop
+from sbr_tpu.social.graphgen import (
+    ErdosRenyiSpec,
+    ScaleFreeSpec,
+    StochasticBlockSpec,
+    prepare_generated_graph,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+MODEL = make_model_params(beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25)
+
+
+@pytest.fixture(scope="module")
+def bayes_fp():
+    """The default bayes fixed point at the Figure-12 economics, shared by
+    every closure/population test in the module (the solve is the
+    expensive step)."""
+    return solve_fixed_point_info(
+        InfoModelSpec(channel="bayes"), MODEL, config=SolverConfig(n_grid=512)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Spec algebra
+# ---------------------------------------------------------------------------
+
+
+class TestInfoModelSpec:
+    def test_validation_rejects_bad_fields(self):
+        with pytest.raises(ValueError, match="channel"):
+            InfoModelSpec(channel="telepathy")
+        with pytest.raises(ValueError, match="dynamics"):
+            InfoModelSpec(dynamics="wormhole")
+        with pytest.raises(ValueError, match="q_calm"):
+            InfoModelSpec(q_calm=0.5, q_run=0.1)
+        with pytest.raises(ValueError, match="threshold_scale"):
+            InfoModelSpec(threshold_scale=0.0)
+        with pytest.raises(ValueError, match="sum to 1"):
+            InfoModelSpec(groups=((0.5, 3.0, 1.0), (0.6, 3.0, 1.0)))
+        with pytest.raises(ValueError, match="K >= 2"):
+            InfoModelSpec(groups=((1.0, 3.0, 1.0),))
+        with pytest.raises(ValueError, match="epoch_steps"):
+            InfoModelSpec(epoch_steps=0)
+
+    def test_llr_signs(self):
+        llr0, llr1 = InfoModelSpec(channel="bayes").llr
+        assert llr0 < 0 < llr1
+
+    def test_doc_round_trip(self):
+        spec = InfoModelSpec(
+            channel="bayes", dynamics="rewire", epoch_steps=7,
+            groups=((0.25, 2.0, 1.0), (0.75, 4.0, 3.0)),
+        )
+        assert InfoModelSpec.from_doc(spec.to_doc()) == spec
+        assert InfoModelSpec.from_doc({}) == InfoModelSpec()
+
+    def test_doc_unknown_key_is_loud(self):
+        with pytest.raises(ValueError, match="chanel"):
+            InfoModelSpec.from_doc({"chanel": "bayes"})
+
+    def test_reduces_to_gossip(self):
+        assert InfoModelSpec().reduces_to_gossip()
+        assert not InfoModelSpec(channel="bayes").reduces_to_gossip()
+        assert not InfoModelSpec(dynamics="rewire").reduces_to_gossip()
+        assert not InfoModelSpec(
+            groups=((0.5, 3.0, 1.0), (0.5, 3.0, 2.0))
+        ).reduces_to_gossip()
+
+    def test_fingerprint_distinct_and_stable(self):
+        a = infomodel_fingerprint(InfoModelSpec(), MODEL)
+        b = infomodel_fingerprint(InfoModelSpec(channel="bayes"), MODEL)
+        assert a != b
+        assert a == infomodel_fingerprint(InfoModelSpec(), MODEL)
+        assert a != infomodel_fingerprint(InfoModelSpec(), MODEL, extra=(1,))
+
+    def test_from_hetero_params(self):
+        hp = make_hetero_params(betas=(0.5, 1.5), dist=(0.4, 0.6))
+        spec = InfoModelSpec.from_hetero_params(hp, channel="bayes")
+        w, t, a = spec.group_table()
+        assert w == (0.4, 0.6)
+        # awareness = beta_k / <beta>, dist-weighted mean 1
+        assert abs(sum(wi * ai for wi, ai in zip(w, a)) - 1.0) < 1e-12
+
+    def test_default_spec_env(self, monkeypatch):
+        monkeypatch.setenv("SBR_INFOMODEL", "bayes")
+        monkeypatch.setenv("SBR_INFOMODEL_DYNAMICS", "rewire")
+        monkeypatch.setenv("SBR_INFOMODEL_EPOCH_STEPS", "9")
+        spec = default_spec()
+        assert (spec.channel, spec.dynamics, spec.epoch_steps) == ("bayes", "rewire", 9)
+        monkeypatch.setenv("SBR_INFOMODEL", "psychic")
+        with pytest.raises(ValueError, match="SBR_INFOMODEL"):
+            default_spec()
+
+
+# ---------------------------------------------------------------------------
+# Gossip bitwise reduction (ISSUE 15 satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestGossipReduction:
+    @pytest.mark.parametrize("engine", ["gather", "incremental"])
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("fused", ["lax", "interpret"])
+    def test_bitwise_equal_to_legacy(self, engine, dtype, fused):
+        graph = ErdosRenyiSpec(n=400, avg_degree=8.0)
+        cfg = AgentSimConfig(n_steps=20, dt=0.1, fused=fused)
+        r_info = simulate_info(
+            InfoModelSpec(), graph, beta=1.2, x0=0.02, config=cfg, seed=5,
+            dtype=dtype, engine=engine,
+        )
+        pg = prepare_generated_graph(
+            graph, seed=5, betas=1.2, config=cfg, dtype=dtype, engine=engine
+        )
+        r_leg = simulate_agents(prepared=pg, x0=0.02, config=cfg, seed=5)
+        for f in ("informed", "t_inf", "informed_frac", "withdrawn_frac", "t_grid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r_info, f)), np.asarray(getattr(r_leg, f))
+            )
+        assert r_info.belief is None and r_info.epochs == 1
+
+    def test_group_heterogeneity_changes_trajectory(self):
+        graph = ErdosRenyiSpec(n=2000, avg_degree=10.0)
+        cfg = AgentSimConfig(n_steps=30, dt=0.1)
+        homog = simulate_info(
+            InfoModelSpec(), graph, beta=1.0, x0=0.02, config=cfg, seed=2
+        )
+        hetero = simulate_info(
+            InfoModelSpec(groups=((0.5, 3.0, 0.2), (0.5, 3.0, 1.8))),
+            graph, beta=1.0, x0=0.02, config=cfg, seed=2,
+        )
+        assert not np.array_equal(
+            np.asarray(homog.informed_frac), np.asarray(hetero.informed_frac)
+        )
+
+
+# ---------------------------------------------------------------------------
+# The fused belief kernel
+# ---------------------------------------------------------------------------
+
+
+class TestBeliefKernel:
+    def _args(self, n, dtype):
+        rng = np.random.default_rng(0)
+        informed = jnp.asarray(rng.random(n) < 0.1)
+        t_inf = jnp.where(informed, 0.0, 0.0).astype(dtype)
+        belief = jnp.asarray(rng.normal(0, 1, n), dtype)
+        counts = jnp.asarray(rng.integers(0, 12, n), jnp.int32)
+        awareness = jnp.full(n, 2.0, dtype)
+        deg = jnp.full(n, 12.0, dtype)
+        thr = jnp.asarray(rng.normal(3.0, 1.5, n), dtype)
+        return informed, t_inf, belief, counts, awareness, deg, thr
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+    def test_interpret_matches_lax(self, dtype):
+        """Decisions (informed', t_inf') equal; beliefs ulp-close — the
+        float accumulator may fuse differently per lowering (FMA), unlike
+        the integer-Threefry infection kernel (see `belief_update`)."""
+        from sbr_tpu.social.fused import belief_update
+
+        n = 1500  # exercises the pad path (not a multiple of the block)
+        args = self._args(n, dtype)
+        llr0, llr1 = InfoModelSpec(channel="bayes").llr
+        out_lax = belief_update(*args, 0.3, 0.1, llr0, llr1, "lax")
+        out_int = belief_update(*args, 0.3, 0.1, llr0, llr1, "interpret")
+        np.testing.assert_array_equal(np.asarray(out_lax[0]), np.asarray(out_int[0]))
+        np.testing.assert_array_equal(np.asarray(out_lax[1]), np.asarray(out_int[1]))
+        # a few ulp at the ACCUMULATOR's magnitude (the increment is a
+        # same-order add, so relative error vs the small post-sum value
+        # can read as tens of eps — measured 6e-6 f32 / 5e-15 f64)
+        tol = 1e-4 if dtype == jnp.float32 else 1e-12
+        np.testing.assert_allclose(
+            np.asarray(out_lax[2]), np.asarray(out_int[2]), rtol=tol, atol=tol
+        )
+
+    def test_crossing_is_absorbing_and_stamps_t_inf(self):
+        from sbr_tpu.social.fused import belief_update
+
+        informed = jnp.zeros(4, bool)
+        t_inf = jnp.zeros(4, jnp.float32)
+        belief = jnp.asarray([0.0, 2.9, -5.0, 10.0], jnp.float32)
+        counts = jnp.asarray([10, 10, 0, 0], jnp.int32)
+        awareness = jnp.ones(4, jnp.float32)
+        deg = jnp.full(4, 10.0, jnp.float32)
+        thr = jnp.asarray([100.0, 3.0, 0.0, 3.0], jnp.float32)
+        llr0, llr1 = InfoModelSpec(channel="bayes").llr
+        inf2, t2, bel2 = belief_update(
+            informed, t_inf, belief, counts, awareness, deg, thr,
+            1.0, 0.1, llr0, llr1, "lax",
+        )
+        inf2, t2 = np.asarray(inf2), np.asarray(t2)
+        assert not inf2[0]  # threshold out of reach
+        assert inf2[1] and t2[1] == pytest.approx(1.1)  # crossed this step
+        assert not inf2[2]  # negative evidence, threshold 0 not crossed
+        assert inf2[3] and t2[3] == pytest.approx(1.1)  # already above
+
+    def test_unfused_resolves_to_lax(self):
+        from sbr_tpu.social.fused import resolve_belief_mode
+
+        assert resolve_belief_mode("unfused", np.float32) == "lax"
+        assert resolve_belief_mode("pallas", np.float64) == "lax"
+        with pytest.raises(ValueError, match="belief mode"):
+            resolve_belief_mode("warp", np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Panic rewiring
+# ---------------------------------------------------------------------------
+
+
+class TestRewire:
+    GRAPH = ErdosRenyiSpec(n=800, avg_degree=8.0)
+    CFG = AgentSimConfig(n_steps=24, dt=0.1)
+
+    def test_epoch_count_and_divergence_from_static(self):
+        spec = InfoModelSpec(dynamics="rewire", epoch_steps=8, rewire_bias=2.0)
+        r = simulate_info(spec, self.GRAPH, beta=1.2, x0=0.02, config=self.CFG, seed=3)
+        assert r.epochs == 3
+        r_static = simulate_info(
+            InfoModelSpec(), self.GRAPH, beta=1.2, x0=0.02, config=self.CFG, seed=3
+        )
+        assert not np.array_equal(
+            np.asarray(r.informed_frac), np.asarray(r_static.informed_frac)
+        )
+
+    def test_in_process_determinism(self):
+        spec = InfoModelSpec(
+            channel="bayes", dynamics="rewire", epoch_steps=8, rewire_bias=2.0
+        )
+        r1 = simulate_info(spec, self.GRAPH, x0=0.02, config=self.CFG, seed=4)
+        r2 = simulate_info(spec, self.GRAPH, x0=0.02, config=self.CFG, seed=4)
+        for f in ("informed", "t_inf", "belief", "informed_frac", "withdrawn_frac"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r1, f)), np.asarray(getattr(r2, f))
+            )
+
+    def test_cross_process_determinism(self):
+        spec = InfoModelSpec(dynamics="rewire", epoch_steps=8, rewire_bias=2.0)
+        r = simulate_info(spec, self.GRAPH, beta=1.2, x0=0.02, config=self.CFG, seed=6)
+        digest = hashlib.sha256(
+            np.asarray(r.informed).tobytes() + np.asarray(r.t_inf).tobytes()
+        ).hexdigest()
+        code = (
+            "import hashlib, numpy as np\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            "from sbr_tpu.infomodels import InfoModelSpec, simulate_info\n"
+            "from sbr_tpu.social.graphgen import ErdosRenyiSpec\n"
+            "from sbr_tpu.social.agents import AgentSimConfig\n"
+            "spec = InfoModelSpec(dynamics='rewire', epoch_steps=8, rewire_bias=2.0)\n"
+            "g = ErdosRenyiSpec(n=800, avg_degree=8.0)\n"
+            "r = simulate_info(spec, g, beta=1.2, x0=0.02,"
+            " config=AgentSimConfig(n_steps=24, dt=0.1), seed=6)\n"
+            "print(hashlib.sha256(np.asarray(r.informed).tobytes()"
+            " + np.asarray(r.t_inf).tobytes()).hexdigest())"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"},
+            cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        assert out.stdout.strip() == digest
+
+    def test_tilt_table_shape_and_monotone(self):
+        from sbr_tpu.social.graphgen import tilt_threshold_table
+
+        wd = jnp.zeros(100, bool).at[10].set(True)
+        thr = np.asarray(tilt_threshold_table(jnp.ones(100), wd, 4.0))
+        assert thr.dtype == np.uint32
+        assert (np.diff(thr.astype(np.int64)) >= 0).all()
+        assert thr[-1] == 4294967295
+        # the withdrawing slot's probability mass is (1+bias)x a calm slot's
+        gap = thr.astype(np.int64)[10] - thr.astype(np.int64)[9]
+        calm = thr.astype(np.int64)[9] - thr.astype(np.int64)[8]
+        assert gap == pytest.approx(5 * calm, rel=0.01)
+
+    def test_sbm_base_rejected(self):
+        spec = InfoModelSpec(dynamics="rewire")
+        sbm = StochasticBlockSpec(n=100, avg_degree=5.0)
+        with pytest.raises(ValueError, match="rewire"):
+            simulate_info(spec, sbm, config=self.CFG)
+
+    def test_prepared_conflicts_with_rewire(self):
+        pg = prepare_generated_graph(self.GRAPH, seed=0, betas=1.0, config=self.CFG)
+        with pytest.raises(ValueError, match="prepared"):
+            simulate_info(
+                InfoModelSpec(dynamics="rewire"), self.GRAPH, config=self.CFG,
+                prepared=pg,
+            )
+
+    def test_bias_zero_rewire_matches_static_physics(self):
+        """The scalar awareness (a bayes knob, default 3.0) must CANCEL in
+        the gossip channel: a bias-0 rewire of the default spec is the
+        same model as static up to graph realizations, so the trajectories
+        agree in distribution — a hidden β×awareness multiplier on one
+        path (the review finding) would triple the cascade speed."""
+        g = ErdosRenyiSpec(n=4000, avg_degree=12.0)
+        cfg = AgentSimConfig(n_steps=60, dt=0.1)
+        r_st = simulate_info(InfoModelSpec(), g, beta=1.0, x0=0.02, config=cfg, seed=3)
+        r_rw = simulate_info(
+            InfoModelSpec(dynamics="rewire", rewire_bias=0.0, epoch_steps=10),
+            g, beta=1.0, x0=0.02, config=cfg, seed=3,
+        )
+        g_st = float(np.asarray(r_st.informed_frac)[-1])
+        g_rw = float(np.asarray(r_rw.informed_frac)[-1])
+        assert abs(g_st - g_rw) < 0.1, (g_st, g_rw)
+
+    def test_scale_free_base_runs(self):
+        spec = InfoModelSpec(dynamics="rewire", epoch_steps=12, rewire_bias=1.0)
+        sf = ScaleFreeSpec(n=500, avg_degree=6.0, gamma=2.5)
+        r = simulate_info(spec, sf, beta=1.0, x0=0.05, config=self.CFG, seed=1)
+        assert r.epochs == 2
+        assert np.isfinite(np.asarray(r.informed_frac)).all()
+
+
+# ---------------------------------------------------------------------------
+# Mean-field fixed points
+# ---------------------------------------------------------------------------
+
+
+class TestMeanField:
+    def test_observed_fraction_tilt(self):
+        spec = InfoModelSpec(dynamics="rewire", rewire_bias=4.0)
+        aw = np.asarray([0.0, 0.1, 1.0])
+        w = np.asarray(observed_fraction(jnp.asarray(aw), spec))
+        np.testing.assert_allclose(w, aw * 5.0 / (1.0 + 4.0 * aw), rtol=1e-6)
+        static = InfoModelSpec()
+        assert observed_fraction(jnp.asarray(aw), static) is not None
+        np.testing.assert_array_equal(
+            np.asarray(observed_fraction(jnp.asarray(aw), static)), aw
+        )
+
+    def test_bayes_learning_curve_shape(self):
+        spec = InfoModelSpec(channel="bayes")
+        grid = jnp.linspace(0.0, 10.0, 200)
+        aw = jnp.full(200, 0.3)
+        ls = info_learning_curve(spec, 0.9, aw, grid, 1e-4)
+        cdf = np.asarray(ls.cdf)
+        assert (np.diff(cdf) >= -1e-12).all()  # monotone
+        assert cdf[0] > 0.05  # the panic-prone instant cohort
+        assert (np.asarray(ls.pdf) >= 0).all()
+
+    def test_bayes_fixed_point_runs_and_converges(self, bayes_fp):
+        assert bool(bayes_fp.converged)
+        assert bool(bayes_fp.equilibrium.bankrun)
+        assert 0.0 < float(bayes_fp.xi) < float(MODEL.economic.eta)
+
+    def test_gossip_reducible_delegates_to_legacy(self):
+        from sbr_tpu.social.solver import solve_equilibrium_social
+
+        cfg = SolverConfig(n_grid=256)
+        fp_info = solve_fixed_point_info(InfoModelSpec(), MODEL, config=cfg)
+        fp_leg = solve_equilibrium_social(MODEL, config=cfg)
+        assert np.array_equal(np.asarray(fp_info.aw), np.asarray(fp_leg.aw))
+        assert float(fp_info.xi) == float(fp_leg.xi)
+
+    def test_gossip_rewire_fixed_point_has_run(self):
+        spec = InfoModelSpec(dynamics="rewire", rewire_bias=1.0, epoch_steps=5)
+        fp = solve_fixed_point_info(spec, MODEL, config=SolverConfig(n_grid=512))
+        assert bool(fp.converged) and bool(fp.equilibrium.bankrun)
+
+
+# ---------------------------------------------------------------------------
+# Close-the-loop contract + the seeds axis
+# ---------------------------------------------------------------------------
+
+
+class TestCloseLoop:
+    def test_bayes_closes_against_mean_field(self, bayes_fp):
+        comp = close_loop(
+            model=MODEL, infomodel=InfoModelSpec(channel="bayes"),
+            n_agents=4000, avg_degree=15.0, dt=0.05, g0=0.2, t_max=8.0,
+            n_reps=2, fp=bayes_fp, tolerance=0.25,
+        )
+        assert comp.err_aw_sup < 0.25
+        assert comp.err_g_rms < 0.06
+        assert comp.infomodel is not None
+
+    def test_gossip_rewire_closes_against_tilted_curve(self):
+        spec = InfoModelSpec(dynamics="rewire", epoch_steps=2, rewire_bias=1.0)
+        comp = close_loop(
+            model=MODEL, infomodel=spec, n_agents=6000, avg_degree=15.0,
+            dt=0.1, g0=0.02, t_max=14.0, config=SolverConfig(n_grid=512),
+        )
+        assert comp.err_aw_sup < 0.3
+        assert comp.err_g_rms < 0.08
+
+    def test_bayes_rewire_closes_at_fine_epochs(self):
+        # The rewire curve is the epoch→0 limit and the bayes run window
+        # is short (ξ≈0.4): epoch_steps·dt must sit well under it
+        # (meanfield module docstring) — at 0.04 the loop closes.
+        spec = InfoModelSpec(
+            channel="bayes", dynamics="rewire", epoch_steps=2, rewire_bias=1.0
+        )
+        comp = close_loop(
+            model=MODEL, infomodel=spec, n_agents=6000, avg_degree=20.0,
+            dt=0.02, g0=None, t_max=6.0, config=SolverConfig(n_grid=512),
+        )
+        assert bool(comp.fp.equilibrium.bankrun)
+        assert comp.aw_sim.max() > float(MODEL.economic.kappa)  # cascade ran
+        assert comp.err_g_rms < 0.06
+
+    def test_seeds_axis_prepares_graph_once(self, bayes_fp, monkeypatch):
+        import sbr_tpu.social.closure as closure_mod
+        from sbr_tpu.social import graphgen
+
+        calls = []
+        real = graphgen.prepare_generated_graph
+
+        def counting(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(graphgen, "prepare_generated_graph", counting)
+        comp = close_loop(
+            model=MODEL, infomodel=InfoModelSpec(channel="bayes"),
+            n_agents=2000, avg_degree=10.0, dt=0.1, g0=None, t_max=6.0,
+            seeds=[11, 22, 33], fp=bayes_fp,
+        )
+        assert len(calls) == 1  # ONE prepare for three members
+        assert comp.n_reps == 3
+        assert comp.aw_seeds is not None and comp.aw_seeds.shape[0] == 3
+        # members differ (per-member thresholds/seeds vary)
+        assert not np.array_equal(comp.aw_seeds[0], comp.aw_seeds[1])
+
+    def test_seeds_axis_legacy_graph_spec(self):
+        comp = close_loop(
+            n_agents=3000, avg_degree=12.0, dt=0.1, t_max=10.0,
+            graph=ErdosRenyiSpec(n=3000, avg_degree=12.0),
+            seeds=[1, 2], config=SolverConfig(n_grid=256),
+        )
+        assert comp.aw_seeds is not None and comp.aw_seeds.shape[0] == 2
+
+    def test_infomodel_rejects_mesh(self):
+        from sbr_tpu.parallel import make_agent_mesh
+
+        with pytest.raises(ValueError, match="single-device"):
+            close_loop(
+                model=MODEL, infomodel=InfoModelSpec(channel="bayes"),
+                n_agents=1000, mesh=make_agent_mesh(),
+            )
+
+    def test_empty_seeds_rejected(self, bayes_fp):
+        with pytest.raises(ValueError, match="non-empty"):
+            close_loop(
+                model=MODEL, infomodel=InfoModelSpec(channel="bayes"),
+                n_agents=1000, seeds=[], fp=bayes_fp,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Population queries
+# ---------------------------------------------------------------------------
+
+
+class TestPopulation:
+    def test_crossing_times_unit(self):
+        t = np.asarray([0.0, 1.0, 2.0, 3.0])
+        rows = np.asarray([
+            [0.0, 0.1, 0.3, 0.5],   # crosses 0.25 between t=1 and t=2
+            [0.0, 0.05, 0.1, 0.2],  # never crosses
+            [0.5, 0.6, 0.7, 0.8],   # already above at t=0
+        ])
+        out = crossing_times(rows, t, 0.25)
+        assert out[0] == pytest.approx(1.75)
+        assert np.isnan(out[1])
+        assert out[2] == 0.0
+
+    def test_population_query_record(self, bayes_fp):
+        rec = population_query(
+            InfoModelSpec(channel="bayes"), ErdosRenyiSpec(n=1000, avg_degree=10.0),
+            MODEL, seeds=3, vary="sim", g0=None,
+            config=SolverConfig(n_grid=512), fp=bayes_fp,
+        )
+        assert rec["kind"] == "population"
+        assert rec["seeds"] == 3 and len(rec["crossing_times"]) == 3
+        assert 0.0 <= rec["run_probability"] <= 1.0
+        q = rec["crossing_quantiles"]
+        if rec["run_probability"] == 1.0:
+            assert q["p10"] <= q["p50"] <= q["p90"]
+
+    def test_population_query_vary_graph(self, bayes_fp):
+        rec = population_query(
+            InfoModelSpec(channel="bayes"), ErdosRenyiSpec(n=800, avg_degree=8.0),
+            MODEL, seeds=2, vary="graph", g0=None,
+            config=SolverConfig(n_grid=512), fp=bayes_fp,
+        )
+        assert rec["vary"] == "graph" and len(rec["crossing_times"]) == 2
+        # per-realization comparisons, max-reduced (the review finding)
+        assert rec["err_aw_sup"] > 0
+
+    def test_parse_population_doc_errors(self):
+        with pytest.raises(ValueError, match="graph"):
+            parse_population_doc({})
+        with pytest.raises(ValueError, match="unknown population"):
+            parse_population_doc({"graph": {"n": 10, "avg_degree": 2}, "sedes": 3})
+        with pytest.raises(ValueError, match="seeds"):
+            parse_population_doc(
+                {"graph": {"n": 10, "avg_degree": 2}, "seeds": 100000}
+            )
+        with pytest.raises(ValueError, match="vary"):
+            parse_population_doc(
+                {"graph": {"n": 10, "avg_degree": 2}, "vary": "chaos"}
+            )
+        kw = parse_population_doc(
+            {"graph": {"model": "scale_free", "n": 50, "avg_degree": 3, "gamma": 2.2},
+             "infomodel": {"channel": "bayes"}, "seeds": 2}
+        )
+        assert isinstance(kw["graph"], ScaleFreeSpec)
+        assert kw["spec"].channel == "bayes"
+
+    def test_population_fingerprint_distinctions(self):
+        base = {"spec": InfoModelSpec(channel="bayes"),
+                "graph": ErdosRenyiSpec(n=100, avg_degree=5.0),
+                "seeds": 4, "vary": "sim", "seed": 0, "dt": 0.1}
+        cfg = SolverConfig(n_grid=128)
+        f = population_fingerprint(base, MODEL, cfg, "float64")
+        assert f == population_fingerprint(dict(base), MODEL, cfg, "float64")
+        assert f != population_fingerprint({**base, "vary": "graph"}, MODEL, cfg, "float64")
+        assert f != population_fingerprint(
+            {**base, "graph": ErdosRenyiSpec(n=101, avg_degree=5.0)}, MODEL, cfg,
+            "float64",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Serving: Engine.query_population + the endpoint route
+# ---------------------------------------------------------------------------
+
+
+class TestServePopulation:
+    POP = {
+        "graph": {"model": "erdos_renyi", "n": 800, "avg_degree": 8},
+        "infomodel": {"channel": "bayes"},
+        "seeds": 2, "vary": "sim", "g0": None,
+    }
+    PARAMS_DOC = {
+        "beta": 0.9, "eta_bar": 30.0, "u": 0.5, "p": 0.99,
+        "kappa": 0.25, "lam": 0.25,
+    }
+
+    def _engine(self, tmp_path, monkeypatch):
+        from sbr_tpu.serve.engine import Engine
+
+        monkeypatch.setenv("SBR_SERVE_CACHE_DIR", str(tmp_path / "cache"))
+        from sbr_tpu.serve.engine import ServeConfig
+
+        return Engine(config=SolverConfig(n_grid=256), serve=ServeConfig.from_env())
+
+    def test_cache_layers_and_restart(self, tmp_path, monkeypatch):
+        eng = self._engine(tmp_path, monkeypatch)
+        rec1 = eng.query_population(MODEL, self.POP)
+        assert rec1["source"] == "computed"
+        rec2 = eng.query_population(MODEL, self.POP)
+        assert rec2["source"] == "lru"
+        assert rec2["population_fingerprint"] == rec1["population_fingerprint"]
+        eng.close()
+        eng2 = self._engine(tmp_path, monkeypatch)
+        rec3 = eng2.query_population(MODEL, self.POP)
+        assert rec3["source"] == "disk"  # restart restores from the disk layer
+        assert rec3["run_probability"] == rec1["run_probability"]
+        eng2.close()
+
+    def test_endpoint_route(self, tmp_path, monkeypatch):
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+
+        eng = self._engine(tmp_path, monkeypatch)
+        with ServeEndpoint(eng) as ep:
+            url = f"http://127.0.0.1:{ep.port}/query"
+            body = json.dumps({**self.PARAMS_DOC, "population": self.POP}).encode()
+            r = urllib.request.urlopen(urllib.request.Request(url, data=body))
+            doc = json.loads(r.read())
+            assert r.status == 200
+            assert doc["kind"] == "population" and "run_probability" in doc
+            # malformed population -> 400
+            bad = json.dumps(
+                {**self.PARAMS_DOC, "population": {"graph": {"model": "nope"}}}
+            ).encode()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(url, data=bad))
+            assert exc.value.code == 400
+            # population + scenario is a contradiction -> 400
+            both = json.dumps(
+                {**self.PARAMS_DOC, "population": self.POP,
+                 "scenario": {"learning": "baseline"}}
+            ).encode()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(url, data=both))
+            assert exc.value.code == 400
+            # population + grads -> 400
+            wg = json.dumps(
+                {**self.PARAMS_DOC, "population": self.POP, "grads": True}
+            ).encode()
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(url, data=wg))
+            assert exc.value.code == 400
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Tiled scenario grids (ISSUE 15 satellite 1 — the PR 13 remainder)
+# ---------------------------------------------------------------------------
+
+
+class TestTiledScenarioGrid:
+    BETAS = np.linspace(0.5, 2.0, 10)
+    US = np.linspace(0.1, 0.9, 8)
+    CFG = SolverConfig(n_grid=96, bisect_iters=40, refine_crossings=False)
+
+    def test_tiled_equals_plain_and_warm_cache(self, tmp_path, monkeypatch):
+        from sbr_tpu import scenario
+        from sbr_tpu.resilience.elastic import TileCache
+
+        base = make_model_params(insurance_cap=0.3)
+        spec = scenario.ScenarioSpec(modifiers=("insurance_cap",))
+        plain = scenario.scenario_grid(
+            spec, self.BETAS, self.US, base, config=self.CFG
+        )
+        cache = TileCache(str(tmp_path / "tc"))
+        tiled = scenario.run_tiled_scenario_grid(
+            spec, self.BETAS, self.US, base,
+            checkpoint_dir=str(tmp_path / "ck1"), config=self.CFG,
+            tile_shape=(5, 4), tile_cache=cache,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.status), np.asarray(tiled.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.xi), np.asarray(tiled.xi)
+        )
+        # warm re-sweep on a FRESH checkpoint: every tile answers from the
+        # cross-run cache — scenario_grid must never run again
+        import sbr_tpu.scenario.engine as eng_mod
+
+        def boom(*a, **kw):
+            raise AssertionError("warm re-sweep recomputed a tile")
+
+        monkeypatch.setattr(eng_mod, "scenario_grid", boom)
+        monkeypatch.setattr(scenario, "scenario_grid", boom)
+        warm = scenario.run_tiled_scenario_grid(
+            spec, self.BETAS, self.US, base,
+            checkpoint_dir=str(tmp_path / "ck2"), config=self.CFG,
+            tile_shape=(5, 4), tile_cache=cache,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.status), np.asarray(warm.status)
+        )
+
+    def test_spec_joins_fingerprint_and_cache_key(self, tmp_path):
+        from sbr_tpu import scenario
+        from sbr_tpu.resilience.elastic import TileCache
+        from sbr_tpu.utils.checkpoint import tile_runner
+
+        base = make_model_params(insurance_cap=0.3)
+        cache = TileCache(str(tmp_path / "tc"))
+        spec = scenario.ScenarioSpec(modifiers=("insurance_cap",))
+        r_plain = tile_runner(
+            self.BETAS, self.US, base, None, config=self.CFG,
+            tile_shape=(5, 4), tile_cache=cache,
+        )
+        r_spec = tile_runner(
+            self.BETAS, self.US, base, None, config=self.CFG,
+            tile_shape=(5, 4), tile_cache=cache, scenario_spec=spec,
+        )
+        assert r_plain.cache_key(0, 0) != r_spec.cache_key(0, 0)
+        # and the checkpoint fingerprints differ too: the same dir must
+        # reject the other kind loudly
+        ck = str(tmp_path / "ck")
+        tile_runner(
+            self.BETAS, self.US, base, ck, config=self.CFG, tile_shape=(5, 4),
+        )
+        with pytest.raises(ValueError, match="[Ff]ingerprint"):
+            tile_runner(
+                self.BETAS, self.US, base, ck, config=self.CFG,
+                tile_shape=(5, 4), scenario_spec=spec,
+            )
+
+    def test_baseline_reduction_shares_plain_keying(self, tmp_path):
+        from sbr_tpu import scenario
+        from sbr_tpu.sweeps.baseline_sweeps import beta_u_grid
+
+        base = make_model_params()
+        tiled = scenario.run_tiled_scenario_grid(
+            scenario.ScenarioSpec(), self.BETAS, self.US, base,
+            checkpoint_dir=str(tmp_path / "ck"), config=self.CFG,
+            tile_shape=(5, 4),
+        )
+        legacy = beta_u_grid(self.BETAS, self.US, base, config=self.CFG)
+        np.testing.assert_array_equal(
+            np.asarray(tiled.status), np.asarray(legacy.status)
+        )
+
+    def test_spec_constraints(self, tmp_path):
+        from sbr_tpu import scenario
+
+        base = make_model_params()
+        with pytest.raises(ValueError, match="single-bank"):
+            scenario.run_tiled_scenario_grid(
+                scenario.ScenarioSpec(banks=2, exposure=((0, 1, 0.5),)),
+                self.BETAS, self.US, [base, base],
+            )
+        with pytest.raises(ValueError, match="mesh"):
+            from sbr_tpu.utils.checkpoint import tile_runner
+
+            from sbr_tpu.parallel import make_agent_mesh
+
+            tile_runner(
+                self.BETAS, self.US, base, None, config=self.CFG,
+                tile_shape=(5, 4), mesh=make_agent_mesh(),
+                scenario_spec=scenario.ScenarioSpec(modifiers=("lolr",)),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Obs: log_infomodel roll-up + report infomodel gating
+# ---------------------------------------------------------------------------
+
+
+class TestReportInfomodel:
+    def _report(self, run_dir, *args):
+        r = subprocess.run(
+            [sys.executable, "-m", "sbr_tpu.obs.report", "infomodel",
+             str(run_dir), *args],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": str(REPO)}, cwd=str(REPO),
+        )
+        return r
+
+    def test_manifest_rollup_and_exit_zero(self, tmp_path):
+        run = obs.start_run(label="im", run_dir=str(tmp_path / "r"))
+        obs.log_infomodel("fixed_point", channel="bayes", dynamics="static",
+                          converged=True, iterations=20, xi=0.9, bankrun=True)
+        obs.log_infomodel("closure", channel="bayes", dynamics="static",
+                          n_agents=100, n_reps=1, err_aw_sup=0.1,
+                          err_g_rms=0.02, tolerance=0.25)
+        obs.log_infomodel("rewire_epoch", epoch=0, channel="bayes", steps=5,
+                          edges=10, withdrawing=0)
+        obs.end_run()
+        manifest = json.loads((tmp_path / "r" / "manifest.json").read_text())
+        blk = manifest["infomodel"]
+        assert blk["fixed_point"] == 1 and blk["closure"] == 1
+        assert "nonconverged" not in blk and "breaches" not in blk
+        r = self._report(tmp_path / "r", "--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["counts"]["rewire_epoch"] == 1
+
+    def test_breach_and_nonconverged_exit_one(self, tmp_path):
+        run = obs.start_run(label="im", run_dir=str(tmp_path / "r"))
+        obs.log_infomodel("fixed_point", channel="bayes", dynamics="static",
+                          converged=False, iterations=250, xi=0.0, bankrun=False)
+        obs.log_infomodel("closure", channel="gossip", dynamics="rewire",
+                          n_agents=100, n_reps=1, err_aw_sup=0.9,
+                          err_g_rms=0.5, tolerance=0.25)
+        obs.end_run()
+        manifest = json.loads((tmp_path / "r" / "manifest.json").read_text())
+        assert manifest["infomodel"]["nonconverged"] == 1
+        assert manifest["infomodel"]["breaches"] == 1
+        r = self._report(tmp_path / "r", "--json")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["nonconverged"] == 1 and doc["breaches_count"] == 1
+
+    def test_no_data_exit_three(self, tmp_path):
+        run = obs.start_run(label="plain", run_dir=str(tmp_path / "r"))
+        obs.event("status", stage="x")
+        obs.end_run()
+        assert self._report(tmp_path / "r").returncode == 3
+
+    def test_legacy_close_loop_emits_no_infomodel_events(self, tmp_path):
+        """A run dir produced by the LEGACY gossip closure must keep
+        reading exit 3 — emitting closure events there would defeat the
+        no-data guard (the review finding)."""
+        run = obs.start_run(label="legacy", run_dir=str(tmp_path / "r"))
+        close_loop(
+            n_agents=1500, avg_degree=10.0, dt=0.1, t_max=8.0,
+            config=SolverConfig(n_grid=256),
+        )
+        obs.end_run()
+        assert self._report(tmp_path / "r").returncode == 3
+
+    def test_bad_dir_exit_two(self, tmp_path):
+        assert self._report(tmp_path / "missing").returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# History schema 10
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema10:
+    def test_append_and_gate_pick_up_schema10_keys(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        result = {
+            "metric": "beta_u_grid_equilibria_per_sec", "value": 1000.0,
+            "extra": {
+                "infomodel_belief_updates_per_sec": 3.0e6,
+                "infomodel_population_queries_per_sec": 2.5,
+            },
+        }
+        metrics = history.bench_metrics(result)
+        assert metrics["infomodel_belief_updates_per_sec"] == 3.0e6
+        assert metrics["infomodel_population_queries_per_sec"] == 2.5
+        history.append(metrics, path=path)
+        recs = history.load(path)
+        assert recs[-1]["schema"] == 10
+        assert recs[-1]["metrics"]["infomodel_population_queries_per_sec"] == 2.5
+
+    def test_polarity_higher_better(self):
+        from sbr_tpu.obs.history import polarity
+
+        assert polarity("infomodel_belief_updates_per_sec") == 1
+        assert polarity("infomodel_population_queries_per_sec") == 1
+
+    def test_old_schema_lines_still_load(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        lines = [
+            {"label": "bench", "metrics": {"agent_steps_per_sec": 1.0}},  # schema-less
+            {"schema": 9, "label": "bench",
+             "metrics": {"scenario_overhead_ratio": 1.0}},
+        ]
+        with open(path, "w") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec) + "\n")
+        history.append({"infomodel_belief_updates_per_sec": 5.0}, path=path)
+        recs = history.load(path)
+        assert [r["schema"] for r in recs] == [1, 9, 10]

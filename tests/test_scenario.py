@@ -635,7 +635,7 @@ class TestHistorySchema9:
     def test_schema_bumped_and_keys_harvested(self, tmp_path):
         from sbr_tpu.obs import history
 
-        assert history.SCHEMA == 9
+        assert history.SCHEMA >= 9  # ISSUE 15 bumped to 10
         result = {
             "metric": "beta_u_grid_equilibria_per_sec", "value": 100.0,
             "extra": {
@@ -668,7 +668,7 @@ class TestHistorySchema9:
         records = history.load(p)
         assert len(records) == 10
         assert records[0]["schema"] == 1  # schema-less stamped as 1
-        assert records[-1]["schema"] == 9
+        assert records[-1]["schema"] == history.SCHEMA
         verdicts, status = history.check(records)
         assert status == "ok"
         assert verdicts["eq_per_sec"]["status"] == "ok"
