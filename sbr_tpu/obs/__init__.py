@@ -57,7 +57,10 @@ Disabled (the default), every instrumentation site is a single global read
 jit caches (asserted by tests/test_obs.py).
 """
 
-from sbr_tpu.obs import history, mem, prof
+# NOTE: `obs.trace` is the distributed-tracing MODULE (ISSUE 16). The
+# profiler-capture context manager formerly re-exported under this name
+# lives at its home, `obs.timing.trace` (also `utils.timing.trace`).
+from sbr_tpu.obs import history, mem, prof, trace
 from sbr_tpu.obs.metrics import MetricsRegistry, metrics
 from sbr_tpu.obs.prof import annotate, note_trace, profile, step_annotation
 from sbr_tpu.obs.runlog import (
@@ -86,7 +89,7 @@ from sbr_tpu.obs.runlog import (
     start_run,
     suspended,
 )
-from sbr_tpu.obs.timing import StageTimer, fence, trace
+from sbr_tpu.obs.timing import StageTimer, fence
 
 __all__ = [
     "MetricsRegistry",

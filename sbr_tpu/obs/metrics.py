@@ -178,6 +178,55 @@ class LogHistogram:
         return h
 
 
+class LabeledHistograms:
+    """A family of `LogHistogram`s keyed by one label value.
+
+    The shape behind per-layer span-duration histograms on ``/metrics``
+    (``sbr_trace_span_ms{layer="engine.dispatch"}``): `record` is the same
+    O(1) lock-free-under-CPython increment `LogHistogram.record` is — the
+    dict-get worst race creates one extra throwaway histogram whose single
+    sample is lost, never corrupts existing buckets. ``max_labels`` bounds
+    cardinality: past it, new labels fold into ``"other"`` instead of
+    growing the exposition without bound."""
+
+    __slots__ = ("bounds", "by_label", "max_labels")
+
+    def __init__(self, bounds: Tuple[float, ...], max_labels: int = 64) -> None:
+        self.bounds = tuple(bounds)
+        self.by_label: Dict[str, LogHistogram] = {}
+        self.max_labels = max_labels
+
+    def record(self, label: str, value: float) -> None:
+        h = self.by_label.get(label)
+        if h is None:
+            if len(self.by_label) >= self.max_labels:
+                label = "other"
+                h = self.by_label.get(label)
+            if h is None:
+                h = self.by_label.setdefault(label, LogHistogram(self.bounds))
+        h.record(value)
+
+    def summaries(self) -> Dict[str, dict]:
+        """JSON-ready per-label reductions, label-sorted for determinism."""
+        return {k: self.by_label[k].summary() for k in sorted(self.by_label)}
+
+    def to_prometheus(self, name: str, label_key: str = "layer") -> List[str]:
+        """Exposition lines for every label's histogram under one family.
+
+        Emits the ``# TYPE`` header once; per-label lines carry
+        ``label_key="<label>"`` so a single scrape shows the full per-layer
+        latency breakdown."""
+        if not self.by_label:
+            return []
+        lines = [f"# TYPE {name} histogram"]
+        for label in sorted(self.by_label):
+            sub = self.by_label[label].to_prometheus(
+                name, labels=f'{label_key}="{label}"'
+            )
+            lines.extend(sub[1:])  # drop the per-label TYPE header
+        return lines
+
+
 class MetricsRegistry:
     """Process-local counters / gauges / timer histograms.
 

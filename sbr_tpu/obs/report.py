@@ -45,6 +45,21 @@ Usage:
                                                     # queries or a breaker
                                                     # stuck open, 3 when no
                                                     # fleet data was recorded
+    python -m sbr_tpu.obs.report trace DIR [DIR..]  # fleet-wide trace join
+                                                    # (router + worker run
+                                                    # dirs): per-query span
+                                                    # waterfalls; exit 1 when
+                                                    # a sampled trace has
+                                                    # orphaned/unjoinable
+                                                    # spans, 3 with no spans
+    python -m sbr_tpu.obs.report slo DIR [DIR..]    # SLO observatory over
+                                                    # trace spans: per-layer
+                                                    # latency breakdowns,
+                                                    # breach exemplar tables,
+                                                    # hedge/failover
+                                                    # causality; exit 1 on a
+                                                    # breach, 3 with nothing
+                                                    # to judge
     python -m sbr_tpu.obs.report gc [ROOT] --keep N # prune old run dirs +
                                                     # checkpoint debris
                                                     # (quarantine/, stale
@@ -1939,6 +1954,12 @@ def _main_gc(argv) -> int:
         help="age (days) past which an unused tile-cache entry is pruned "
         "(default 30; only with --tile-cache)",
     )
+    parser.add_argument(
+        "--trace-keep", type=int, default=None, metavar="N", dest="trace_keep",
+        help="also prune rotated trace span files (trace.NNN.jsonl) inside "
+        "kept run dirs down to the N most recent per dir; live runs and "
+        "the active trace.jsonl are never touched",
+    )
     args = parser.parse_args(argv)
     import os
 
@@ -1967,7 +1988,506 @@ def _main_gc(argv) -> int:
               f"(unused for {args.keep_days:g} days)")
         for p in pruned:
             print(f"  {p}")
+    if args.trace_keep is not None:
+        from sbr_tpu.obs.trace import gc_trace_files
+
+        pruned = gc_trace_files(root, keep_rotated=args.trace_keep)
+        print(f"removed {len(pruned)} rotated trace span file(s) "
+              f"(keep {args.trace_keep} per run dir)")
+        for p in pruned:
+            print(f"  {p}")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# Distributed-trace reports (`trace` / `slo` subcommands — ISSUE 16)
+# ---------------------------------------------------------------------------
+
+#: Span names that root a trace in some process (used by `slo` to pick the
+#: end-to-end measurement when the cross-process root is ambiguous).
+_TRACE_ROOT_NAMES = ("router.request", "worker.request", "loadgen.query")
+
+
+def _load_fleet_spans(run_dirs) -> tuple:
+    """Spans from N run dirs, each tagged with its source dir.
+
+    Returns ``(spans, bad_lines, per_dir)``; raises ``NotADirectoryError``
+    for a missing dir (the exit-2 contract every run-dir subcommand keeps).
+    """
+    from sbr_tpu.obs import trace as qtrace
+
+    spans, bad, per_dir = [], 0, []
+    for d in run_dirs:
+        if not Path(d).is_dir():
+            raise NotADirectoryError(str(d))
+        got, b = qtrace.load_spans(d)
+        for s in got:
+            s["_dir"] = str(d)
+        spans.extend(got)
+        bad += b
+        per_dir.append({"dir": str(d), "spans": len(got), "bad_span_lines": b})
+    return spans, bad, per_dir
+
+
+def _span_attrs(span: dict) -> dict:
+    skip = {"trace", "span", "parent", "name", "svc", "ts", "dur_ms", "_dir"}
+    return {k: v for k, v in span.items() if k not in skip}
+
+
+def _join_trace(spans: list) -> dict:
+    """Join one trace's spans into a tree; returns the join verdict.
+
+    - root: the unique parentless span (earliest by ts when several claim
+      it — a worker-side exemplar whose router half was head-dropped).
+    - orphans: spans whose parent id exists nowhere in the trace AND is not
+      the root's own remote parent (which legitimately lives upstream).
+    - coverage: union of non-root span intervals clipped to the root's
+      interval, over the root's duration — "how much of the end-to-end
+      latency the waterfall explains".
+    """
+    ids = {s["span"] for s in spans}
+    roots = [s for s in spans if not s.get("parent")]
+    root = min(roots, key=lambda s: s.get("ts", 0.0)) if roots else None
+    orphans = [
+        s for s in spans
+        if s.get("parent") and s["parent"] not in ids and s is not root
+    ]
+    coverage = None
+    if root is not None and root.get("dur_ms"):
+        r0 = root.get("ts", 0.0)
+        r1 = r0 + root["dur_ms"] / 1e3
+        ivals = []
+        for s in spans:
+            if s is root:
+                continue
+            a = max(s.get("ts", 0.0), r0)
+            b = min(s.get("ts", 0.0) + s.get("dur_ms", 0.0) / 1e3, r1)
+            if b > a:
+                ivals.append((a, b))
+        ivals.sort()
+        covered, cur_a, cur_b = 0.0, None, None
+        for a, b in ivals:
+            if cur_b is None or a > cur_b:
+                if cur_b is not None:
+                    covered += cur_b - cur_a
+                cur_a, cur_b = a, b
+            else:
+                cur_b = max(cur_b, b)
+        if cur_b is not None:
+            covered += cur_b - cur_a
+        coverage = round(covered / max(r1 - r0, 1e-12), 4)
+    return {
+        "root": root,
+        "orphans": orphans,
+        "rootless": root is None,
+        "coverage": coverage,
+        "exemplar": any(s.get("exemplar") for s in spans),
+    }
+
+
+def _waterfall_rows(spans: list, root: dict) -> list:
+    """Depth-first waterfall rows (offset from the root's start)."""
+    children: dict = {}
+    for s in spans:
+        children.setdefault(s.get("parent"), []).append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: s.get("ts", 0.0))
+    r0 = root.get("ts", 0.0)
+    rows, seen = [], set()
+
+    def walk(span, depth):
+        if id(span) in seen:  # defensive: a span cycle must not hang report
+            return
+        seen.add(id(span))
+        rows.append({
+            "name": span.get("name", "?"),
+            "svc": span.get("svc", "?"),
+            "offset_ms": round((span.get("ts", 0.0) - r0) * 1e3, 3),
+            "dur_ms": span.get("dur_ms"),
+            "depth": depth,
+            "attrs": _span_attrs(span),
+        })
+        for kid in children.get(span["span"], []):
+            walk(kid, depth + 1)
+
+    walk(root, 0)
+    # Joinable-but-detached spans (orphans) still show up, flattened at the
+    # end, so the waterfall never silently hides data.
+    for s in spans:
+        if id(s) not in seen:
+            rows.append({
+                "name": s.get("name", "?"), "svc": s.get("svc", "?"),
+                "offset_ms": round((s.get("ts", 0.0) - r0) * 1e3, 3),
+                "dur_ms": s.get("dur_ms"), "depth": 1,
+                "attrs": dict(_span_attrs(s), detached=True),
+            })
+    return rows
+
+
+def trace_doc(run_dirs, max_waterfalls: int = 5) -> tuple:
+    """Fleet-wide trace join: spans from the router's and every worker's run
+    dir, joined by trace id into per-query waterfalls.
+
+    Exit codes: 0 ok; 1 when a hash-sampled (non-exemplar) trace has
+    orphaned or rootless spans — the join gate; 2 bad dir; 3 no spans.
+    Exemplar-only traces may legitimately miss their upstream half (the
+    other process head-dropped the trace), so they never trip the gate.
+    """
+    try:
+        spans, bad, per_dir = _load_fleet_spans(run_dirs)
+    except NotADirectoryError as err:
+        return {"error": f"not a run directory: {err}", "exit": 2}, 2
+    if not spans:
+        return {
+            "error": "no trace spans recorded (is SBR_TRACE_SAMPLE set?)",
+            "dirs": per_dir, "bad_span_lines": bad, "exit": 3,
+        }, 3
+
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+
+    traces, bad_joins = [], []
+    for tid, group in sorted(by_trace.items()):
+        verdict = _join_trace(group)
+        root = verdict["root"]
+        has_failover = any(
+            s.get("name") == "router.forward" and s.get("outcome") == "error"
+            for s in group
+        )
+        has_hedge = any(s.get("role") == "hedge" for s in group)
+        entry = {
+            "trace": tid,
+            "spans": len(group),
+            "services": sorted({s.get("svc", "?") for s in group}),
+            "root": root.get("name") if root else None,
+            "dur_ms": root.get("dur_ms") if root else None,
+            "coverage": verdict["coverage"],
+            "orphans": len(verdict["orphans"]),
+            "rootless": verdict["rootless"],
+            "exemplar": verdict["exemplar"],
+            "failover": has_failover,
+            "hedged": has_hedge,
+        }
+        traces.append(entry)
+        if (verdict["orphans"] or verdict["rootless"]) and not verdict["exemplar"]:
+            bad_joins.append(tid)
+
+    # Waterfalls for the most interesting traces: every failover/hedge/
+    # exemplar first, then the slowest — capped so --json stays bounded.
+    def interest(e):
+        return (e["failover"] or e["hedged"] or e["exemplar"],
+                e["dur_ms"] or 0.0)
+
+    picked = sorted(traces, key=interest, reverse=True)[:max_waterfalls]
+    waterfalls = []
+    for e in picked:
+        group = by_trace[e["trace"]]
+        root = _join_trace(group)["root"]
+        if root is None:
+            continue
+        waterfalls.append({
+            "trace": e["trace"], "dur_ms": root.get("dur_ms"),
+            "coverage": e["coverage"],
+            "rows": _waterfall_rows(group, root),
+        })
+
+    coverages = [e["coverage"] for e in traces if e["coverage"] is not None]
+    # Duration-weighted coverage: share of TOTAL end-to-end latency that
+    # the joined span trees explain.  Per-query coverage is noisy for
+    # millisecond requests (fixed parse/respond slices loom large); the
+    # weighted figure is the fleet-level acceptance number.
+    wpairs = [
+        (e["coverage"], e["dur_ms"])
+        for e in traces
+        if e["coverage"] is not None and e["dur_ms"]
+    ]
+    wtotal = sum(d for _, d in wpairs)
+    coverage_weighted = (
+        round(sum(c * d for c, d in wpairs) / wtotal, 4) if wtotal else None
+    )
+    code = 1 if bad_joins else 0
+    doc = {
+        "dirs": per_dir,
+        "spans": len(spans),
+        "bad_span_lines": bad,
+        "traces": len(traces),
+        "joined": len(traces) - len(bad_joins),
+        "unjoined_traces": bad_joins,
+        "exemplar_traces": sum(1 for e in traces if e["exemplar"]),
+        "failover_traces": sum(1 for e in traces if e["failover"]),
+        "hedged_traces": sum(1 for e in traces if e["hedged"]),
+        "coverage_min": round(min(coverages), 4) if coverages else None,
+        "coverage_mean": (
+            round(sum(coverages) / len(coverages), 4) if coverages else None
+        ),
+        "coverage_weighted": coverage_weighted,
+        "trace_table": traces,
+        "waterfalls": waterfalls,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_trace(doc: dict) -> str:
+    if "error" in doc:
+        return f"TRACE REPORT\n  {doc['error']}"
+    lines = ["TRACE REPORT (fleet-wide join)"]
+    lines.append(
+        f"  dirs {len(doc['dirs'])}  spans {doc['spans']}  "
+        f"traces {doc['traces']}  joined {doc['joined']}  "
+        f"bad span lines {doc['bad_span_lines']}"
+    )
+    lines.append(
+        f"  failover {doc['failover_traces']}  hedged {doc['hedged_traces']}  "
+        f"exemplars {doc['exemplar_traces']}  "
+        f"coverage min {doc['coverage_min']} mean {doc['coverage_mean']} "
+        f"weighted {doc['coverage_weighted']}"
+    )
+    if doc["unjoined_traces"]:
+        lines.append(
+            "  UNJOINED (orphaned/rootless sampled traces): "
+            + ", ".join(doc["unjoined_traces"][:10])
+        )
+    rows = [
+        [
+            e["trace"][:12], e["root"] or "-", e["spans"],
+            _fmt_val_ms(e["dur_ms"]),
+            "-" if e["coverage"] is None else f"{e['coverage']:.0%}",
+            ",".join(e["services"]),
+            "".join([
+                "F" if e["failover"] else "",
+                "H" if e["hedged"] else "",
+                "E" if e["exemplar"] else "",
+                "!" if (e["orphans"] or e["rootless"]) else "",
+            ]) or "-",
+        ]
+        for e in doc["trace_table"][:30]
+    ]
+    lines.append(_table(
+        ["trace", "root", "spans", "e2e", "cover", "services", "flags"], rows
+    ))
+    for wf in doc["waterfalls"]:
+        lines.append(
+            f"\n  trace {wf['trace']}  {_fmt_val_ms(wf['dur_ms'])}  "
+            f"coverage {'-' if wf['coverage'] is None else format(wf['coverage'], '.0%')}"
+        )
+        for r in wf["rows"]:
+            attrs = " ".join(f"{k}={v}" for k, v in r["attrs"].items())
+            pad = "  " * r["depth"]
+            lines.append(
+                f"    {r['offset_ms']:>9.2f}ms {pad}{r['name']} "
+                f"[{r['svc']}] {_fmt_val_ms(r['dur_ms'])}"
+                + (f"  {attrs}" if attrs else "")
+            )
+    verdict = "OK" if doc["exit"] == 0 else "JOIN GATE FAILED"
+    lines.append(f"\n  {verdict} (exit {doc['exit']})")
+    return "\n".join(lines)
+
+
+def _main_trace(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report trace",
+        description="Join trace spans across a router's and its workers' run "
+        "dirs into per-query waterfalls; exit 1 when a sampled trace has "
+        "orphaned/unjoinable spans, 2 on a bad dir, 3 when no spans exist",
+    )
+    parser.add_argument("run_dirs", nargs="+",
+                        help="run directories (router + every worker)")
+    parser.add_argument("--max-waterfalls", type=int, default=5,
+                        dest="max_waterfalls",
+                        help="waterfall trees to include (default 5)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = trace_doc(args.run_dirs, args.max_waterfalls)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_trace(doc))
+    return code
+
+
+def _dir_slo_ms(run_dir) -> tuple:
+    """A run dir's resolved SLO: ``live.json`` ``slo.slo_ms`` (the worker
+    wrote its own resolved value there), falling back to the manifest's
+    copy; ``(slo_ms, found_live_doc)``."""
+    for name in ("live.json", "fleet.json"):
+        p = Path(run_dir) / name
+        try:
+            doc = json.loads(p.read_text())
+        except (OSError, ValueError):
+            continue
+        slo = ((doc.get("slo") or {}).get("slo_ms")
+               if isinstance(doc, dict) else None)
+        return slo, True
+    return None, False
+
+
+def slo_doc(run_dirs, breach_limit: int = 10) -> tuple:
+    """Fleet-wide SLO observatory: per-layer latency breakdowns from trace
+    spans, per-dir resolved SLOs, breach exemplar tables, and hedge/failover
+    causality for the breached tail.
+
+    Exit codes: 0 ok; 1 when any end-to-end trace breaches its run dir's
+    resolved SLO (or carries an ``exemplar`` mark — the writer's own breach
+    verdict); 2 bad dir; 3 when neither spans nor any live/fleet snapshot
+    exist to judge.
+    """
+    from sbr_tpu.obs.metrics import DEFAULT_LATENCY_BOUNDS_MS, LogHistogram
+
+    try:
+        spans, bad, per_dir = _load_fleet_spans(run_dirs)
+    except NotADirectoryError as err:
+        return {"error": f"not a run directory: {err}", "exit": 2}, 2
+
+    any_live = False
+    for entry in per_dir:
+        slo, found = _dir_slo_ms(entry["dir"])
+        entry["slo_ms"] = slo
+        any_live = any_live or found
+    if not spans:
+        code = 3 if not any_live else 0
+        return {
+            "error": "no trace spans recorded (is SBR_TRACE_SAMPLE set?)",
+            "dirs": per_dir, "bad_span_lines": bad, "exit": code,
+        }, code
+
+    slo_by_dir = {e["dir"]: e["slo_ms"] for e in per_dir}
+
+    # Per-layer duration histograms over every committed span.
+    layers: dict = {}
+    for s in spans:
+        name = s.get("name", "?")
+        h = layers.get(name)
+        if h is None:
+            h = layers[name] = LogHistogram(DEFAULT_LATENCY_BOUNDS_MS)
+        h.record(s.get("dur_ms") or 0.0)
+    layer_table = {name: h.summary() for name, h in sorted(layers.items())}
+
+    # End-to-end verdict per trace: the root span's duration vs the SLO of
+    # the dir that recorded it (each worker may serve under its own SLO).
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    breaches = []
+    for tid, group in sorted(by_trace.items()):
+        root = _join_trace(group)["root"]
+        if root is None:
+            continue
+        slo = slo_by_dir.get(root.get("_dir"))
+        dur = root.get("dur_ms") or 0.0
+        marked = any(s.get("exemplar") for s in group)
+        if marked or (slo is not None and dur > slo):
+            by_layer: dict = {}
+            for s in group:
+                if s is not root:
+                    by_layer[s["name"]] = round(
+                        by_layer.get(s["name"], 0.0) + (s.get("dur_ms") or 0.0), 3
+                    )
+            slowest = max(by_layer.items(), key=lambda kv: kv[1])[0] \
+                if by_layer else None
+            breaches.append({
+                "trace": tid, "dur_ms": dur, "slo_ms": slo,
+                "root": root.get("name"), "exemplar": marked,
+                "hedged": any(s.get("role") == "hedge" for s in group),
+                "failover": any(
+                    s.get("name") == "router.forward"
+                    and s.get("outcome") == "error"
+                    for s in group
+                ),
+                "degraded": any(s.get("degraded") for s in group),
+                "slowest_layer": slowest,
+                "by_layer_ms": by_layer,
+            })
+    breaches.sort(key=lambda b: b["dur_ms"], reverse=True)
+
+    causality = {
+        "breaches": len(breaches),
+        "hedged": sum(1 for b in breaches if b["hedged"]),
+        "failover": sum(1 for b in breaches if b["failover"]),
+        "degraded": sum(1 for b in breaches if b["degraded"]),
+    }
+    code = 1 if breaches else 0
+    doc = {
+        "dirs": per_dir,
+        "spans": len(spans),
+        "bad_span_lines": bad,
+        "traces": len(by_trace),
+        "layers": layer_table,
+        "breach_causality": causality,
+        "breach_exemplars": breaches[:breach_limit],
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_slo(doc: dict) -> str:
+    if "error" in doc:
+        return f"SLO REPORT\n  {doc['error']}"
+    lines = ["SLO REPORT (per-layer latency observatory)"]
+    for e in doc["dirs"]:
+        slo = e.get("slo_ms")
+        lines.append(
+            f"  {e['dir']}: {e['spans']} span(s), "
+            f"slo {'-' if slo is None else f'{slo:g} ms'}"
+        )
+    rows = [
+        [
+            name, s["count"], _fmt_val_ms(s.get("p50")),
+            _fmt_val_ms(s.get("p95")), _fmt_val_ms(s.get("p99")),
+            _fmt_val_ms(s.get("max")),
+        ]
+        for name, s in doc["layers"].items()
+    ]
+    lines.append(_table(["layer", "count", "p50", "p95", "p99", "max"], rows))
+    c = doc["breach_causality"]
+    lines.append(
+        f"\n  SLO breaches {c['breaches']}  (hedged {c['hedged']}, "
+        f"failover {c['failover']}, degraded {c['degraded']})"
+    )
+    if doc["breach_exemplars"]:
+        rows = [
+            [
+                b["trace"][:12], _fmt_val_ms(b["dur_ms"]),
+                "-" if b["slo_ms"] is None else f"{b['slo_ms']:g}",
+                b["slowest_layer"] or "-",
+                "".join([
+                    "F" if b["failover"] else "",
+                    "H" if b["hedged"] else "",
+                    "D" if b["degraded"] else "",
+                    "E" if b["exemplar"] else "",
+                ]) or "-",
+            ]
+            for b in doc["breach_exemplars"]
+        ]
+        lines.append(_table(
+            ["trace", "e2e", "slo_ms", "slowest layer", "flags"], rows
+        ))
+    verdict = "OK" if doc["exit"] == 0 else "SLO BREACHED"
+    lines.append(f"\n  {verdict} (exit {doc['exit']})")
+    return "\n".join(lines)
+
+
+def _main_slo(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report slo",
+        description="Fleet-wide SLO observatory over trace spans: per-layer "
+        "latency breakdowns, breach exemplars, hedge/failover causality; "
+        "exit 1 on an SLO breach, 2 on a bad dir, 3 with nothing to judge",
+    )
+    parser.add_argument("run_dirs", nargs="+",
+                        help="run directories (router + every worker)")
+    parser.add_argument("--breach-limit", type=int, default=10,
+                        dest="breach_limit",
+                        help="breach exemplar rows to include (default 10)")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = slo_doc(args.run_dirs, args.breach_limit)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_slo(doc))
+    return code
 
 
 def main(argv=None) -> int:
@@ -1990,6 +2510,10 @@ def main(argv=None) -> int:
         return _main_grad(argv[1:])
     if argv and argv[0] == "infomodel":
         return _main_infomodel(argv[1:])
+    if argv and argv[0] == "trace":
+        return _main_trace(argv[1:])
+    if argv and argv[0] == "slo":
+        return _main_slo(argv[1:])
     if argv and argv[0] == "gc":
         return _main_gc(argv[1:])
     if argv and argv[0] == "trend":
@@ -2002,7 +2526,7 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'grad' / 'infomodel' / 'trend' / 'gc' subcommands",
+        "'grad' / 'infomodel' / 'trace' / 'slo' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

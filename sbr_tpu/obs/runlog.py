@@ -600,10 +600,21 @@ class RunContext:
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
             "profiles": self.profiles or None,
+            "trace": self._trace_manifest(),
         }
         tmp = self.run_dir / "manifest.json.tmp"
         tmp.write_text(json.dumps(manifest, indent=1, default=_json_default) + "\n")
         os.replace(tmp, self.run_dir / "manifest.json")
+
+    def _trace_manifest(self) -> Optional[dict]:
+        """Distributed-tracing counter roll-up (ISSUE 16): the run's
+        `TraceWriter` counters, when any process committed spans here."""
+        try:
+            from sbr_tpu.obs import trace as _trace
+
+            return _trace.summary_for(self.run_dir)
+        except Exception:
+            return None
 
     def live_snapshot(self, doc: dict, name: str = "live.json") -> Path:
         """Atomically rewrite a rolling snapshot file inside the run dir
@@ -734,6 +745,14 @@ class RunContext:
         self._write_manifest(status=status)
         self._closed = True
         self._fh.close()
+        try:
+            # Release the run's trace-span fd (ISSUE 16); the counters were
+            # already folded into the manifest above.
+            from sbr_tpu.obs import trace as _trace
+
+            _trace.close_for(self.run_dir)
+        except Exception:
+            pass  # tracing teardown must never sink the run
         if not self._metrics_was_on:
             metrics().disable()
         if self._auto_prune_keep is not None:

@@ -184,6 +184,20 @@ def _report_resilience(run_dir) -> tuple:
     return _report("resilience", run_dir)
 
 
+def _report_multi(subcommand: str, run_dirs) -> tuple:
+    """(exit_code, json_doc) for a multi-dir report subcommand
+    (`trace`/`slo` join spans across the router's AND every worker's dir)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "sbr_tpu.obs.report", subcommand,
+         *[str(d) for d in run_dirs], "--json"],
+        capture_output=True, text=True, timeout=120.0,
+    )
+    try:
+        return proc.returncode, json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return proc.returncode, {}
+
+
 def _bit_identical(a_npz, b_npz) -> bool:
     try:
         want, got = np.load(a_npz), np.load(b_npz)
@@ -304,9 +318,12 @@ _ANSWER_FIELDS = ("xi", "tau_bar_in", "aw_max", "status", "flags")
 
 
 def _run_loadgen_fleet(out: Path, name: str, n_workers: int,
-                       kill_after=None, timeout_s: float = 900.0) -> tuple:
+                       kill_after=None, timeout_s: float = 900.0,
+                       extra_env=None, trace_out=None) -> tuple:
     """One `loadgen --fleet` subprocess; returns (rc, summary, answers,
-    router_run_dir)."""
+    router_run_dir). ``extra_env`` overlays the scrubbed environment (the
+    churn phase turns tracing on with it); ``trace_out`` forwards
+    ``--trace-out``."""
     run_dir = out / f"obs_{name}"
     answers_path = out / f"{name}_answers.json"
     argv = [
@@ -323,10 +340,14 @@ def _run_loadgen_fleet(out: Path, name: str, n_workers: int,
     ]
     if kill_after is not None:
         argv += ["--fleet-kill-after", str(kill_after)]
+    if trace_out is not None:
+        argv += ["--trace-out", str(trace_out)]
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     for k in ("SBR_FAULT_PLAN", "SBR_SERVE_DEADLINE_MS", "SBR_FLEET_DIR",
-              "SBR_SERVE_CACHE_DIR", "SBR_TILE_CACHE_DIR"):
+              "SBR_SERVE_CACHE_DIR", "SBR_TILE_CACHE_DIR",
+              "SBR_TRACE_SAMPLE", "SBR_SERVE_SLO_MS"):
         env.pop(k, None)
+    env.update(extra_env or {})
     proc = subprocess.run(argv, env=env, timeout=timeout_s,
                           capture_output=True, text=True)
     if proc.stderr:
@@ -376,9 +397,15 @@ def main_fleet(out: Path, as_json: bool) -> int:
     checks["solo_report_fleet_rc0"] = rc_f1 == 0
 
     log("phase 2/2: three workers, one SIGKILLed after "
-        f"{_FLEET['kill_after']} of {_FLEET['queries']} queries …")
+        f"{_FLEET['kill_after']} of {_FLEET['queries']} queries "
+        "(tracing on: every query must yield a joinable waterfall) …")
+    # Tracing is ON only for the churn phase: the solo phase stays
+    # untraced, so `answers_bit_identical` below doubles as the
+    # SBR_TRACE_SAMPLE=0-vs-1 bit-identity witness (ISSUE 16 acceptance).
     rc2, sum2, ans2, run2 = _run_loadgen_fleet(
-        out, "fleet_churn", 3, kill_after=_FLEET["kill_after"]
+        out, "fleet_churn", 3, kill_after=_FLEET["kill_after"],
+        extra_env={"SBR_TRACE_SAMPLE": "1"},
+        trace_out=out / "fleet_churn_trace_rows.jsonl",
     )
     checks["churn_rc0"] = rc2 == 0
     checks["churn_zero_lost"] = sum2.get("fleet_lost", 1) == 0
@@ -396,6 +423,28 @@ def main_fleet(out: Path, as_json: bool) -> int:
     checks["churn_workers_joined"] = (doc2.get("events") or {}).get(
         "worker_join", 0
     ) == 3
+    # Distributed tracing (ISSUE 16): spans from the router's and every
+    # worker's run dir must JOIN into waterfalls — including for the
+    # queries that failed over off the killed worker (the dead worker's
+    # final trace line may be torn; the join must survive that).
+    worker_dirs = sorted((run2.parent / (run2.name + "_workers")).glob("w*"))
+    rc_t, doc_t = _report_multi("trace", [run2, *worker_dirs])
+    checks["churn_report_trace_rc0"] = rc_t == 0
+    checks["churn_traces_joined"] = doc_t.get("joined", 0) >= 1
+    checks["churn_failover_trace"] = doc_t.get("failover_traces", 0) >= 1
+    # Acceptance floor: the joined span trees must explain >= 95% of the
+    # TOTAL measured end-to-end latency (duration-weighted).  Per-query
+    # coverage is only floored loosely — a millisecond cache hit's fixed
+    # parse/respond slice is a big fraction of nothing.
+    checks["churn_trace_coverage_95"] = (
+        (doc_t.get("coverage_weighted") or 0) >= 0.95
+        and (doc_t.get("coverage_min") or 0) >= 0.70
+    )
+    rc_s, _doc_s = _report_multi("slo", [run2, *worker_dirs])
+    checks["churn_report_slo_rc0"] = rc_s == 0
+    checks["churn_trace_rows_written"] = (
+        out / "fleet_churn_trace_rows.jsonl"
+    ).exists()
     # The headline: every answer the degraded fleet served is byte-
     # identical to the fault-free single-worker ground truth.
     checks["answers_bit_identical"] = _answers_identical(ans1, ans2)
@@ -413,6 +462,8 @@ def main_fleet(out: Path, as_json: bool) -> int:
             + f" ({out})"
         )
         print(f"fleet story: python -m sbr_tpu.obs.report fleet {run2}")
+        print(f"trace story: python -m sbr_tpu.obs.report trace {run2} "
+              f"{run2}_workers/w*")
     return 0 if ok else 1
 
 

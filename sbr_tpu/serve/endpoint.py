@@ -28,6 +28,15 @@ A stdlib-only (``http.server``) thread serving four routes off an
   with an empty degradation ladder is a ``503``; malformed parameter
   documents are ``400``.
 
+Distributed tracing (ISSUE 16): when ``SBR_TRACE_SAMPLE`` > 0 (or the
+router already minted a trace and sent ``X-SBR-Trace-Id`` /
+``X-SBR-Parent-Span``), ``/query`` owns a per-request ``worker.request``
+root span, threads the `obs.trace.TraceContext` into the engine (admission
+/ queue / cache / batch / dispatch child spans), echoes the trace id as a
+response header AND a ``trace_id`` body field, and commits the finished
+trace to the run dir's ``trace.jsonl`` — SLO-breach requests always kept
+as tail-latency exemplars.
+
 Only ``/query`` mutates engine state (it serves traffic); the other three
 only read. ``port=0`` binds an ephemeral port (tests, parallel CI); the
 bound port is `.port`.
@@ -39,7 +48,10 @@ import json
 import math
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from sbr_tpu.obs import trace as qtrace
 
 # The make_model_params keywords a /query document may carry (everything
 # else is 400 — a typo like "bta" must not silently serve defaults),
@@ -94,19 +106,70 @@ class ServeEndpoint:
             def log_message(self, fmt, *args):  # route access logs off stdout
                 print(f"[serve.endpoint] {fmt % args}", file=sys.stderr)
 
-            def _send(self, code: int, body: bytes, ctype: str) -> None:
+            def _send(self, code: int, body: bytes, ctype: str,
+                      headers=None) -> None:
+                self._last_code = code
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
+                # Echo the trace id on EVERY response (including 429/503)
+                # so a client can link a shed or failed query to its
+                # waterfall without parsing the body.
+                ctx = getattr(self, "_trace_ctx", None)
+                if ctx is not None:
+                    self.send_header(qtrace.TRACE_HEADER, ctx.trace_id)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _commit_trace(self, ctx, root_id, t0w, t0m) -> None:
+                """Close + persist this request's trace: the worker-side
+                root span ("worker.request", parented to the router's
+                forward span when one minted the trace) and everything the
+                engine attached under it. An SLO-breach request is always
+                kept as a tail-latency exemplar, whatever the sampling
+                verdict said."""
+                try:
+                    dur = time.monotonic() - t0m
+                    attrs = getattr(self, "_trace_attrs", None) or {}
+                    ctx.add(
+                        "worker.request", t0w, dur,
+                        parent=ctx.remote_parent, span_id=root_id,
+                        status=getattr(self, "_last_code", None), **attrs,
+                    )
+                    writer = endpoint.engine.trace_writer()
+                    if writer is not None:
+                        slo = qtrace.slo_ms()
+                        breach = slo is not None and dur * 1e3 > slo
+                        writer.commit(ctx, exemplar=breach)
+                except Exception:
+                    pass  # tracing must never break serving
+
             def do_POST(self):
+                t0w, t0m = time.time(), time.monotonic()
+                self._trace_ctx = None
+                self._trace_attrs = None
+                self._last_code = None
+                ctx = root_id = None
                 try:
                     path = self.path.split("?", 1)[0]
                     if path != "/query":
                         self._send(404, b'{"error": "not found"}', "application/json")
                         return
+                    # Distributed tracing (ISSUE 16): adopt the router's
+                    # trace (header presence == sampled) or mint one on a
+                    # direct hit; None when tracing is off — the zero-
+                    # overhead path.
+                    ctx = qtrace.from_headers(
+                        self.headers.get(qtrace.TRACE_HEADER),
+                        self.headers.get(qtrace.PARENT_HEADER),
+                        service="worker",
+                    )
+                    if ctx is not None:
+                        self._trace_ctx = ctx
+                        root_id = ctx.alloc_id()
+                        ctx.parent_id = root_id
                     try:
                         n = int(self.headers.get("Content-Length") or 0)
                         doc = json.loads(self.rfile.read(n).decode() or "{}")
@@ -255,6 +318,10 @@ class ServeEndpoint:
                                     "application/json",
                                 )
                                 return
+                            if ctx is not None:
+                                rec = {**rec, "trace_id": ctx.trace_id}
+                                self._trace_attrs = {"route": "population",
+                                                     "source": rec.get("source")}
                             self._send(
                                 200, json.dumps(rec).encode(), "application/json"
                             )
@@ -278,25 +345,29 @@ class ServeEndpoint:
                                     "application/json",
                                 )
                                 return
+                            if ctx is not None:
+                                rec = {**rec, "trace_id": ctx.trace_id}
+                                self._trace_attrs = {"route": "scenario",
+                                                     "source": rec.get("source")}
                             self._send(
                                 200, json.dumps(rec).encode(), "application/json"
                             )
                             return
                         result = endpoint.engine.query(
                             params, scenario=scenario, deadline_ms=deadline_ms,
-                            grads=grads,
+                            grads=grads, trace=ctx,
                         )
                     except DeadlineExceeded as err:
+                        if ctx is not None:
+                            self._trace_attrs = {"shed": True}
                         body = json.dumps(
                             {"error": "deadline", "detail": str(err),
                              "retry_after_s": err.retry_after_s}
                         ).encode()
-                        self.send_response(429)
-                        self.send_header("Content-Type", "application/json")
-                        self.send_header("Retry-After", f"{err.retry_after_s:g}")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._send(
+                            429, body, "application/json",
+                            {"Retry-After": f"{err.retry_after_s:g}"},
+                        )
                         return
                     except Exception as err:
                         # Solver down AND the degradation ladder empty: an
@@ -308,9 +379,15 @@ class ServeEndpoint:
                             "application/json",
                         )
                         return
+                    rdoc = query_result_doc(result)
+                    if ctx is not None:
+                        rdoc["trace_id"] = ctx.trace_id
+                        self._trace_attrs = {
+                            "source": result.source,
+                            "degraded": True if result.degraded else None,
+                        }
                     self._send(
-                        200, json.dumps(query_result_doc(result)).encode(),
-                        "application/json",
+                        200, json.dumps(rdoc).encode(), "application/json"
                     )
                 except BrokenPipeError:
                     pass
@@ -322,6 +399,9 @@ class ServeEndpoint:
                         )
                     except Exception:
                         pass
+                finally:
+                    if ctx is not None:
+                        self._commit_trace(ctx, root_id, t0w, t0m)
 
             def do_GET(self):
                 try:

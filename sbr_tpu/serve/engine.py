@@ -205,16 +205,31 @@ class QueryResult:
 
 
 class _Ticket:
-    __slots__ = ("params", "scenario", "key", "t0", "deadline", "event",
-                 "result", "error", "grads")
+    __slots__ = ("params", "scenario", "key", "t0", "t_wall0", "t_popped",
+                 "deadline", "event", "result", "error", "grads",
+                 "trace", "span_id", "tparent")
 
     def __init__(self, params: ModelParams, scenario: str, key: str,
-                 deadline: Optional[float] = None, grads: bool = False) -> None:
+                 deadline: Optional[float] = None, grads: bool = False,
+                 trace=None) -> None:
         self.params = params
         self.scenario = scenario
         self.key = key
         self.grads = grads
         self.t0 = time.monotonic()
+        # Distributed tracing (ISSUE 16): `trace` is an obs.trace
+        # TraceContext (or None, the untraced fast path — every
+        # instrumentation site below is a single None check). The engine's
+        # spans for this query hang off `span_id` ("engine.query", emitted
+        # at fulfillment), which itself attaches to the caller's
+        # `parent_id` (the endpoint's request span).
+        self.trace = trace
+        self.span_id = trace.alloc_id() if trace is not None else None
+        self.tparent = trace.parent_id if trace is not None else None
+        # Wall-clock twin of t0: span records join across processes on the
+        # wall axis (`wall()` maps any monotonic instant onto it).
+        self.t_wall0 = time.time()
+        self.t_popped: Optional[float] = None  # when the batcher took it
         # Absolute monotonic deadline, or None. Admission already shed the
         # unmeetable; a ticket whose deadline expires while still QUEUED
         # is shed at batch formation (no dispatch burned); one whose
@@ -225,6 +240,10 @@ class _Ticket:
         self.event = threading.Event()
         self.result: Optional[QueryResult] = None
         self.error: Optional[BaseException] = None
+
+    def wall(self, mono: float) -> float:
+        """Wall-clock time of monotonic instant ``mono`` (span timestamps)."""
+        return self.t_wall0 + (mono - self.t0)
 
     def wait(self, timeout: Optional[float] = None) -> QueryResult:
         if not self.event.wait(timeout):
@@ -520,17 +539,32 @@ class Engine:
 
     # -- public query API ---------------------------------------------------
     def submit(self, params: ModelParams, scenario: str = "default",
-               deadline_ms: Optional[float] = None, grads: bool = False) -> _Ticket:
+               deadline_ms: Optional[float] = None, grads: bool = False,
+               trace=None) -> _Ticket:
         """Enqueue one query for the micro-batcher (requires `start()`).
         Raises once the engine is closed — a ticket enqueued after the
         batcher drained would block its waiter forever — and sheds
         (`DeadlineExceeded`) when the deadline cannot be met. With
         ``grads`` the answer carries dξ/d{β,u,κ} next to ξ (ISSUE 13),
-        cached under its own fingerprint tag."""
-        deadline = self._admit(deadline_ms)
+        cached under its own fingerprint tag. ``trace`` (an `obs.trace`
+        TraceContext) attaches the engine's per-layer spans to the caller's
+        request span."""
+        t_adm_w = time.time() if trace is not None else 0.0
+        t_adm = time.monotonic()
+        try:
+            deadline = self._admit(deadline_ms)
+        except DeadlineExceeded:
+            if trace is not None:
+                trace.add("engine.admission", t_adm_w, time.monotonic() - t_adm,
+                          parent=trace.parent_id, shed=True)
+            raise
         ticket = _Ticket(
-            params, scenario, self._result_key(params, grads), deadline, grads
+            params, scenario, self._result_key(params, grads), deadline, grads,
+            trace=trace,
         )
+        if trace is not None:
+            trace.add("engine.admission", t_adm_w, time.monotonic() - t_adm,
+                      parent=ticket.span_id)
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("engine is closed")
@@ -541,33 +575,54 @@ class Engine:
     def query(
         self, params: ModelParams, scenario: str = "default",
         timeout: Optional[float] = None, deadline_ms: Optional[float] = None,
-        grads: bool = False,
+        grads: bool = False, trace=None,
     ) -> QueryResult:
         """Synchronous single query. Batched with concurrent submitters
         when the engine is started; solved inline otherwise."""
         if self._thread is None:
             return self.query_many(
-                [params], scenario=scenario, deadline_ms=deadline_ms, grads=grads
+                [params], scenario=scenario, deadline_ms=deadline_ms,
+                grads=grads, traces=[trace] if trace is not None else None,
             )[0]
         return self.submit(
-            params, scenario, deadline_ms=deadline_ms, grads=grads
+            params, scenario, deadline_ms=deadline_ms, grads=grads, trace=trace
         ).wait(timeout)
 
     def query_many(
         self, params_list: List[ModelParams], scenario: str = "default",
         timeout: Optional[float] = None, deadline_ms: Optional[float] = None,
-        grads: bool = False,
+        grads: bool = False, traces=None,
     ) -> List[QueryResult]:
         """Solve a list of queries. Started engine: all enqueue at once (the
         natural micro-batch). Unstarted: processed inline in this thread —
-        the deterministic, thread-free path."""
+        the deterministic, thread-free path. ``traces`` (optional, parallel
+        to ``params_list``) carries one `obs.trace` TraceContext — or
+        None — per query."""
         if self._closed:
             raise RuntimeError("engine is closed")
-        deadline = self._admit(deadline_ms)
+        any_trace = traces is not None and any(tr is not None for tr in traces)
+        t_adm_w = time.time() if any_trace else 0.0
+        t_adm = time.monotonic()
+        try:
+            deadline = self._admit(deadline_ms)
+        except DeadlineExceeded:
+            if any_trace:
+                dur = time.monotonic() - t_adm
+                for tr in traces:
+                    if tr is not None:
+                        tr.add("engine.admission", t_adm_w, dur,
+                               parent=tr.parent_id, shed=True)
+            raise
         tickets = [
-            _Ticket(p, scenario, self._result_key(p, grads), deadline, grads)
-            for p in params_list
+            _Ticket(p, scenario, self._result_key(p, grads), deadline, grads,
+                    trace=traces[i] if traces is not None else None)
+            for i, p in enumerate(params_list)
         ]
+        if any_trace:
+            dur = time.monotonic() - t_adm
+            for t in tickets:
+                if t.trace is not None:
+                    t.trace.add("engine.admission", t_adm_w, dur, parent=t.span_id)
         if self._thread is None:
             self._process(tickets)
         else:
@@ -655,13 +710,35 @@ class Engine:
         return self.live.snapshot(self._live_extra(window=window), window=window)
 
     def prometheus(self) -> str:
+        from sbr_tpu.obs import trace as qtrace
+
         extra = {
             "sbr_serve_execs_loaded": ("counter", self._exec_meta["loaded"]),
             "sbr_serve_execs_compiled": ("counter", self._exec_meta["compiled"]),
             "sbr_serve_lru_entries": ("gauge", len(self._lru)),
             "sbr_serve_retry_budget_remaining": ("gauge", self.retry_budget.remaining),
         }
-        return self.live.to_prometheus(extra)
+        # The worker's RESOLVED SLO (read per scrape, like healthz does):
+        # the fleet aggregator (`report slo`) reads each worker's own value
+        # instead of assuming a fleet-wide one. Absent gauge == no SLO set.
+        slo = slo_ms()
+        if slo is not None:
+            extra["sbr_serve_slo_ms"] = ("gauge", slo)
+        text = self.live.to_prometheus(extra)
+        # Per-layer span-duration histograms (committed trace spans only;
+        # empty exposition while tracing is off).
+        hist_lines = qtrace.layer_prometheus()
+        if hist_lines:
+            text = text.rstrip("\n") + "\n" + "\n".join(hist_lines) + "\n"
+        return text
+
+    def trace_writer(self):
+        """This engine's span sink (`obs.trace.TraceWriter`), or None when
+        the engine has no run directory — the root-span owner (endpoint,
+        loadgen) commits finished traces here."""
+        from sbr_tpu.obs import trace as qtrace
+
+        return qtrace.writer_for(self._run)
 
     def _live_extra(self, window: Optional[dict] = None) -> dict:
         return {
@@ -712,6 +789,7 @@ class Engine:
                 continue
             batch, shutdown = [], item is _SHUTDOWN
             if not shutdown:
+                item.t_popped = time.monotonic()
                 batch.append(item)
                 deadline = time.monotonic() + self.serve.max_wait_ms / 1e3
                 while len(batch) < max_bucket:
@@ -723,6 +801,7 @@ class Engine:
                     if nxt is _SHUTDOWN:
                         shutdown = True
                         break
+                    nxt.t_popped = time.monotonic()
                     batch.append(nxt)
             else:
                 # Drain everything still queued so no ticket hangs forever.
@@ -732,6 +811,7 @@ class Engine:
                     except queue.Empty:
                         break
                     if nxt is not _SHUTDOWN:
+                        nxt.t_popped = time.monotonic()
                         batch.append(nxt)
             self.live.queue_depth = self._queue.qsize()
             if batch:
@@ -755,8 +835,31 @@ class Engine:
         must cost one solve, not N. Never raises — failures land on
         tickets."""
         groups: "OrderedDict[str, List[_Ticket]]" = OrderedDict()
+        t_proc = time.monotonic()
         for t in tickets:
+            if t.trace is not None:
+                # Queue wait: enqueue → the batcher taking the ticket
+                # (inline query_many never queues: ~0).
+                popped = t.t_popped if t.t_popped is not None else t_proc
+                t.trace.add("engine.queue", t.wall(t.t0), popped - t.t0,
+                            parent=t.span_id)
+            t_lk = time.monotonic()
             rec, source = self._lookup(t.key)
+            if t.trace is not None:
+                # Per-layer cache outcome: LRU always probed; disk only on
+                # an LRU miss (attr omitted when not consulted, "off" when
+                # no cache dir is configured).
+                disk = (
+                    None if source == "lru"
+                    else "hit" if source == "disk"
+                    else "miss" if self.serve.cache_dir
+                    else "off"
+                )
+                t.trace.add(
+                    "engine.cache", t.wall(t_lk), time.monotonic() - t_lk,
+                    parent=t.span_id,
+                    lru="hit" if source == "lru" else "miss", disk=disk,
+                )
             if rec is not None:
                 # A cache hit is free: serve it even past its deadline (a
                 # late answer beats a late rejection at zero device cost).
@@ -779,6 +882,12 @@ class Engine:
                     "deadline expired while queued",
                     retry_after_s=round(max(est or 0.05, 0.05), 3),
                 )
+                if t.trace is not None:
+                    t.trace.add(
+                        "engine.query", t.t_wall0, time.monotonic() - t.t0,
+                        parent=t.tparent, span_id=t.span_id,
+                        shed="queue-expired",
+                    )
                 t.event.set()
             else:
                 groups.setdefault(t.key, []).append(t)
@@ -797,6 +906,9 @@ class Engine:
     def _process_chunks(self, unique: List[_Ticket], groups, max_bucket: int) -> None:
         for i in range(0, len(unique), max_bucket):
             chunk = unique[i : i + max_bucket]
+            n = len(chunk)
+            bucket = self._bucket_for(n)
+            t_d0w, t_d0m = time.time(), time.monotonic()
             try:
                 # Positional call for the plain path: `_dispatch(params)` is
                 # a stubbing point (tests monkeypatch it for failure
@@ -816,12 +928,28 @@ class Engine:
                 # 503). Degraded answers are never cached: the moment the
                 # solver recovers, fresh dispatches must take over.
                 for t in chunk:
+                    t_lad = time.monotonic()
                     rec = self._degraded_rec(t)
+                    lad_dur = time.monotonic() - t_lad
                     for dup in groups[t.key]:
+                        if dup.trace is not None:
+                            # The tile-cache rung of the degradation ladder
+                            # — its own cache-layer span, hit or miss.
+                            dup.trace.add(
+                                "engine.tilecache", dup.wall(t_lad), lad_dur,
+                                parent=dup.span_id, hit=rec is not None,
+                            )
                         if rec is not None:
                             self._fulfill(dup, dict(rec), "tilecache", degraded=True)
                         else:
                             self.live.record_error()
+                            if dup.trace is not None:
+                                dup.trace.add(
+                                    "engine.query", dup.t_wall0,
+                                    time.monotonic() - dup.t0,
+                                    parent=dup.tparent, span_id=dup.span_id,
+                                    error=type(err).__name__,
+                                )
                             dup.error = err
                             dup.event.set()
                 continue
@@ -836,7 +964,25 @@ class Engine:
                 # retry to succeed on transient poison.
                 if not (rec["flags"] & DIVERGENT_MASK):
                     self._store(t.key, rec)
+                disp_dur = time.monotonic() - t_d0m
                 for j, dup in enumerate(groups[t.key]):
+                    if dup.trace is not None:
+                        # Batch formation (pop → dispatch start, includes
+                        # waiting out earlier chunks) and the dispatch
+                        # itself, with the padded-lane share this query's
+                        # bucket paid for.
+                        popped = dup.t_popped if dup.t_popped is not None else t_d0m
+                        dup.trace.add(
+                            "engine.batch", dup.wall(popped),
+                            max(t_d0m - popped, 0.0), parent=dup.span_id,
+                            n=n, coalesced=True if j else None,
+                        )
+                        dup.trace.add(
+                            "engine.dispatch", t_d0w, disp_dur,
+                            parent=dup.span_id, bucket=bucket,
+                            padded_lanes=bucket - n,
+                            padded_share=round((bucket - n) / bucket, 4),
+                        )
                     self._fulfill(dup, rec, "computed" if j == 0 else "coalesced")
 
     def _degraded_rec(self, t: _Ticket) -> Optional[dict]:
@@ -877,6 +1023,15 @@ class Engine:
             source=source, scenario=t.scenario, latency_s=latency,
             degraded=degraded, grads=grads, grad_flags=grad_flags, **rec
         )
+        if t.trace is not None:
+            # The engine-level root span for this query: admission/queue/
+            # cache/batch/dispatch spans above all parent to it.
+            t.trace.add(
+                "engine.query", t.t_wall0, latency,
+                parent=t.tparent, span_id=t.span_id, source=source,
+                degraded=True if degraded else None,
+                divergent=True if t.result.divergent else None,
+            )
         self.live.record_query(
             latency, source, scenario=t.scenario, divergent=t.result.divergent
         )

@@ -422,6 +422,8 @@ def main(argv=None) -> int:
 
 def _worker_stats(engine) -> dict:
     """The heartbeat stats block: what the router's cost model reads."""
+    from sbr_tpu.obs import trace as qtrace
+
     window = engine.live.window()
     lat = window.get("latency_ms") or {}
     qps = (window.get("queries", 0) or 0) / max(engine.live.window_s, 1e-9)
@@ -431,6 +433,10 @@ def _worker_stats(engine) -> dict:
         "inflight": engine.live.inflight,
         "queue_depth": engine.live.queue_depth,
         "healthz": engine.healthz(window=window)["status"],
+        # Resolved at the worker, surfaced fleet-wide so `report slo` can
+        # judge each run dir against the SLO its owner actually served
+        # under (ISSUE 16 satellite).
+        "slo_ms": qtrace.slo_ms(),
     }
 
 

@@ -33,6 +33,13 @@ cross-run byte-identity comparison. ``--run-dir`` is the ROUTER's obs
 run dir (``report fleet`` gates it); workers land their own run dirs
 beside it.
 
+**Distributed tracing** (ISSUE 16): with ``SBR_TRACE_SAMPLE > 0`` every
+query carries an ``X-SBR-Trace-Id`` (fleet mode: minted by the router;
+direct mode: minted here, with a ``loadgen.query`` root span committed to
+the engine's run dir), and ``--trace-out PATH`` writes one JSONL row per
+measured query — trace id, client latency, source, degraded, status —
+the client-side half a ``report trace`` waterfall joins against.
+
 Exit codes: 0 ok, 1 failed assertion (--assert-warm / fleet loss), 2
 setup error.
 """
@@ -163,6 +170,8 @@ def run_fleet(args) -> dict:
     from sbr_tpu.obs.metrics import DEFAULT_LATENCY_BOUNDS_MS, LogHistogram
     from sbr_tpu.serve.router import Router
 
+    from sbr_tpu.obs import trace as qtrace
+
     pool = build_pool(args.seed, args.pool)
     mix = query_mix(args.seed, args.pool, args.queries)
     docs = [json.dumps(params_doc(p)).encode() for p in pool]
@@ -173,6 +182,7 @@ def run_fleet(args) -> dict:
     router = None
     failures: List[str] = []
     answers: List[Optional[dict]] = [None] * len(mix)
+    trace_rows: List[Optional[dict]] = [None] * len(mix)
     hist = LogHistogram(DEFAULT_LATENCY_BOUNDS_MS)
     killed: dict = {}
     try:
@@ -199,19 +209,20 @@ def run_fleet(args) -> dict:
             )
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    return resp.status, json.loads(resp.read())
+                    return resp.status, json.loads(resp.read()), dict(resp.headers)
             except urllib.error.HTTPError as e:
+                hdrs = dict(e.headers or {})
                 try:
-                    return e.code, json.loads(e.read())
+                    return e.code, json.loads(e.read()), hdrs
                 except ValueError:
-                    return e.code, {}
+                    return e.code, {}, hdrs
             except OSError as e:
                 # Connection reset / refused / client timeout (URLError is
                 # an OSError): this MUST surface as a counted failure —
                 # an exception escaping the recording thread would vanish
                 # silently and let the zero-lost assertion pass on a run
                 # that actually lost a query.
-                return 599, {"error": repr(e)}
+                return 599, {"error": repr(e)}, {}
 
         # Warmup: every pool member, --group at a time (concurrency spreads
         # the pool over the workers, so every worker compiles its buckets).
@@ -227,7 +238,7 @@ def run_fleet(args) -> dict:
                 t.join()
 
         def warm_one(pos, pool_idx):
-            code, doc = post(docs[pool_idx])
+            code, doc, _ = post(docs[pool_idx])
             if code != 200:
                 failures.append(f"warmup query {pos} (pool {pool_idx}) -> {code}")
 
@@ -263,14 +274,30 @@ def run_fleet(args) -> dict:
 
         def measured_one(pos, pool_idx):
             t0 = time.monotonic()
-            code, doc = post(docs[pool_idx])
+            code, doc, hdrs = post(docs[pool_idx])
+            dur_ms = (time.monotonic() - t0) * 1e3
             if code == 200:
-                hist.record((time.monotonic() - t0) * 1e3)
+                hist.record(dur_ms)
                 answers[pos] = doc
             elif code == 429:
                 answers[pos] = {"shed": True}
             else:
                 failures.append(f"measured query {pos} (pool {pool_idx}) -> {code}: {doc}")
+            # Per-query trace row (--trace-out): the trace id from the
+            # response body when the worker answered, else the router's
+            # echoed header (sheds and errors still carry it).
+            tid = doc.get("trace_id") if isinstance(doc, dict) else None
+            if tid is None:
+                tid = hdrs.get(qtrace.TRACE_HEADER)
+            trace_rows[pos] = {
+                "query": pos,
+                "pool": pool_idx,
+                "trace_id": tid,
+                "latency_ms": round(dur_ms, 3),
+                "status": code,
+                "source": doc.get("source") if isinstance(doc, dict) else None,
+                "degraded": bool(doc.get("degraded")) if isinstance(doc, dict) else None,
+            }
             completed[0] += 1
             maybe_kill()
 
@@ -334,7 +361,23 @@ def run_fleet(args) -> dict:
     if args.answers_out:
         with open(args.answers_out, "w") as fh:
             json.dump(answers, fh)
+    if args.trace_out:
+        _write_trace_rows(args.trace_out, trace_rows)
+        summary["trace_out"] = args.trace_out
+        summary["traced_queries"] = sum(
+            1 for r in trace_rows if r is not None and r.get("trace_id")
+        )
     return summary
+
+
+def _write_trace_rows(path: str, rows: List[Optional[dict]]) -> None:
+    """``--trace-out``: one JSONL row per measured query (trace id, client
+    latency, source, degraded, status) — the client-side half that joins a
+    loadgen run against ``report trace`` waterfalls by trace id."""
+    with open(path, "w") as fh:
+        for row in rows:
+            if row is not None:
+                fh.write(json.dumps(row) + "\n")
 
 
 def _metric_value(text: str, name: str) -> float:
@@ -384,6 +427,10 @@ def main(argv=None) -> int:
     parser.add_argument("--answers-out", default=None, dest="answers_out",
                         help="write the per-query answer list (JSON) here "
                         "(fleet mode; byte-identity comparisons)")
+    parser.add_argument("--trace-out", default=None, dest="trace_out",
+                        help="write per-measured-query JSONL rows (trace id, "
+                        "latency, source, degraded) here; trace ids are null "
+                        "unless SBR_TRACE_SAMPLE > 0")
     args = parser.parse_args(argv)
 
     if args.fleet:
@@ -461,9 +508,47 @@ def main(argv=None) -> int:
         # compile latencies (the same isolation bench_serve uses).
         hist_before = engine.live.total_hist.copy()
 
+        from sbr_tpu.obs import trace as qtrace
+
+        tracing = qtrace.sample_rate() > 0
+        trace_rows: List[Optional[dict]] = [None] * len(mix)
+        writer = engine.trace_writer() if tracing else None
+        t_slo = qtrace.slo_ms()
         for i in range(0, len(mix), args.group):
-            group = [pool[j] for j in mix[i : i + args.group]]
-            engine.query_many(group, scenario="mix")
+            span_pos = list(range(i, min(i + args.group, len(mix))))
+            group = [pool[mix[p]] for p in span_pos]
+            ctxs = None
+            if tracing:
+                # Direct engine drive: loadgen is the trace minter. The
+                # `loadgen.query` root stands in for the router.request /
+                # worker.request rungs of the fleet topology.
+                ctxs = [qtrace.mint(service="loadgen") for _ in span_pos]
+                for c in ctxs:
+                    if c is not None:
+                        c.parent_id = c.alloc_id()
+            t0g_w, t0g_m = time.time(), time.monotonic()
+            results = engine.query_many(
+                group, scenario="mix",
+                **({"traces": ctxs} if ctxs is not None else {}),
+            )
+            dur_g = time.monotonic() - t0g_m
+            for k, p in enumerate(span_pos):
+                r = results[k]
+                row = {
+                    "query": p, "pool": mix[p], "trace_id": None,
+                    "latency_ms": round(dur_g * 1e3, 3), "status": 200,
+                    "source": r.source, "degraded": bool(r.degraded),
+                }
+                c = ctxs[k] if ctxs is not None else None
+                if c is not None:
+                    c.add("loadgen.query", t0g_w, dur_g,
+                          parent=c.remote_parent, span_id=c.parent_id,
+                          pool=mix[p], source=r.source)
+                    row["trace_id"] = c.trace_id
+                    if writer is not None:
+                        breach = t_slo is not None and dur_g * 1e3 > t_slo
+                        writer.commit(c, exemplar=breach)
+                trace_rows[p] = row
 
         _, metrics_text = _scrape(endpoint.port, "/metrics")
         health_code, health_body = _scrape(endpoint.port, "/healthz")
@@ -499,6 +584,12 @@ def main(argv=None) -> int:
             "endpoint_port": endpoint.port,
             "run_dir": args.run_dir,
         }
+        if args.trace_out:
+            _write_trace_rows(args.trace_out, trace_rows)
+            summary["trace_out"] = args.trace_out
+            summary["traced_queries"] = sum(
+                1 for r in trace_rows if r is not None and r.get("trace_id")
+            )
     finally:
         endpoint.close()
         engine.close()

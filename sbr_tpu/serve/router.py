@@ -59,6 +59,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from sbr_tpu.obs import trace as qtrace
 from sbr_tpu.obs.metrics import DEFAULT_LATENCY_BOUNDS_MS, LogHistogram
 from sbr_tpu.serve.fleet import (
     CircuitBreaker,
@@ -182,7 +183,9 @@ class Router:
                     n = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(n)
                     code, out, headers = router.handle_query(
-                        body, self.headers.get("X-SBR-Deadline-Ms")
+                        body, self.headers.get("X-SBR-Deadline-Ms"),
+                        trace_header=self.headers.get(qtrace.TRACE_HEADER),
+                        parent_header=self.headers.get(qtrace.PARENT_HEADER),
                     )
                     self._send(code, out, headers=headers)
                 except BrokenPipeError:
@@ -317,10 +320,51 @@ class Router:
         return sorted(admissible, key=lambda w: (w.score(), w.host))
 
     # -- the query path ------------------------------------------------------
-    def handle_query(self, body: bytes, deadline_header: Optional[str]) -> tuple:
-        """Route one query; returns (status_code, body_bytes, headers)."""
+    def handle_query(self, body: bytes, deadline_header: Optional[str],
+                     trace_header: Optional[str] = None,
+                     parent_header: Optional[str] = None) -> tuple:
+        """Route one query; returns (status_code, body_bytes, headers).
+
+        Distributed tracing (ISSUE 16): the router MINTS the trace here
+        (or adopts an inbound ``X-SBR-Trace-Id``), owns the
+        ``router.request`` root span, propagates the id on every forward,
+        and commits the finished trace to its own run dir — SLO breaches
+        (``SBR_SERVE_SLO_MS`` as resolved at the router) always kept as
+        tail-latency exemplars. The trace id is echoed as a response
+        header on every outcome, including sheds and failures."""
+        ctx = qtrace.from_headers(trace_header, parent_header, service="router")
+        root_id = ctx.alloc_id() if ctx is not None else None
+        t0w, t0 = time.time(), time.monotonic()
+        code, out, headers = self._handle_routed(body, deadline_header, t0,
+                                                 ctx, root_id)
+        if ctx is not None:
+            dur = time.monotonic() - t0
+            outcome = (
+                "completed" if code == 200
+                else "shed" if code == 429
+                else "client_error" if 400 <= code < 500
+                else "failed"
+            )
+            ctx.add("router.request", t0w, dur, parent=ctx.remote_parent,
+                    span_id=root_id, status=code, outcome=outcome)
+            try:
+                writer = qtrace.writer_for(self._run)
+                if writer is not None:
+                    slo = qtrace.slo_ms()
+                    breach = slo is not None and dur * 1e3 > slo
+                    writer.commit(ctx, exemplar=breach)
+            except Exception:
+                pass  # tracing must never break routing
+            headers = dict(headers)
+            headers[qtrace.TRACE_HEADER] = ctx.trace_id
+        return code, out, headers
+
+    def _handle_routed(self, body: bytes, deadline_header: Optional[str],
+                       t0: float, trace=None, parent=None) -> tuple:
+        """The routing body behind `handle_query` (deadline resolution,
+        failover loop, counters); returns (status_code, body_bytes,
+        headers)."""
         self._inc("queries")
-        t0 = time.monotonic()
         deadline_ms = None
         try:
             if deadline_header is not None:
@@ -345,7 +389,8 @@ class Router:
         )
 
         try:
-            code, out = self._route(body, deadline, t0)
+            code, out = self._route(body, deadline, t0, trace=trace,
+                                    parent=parent)
         except _Shed as err:
             self._inc("shed")
             self._log_fleet("shed", reason=str(err))
@@ -384,7 +429,8 @@ class Router:
             return None
         return (deadline - time.monotonic()) * 1e3
 
-    def _route(self, body: bytes, deadline: Optional[float], t0: float) -> tuple:
+    def _route(self, body: bytes, deadline: Optional[float], t0: float,
+               trace=None, parent=None) -> tuple:
         """Failover loop: try admissible workers best-first until one
         answers, hedging stragglers when configured."""
         remaining = self._remaining_ms(deadline)
@@ -404,10 +450,12 @@ class Router:
             try:
                 if self.hedge_ms is not None and hedge_peer is not None:
                     code, out = self._forward_hedged(
-                        worker, hedge_peer, body, deadline
+                        worker, hedge_peer, body, deadline,
+                        trace=trace, parent=parent,
                     )
                 else:
-                    code, out = self._forward(worker, body, deadline)
+                    code, out = self._forward(worker, body, deadline,
+                                              trace=trace, parent=parent)
             except _Shed:
                 raise
             except Exception as err:
@@ -423,9 +471,17 @@ class Router:
             return code, out
 
     def _forward(self, worker: _Worker, body: bytes,
-                 deadline: Optional[float]) -> tuple:
+                 deadline: Optional[float], trace=None, parent=None,
+                 role: Optional[str] = None) -> tuple:
         """One forward attempt to one worker; raises `_ForwardError` on
-        anything failover-able, `_Shed` on a worker 429."""
+        anything failover-able, `_Shed` on a worker 429.
+
+        With ``trace`` set, the attempt propagates ``X-SBR-Trace-Id`` (and
+        this span's id as ``X-SBR-Parent-Span`` — the worker's request span
+        parents to it) and records one ``router.forward`` span per attempt,
+        outcome-labeled, so the aggregator sees failover and hedge
+        causality per query. ``role`` labels hedge racers
+        ("primary"/"hedge")."""
         from sbr_tpu.resilience import faults
         from sbr_tpu.resilience.faults import InjectedFault
 
@@ -444,26 +500,35 @@ class Router:
         headers = {"Content-Type": "application/json"}
         if remaining is not None:
             headers["X-SBR-Deadline-Ms"] = f"{remaining:g}"
+        fid = None
+        if trace is not None:
+            fid = trace.alloc_id()
+            headers[qtrace.TRACE_HEADER] = trace.trace_id
+            headers[qtrace.PARENT_HEADER] = fid
         req = urllib.request.Request(
             worker.url + "/query", data=body, headers=headers, method="POST"
         )
         worker.inflight += 1
         worker.forwards += 1
-        t0 = time.monotonic()
+        t0w, t0 = time.time(), time.monotonic()
+        outcome, err_name, code = "ok", None, None
         try:
             faults.fire("router.forward", target=worker.host)
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 out = resp.read()
                 code = resp.status
         except InjectedFault as err:
+            outcome, err_name = "error", "injected"
             worker.failures += 1
             worker.breaker.record_failure()
             raise _ForwardError(f"injected forward fault: {err}") from err
         except urllib.error.HTTPError as err:
             body_bytes = err.read()
+            code = err.code
             if err.code == 429:
                 # Backpressure is deliberate: pass it through, don't dodge
                 # it by hammering a peer with the same unmeetable deadline.
+                outcome = "shed"
                 retry_after = 0.1
                 try:
                     retry_after = float(err.headers.get("Retry-After") or 0.1)
@@ -480,8 +545,10 @@ class Router:
                 # everywhere, charge every breaker, and finally read as a
                 # "lost" query on a healthy fleet. Pass it through — the
                 # worker answered correctly.
+                outcome = "client_error"
                 worker.breaker.record_success()
                 return err.code, body_bytes
+            outcome, err_name = "error", f"http_{err.code}"
             worker.failures += 1
             worker.breaker.record_failure()
             raise _ForwardError(
@@ -498,23 +565,32 @@ class Router:
                 # tight-deadline traffic open breakers on healthy workers;
                 # crediting a success would be equally unearned — release
                 # any held probe with no verdict.
+                outcome, err_name = "shed", "deadline_in_flight"
                 worker.breaker.record_abandoned()
                 raise _Shed(
                     f"deadline exhausted in flight on {worker.host}",
                     retry_after_s=0.1,
                 ) from err
+            outcome, err_name = "error", type(err).__name__
             worker.failures += 1
             worker.breaker.record_failure()
             raise _ForwardError(f"worker {worker.host} unreachable: {err}") from err
         finally:
             worker.inflight = max(worker.inflight - 1, 0)
+            if trace is not None:
+                trace.add(
+                    "router.forward", t0w, time.monotonic() - t0,
+                    parent=parent, span_id=fid, worker=worker.host,
+                    outcome=outcome, status=code, role=role, error=err_name,
+                )
         worker.breaker.record_success()
         dur_ms = (time.monotonic() - t0) * 1e3
         worker.ewma_ms = 0.3 * dur_ms + 0.7 * worker.ewma_ms
         return code, out
 
     def _forward_hedged(self, worker: _Worker, peer: _Worker, body: bytes,
-                        deadline: Optional[float]) -> tuple:
+                        deadline: Optional[float], trace=None,
+                        parent=None) -> tuple:
         """Primary forward with one hedge: if the primary hasn't answered
         within ``hedge_ms``, race a secondary on ``peer``; first response
         wins. The loser is abandoned (its duplicate dispatch is benign —
@@ -525,7 +601,9 @@ class Router:
 
         def attempt(w: _Worker, role: str) -> None:
             try:
-                code, out = self._forward(w, body, deadline)
+                code, out = self._forward(w, body, deadline,
+                                          trace=trace, parent=parent,
+                                          role=role)
             except Exception as err:  # noqa: BLE001 — collected, not dropped
                 outcomes.put(("error", err, w, role))
             else:

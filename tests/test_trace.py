@@ -1,0 +1,563 @@
+"""Distributed request tracing tests (ISSUE 16): sampling semantics,
+writer atomicity + torn/interleaved-line tolerance, trace-file GC, the
+engine/endpoint span pipeline, `report trace` join gating, `report slo`
+breach gating, and the zero-XLA-trace + bit-identity acceptance witnesses.
+
+The engine tests solve tiny SolverConfig programs (bucket (1,), n_grid 96)
+so each compiles in seconds on CPU; everything here is tier-1."""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.obs import trace as qtrace
+from sbr_tpu.obs.metrics import DEFAULT_LATENCY_BOUNDS_MS, LabeledHistograms
+from sbr_tpu.obs.report import slo_doc, trace_doc
+
+CFG = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+
+
+# ---------------------------------------------------------------------------
+# Sampling semantics
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    def test_default_off_mints_nothing(self, monkeypatch):
+        monkeypatch.delenv("SBR_TRACE_SAMPLE", raising=False)
+        assert qtrace.sample_rate() == 0.0
+        assert qtrace.mint("worker") is None
+
+    def test_rate_zero_hard_off(self, monkeypatch):
+        monkeypatch.setenv("SBR_TRACE_SAMPLE", "0")
+        assert qtrace.mint("router") is None
+
+    def test_rate_one_always_keeps(self, monkeypatch):
+        monkeypatch.setenv("SBR_TRACE_SAMPLE", "1")
+        ctx = qtrace.mint("router")
+        assert ctx is not None and ctx.keep
+
+    def test_garbage_rate_is_off(self, monkeypatch):
+        monkeypatch.setenv("SBR_TRACE_SAMPLE", "definitely")
+        assert qtrace.sample_rate() == 0.0
+
+    def test_keep_decision_deterministic(self):
+        tid = qtrace.new_trace_id()
+        votes = {qtrace.keep_decision(tid, 0.3) for _ in range(10)}
+        assert len(votes) == 1  # router and workers agree without talking
+        assert qtrace.keep_decision(tid, 1.0) is True
+        assert qtrace.keep_decision(tid, 0.0) is False
+
+    def test_keep_decision_tracks_rate(self):
+        ids = [qtrace.new_trace_id() for _ in range(400)]
+        kept = sum(qtrace.keep_decision(t, 0.5) for t in ids)
+        assert 100 < kept < 300  # hash-uniform, loose bounds
+
+    def test_header_presence_wins_over_local_rate(self, monkeypatch):
+        monkeypatch.setenv("SBR_TRACE_SAMPLE", "0")
+        ctx = qtrace.from_headers("abc123", "ff00ff00", service="worker")
+        assert ctx is not None and ctx.keep
+        assert ctx.trace_id == "abc123"
+        assert ctx.remote_parent == "ff00ff00"
+
+    def test_no_header_no_rate_no_context(self, monkeypatch):
+        monkeypatch.delenv("SBR_TRACE_SAMPLE", raising=False)
+        assert qtrace.from_headers(None, None) is None
+
+    def test_add_drops_none_and_reserved_attrs(self):
+        ctx = qtrace.TraceContext("t" * 16, service="x")
+        sid = ctx.add("a.b", time.time(), 0.001, degraded=None, n=3,
+                      trace="spoof")
+        (rec,) = ctx.spans
+        assert rec["span"] == sid
+        assert rec["trace"] == "t" * 16  # reserved key not overridable
+        assert "degraded" not in rec and rec["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Writer: atomic append, exemplars, torn + interleaved lines, rotation, GC
+# ---------------------------------------------------------------------------
+
+
+def _commit_one(run_dir, tid="a1b2c3d4e5f60718", keep=True, exemplar=False,
+                n_spans=2):
+    ctx = qtrace.TraceContext(tid, keep=keep, service="test")
+    t0 = time.time()
+    for i in range(n_spans):
+        ctx.add(f"layer.{i}", t0, 0.001 * (i + 1))
+    w = qtrace.TraceWriter(run_dir)
+    wrote = w.commit(ctx, exemplar=exemplar)
+    w.close()
+    return wrote
+
+
+class TestWriter:
+    def test_commit_and_load_roundtrip(self, tmp_path):
+        assert _commit_one(tmp_path, n_spans=3)
+        spans, bad = qtrace.load_spans(tmp_path)
+        assert len(spans) == 3 and bad == 0
+        assert all(s["trace"] == "a1b2c3d4e5f60718" for s in spans)
+
+    def test_head_dropped_trace_not_written(self, tmp_path):
+        assert not _commit_one(tmp_path, keep=False)
+        assert not (tmp_path / qtrace.TRACE_FILE).exists()
+
+    def test_exemplar_overrides_drop_and_marks(self, tmp_path):
+        assert _commit_one(tmp_path, keep=False, exemplar=True)
+        spans, _ = qtrace.load_spans(tmp_path)
+        assert spans and all(s.get("exemplar") for s in spans)
+
+    def test_kept_trace_not_marked_exemplar(self, tmp_path):
+        assert _commit_one(tmp_path, keep=True, exemplar=True)
+        spans, _ = qtrace.load_spans(tmp_path)
+        assert spans and not any("exemplar" in s for s in spans)
+
+    def test_torn_final_line_counted_not_fatal(self, tmp_path):
+        _commit_one(tmp_path, n_spans=2)
+        path = tmp_path / qtrace.TRACE_FILE
+        raw = path.read_bytes()
+        # kill -9 mid-append: final line cut inside the JSON (and inside a
+        # UTF-8 continuation for good measure)
+        path.write_bytes(raw + b'{"trace": "deadbeef", "sp\xc3')
+        spans, bad = qtrace.load_spans(tmp_path)
+        assert len(spans) == 2 and bad == 1
+
+    def test_non_dict_and_missing_key_lines_counted(self, tmp_path):
+        path = tmp_path / qtrace.TRACE_FILE
+        path.write_text('[1, 2]\n{"trace": "x"}\n{"trace": "t", "span": "s"}\n')
+        spans, bad = qtrace.load_spans(tmp_path)
+        assert len(spans) == 1 and bad == 2
+
+    def test_thread_interleaved_commits_all_parse(self, tmp_path):
+        writer = qtrace.TraceWriter(tmp_path)
+        n_threads, per_thread = 8, 25
+
+        def work(k):
+            for i in range(per_thread):
+                ctx = qtrace.TraceContext(f"{k:08x}{i:08x}", service="test")
+                t0 = time.time()
+                ctx.add("alpha", t0, 0.001, k=k)
+                ctx.add("beta", t0, 0.002, i=i)
+                writer.commit(ctx)
+
+        threads = [threading.Thread(target=work, args=(k,)) for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        writer.close()
+        spans, bad = qtrace.load_spans(tmp_path)
+        assert bad == 0  # whole-line atomic append: no torn interleavings
+        assert len(spans) == n_threads * per_thread * 2
+
+    def test_rotation_bounds_active_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SBR_TRACE_MAX_MB", "0.0000001")  # floor: 64 KiB
+        writer = qtrace.TraceWriter(tmp_path)
+        for i in range(60):
+            ctx = qtrace.TraceContext(f"{i:016x}", service="test")
+            t0 = time.time()
+            for j in range(20):
+                ctx.add(f"layer.{j}", t0, 0.001, filler="x" * 64)
+            writer.commit(ctx)
+        writer.close()
+        rotated = list(tmp_path.glob("trace.*.jsonl"))
+        assert rotated, "rotation never fired"
+        assert (tmp_path / qtrace.TRACE_FILE).stat().st_size < 2 * (1 << 16)
+        # Nothing lost across the rotation boundary
+        spans, bad = qtrace.load_spans(tmp_path)
+        assert bad == 0 and len(spans) == 60 * 20
+
+    def test_writer_registry_singleton_and_summary(self, tmp_path):
+        w1 = qtrace.writer_for(tmp_path)
+        w2 = qtrace.writer_for(str(tmp_path))
+        assert w1 is w2
+        assert qtrace.writer_for(None) is None
+        ctx = qtrace.TraceContext("f" * 16, service="test")
+        ctx.add("x", time.time(), 0.001)
+        w1.commit(ctx)
+        assert qtrace.summary_for(tmp_path)["traces"] == 1
+        counters = qtrace.close_for(tmp_path)
+        assert counters["spans"] == 1
+        assert qtrace.summary_for(tmp_path) is None  # forgotten after close
+
+
+class TestTraceGC:
+    def _mk_run(self, root, name, status="complete", rotated=3, mtime=None):
+        d = root / name
+        d.mkdir(parents=True)
+        (d / "manifest.json").write_text(json.dumps({"status": status}))
+        for i in range(rotated):
+            p = d / f"trace.{i + 1:03d}.jsonl"
+            p.write_text('{"trace": "t", "span": "s"}\n')
+            t = (mtime or time.time()) + i
+            import os
+
+            os.utime(p, (t, t))
+        (d / "trace.jsonl").write_text('{"trace": "t", "span": "s"}\n')
+        return d
+
+    def test_prunes_rotated_keeps_active_and_newest(self, tmp_path):
+        d = self._mk_run(tmp_path, "run_a", rotated=3, mtime=time.time() - 60)
+        removed = qtrace.gc_trace_files(tmp_path, keep_rotated=1)
+        assert len(removed) == 2
+        assert (d / "trace.jsonl").exists()
+        assert (d / "trace.003.jsonl").exists()  # the newest rotated file
+        assert not (d / "trace.001.jsonl").exists()
+
+    def test_live_run_untouched(self, tmp_path):
+        d = self._mk_run(tmp_path, "run_live", status="running", rotated=3)
+        assert qtrace.gc_trace_files(tmp_path, keep_rotated=0) == []
+        assert len(list(d.glob("trace.*.jsonl"))) == 3
+
+    def test_report_gc_trace_keep_flag(self, tmp_path):
+        import subprocess
+        import sys
+
+        self._mk_run(tmp_path, "run_b", rotated=2, mtime=time.time() - 60)
+        proc = subprocess.run(
+            [sys.executable, "-m", "sbr_tpu.obs.report", "gc", str(tmp_path),
+             "--keep", "10", "--trace-keep", "0"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0
+        assert "2 rotated trace span file(s)" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Per-layer histograms (the /metrics satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestLayerHistograms:
+    def test_labeled_histograms_record_and_export(self):
+        h = LabeledHistograms(DEFAULT_LATENCY_BOUNDS_MS)
+        h.record("engine.queue", 1.0)
+        h.record("engine.queue", 2.0)
+        h.record("engine.dispatch", 50.0)
+        summ = h.summaries()
+        assert summ["engine.queue"]["count"] == 2
+        text = "\n".join(h.to_prometheus("sbr_trace_span_ms", label_key="layer"))
+        assert 'layer="engine.queue"' in text
+        assert text.count("# TYPE") == 1  # one header for the family
+
+    def test_commit_folds_into_process_histograms(self, tmp_path):
+        before = qtrace.layer_histograms().summaries().get(
+            "test.fold", {}
+        ).get("count", 0)
+        ctx = qtrace.TraceContext("e" * 16, service="test")
+        ctx.add("test.fold", time.time(), 0.005)
+        w = qtrace.TraceWriter(tmp_path)
+        w.commit(ctx)
+        w.close()
+        after = qtrace.layer_histograms().summaries()["test.fold"]["count"]
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# report trace / report slo (synthetic spans — no engine)
+# ---------------------------------------------------------------------------
+
+
+def _write_spans(run_dir, spans):
+    Path(run_dir).mkdir(parents=True, exist_ok=True)
+    with open(Path(run_dir) / qtrace.TRACE_FILE, "a") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+
+
+def _span(trace, span, parent, name, svc, ts, dur_ms, **attrs):
+    return {"trace": trace, "span": span, "parent": parent, "name": name,
+            "svc": svc, "ts": ts, "dur_ms": dur_ms, **attrs}
+
+
+def _fleet_trace(router_dir, worker_dir, tid="11aa22bb33cc44dd", t0=1000.0,
+                 forward_outcome="ok"):
+    """One synthetic cross-process trace: router root + forward, worker
+    request + engine child — the join the aggregator must reassemble."""
+    _write_spans(router_dir, [
+        _span(tid, "r0000001", None, "router.request", "router", t0, 100.0,
+              status=200, outcome="completed"),
+        _span(tid, "rf000001", "r0000001", "router.forward", "router",
+              t0 + 1e-3, 98.0, worker="w1", outcome=forward_outcome),
+    ])
+    _write_spans(worker_dir, [
+        _span(tid, "w0000001", "rf000001", "worker.request", "worker",
+              t0 + 2e-3, 95.0, status=200),
+        _span(tid, "e0000001", "w0000001", "engine.query", "worker",
+              t0 + 3e-3, 90.0, source="computed"),
+    ])
+
+
+class TestReportTrace:
+    def test_cross_dir_join_and_coverage(self, tmp_path):
+        r, w = tmp_path / "router", tmp_path / "w0"
+        _fleet_trace(r, w)
+        doc, code = trace_doc([str(r), str(w)])
+        assert code == 0
+        assert doc["traces"] == 1 and doc["joined"] == 1
+        assert doc["coverage_min"] > 0.9
+        # With a single trace the duration-weighted figure equals it.
+        assert doc["coverage_weighted"] == doc["coverage_min"]
+        (wf,) = doc["waterfalls"]
+        names = [row["name"] for row in wf["rows"]]
+        assert names == ["router.request", "router.forward",
+                         "worker.request", "engine.query"]
+
+    def test_orphaned_sampled_trace_gates_exit_1(self, tmp_path):
+        d = tmp_path / "router"
+        _write_spans(d, [
+            _span("ab" * 8, "r1", None, "router.request", "router", 1.0, 10.0),
+            _span("ab" * 8, "x1", "missing0", "engine.query", "worker", 1.0, 5.0),
+        ])
+        doc, code = trace_doc([str(d)])
+        assert code == 1
+        assert doc["unjoined_traces"] == ["ab" * 8]
+
+    def test_orphaned_exemplar_trace_tolerated(self, tmp_path):
+        # A worker-side SLO-breach exemplar may legitimately miss its
+        # router half (head-dropped there) — never a join failure.
+        d = tmp_path / "w0"
+        _write_spans(d, [
+            _span("cd" * 8, "w1", "gone0001", "worker.request", "worker",
+                  1.0, 10.0, exemplar=True),
+        ])
+        doc, code = trace_doc([str(d)])
+        assert code == 0 and doc["exemplar_traces"] == 1
+
+    def test_failover_and_hedge_counted(self, tmp_path):
+        r, w = tmp_path / "router", tmp_path / "w0"
+        _fleet_trace(r, w, tid="11" * 8, forward_outcome="error")
+        _write_spans(r, [_span("11" * 8, "rf2", "r0000001", "router.forward",
+                               "router", 1000.05, 40.0, worker="w2",
+                               outcome="ok", role="hedge")])
+        doc, code = trace_doc([str(r), str(w)])
+        assert doc["failover_traces"] == 1
+        assert doc["hedged_traces"] == 1
+
+    def test_no_spans_exit_3_bad_dir_exit_2(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        _, code = trace_doc([str(empty)])
+        assert code == 3
+        _, code = trace_doc([str(tmp_path / "nope")])
+        assert code == 2
+
+    def test_torn_line_surfaced_as_bad_span_lines(self, tmp_path):
+        d = tmp_path / "w0"
+        _fleet_trace(d, d)
+        with open(d / qtrace.TRACE_FILE, "ab") as fh:
+            fh.write(b'{"trace": "torn')
+        doc, code = trace_doc([str(d)])
+        assert code == 0 and doc["bad_span_lines"] == 1
+
+
+class TestReportSlo:
+    def _live(self, d, slo_ms):
+        Path(d).mkdir(parents=True, exist_ok=True)
+        (Path(d) / "live.json").write_text(
+            json.dumps({"slo": {"slo_ms": slo_ms}})
+        )
+
+    def test_breach_gates_exit_1_with_causality(self, tmp_path):
+        r, w = tmp_path / "router", tmp_path / "w0"
+        _fleet_trace(r, w, forward_outcome="error")  # e2e 100 ms
+        self._live(r, 50.0)
+        doc, code = slo_doc([str(r), str(w)])
+        assert code == 1
+        assert doc["breach_causality"]["breaches"] == 1
+        assert doc["breach_causality"]["failover"] == 1
+        (b,) = doc["breach_exemplars"]
+        assert b["slo_ms"] == 50.0 and b["slowest_layer"] == "router.forward"
+
+    def test_under_slo_exit_0_with_layer_table(self, tmp_path):
+        r, w = tmp_path / "router", tmp_path / "w0"
+        _fleet_trace(r, w)
+        self._live(r, 5000.0)
+        doc, code = slo_doc([str(r), str(w)])
+        assert code == 0
+        assert doc["layers"]["engine.query"]["count"] == 1
+        assert doc["dirs"][0]["slo_ms"] == 5000.0
+
+    def test_exemplar_mark_is_a_breach_verdict(self, tmp_path):
+        d = tmp_path / "w0"
+        _write_spans(d, [
+            _span("ee" * 8, "w1", None, "worker.request", "worker", 1.0,
+                  10.0, exemplar=True),
+        ])
+        doc, code = slo_doc([str(d)])
+        assert code == 1 and doc["breach_exemplars"][0]["exemplar"]
+
+    def test_nothing_to_judge_exit_3(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        _, code = slo_doc([str(empty)])
+        assert code == 3
+
+
+# ---------------------------------------------------------------------------
+# Engine + endpoint integration (the expensive block: one shared engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_serve(tmp_path_factory):
+    """One engine + HTTP endpoint with tracing at rate 1, run dir attached.
+    Module-scoped: every integration test shares the compiled bucket."""
+    import os
+
+    from sbr_tpu import obs
+    from sbr_tpu.serve.endpoint import ServeEndpoint
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+
+    run_dir = tmp_path_factory.mktemp("trace_run")
+    old = os.environ.get("SBR_TRACE_SAMPLE")
+    os.environ["SBR_TRACE_SAMPLE"] = "1"
+    run = obs.start_run(label="trace_it", run_dir=str(run_dir))
+    eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)), run=run)
+    eng.start()
+    ep = ServeEndpoint(eng).start()
+    try:
+        yield eng, ep, run_dir
+    finally:
+        ep.close()
+        eng.close()
+        obs.end_run()
+        if old is None:
+            os.environ.pop("SBR_TRACE_SAMPLE", None)
+        else:
+            os.environ["SBR_TRACE_SAMPLE"] = old
+
+
+def _post(port, doc, headers=None):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _spans_for(run_dir, tid, timeout_s=10.0):
+    """Poll for a trace's spans: the endpoint commits in its handler's
+    ``finally`` — AFTER the response bytes reach the client — so an
+    immediate read races the writer."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        spans, _ = qtrace.load_spans(run_dir)
+        mine = [s for s in spans if s["trace"] == tid]
+        if mine or time.monotonic() > deadline:
+            return mine
+        time.sleep(0.02)
+
+
+class TestServeIntegration:
+    def test_direct_hit_mints_and_joins(self, traced_serve):
+        eng, ep, run_dir = traced_serve
+        code, doc, hdrs = _post(ep.port, {"beta": 1.5, "u": 0.2})
+        assert code == 200
+        tid = doc["trace_id"]
+        assert tid and hdrs[qtrace.TRACE_HEADER] == tid
+        mine = _spans_for(run_dir, tid)
+        names = {s["name"] for s in mine}
+        assert {"worker.request", "engine.query", "engine.admission",
+                "engine.queue", "engine.cache", "engine.dispatch"} <= names
+        rdoc, rcode = trace_doc([str(run_dir)])
+        assert rcode == 0
+        mine_row = [e for e in rdoc["trace_table"] if e["trace"] == tid]
+        # A warm in-process query finishes in single-digit ms, where the
+        # endpoint's fixed parse/respond overhead is a visible slice; the
+        # >= 0.95 acceptance floor is gated in the fleet chaos smoke
+        # (realistic HTTP round trips), not on this micro request.
+        assert mine_row and mine_row[0]["coverage"] >= 0.75
+
+    def test_inbound_header_adopted_and_parented(self, traced_serve):
+        eng, ep, run_dir = traced_serve
+        tid, fid = "12" * 8, "34" * 4
+        code, doc, _ = _post(
+            ep.port, {"beta": 1.5, "u": 0.21},
+            headers={qtrace.TRACE_HEADER: tid, qtrace.PARENT_HEADER: fid},
+        )
+        assert code == 200 and doc["trace_id"] == tid
+        mine = _spans_for(run_dir, tid)
+        root = [s for s in mine if s["name"] == "worker.request"]
+        assert root and root[0]["parent"] == fid  # the cross-process edge
+
+    def test_warm_traced_queries_add_zero_xla_traces(self, traced_serve):
+        from sbr_tpu.obs import prof
+
+        eng, ep, run_dir = traced_serve
+        _post(ep.port, {"beta": 1.5, "u": 0.22})  # compile + fill cache
+        before = dict(prof.trace_counts())
+        for _ in range(3):
+            code, doc, _ = _post(ep.port, {"beta": 1.5, "u": 0.22})
+            assert code == 200 and doc["source"] in ("lru", "disk")
+        assert dict(prof.trace_counts()) == before
+
+    def test_cache_hit_span_says_lru(self, traced_serve):
+        eng, ep, run_dir = traced_serve
+        _post(ep.port, {"beta": 1.5, "u": 0.23})
+        code, doc, _ = _post(ep.port, {"beta": 1.5, "u": 0.23})
+        assert doc["source"] == "lru"
+        mine = _spans_for(run_dir, doc["trace_id"])
+        cache = [s for s in mine if s["name"] == "engine.cache"]
+        assert cache and cache[0]["lru"] == "hit"
+        # LRU hits never touch the batcher: no dispatch span, and the
+        # queue/cache spans still cover the engine.query interval.
+        assert not any(s["name"] == "engine.dispatch" for s in mine)
+
+
+class TestBitIdentityWhenOff:
+    def test_untraced_engine_answers_bit_identical(self, monkeypatch, tmp_path):
+        """SBR_TRACE_SAMPLE=0 must be indistinguishable from a traced run
+        in every served byte (the acceptance's bit-identity witness)."""
+        from sbr_tpu.serve.engine import Engine, ServeConfig
+
+        import numpy as np
+
+        params = [make_model_params(beta=1.1 + 0.1 * i, u=0.2) for i in range(3)]
+
+        def run_mix(rate):
+            monkeypatch.setenv("SBR_TRACE_SAMPLE", rate)
+            eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)))
+            eng.start()
+            try:
+                out = eng.query_many(params, scenario="bitid")
+            finally:
+                eng.close()
+            return [
+                (np.float64(r.xi).tobytes(), np.float64(r.tau_bar_in).tobytes(),
+                 np.float64(r.aw_max).tobytes(), r.status, r.flags, r.source)
+                for r in out
+            ]
+
+        assert run_mix("0") == run_mix("1")
+
+    def test_off_leaves_no_trace_artifacts(self, monkeypatch, tmp_path):
+        import urllib.request
+
+        from sbr_tpu import obs
+        from sbr_tpu.serve.endpoint import ServeEndpoint
+        from sbr_tpu.serve.engine import Engine, ServeConfig
+
+        monkeypatch.setenv("SBR_TRACE_SAMPLE", "0")
+        run = obs.start_run(label="untraced", run_dir=str(tmp_path / "run"))
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)), run=run)
+        eng.start()
+        ep = ServeEndpoint(eng).start()
+        try:
+            code, doc, hdrs = _post(ep.port, {"beta": 1.5, "u": 0.2})
+        finally:
+            ep.close()
+            eng.close()
+            obs.end_run()
+        assert code == 200
+        assert "trace_id" not in doc
+        assert qtrace.TRACE_HEADER not in hdrs
+        assert not (tmp_path / "run" / qtrace.TRACE_FILE).exists()
