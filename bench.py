@@ -1604,6 +1604,130 @@ def bench_infomodels(platform: str) -> dict:
     }
 
 
+def bench_audit(platform: str) -> dict:
+    """Numerics-audit workload (ISSUE 17): canary-battery probe throughput
+    + serve-loop overhead of the idle-gated audit scheduler.
+
+    Part 1 generates goldens for a cheap probe subset into a temp registry
+    (compiles the probe solves), then times a steady battery pass →
+    audit_probes_per_sec. Part 2 drives the same seeded query mix through
+    an in-process Engine twice — audit scheduler OFF (control) then ON with
+    a short interval so canaries really interleave with the idle gaps — and
+    reports audit_overhead_ratio = on/off steady time (lower-better by the
+    overhead rule; ~1.0 means canaries are invisible to the hot path).
+    History schema 11; tiny dry-run shapes zero the gated keys so
+    reduced-shape stats never seed a baseline."""
+    import tempfile
+
+    from sbr_tpu import obs
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.obs import audit
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+    from sbr_tpu.serve.loadgen import build_pool, query_mix
+
+    if _tiny():
+        probe_names = ["graphgen.layout"]
+        pool_n, n_queries, n_grid, rounds = 4, 24, 64, 1
+    elif platform == "cpu":
+        probe_names = ["graphgen.layout", "scenario.composed", "infomodel.gossip"]
+        pool_n, n_queries, n_grid, rounds = 16, 192, 256, 2
+    else:
+        probe_names = ["graphgen.layout", "scenario.composed", "infomodel.gossip"]
+        pool_n, n_queries, n_grid, rounds = 32, 512, 512, 2
+
+    reg = tempfile.mkdtemp(prefix="sbr_audit_bench_")
+    # Golden generation doubles as the compile warm-up: the scheduler in
+    # part 2 runs in THIS process, so its canaries reuse these executables.
+    audit.run_battery(update=True, probe_names=probe_names, reg_dir=reg,
+                      emit=False)
+    with obs.suspended(), obs.mem.live_disabled():
+        battery_s = min(
+            _timed(lambda: audit.run_battery(
+                probe_names=probe_names, reg_dir=reg, emit=False))
+            for _ in range(2)
+        )
+    probes_per_sec = len(probe_names) / battery_s if battery_s > 0 else 0.0
+
+    if _tiny():
+        # The overhead ratio is zeroed-and-dropped at tiny sizes anyway —
+        # don't burn two engine warm-ups in the dry-run pipeline for it.
+        _log(
+            f"audit: {len(probe_names)} probe(s) battery in {battery_s:.3f}s "
+            "steady (tiny: overhead phase skipped)"
+        )
+        return {
+            "audit_probe_count": len(probe_names),
+            "audit_battery_s": round(battery_s, 4),
+            "audit_probes_per_sec": 0.0,
+            "audit_overhead_ratio": 0.0,
+            "audit_off_s": 0.0,
+            "audit_on_s": 0.0,
+            "audit_canary_cycles": 0,
+        }
+
+    config = SolverConfig(n_grid=n_grid, bisect_iters=40, refine_crossings=False)
+    pool = build_pool(0, pool_n)
+    mix = query_mix(0, pool_n, n_queries)
+    audit_env = {
+        "SBR_AUDIT_REGISTRY_DIR": reg,
+        "SBR_AUDIT_INTERVAL_S": "0.5",
+        "SBR_AUDIT_PROBES": ",".join(probe_names),
+    }
+
+    def drive(audit_on: bool):
+        flip = {"SBR_AUDIT": "1" if audit_on else "0", **audit_env}
+        old = {k: os.environ.get(k) for k in flip}
+        os.environ.update(flip)
+        try:
+            engine = Engine(config=config, serve=ServeConfig(buckets=(1, 8)))
+            engine.start()
+            try:
+                for i in range(0, len(pool), 8):
+                    engine.query_many(pool[i : i + 8], scenario="warmup")
+                with obs.suspended(), obs.mem.live_disabled():
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):
+                        for i in range(0, len(mix), 8):
+                            engine.query_many(
+                                [pool[j] for j in mix[i : i + 8]],
+                                scenario="mix",
+                            )
+                    dt = time.perf_counter() - t0
+                cycles = (
+                    engine.audit.snapshot()["cycles"]
+                    if engine.audit is not None else 0
+                )
+            finally:
+                engine.close()
+            return dt, cycles
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    off_s, _ = drive(False)
+    on_s, cycles = drive(True)
+    overhead = on_s / off_s if off_s > 0 else 0.0
+
+    _log(
+        f"audit: {len(probe_names)} probe(s) battery in {battery_s:.3f}s "
+        f"steady ({probes_per_sec:.2f} probes/s); serve mix "
+        f"{len(mix) * rounds} queries audit-off {off_s:.3f}s vs audit-on "
+        f"{on_s:.3f}s (overhead x{overhead:.3f}, {cycles} canary cycle(s))"
+    )
+    return {
+        "audit_probe_count": len(probe_names),
+        "audit_battery_s": round(battery_s, 4),
+        "audit_probes_per_sec": 0.0 if _tiny() else round(probes_per_sec, 3),
+        "audit_overhead_ratio": 0.0 if _tiny() else round(overhead, 4),
+        "audit_off_s": round(off_s, 3),
+        "audit_on_s": round(on_s, 3),
+        "audit_canary_cycles": int(cycles),
+    }
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -1739,6 +1863,20 @@ def _measure_inner(platform: str) -> None:
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in info.items() if v is not None},
         )
+    try:
+        with obs.span("bench.audit"):
+            aud = bench_audit(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the numerics-audit workload fails.
+        _log(f"audit bench failed: {err!r}")
+        aud = None
+    if aud is not None:
+        obs.event(
+            "bench_audit",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in aud.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -1864,6 +2002,16 @@ def _measure_inner(platform: str) -> None:
         out["extra"]["infomodel_population_run_probability"] = info[
             "infomodel_population_run_probability"
         ]
+    if aud is not None:
+        # Schema-11 history metrics (ISSUE 17): canary-battery probe
+        # throughput + idle-gated scheduler overhead ratio. Tiny shapes
+        # zero the gated keys (falsy → dropped here) so reduced-shape
+        # stats never seed baselines.
+        for k in ("audit_probes_per_sec", "audit_overhead_ratio"):
+            if aud.get(k):
+                out["extra"][k] = aud[k]
+        out["extra"]["audit_probe_count"] = aud["audit_probe_count"]
+        out["extra"]["audit_canary_cycles"] = aud["audit_canary_cycles"]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

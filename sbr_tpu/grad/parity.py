@@ -104,30 +104,42 @@ def main(argv=None) -> int:
     parser.add_argument("--tol", type=float, default=1e-5,
                         help="max allowed relative error (default 1e-5)")
     parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument("--obs-dir", default=None,
+                        help="record the verdict as an audit probe event "
+                        "under this obs run root")
     args = parser.parse_args(argv)
-    report = run_battery(n=args.n, seed=args.seed, tol=args.tol)
-    if args.json:
-        print(json.dumps(report))
-    else:
-        for pt in report["points"]:
-            rels = pt.get("rel_errors")
-            if rels is None:
-                print(f"  skip  β={pt['beta']:.3f} u={pt['u']:.3f} κ={pt['kappa']:.3f} "
-                      f"(status {pt['status']}, flags {pt['flags']})")
-                continue
-            line = " ".join(
-                f"d{k}: {v['rel']:.2e}" for k, v in rels.items()
+
+    def _check() -> int:
+        report = run_battery(n=args.n, seed=args.seed, tol=args.tol)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            for pt in report["points"]:
+                rels = pt.get("rel_errors")
+                if rels is None:
+                    print(f"  skip  β={pt['beta']:.3f} u={pt['u']:.3f} κ={pt['kappa']:.3f} "
+                          f"(status {pt['status']}, flags {pt['flags']})")
+                    continue
+                line = " ".join(
+                    f"d{k}: {v['rel']:.2e}" for k, v in rels.items()
+                )
+                print(f"  ok    β={pt['beta']:.3f} u={pt['u']:.3f} κ={pt['kappa']:.3f}  {line}")
+            print(
+                f"grad parity: {report['n_checked']}/{report['n_points']} run points, "
+                f"worst rel {report['worst_rel']:.3e} vs tol {report['tol']:g} "
+                f"-> {'OK' if report['ok'] else 'FAIL'}"
             )
-            print(f"  ok    β={pt['beta']:.3f} u={pt['u']:.3f} κ={pt['kappa']:.3f}  {line}")
-        print(
-            f"grad parity: {report['n_checked']}/{report['n_points']} run points, "
-            f"worst rel {report['worst_rel']:.3e} vs tol {report['tol']:g} "
-            f"-> {'OK' if report['ok'] else 'FAIL'}"
-        )
-    if not report["ok"]:
-        print("grad parity FAILED", file=sys.stderr)
-        return 1
-    return 0
+        if not report["ok"]:
+            print("grad parity FAILED", file=sys.stderr)
+            return 1
+        return 0
+
+    # Legacy entrypoint, audit protocol (ISSUE 17): the battery executes
+    # through the unified registry runner so its verdict lands as an
+    # ``audit`` probe event; flags, output, and exit code are unchanged.
+    from sbr_tpu.obs import audit
+
+    return audit.run_legacy_cli("grad.ift_fd", _check, obs_dir=args.obs_dir)
 
 
 if __name__ == "__main__":

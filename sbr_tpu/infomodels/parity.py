@@ -27,21 +27,14 @@ import argparse
 import sys
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(prog="python -m sbr_tpu.infomodels.parity")
-    parser.add_argument("--n", type=int, default=600, help="agents (default 600)")
-    parser.add_argument(
-        "--obs-dir", default=None,
-        help="run the battery inside an obs run rooted here (dir printed)",
-    )
-    args = parser.parse_args(argv)
-
+def run_checks(n: int = 600) -> int:
+    """Run all three checks; raises AssertionError naming the first
+    divergence, returns 0 on success (the audit legacy-CLI contract)."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
     import numpy as np
 
-    from sbr_tpu import obs
     from sbr_tpu.infomodels import (
         InfoModelSpec,
         population_fingerprint,
@@ -52,87 +45,97 @@ def main(argv=None) -> int:
     from sbr_tpu.social.closure import close_loop
     from sbr_tpu.social.graphgen import ErdosRenyiSpec, prepare_generated_graph
 
-    run = None
-    if args.obs_dir:
-        run = obs.start_run(label="infomodel-parity", run_dir=args.obs_dir)
-        print(f"obs run dir: {run.run_dir}")
+    graph = ErdosRenyiSpec(n=n, avg_degree=8.0)
+    spec = InfoModelSpec()  # gossip, static, homogeneous — the reduction
+    from sbr_tpu.infomodels import simulate_info
 
-    try:
-        n = args.n
-        graph = ErdosRenyiSpec(n=n, avg_degree=8.0)
-        spec = InfoModelSpec()  # gossip, static, homogeneous — the reduction
-        from sbr_tpu.infomodels import simulate_info
-
-        for engine in ("gather", "incremental"):
-            for dtype in (np.float32, np.float64):
-                for fused in ("lax", "interpret"):
-                    cfg = AgentSimConfig(n_steps=25, dt=0.1, fused=fused)
-                    r_info = simulate_info(
-                        spec, graph, beta=1.2, x0=0.02, config=cfg, seed=7,
-                        dtype=dtype, engine=engine,
+    for engine in ("gather", "incremental"):
+        for dtype in (np.float32, np.float64):
+            for fused in ("lax", "interpret"):
+                cfg = AgentSimConfig(n_steps=25, dt=0.1, fused=fused)
+                r_info = simulate_info(
+                    spec, graph, beta=1.2, x0=0.02, config=cfg, seed=7,
+                    dtype=dtype, engine=engine,
+                )
+                pg = prepare_generated_graph(
+                    graph, seed=7, betas=1.2, config=cfg, dtype=dtype,
+                    engine=engine,
+                )
+                r_leg = simulate_agents(
+                    prepared=pg, x0=0.02, config=cfg, seed=7
+                )
+                label = f"{engine}/{np.dtype(dtype).name}/{fused}"
+                for f in ("informed", "t_inf", "informed_frac", "withdrawn_frac"):
+                    a = np.asarray(getattr(r_info, f))
+                    b = np.asarray(getattr(r_leg, f))
+                    assert np.array_equal(a, b), (
+                        f"gossip reduction diverged at {label}.{f}"
                     )
-                    pg = prepare_generated_graph(
-                        graph, seed=7, betas=1.2, config=cfg, dtype=dtype,
-                        engine=engine,
-                    )
-                    r_leg = simulate_agents(
-                        prepared=pg, x0=0.02, config=cfg, seed=7
-                    )
-                    label = f"{engine}/{np.dtype(dtype).name}/{fused}"
-                    for f in ("informed", "t_inf", "informed_frac", "withdrawn_frac"):
-                        a = np.asarray(getattr(r_info, f))
-                        b = np.asarray(getattr(r_leg, f))
-                        assert np.array_equal(a, b), (
-                            f"gossip reduction diverged at {label}.{f}"
-                        )
-        print("gossip reduction ok: bitwise across "
-              "{gather,incremental} x {f32,f64} x {lax,interpret}")
+    print("gossip reduction ok: bitwise across "
+          "{gather,incremental} x {f32,f64} x {lax,interpret}")
 
-        # Bayes close-the-loop at smoke scale.
-        model = make_model_params(
-            beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25
-        )
-        bayes = InfoModelSpec(channel="bayes")
-        tol = 0.25
-        comp = close_loop(
-            model=model, infomodel=bayes, n_agents=4000, avg_degree=15.0,
-            dt=0.05, g0=0.2, t_max=8.0, n_reps=2,
-            config=SolverConfig(n_grid=512), tolerance=tol,
-        )
-        assert bool(comp.fp.converged), "bayes fixed point did not converge"
-        assert bool(comp.fp.equilibrium.bankrun), "bayes fixed point has no run"
-        assert comp.err_aw_sup < tol, (
-            f"bayes closure err_aw_sup {comp.err_aw_sup:.4f} over {tol}"
-        )
-        print(f"bayes close-the-loop ok: err_aw_sup {comp.err_aw_sup:.4f} "
-              f"(< {tol}), xi {float(comp.fp.xi):.4f}")
+    # Bayes close-the-loop at smoke scale.
+    model = make_model_params(
+        beta=0.9, eta_bar=30.0, u=0.5, p=0.99, kappa=0.25, lam=0.25
+    )
+    bayes = InfoModelSpec(channel="bayes")
+    tol = 0.25
+    comp = close_loop(
+        model=model, infomodel=bayes, n_agents=4000, avg_degree=15.0,
+        dt=0.05, g0=0.2, t_max=8.0, n_reps=2,
+        config=SolverConfig(n_grid=512), tolerance=tol,
+    )
+    assert bool(comp.fp.converged), "bayes fixed point did not converge"
+    assert bool(comp.fp.equilibrium.bankrun), "bayes fixed point has no run"
+    assert comp.err_aw_sup < tol, (
+        f"bayes closure err_aw_sup {comp.err_aw_sup:.4f} over {tol}"
+    )
+    print(f"bayes close-the-loop ok: err_aw_sup {comp.err_aw_sup:.4f} "
+          f"(< {tol}), xi {float(comp.fp.xi):.4f}")
 
-        # Population determinism + fingerprint stability.
-        pop_graph = ErdosRenyiSpec(n=1500, avg_degree=10.0)
-        rec1 = population_query(
-            bayes, pop_graph, model, seeds=3, vary="sim", g0=None,
-            config=SolverConfig(n_grid=256),
-        )
-        rec2 = population_query(
-            bayes, pop_graph, model, seeds=3, vary="sim", g0=None,
-            config=SolverConfig(n_grid=256),
-        )
-        assert rec1 == rec2, "population query is not deterministic"
-        kw = {"spec": bayes, "graph": pop_graph, "seeds": 3, "vary": "sim",
-              "seed": 0, "dt": 0.1}
-        f1 = population_fingerprint(kw, model, SolverConfig(n_grid=256), "float64")
-        f2 = population_fingerprint(kw, model, SolverConfig(n_grid=256), "float64")
-        assert f1 == f2, "population fingerprint unstable"
-        kw2 = {**kw, "seeds": 4}
-        assert population_fingerprint(
-            kw2, model, SolverConfig(n_grid=256), "float64"
-        ) != f1, "population fingerprint ignores the seed count"
-        print(f"population determinism ok: run_p {rec1['run_probability']:.2f}, "
-              f"fingerprint {f1[:12]}")
-    finally:
-        if run is not None:
-            obs.end_run()
+    # Population determinism + fingerprint stability.
+    pop_graph = ErdosRenyiSpec(n=1500, avg_degree=10.0)
+    rec1 = population_query(
+        bayes, pop_graph, model, seeds=3, vary="sim", g0=None,
+        config=SolverConfig(n_grid=256),
+    )
+    rec2 = population_query(
+        bayes, pop_graph, model, seeds=3, vary="sim", g0=None,
+        config=SolverConfig(n_grid=256),
+    )
+    assert rec1 == rec2, "population query is not deterministic"
+    kw = {"spec": bayes, "graph": pop_graph, "seeds": 3, "vary": "sim",
+          "seed": 0, "dt": 0.1}
+    f1 = population_fingerprint(kw, model, SolverConfig(n_grid=256), "float64")
+    f2 = population_fingerprint(kw, model, SolverConfig(n_grid=256), "float64")
+    assert f1 == f2, "population fingerprint unstable"
+    kw2 = {**kw, "seeds": 4}
+    assert population_fingerprint(
+        kw2, model, SolverConfig(n_grid=256), "float64"
+    ) != f1, "population fingerprint ignores the seed count"
+    print(f"population determinism ok: run_p {rec1['run_probability']:.2f}, "
+          f"fingerprint {f1[:12]}")
     return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m sbr_tpu.infomodels.parity")
+    parser.add_argument("--n", type=int, default=600, help="agents (default 600)")
+    parser.add_argument(
+        "--obs-dir", default=None,
+        help="run the battery inside an obs run rooted here (dir printed)",
+    )
+    args = parser.parse_args(argv)
+
+    # Legacy entrypoint, audit protocol (ISSUE 17): run_legacy_cli owns the
+    # obs run (same "obs run dir:" line CI scrapes for `report infomodel`),
+    # records the verdict as an audit probe event alongside the infomodel
+    # events the checks emit, and keeps the AssertionError→exit-1 contract.
+    from sbr_tpu.obs import audit
+
+    return audit.run_legacy_cli(
+        "infomodel.gossip", lambda: run_checks(n=args.n), obs_dir=args.obs_dir
+    )
 
 
 if __name__ == "__main__":

@@ -27,6 +27,14 @@ per-step ABM counters are the design references from PAPERS.md):
   pre-dispatch OOM preflight (AOT analytical footprint vs
   ``SBR_MEM_HEADROOM × capacity``, fail-closed `MemoryPreflightError`),
   and the ``tile_shape="auto"`` capacity planner.
+- ``obs.audit``   — numerics audit observatory (ISSUE 17): the versioned
+  golden-surface registry, the unified canary battery
+  (``python -m sbr_tpu.obs.audit``; the four legacy parity CLIs delegate
+  through it), the serve-worker `AuditScheduler` (``SBR_AUDIT``,
+  ``SBR_AUDIT_INTERVAL_S``), and audit-artifact retention
+  (`gc_audit_files`, ``report gc --audit-keep``). Kept OUT of this
+  package's import graph so ``python -m`` runs exactly one module copy
+  (the `graphgen_cli` rationale).
 - ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
   a run directory or diffs two runs; the `health` subcommand renders and
   gates on numerical health, `resilience` renders/gates the fault/retry/
@@ -74,6 +82,7 @@ from sbr_tpu.obs.runlog import (
     gc_runs,
     interrupt_all,
     jit_call,
+    log_audit,
     log_cache,
     log_fault,
     log_fleet,
@@ -107,6 +116,7 @@ __all__ = [
     "history",
     "interrupt_all",
     "jit_call",
+    "log_audit",
     "log_cache",
     "log_fault",
     "log_fleet",

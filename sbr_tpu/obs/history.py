@@ -85,10 +85,16 @@ from typing import Optional
 # infomodel_population_queries_per_sec (end-to-end population what-if
 # queries per second at the query shape — fixed point + S member sims +
 # crossing reduction; higher-better likewise).
+# Schema 11 adds the numerics-audit workload (bench.py bench_audit):
+# audit_probes_per_sec (golden-battery probe throughput — how fast the
+# canary battery turns over; higher-better by the per_sec rule) and
+# audit_overhead_ratio (serve-loop steady-state latency with the idle-gated
+# audit scheduler enabled over the audit-off control; lower-better by the
+# overhead rule — ~1.0 means canaries are invisible to the hot path).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1/2/3/4/5/6/7/8/9 history keeps gating new schema-10 appends.
-SCHEMA = 10
+# schema-1..10 history keeps gating new schema-11 appends.
+SCHEMA = 11
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -220,6 +226,12 @@ def bench_metrics(result: dict) -> dict:
         # what-if query rate (both higher-better by the per_sec rule)
         "infomodel_belief_updates_per_sec",
         "infomodel_population_queries_per_sec",
+        # schema 11: the numerics-audit workload (bench.py bench_audit):
+        # canary-battery probe throughput (higher-better by the per_sec
+        # rule) and serve-loop audit-on/off overhead ratio (lower-better
+        # by the overhead rule)
+        "audit_probes_per_sec",
+        "audit_overhead_ratio",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):

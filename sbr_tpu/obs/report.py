@@ -45,6 +45,13 @@ Usage:
                                                     # queries or a breaker
                                                     # stuck open, 3 when no
                                                     # fleet data was recorded
+    python -m sbr_tpu.obs.report audit RUN_DIR      # numerics-audit report
+                                                    # (canary probe verdicts
+                                                    # + cycle roll-ups from
+                                                    # sbr_tpu.obs.audit);
+                                                    # exit 1 on any drift
+                                                    # verdict, 3 when no
+                                                    # audit data recorded
     python -m sbr_tpu.obs.report trace DIR [DIR..]  # fleet-wide trace join
                                                     # (router + worker run
                                                     # dirs): per-query span
@@ -69,7 +76,11 @@ Usage:
                                                     # --tile-cache DIR
                                                     # --keep-days N also
                                                     # prunes cold global-
-                                                    # cache entries
+                                                    # cache entries; with
+                                                    # --audit-keep N also
+                                                    # prunes aged audit
+                                                    # batteries + archived
+                                                    # goldens
 
 Every reporting subcommand (timing render, diff, health, trend) takes
 ``--json`` and then prints one machine-readable JSON document instead of
@@ -1067,6 +1078,203 @@ def _main_fleet(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Numerics-audit report (`audit` subcommand — ISSUE 17 drift gate)
+# ---------------------------------------------------------------------------
+
+
+def audit_doc(run_dir) -> tuple:
+    """Machine-readable numerics-audit report (`sbr_tpu.obs.audit`): the
+    manifest ``audit`` roll-up plus the per-event fold of canary probe
+    verdicts and cycle summaries. Returns (doc, exit_code).
+
+    Exit codes: 0 every probe passed; 1 on ANY drift verdict (probe event,
+    cycle roll-up, manifest tally, or a scheduler ``error`` event — an
+    audit that crashed mid-battery must not read as clean) — the manifest
+    tally and the event fold are merged max-style, never summed, so a run
+    killed before its manifest flushed still gates on its events; 3 when
+    the run recorded no audit data at all (a drift gate with nothing to
+    read must not pass silently); 2 when ``run_dir`` is not a directory."""
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    try:
+        run = load_run(run_dir)
+    except (FileNotFoundError, json.JSONDecodeError) as err:
+        return {"dir": str(run_dir), "error": str(err), "exit": 2}, 2
+    manifest_blk = run["manifest"].get("audit") or {}
+    events = [ev for ev in run["events"] if ev.get("kind") == "audit"]
+    if not manifest_blk and not events:
+        return {
+            "dir": str(run_dir),
+            "error": "no audit data (no audit events, no manifest roll-up)",
+            "exit": 3,
+        }, 3
+    # Per-probe LAST verdict (later events supersede: a probe that drifted
+    # once and then went green after --update-goldens reads as its final
+    # state; the drift still counts in the drift tally below).
+    probes: dict = {}
+    drift_events = 0
+    pass_events = 0
+    errors = 0
+    cycles: list = []
+    for ev in events:
+        action = str(ev.get("action", "?"))
+        if action == "probe":
+            name = str(ev.get("probe", "?"))
+            verdict = str(ev.get("verdict", "?"))
+            ent = probes.setdefault(name, {"events": 0})
+            ent["events"] += 1
+            ent["verdict"] = verdict
+            ent["tier"] = ev.get("tier")
+            ent["detail"] = ev.get("detail")
+            ent["duration_ms"] = ev.get("duration_ms")
+            if ev.get("cycle") is not None:
+                ent["cycle"] = ev.get("cycle")
+            if verdict == "drift":
+                drift_events += 1
+                ent["drift_cycle"] = ev.get("cycle")
+            elif verdict == "pass":
+                pass_events += 1
+        elif action == "cycle":
+            cycles.append({
+                "cycle": ev.get("cycle"),
+                "verdict": ev.get("verdict"),
+                "probes": ev.get("probes"),
+                "drift": ev.get("drift"),
+                "missing": ev.get("missing"),
+                "duration_s": ev.get("duration_s"),
+                "key_hash": ev.get("key_hash"),
+            })
+        elif action == "error":
+            errors += 1
+    # Manifest tally vs event fold: max of the two views for every gated
+    # count (the fleet_doc rule — a worker killed before its manifest
+    # flushed still has its events; a torn events.jsonl still has the
+    # manifest), never the sum.
+    drift = max(drift_events, int(manifest_blk.get("drift", 0)))
+    passed = max(pass_events, int(manifest_blk.get("passed", 0)))
+    errors = max(errors, int(manifest_blk.get("error", 0)))
+    drifted = sorted(
+        n for n, e in probes.items() if e.get("verdict") == "drift"
+    )
+    last_cycle = manifest_blk.get("last_cycle")
+    last_verdict = manifest_blk.get("last_verdict")
+    if cycles:
+        last_cycle = cycles[-1].get("cycle", last_cycle)
+        last_verdict = cycles[-1].get("verdict", last_verdict)
+    breaches = []
+    if drift > 0:
+        who = f" ({', '.join(drifted)})" if drifted else ""
+        breaches.append(f"{drift} drift verdict(s){who}")
+    if last_verdict == "drift" and not breaches:
+        breaches.append("last cycle verdict is drift")
+    if errors > 0:
+        breaches.append(f"{errors} audit error event(s) — battery crashed")
+    code = 1 if breaches else 0
+    doc = {
+        "dir": str(run_dir),
+        "manifest_audit": manifest_blk or None,
+        "probes": probes,
+        "cycles": cycles,
+        "drift": drift,
+        "passed": passed,
+        "errors": errors,
+        "drifted_probes": drifted,
+        "last_cycle": last_cycle,
+        "last_verdict": last_verdict,
+        "breaches": breaches,
+        "bad_event_lines": run.get("bad_event_lines", 0),
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_audit(doc: dict) -> str:
+    """Human-readable audit report; same exit contract as `audit_doc`."""
+    out = [f"run      {doc['dir']}"]
+    if doc["exit"] in (2, 3):
+        out.append(doc.get("error", "no audit data"))
+        if doc["exit"] == 3:
+            out.append(
+                "was the battery run with obs enabled (python -m "
+                "sbr_tpu.obs.audit --obs-dir DIR, or SBR_AUDIT=1 serving)?"
+            )
+        return "\n".join(out)
+    out.append(
+        f"audit    {doc['passed']} pass, {doc['drift']} drift, "
+        f"{doc['errors']} error(s)"
+        + (f"   last cycle {doc['last_cycle']} ({doc['last_verdict']})"
+           if doc.get("last_cycle") is not None else "")
+    )
+    if doc.get("bad_event_lines"):
+        out.append(f"warning  {doc['bad_event_lines']} torn event line(s) skipped")
+    if doc["probes"]:
+        out += ["", "PROBES"]
+        out.append(
+            _table(
+                ["probe", "tier", "verdict", "runs", "last ms", "detail"],
+                [
+                    [
+                        n,
+                        e.get("tier") or "-",
+                        str(e.get("verdict", "?")).upper()
+                        if e.get("verdict") == "drift" else e.get("verdict", "?"),
+                        e.get("events", 0),
+                        "-" if e.get("duration_ms") is None
+                        else f"{e['duration_ms']:.1f}",
+                        (e.get("detail") or "-")[:60],
+                    ]
+                    for n, e in sorted(doc["probes"].items())
+                ],
+            )
+        )
+    if doc["cycles"]:
+        out += ["", "CYCLES"]
+        out.append(
+            _table(
+                ["cycle", "verdict", "probes", "drift", "missing", "s"],
+                [
+                    [
+                        "-" if c.get("cycle") is None else c["cycle"],
+                        c.get("verdict", "-"),
+                        c.get("probes", "-"), c.get("drift", "-"),
+                        c.get("missing", "-"),
+                        "-" if c.get("duration_s") is None
+                        else f"{c['duration_s']:.2f}",
+                    ]
+                    for c in doc["cycles"][-12:]
+                ],
+            )
+        )
+    out.append("")
+    if doc["breaches"]:
+        out.append("GATE: NUMERICS DRIFT")
+        for b in doc["breaches"]:
+            out.append(f"  {b}")
+    else:
+        out.append("GATE: ok (every audited probe matched its golden)")
+    return "\n".join(out)
+
+
+def _main_audit(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report audit",
+        description="Numerics-audit report for one run (audit events + "
+        "manifest roll-up from sbr_tpu.obs.audit canary batteries); exit 1 "
+        "on any drift verdict, 3 when no audit data was recorded",
+    )
+    parser.add_argument("run_dir", help="obs run directory with audit events")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = audit_doc(args.run_dir)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_audit(doc))
+    return code
+
+
+# ---------------------------------------------------------------------------
 # Infomodel report (`infomodel` subcommand — information-model gate)
 # ---------------------------------------------------------------------------
 
@@ -1960,6 +2168,14 @@ def _main_gc(argv) -> int:
         "kept run dirs down to the N most recent per dir; live runs and "
         "the active trace.jsonl are never touched",
     )
+    parser.add_argument(
+        "--audit-keep", type=int, default=None, metavar="N", dest="audit_keep",
+        help="also prune audit battery artifacts (audit/battery_NNNN.json) "
+        "inside kept run dirs down to the N most recent per dir, plus "
+        "archived golden snapshots (goldens_*.NNN.json) in the audit "
+        "registry down to N per key; live runs and the active goldens "
+        "are never touched",
+    )
     args = parser.parse_args(argv)
     import os
 
@@ -1994,6 +2210,14 @@ def _main_gc(argv) -> int:
         pruned = gc_trace_files(root, keep_rotated=args.trace_keep)
         print(f"removed {len(pruned)} rotated trace span file(s) "
               f"(keep {args.trace_keep} per run dir)")
+        for p in pruned:
+            print(f"  {p}")
+    if args.audit_keep is not None:
+        from sbr_tpu.obs.audit import gc_audit_files
+
+        pruned = gc_audit_files(root, keep=args.audit_keep)
+        print(f"removed {len(pruned)} audit artifact file(s) "
+              f"(keep {args.audit_keep} per run dir / golden key)")
         for p in pruned:
             print(f"  {p}")
     return 0
@@ -2506,6 +2730,8 @@ def main(argv=None) -> int:
         return _main_serve(argv[1:])
     if argv and argv[0] == "fleet":
         return _main_fleet(argv[1:])
+    if argv and argv[0] == "audit":
+        return _main_audit(argv[1:])
     if argv and argv[0] == "grad":
         return _main_grad(argv[1:])
     if argv and argv[0] == "infomodel":
@@ -2526,7 +2752,8 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'grad' / 'infomodel' / 'trace' / 'slo' / 'trend' / 'gc' subcommands",
+        "'audit' / 'grad' / 'infomodel' / 'trace' / 'slo' / 'trend' / 'gc' "
+        "subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

@@ -197,6 +197,10 @@ class RunContext:
         # solves, closure comparisons, population queries) plus the
         # nonconverged/breach tallies `report infomodel` gates on.
         self.infomodel: dict = {}
+        # Numerics-audit roll-up (sbr_tpu.obs.audit): per-action counts of
+        # audit events plus the drift/pass probe tallies and last cycle —
+        # what `report audit` gates on.
+        self.audit: dict = {}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -596,6 +600,7 @@ class RunContext:
             "elastic": self._elastic_manifest(),
             "fleet": self.fleet or None,
             "infomodel": self.infomodel or None,
+            "audit": self.audit or None,
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -724,6 +729,27 @@ class RunContext:
                 and err > tol
             ):
                 self.infomodel["breaches"] = self.infomodel.get("breaches", 0) + 1
+
+    def log_audit(self, action: str = "?", **fields) -> None:
+        """Emit one numerics-``audit`` event (`sbr_tpu.obs.audit`: canary
+        probe verdicts, per-cycle roll-ups, scheduler errors) and fold it
+        into the manifest roll-up. Besides the per-action count, the gate
+        tallies accumulate: ``drift`` / ``passed`` (probe events by
+        verdict) and ``last_cycle`` / ``last_verdict`` (cycle events) —
+        what `report audit` exits 1 on."""
+        self.event("audit", action=action, **fields)
+        self.audit[action] = self.audit.get(action, 0) + 1
+        if action == "probe":
+            verdict = fields.get("verdict")
+            if verdict == "drift":
+                self.audit["drift"] = self.audit.get("drift", 0) + 1
+            elif verdict == "pass":
+                self.audit["passed"] = self.audit.get("passed", 0) + 1
+        if action == "cycle":
+            if fields.get("cycle") is not None:
+                self.audit["last_cycle"] = fields["cycle"]
+            if fields.get("verdict") is not None:
+                self.audit["last_verdict"] = fields["verdict"]
 
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
@@ -1002,6 +1028,14 @@ def log_infomodel(action: str = "?", **fields) -> None:
     run = current_run()
     if run is not None and _trace_clean():
         run.log_infomodel(action, **fields)
+
+
+def log_audit(action: str = "?", **fields) -> None:
+    """Numerics-audit event + manifest roll-up (no-op when telemetry is
+    off or while tracing) — the `sbr_tpu.obs.audit` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_audit(action, **fields)
 
 
 def interrupt_all() -> int:

@@ -319,11 +319,13 @@ _ANSWER_FIELDS = ("xi", "tau_bar_in", "aw_max", "status", "flags")
 
 def _run_loadgen_fleet(out: Path, name: str, n_workers: int,
                        kill_after=None, timeout_s: float = 900.0,
-                       extra_env=None, trace_out=None) -> tuple:
+                       extra_env=None, trace_out=None,
+                       extra_argv=None) -> tuple:
     """One `loadgen --fleet` subprocess; returns (rc, summary, answers,
     router_run_dir). ``extra_env`` overlays the scrubbed environment (the
-    churn phase turns tracing on with it); ``trace_out`` forwards
-    ``--trace-out``."""
+    churn phase turns tracing on with it; the audit phases turn canaries
+    on — workers inherit it); ``trace_out`` forwards ``--trace-out``;
+    ``extra_argv`` appends raw loadgen flags (--audit-fault/--audit-wait)."""
     run_dir = out / f"obs_{name}"
     answers_path = out / f"{name}_answers.json"
     argv = [
@@ -342,10 +344,13 @@ def _run_loadgen_fleet(out: Path, name: str, n_workers: int,
         argv += ["--fleet-kill-after", str(kill_after)]
     if trace_out is not None:
         argv += ["--trace-out", str(trace_out)]
+    argv += [str(a) for a in (extra_argv or [])]
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     for k in ("SBR_FAULT_PLAN", "SBR_SERVE_DEADLINE_MS", "SBR_FLEET_DIR",
               "SBR_SERVE_CACHE_DIR", "SBR_TILE_CACHE_DIR",
-              "SBR_TRACE_SAMPLE", "SBR_SERVE_SLO_MS"):
+              "SBR_TRACE_SAMPLE", "SBR_SERVE_SLO_MS",
+              "SBR_AUDIT", "SBR_AUDIT_INTERVAL_S", "SBR_AUDIT_PROBES",
+              "SBR_AUDIT_REGISTRY_DIR"):
         env.pop(k, None)
     env.update(extra_env or {})
     proc = subprocess.run(argv, env=env, timeout=timeout_s,
@@ -467,6 +472,171 @@ def main_fleet(out: Path, as_json: bool) -> int:
     return 0 if ok else 1
 
 
+_AUDIT_ENV = dict(
+    SBR_AUDIT="1",
+    SBR_AUDIT_INTERVAL_S="1.5",
+    # One cheap probe keeps the canary cycle short enough for a smoke
+    # (the full battery is the CLI's job, not this proof's).
+    SBR_AUDIT_PROBES="graphgen.layout",
+)
+
+#: Seeded audit.canary corruption, worker 0 only (via loadgen
+#: --audit-fault): every graphgen.layout canary RESULT is perturbed
+#: pre-comparison — the serving path never sees it, so answers must stay
+#: byte-identical while the audit flags drift.
+_AUDIT_FAULT_PLAN = {
+    "seed": 7,
+    "rules": [
+        {"point": "audit.canary", "kind": "corrupt", "match": "graphgen.layout"},
+    ],
+}
+
+
+def _audit_drift_cycles(worker_run_dir: Path) -> list:
+    """Cycle numbers of drift probe verdicts in a worker's audit events."""
+    cycles = []
+    try:
+        for line in (worker_run_dir / "events.jsonl").read_text().splitlines():
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if (isinstance(ev, dict) and ev.get("kind") == "audit"
+                    and ev.get("action") == "probe"
+                    and ev.get("verdict") == "drift"):
+                cycles.append(int(ev.get("cycle") or 0))
+    except OSError:
+        pass
+    return cycles
+
+
+def main_audit(out: Path, as_json: bool) -> int:
+    """The numerics-audit chaos proof (ISSUE 17): corrupt ONE of three
+    workers' canaries — drift detected within 2 cycles, worker
+    quarantined by the router, zero lost queries, answers byte-identical
+    to the fault-free control; and on a clean audited run, zero drift
+    verdicts (no false positives) with p99 inside the audit-off
+    tolerance (canaries never ride the hot path)."""
+    checks: dict = {}
+
+    def log(msg):
+        if not as_json:
+            print(msg)
+
+    # Goldens are generated under the WORKERS' numerics env (cpu pin, x64
+    # off) — the audit CLI pins x64 on, which would land in a different
+    # content-addressed golden set and every worker canary would read
+    # "no_golden" forever.
+    goldens = out / "audit_goldens"
+    log("phase 0/3: generating canary goldens under the worker env …")
+    gen = subprocess.run(
+        [sys.executable, "-c",
+         "from sbr_tpu.utils.platform import pin_cpu_platform;"
+         "pin_cpu_platform();"
+         "import sys;"
+         "from sbr_tpu.obs.audit import run_battery;"
+         "r = run_battery(update=True);"
+         "sys.exit(0 if r.get('updated') else 1)"],
+        env={**os.environ, **_AUDIT_ENV,
+             "SBR_AUDIT_REGISTRY_DIR": str(goldens)},
+        timeout=600, capture_output=True, text=True,
+    )
+    if gen.stderr and not as_json:
+        sys.stderr.write(gen.stderr)
+    checks["goldens_rc0"] = gen.returncode == 0
+
+    audit_env = {**_AUDIT_ENV, "SBR_AUDIT_REGISTRY_DIR": str(goldens)}
+
+    log("phase 1/3: audit-OFF control (ground-truth answers + p99) …")
+    rc0, sum0, ans0, _run0 = _run_loadgen_fleet(out, "audit_off", 3)
+    checks["control_rc0"] = rc0 == 0
+    checks["control_zero_lost"] = sum0.get("fleet_lost", 1) == 0
+
+    log("phase 2/3: clean 3-worker run, canaries ON (no false positives) …")
+    rc1, sum1, ans1, run1 = _run_loadgen_fleet(
+        out, "audit_clean", 3, extra_env=audit_env,
+        extra_argv=["--audit-wait", "2"],
+    )
+    checks["clean_rc0"] = rc1 == 0
+    checks["clean_zero_lost"] = sum1.get("fleet_lost", 1) == 0
+    blocks1 = (sum1.get("audit") or {}).get("workers") or {}
+    checks["clean_canaries_ran"] = len(blocks1) == 3 and all(
+        (b or {}).get("cycles", 0) >= 2 for b in blocks1.values()
+    )
+    checks["clean_no_drift"] = len(blocks1) == 3 and all(
+        (b or {}).get("status") == "pass" for b in blocks1.values()
+    )
+    checks["clean_none_quarantined"] = not (sum1.get("audit") or {}).get("quarantined")
+    checks["clean_answers_identical"] = _answers_identical(ans0, ans1)
+    # Hot-path isolation: canaries are idle-gated, so the audited fleet's
+    # p99 must sit inside the usual cross-run tolerance of the audit-off
+    # control (generous: subprocess fleets on shared CI boxes are noisy).
+    p99_off, p99_on = sum0.get("fleet_p99_ms"), sum1.get("fleet_p99_ms")
+    checks["clean_p99_within_tolerance"] = (
+        p99_off is not None and p99_on is not None
+        and p99_on <= p99_off * 1.5 + 250.0
+    )
+    w1_dirs = sorted((run1.parent / (run1.name + "_workers")).glob("w*"))
+    audit_rcs1 = [_report("audit", d)[0] for d in w1_dirs]
+    checks["clean_report_audit_rc0"] = len(audit_rcs1) == 3 and all(
+        rc == 0 for rc in audit_rcs1
+    )
+
+    log("phase 3/3: corrupt worker 0's canaries (detect + quarantine) …")
+    rc2, sum2, ans2, run2 = _run_loadgen_fleet(
+        out, "audit_fault", 3, extra_env=audit_env,
+        extra_argv=["--audit-fault", json.dumps(_AUDIT_FAULT_PLAN),
+                    "--audit-wait", "2"],
+    )
+    checks["fault_rc0"] = rc2 == 0
+    checks["fault_zero_lost"] = sum2.get("fleet_lost", 1) == 0
+    blocks2 = (sum2.get("audit") or {}).get("workers") or {}
+    drifted = sorted(h for h, b in blocks2.items()
+                     if (b or {}).get("status") == "drift")
+    checks["fault_one_worker_drifted"] = len(drifted) == 1
+    checks["fault_quarantined"] = bool(
+        drifted and (sum2.get("audit") or {}).get("quarantined") == drifted
+    )
+    # Detection latency: the corrupted worker's own audit events must show
+    # the drift verdict within the first 2 canary cycles.
+    w2_dirs = sorted((run2.parent / (run2.name + "_workers")).glob("w*"))
+    w0_drifts = _audit_drift_cycles(w2_dirs[0]) if w2_dirs else []
+    checks["fault_detected_within_2_cycles"] = bool(w0_drifts) and min(w0_drifts) <= 2
+    # The faulted worker keeps serving CORRECT answers (the fault perturbs
+    # only the canary's copy of the result) — byte-identical to control.
+    checks["fault_answers_identical"] = _answers_identical(ans0, ans2)
+    # The quarantine is visible to the fleet, not just the worker: an
+    # audit_quarantine event in the router's run dir.
+    rc_f, doc_f = _report("fleet", run2)
+    checks["fault_quarantine_event"] = (doc_f.get("events") or {}).get(
+        "audit_quarantine", 0
+    ) >= 1
+    # And to the drift gate: exit 1 on the corrupted worker's run dir,
+    # exit 0 on both peers.
+    audit_rcs2 = [_report("audit", d)[0] for d in w2_dirs]
+    checks["fault_report_audit_w0_rc1"] = bool(audit_rcs2) and audit_rcs2[0] == 1
+    checks["fault_report_audit_peers_rc0"] = len(audit_rcs2) == 3 and all(
+        rc == 0 for rc in audit_rcs2[1:]
+    )
+
+    ok = all(checks.values())
+    if as_json:
+        print(json.dumps({"ok": ok, "checks": checks, "out": str(out)}))
+    else:
+        for name, passed in checks.items():
+            print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+        print(
+            "audit smoke: "
+            + ("OK — corrupted canaries detected within 2 cycles, worker "
+               "quarantined, zero lost, answers byte-identical" if ok
+               else "FAILED")
+            + f" ({out})"
+        )
+        if w2_dirs:
+            print(f"drift story: python -m sbr_tpu.obs.report audit {w2_dirs[0]}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.resilience.chaos",
@@ -488,6 +658,13 @@ def main(argv=None) -> int:
         "byte-identical to a fault-free single-worker run, failover + "
         "breaker events visible via report fleet (ISSUE 11)",
     )
+    parser.add_argument(
+        "--audit", action="store_true",
+        help="run the NUMERICS-AUDIT smoke instead: corrupt one of three "
+        "workers' canary solves (audit.canary fault) — drift detected "
+        "within 2 cycles, worker quarantined by the router, zero lost, "
+        "answers byte-identical to the audit-off control (ISSUE 17)",
+    )
     parser.add_argument("--worker", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
     parser.add_argument("--worker-elastic", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
@@ -506,6 +683,8 @@ def main(argv=None) -> int:
         return main_churn(out, args.json)
     if args.fleet:
         return main_fleet(out, args.json)
+    if args.audit:
+        return main_audit(out, args.json)
 
     checks: dict = {}
 

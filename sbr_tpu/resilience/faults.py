@@ -31,6 +31,12 @@ point                  planted in
 ``fleet.heartbeat``    `serve.fleet.WorkerAnnouncer.beat`, per announcement
                        (a fired transient silences the beat — the worker
                        ages out via the TTL like a silent death)
+``audit.canary``       `obs.audit.run_battery`, per probe execution with
+                       the probe name as target, just before the golden
+                       comparison (``nan``/``corrupt`` perturb the canary
+                       result — the audit MUST flag drift and the router
+                       MUST quarantine the worker; use ``match`` to hit
+                       one probe)
 =====================  ====================================================
 
 Fault kinds:

@@ -23,12 +23,9 @@ import argparse
 import sys
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(prog="python -m sbr_tpu.scenario.parity")
-    parser.add_argument("--n", type=int, default=48, help="grid side (default 48)")
-    parser.add_argument("--banks", type=int, default=3, help="banks in the sanity check")
-    args = parser.parse_args(argv)
-
+def run_checks(n: int = 48, banks: int = 3) -> int:
+    """Run both parity checks; raises AssertionError naming the first
+    divergence, returns 0 on success (the audit legacy-CLI contract)."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -41,8 +38,8 @@ def main(argv=None) -> int:
 
     base = make_model_params()
     config = SolverConfig(n_grid=512, bisect_iters=60, refine_crossings=False)
-    betas = np.linspace(0.25, 3.0, args.n)
-    us = np.linspace(0.01, 0.99, args.n)
+    betas = np.linspace(0.25, 3.0, n)
+    us = np.linspace(0.01, 0.99, n)
 
     spec = scenario.ScenarioSpec()  # baseline-reducible
     composed = scenario.scenario_grid(spec, betas, us, base, config=config)
@@ -64,7 +61,7 @@ def main(argv=None) -> int:
           f"ξ bitwise equal")
 
     # Multi-bank sanity: empty exposure == independent solves, bitwise.
-    n_banks = args.banks
+    n_banks = banks
     plist = [
         make_model_params(beta=1.0 + 0.2 * i, u=0.05 + 0.02 * i)
         for i in range(n_banks)
@@ -93,6 +90,26 @@ def main(argv=None) -> int:
     print(f"multibank sanity ok: {n_banks} banks, empty network bit-identical "
           f"to independent solves")
     return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m sbr_tpu.scenario.parity")
+    parser.add_argument("--n", type=int, default=48, help="grid side (default 48)")
+    parser.add_argument("--banks", type=int, default=3, help="banks in the sanity check")
+    parser.add_argument("--obs-dir", default=None,
+                        help="record the verdict as an audit probe event "
+                        "under this obs run root")
+    args = parser.parse_args(argv)
+
+    # Legacy entrypoint, audit protocol (ISSUE 17): the checks run through
+    # the unified registry runner — an AssertionError becomes a drift
+    # verdict + exit 1, exactly the historical contract.
+    from sbr_tpu.obs import audit
+
+    return audit.run_legacy_cli(
+        "scenario.composed", lambda: run_checks(n=args.n, banks=args.banks),
+        obs_dir=args.obs_dir,
+    )
 
 
 if __name__ == "__main__":

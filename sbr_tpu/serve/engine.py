@@ -432,6 +432,17 @@ class Engine:
         # ticket after the batcher's final drain and strand its waiter.
         self._close_lock = threading.Lock()
 
+        # Numerics-audit canaries (ISSUE 17): scheduled background solves
+        # of tiny golden surfaces, off the hot path. SBR_AUDIT=0 (the
+        # default) must be a STRUCTURAL no-op — the module is not even
+        # imported, so no new code paths, traces, or threads exist (the
+        # prof trace-counter witness in tests/test_audit.py).
+        self.audit = None
+        if os.environ.get("SBR_AUDIT", "").strip() not in ("", "0"):
+            from sbr_tpu.obs import audit as _audit
+
+            self.audit = _audit.AuditScheduler(engine=self)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Engine":
         if self._thread is None:
@@ -439,6 +450,8 @@ class Engine:
                 target=self._loop, name="sbr-serve-batcher", daemon=True
             )
             self._thread.start()
+        if self.audit is not None:
+            self.audit.start()
         return self
 
     def close(self) -> None:
@@ -463,6 +476,8 @@ class Engine:
                 if t is not _SHUTDOWN:
                     t.error = RuntimeError("engine closed before the query was served")
                     t.event.set()
+        if self.audit is not None:
+            self.audit.close()
         w = self.live.window()
         self.live.maybe_write(self._run, self._live_extra(window=w), window=w, force=True)
         if self._run is not None:
@@ -693,6 +708,11 @@ class Engine:
             if slo is not None and p99 is not None and p99 > slo:
                 status = "degraded"
                 reasons.append(f"window p99 {p99:.3f} ms over SLO {slo:g} ms")
+            if self.audit is not None and self.audit.status == "drift":
+                status = "degraded"
+                reasons.append(
+                    "audit_drift: " + (",".join(self.audit.drift_probes) or "?")
+                )
         return {"status": status, "reasons": reasons}
 
     def _maybe_refill_budget(self) -> None:
@@ -728,6 +748,11 @@ class Engine:
         # Per-layer span-duration histograms (committed trace spans only;
         # empty exposition while tracing is off).
         hist_lines = qtrace.layer_prometheus()
+        # Audit canary status + per-probe duration histograms. SBR_AUDIT=0
+        # contributes NOTHING here, not even a zero gauge — tests assert
+        # the exposition is byte-free of sbr_audit when the audit is off.
+        if self.audit is not None:
+            hist_lines = list(hist_lines or []) + self.audit.prometheus_lines()
         if hist_lines:
             text = text.rstrip("\n") + "\n" + "\n".join(hist_lines) + "\n"
         return text
@@ -769,6 +794,7 @@ class Engine:
                 "cache_dir": self.serve.cache_dir,
                 **self._exec_meta,
             },
+            **({"audit": self.audit.snapshot()} if self.audit is not None else {}),
         }
 
     # -- batcher loop --------------------------------------------------------

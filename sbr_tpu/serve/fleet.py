@@ -437,6 +437,10 @@ def _worker_stats(engine) -> dict:
         # judge each run dir against the SLO its owner actually served
         # under (ISSUE 16 satellite).
         "slo_ms": qtrace.slo_ms(),
+        # Numerics-audit canary status (ISSUE 17): absent entirely when
+        # SBR_AUDIT is off; the router quarantines on status "drift".
+        **({"audit": engine.audit.heartbeat_block()}
+           if getattr(engine, "audit", None) is not None else {}),
     }
 
 

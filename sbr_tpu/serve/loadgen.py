@@ -115,10 +115,13 @@ def params_doc(p: ModelParams) -> dict:
 def spawn_worker(fleet_dir: str, n_grid: int, bisect_iters: int, buckets: str,
                  run_dir: Optional[str] = None, cache_dir: Optional[str] = None,
                  platform: Optional[str] = "cpu", heartbeat_ttl: float = 30.0,
-                 timeout_s: float = 180.0) -> dict:
+                 timeout_s: float = 180.0,
+                 extra_env: Optional[dict] = None) -> dict:
     """Spawn one fleet worker subprocess and wait for its readiness line.
     Returns ``{"proc", "url", "host", "pid"}``; raises on startup timeout
-    (the worker is killed first)."""
+    (the worker is killed first). ``extra_env`` overlays the inherited
+    environment for THIS worker only (the chaos audit proof plants its
+    per-worker ``audit.canary`` fault plan through it)."""
     argv = [
         sys.executable, "-m", "sbr_tpu.serve.fleet",
         "--fleet-dir", str(fleet_dir),
@@ -133,8 +136,10 @@ def spawn_worker(fleet_dir: str, n_grid: int, bisect_iters: int, buckets: str,
         argv += ["--run-dir", str(run_dir)]
     if cache_dir:
         argv += ["--cache-dir", str(cache_dir)]
+    env = {**os.environ, **{k: str(v) for k, v in extra_env.items()}} if extra_env else None
     proc = subprocess.Popen(
-        argv, stdout=subprocess.PIPE, stderr=sys.stderr.fileno(), text=True
+        argv, stdout=subprocess.PIPE, stderr=sys.stderr.fileno(), text=True,
+        env=env,
     )
     line: dict = {}
     err: Optional[str] = None
@@ -186,16 +191,22 @@ def run_fleet(args) -> dict:
     hist = LogHistogram(DEFAULT_LATENCY_BOUNDS_MS)
     killed: dict = {}
     try:
+        audit_fault = getattr(args, "audit_fault", None)
         for i in range(args.fleet):
             wrun = (
                 os.path.join(args.run_dir + "_workers", f"w{i}")
                 if args.run_dir else None
             )
+            # --audit-fault plants the fault plan in worker 0 ONLY: the
+            # chaos audit proof corrupts one worker's canaries and expects
+            # the peers to stay clean (no false positives).
+            wenv = {"SBR_FAULT_PLAN": audit_fault} if (audit_fault and i == 0) else None
             workers.append(spawn_worker(
                 fleet_dir, args.n_grid, args.bisect_iters,
                 args.buckets or "1,8,64", run_dir=wrun,
                 cache_dir=args.cache_dir,
                 platform=args.platform or "cpu",
+                extra_env=wenv,
             ))
         router = Router(fleet_dir, run_dir=args.run_dir, poll_s=0.2).start()
         base = f"http://127.0.0.1:{router.port}/query"
@@ -309,6 +320,33 @@ def run_fleet(args) -> dict:
             )
         measured_s = time.monotonic() - t0
 
+        # --audit-wait N: hold the fleet up (idle — the measured phase is
+        # over, so canaries are free to run) until every worker's heartbeat
+        # shows either >= N completed audit cycles or a drift verdict, then
+        # let the router's next refresh apply the quarantine. The canary
+        # cadence comes from SBR_AUDIT_INTERVAL_S in the workers' env.
+        audit_wait = int(getattr(args, "audit_wait", 0) or 0)
+        if audit_wait > 0:
+            deadline = time.monotonic() + float(
+                getattr(args, "audit_wait_s", None) or 120.0
+            )
+            while time.monotonic() < deadline:
+                blocks = {
+                    h: (w.get("audit") or {})
+                    for h, w in router.statz()["workers"].items()
+                }
+                if blocks and all(
+                    b.get("status") == "drift" or int(b.get("cycles") or 0) >= audit_wait
+                    for b in blocks.values()
+                ):
+                    break
+                time.sleep(0.25)
+            else:
+                failures.append(
+                    f"audit-wait: not every worker reached {audit_wait} "
+                    f"canary cycle(s) in time"
+                )
+
         router_stats = router.statz()
     finally:
         if router is not None:
@@ -351,6 +389,22 @@ def run_fleet(args) -> dict:
         "router_counters": counters,
         "run_dir": args.run_dir,
     }
+    # Audit canary census (ISSUE 17): per-worker status from the final
+    # heartbeats plus the router's quarantine view — absent entirely when
+    # no worker reported an audit block (SBR_AUDIT off).
+    audit_blocks = {
+        h: w.get("audit")
+        for h, w in (router_stats.get("workers") or {}).items()
+        if w.get("audit")
+    }
+    if audit_blocks:
+        summary["audit"] = {
+            "workers": audit_blocks,
+            "quarantined": sorted(
+                h for h, w in router_stats["workers"].items()
+                if w.get("quarantined")
+            ),
+        }
     # Fleet mode ALWAYS asserts zero lost queries: with a live peer, every
     # failure mode in scope (worker death, breaker, straggler) must be
     # absorbed by failover, not surfaced to the client.
@@ -431,6 +485,19 @@ def main(argv=None) -> int:
                         help="write per-measured-query JSONL rows (trace id, "
                         "latency, source, degraded) here; trace ids are null "
                         "unless SBR_TRACE_SAMPLE > 0")
+    parser.add_argument("--audit-fault", default=None, dest="audit_fault",
+                        help="fault plan (inline JSON or path) planted as "
+                        "SBR_FAULT_PLAN in worker 0 ONLY (fleet mode; the "
+                        "chaos audit.canary corruption proof)")
+    parser.add_argument("--audit-wait", type=int, default=0, dest="audit_wait",
+                        metavar="N",
+                        help="after the measured phase, wait until every "
+                        "worker heartbeat shows >= N audit canary cycles or "
+                        "a drift verdict (fleet mode; needs SBR_AUDIT=1 in "
+                        "the workers' env)")
+    parser.add_argument("--audit-wait-s", type=float, default=120.0,
+                        dest="audit_wait_s",
+                        help="timeout for --audit-wait (default 120 s)")
     args = parser.parse_args(argv)
 
     if args.fleet:

@@ -75,7 +75,7 @@ class _Worker:
     """Router-side view of one fleet worker."""
 
     __slots__ = ("host", "url", "ewma_ms", "inflight", "breaker", "forwards",
-                 "failures", "last_hb")
+                 "failures", "last_hb", "quarantined")
 
     def __init__(self, host: str, url: str, ewma_ms: float, breaker: CircuitBreaker):
         self.host = host
@@ -86,6 +86,12 @@ class _Worker:
         self.forwards = 0
         self.failures = 0
         self.last_hb: dict = {}
+        # Numerics-audit quarantine (ISSUE 17): set while the worker's
+        # heartbeat reports audit status "drift" — treated exactly like an
+        # open breaker by _candidates (routed around, capacity not
+        # correctness), cleared only when a heartbeat reads clean again
+        # (drift latches worker-side, so in practice: a worker restart).
+        self.quarantined = False
 
     def score(self) -> float:
         return self.ewma_ms * (1.0 + self.inflight)
@@ -294,6 +300,18 @@ class Router:
                     self._log_fleet("worker_join", worker=host, url=w.url)
                 w.url = str(rec["url"])
                 w.last_hb = rec
+                drifted = (rec.get("audit") or {}).get("status") == "drift"
+                if drifted and not w.quarantined:
+                    w.quarantined = True
+                    self._log_fleet(
+                        "audit_quarantine", worker=host,
+                        probes=",".join(
+                            (rec.get("audit") or {}).get("drift_probes") or []
+                        ),
+                    )
+                elif w.quarantined and not drifted:
+                    w.quarantined = False
+                    self._log_fleet("audit_unquarantine", worker=host)
             for host in list(self._workers):
                 if host not in live:
                     self._log_fleet("worker_lost", worker=host)
@@ -316,7 +334,12 @@ class Router:
             workers = [
                 w for h, w in self._workers.items() if h not in exclude
             ]
-        admissible = [w for w in workers if w.breaker.admissible()]
+        # An audit-quarantined worker is handled like an open breaker:
+        # numerically drifted answers must not reach clients, so the
+        # worker keeps serving canaries but receives no queries.
+        admissible = [
+            w for w in workers if w.breaker.admissible() and not w.quarantined
+        ]
         return sorted(admissible, key=lambda w: (w.score(), w.host))
 
     # -- the query path ------------------------------------------------------
@@ -642,8 +665,10 @@ class Router:
         with self._workers_lock:
             workers = dict(self._workers)
         open_breakers = [h for h, w in workers.items() if w.breaker.state == "open"]
+        quarantined = [h for h, w in workers.items() if w.quarantined]
         routable = [
-            h for h, w in workers.items() if w.breaker.state != "open"
+            h for h, w in workers.items()
+            if w.breaker.state != "open" and not w.quarantined
         ]
         reasons = []
         status = "ready"
@@ -653,11 +678,17 @@ class Router:
         elif open_breakers:
             status = "degraded"
             reasons.append(f"breaker open for: {', '.join(sorted(open_breakers))}")
+        if quarantined and status != "unhealthy":
+            status = "degraded"
+            reasons.append(
+                f"audit quarantine for: {', '.join(sorted(quarantined))}"
+            )
         if self.counters["failed"]:
             status = "unhealthy" if status == "unhealthy" else "degraded"
             reasons.append(f"{self.counters['failed']} lost quer(ies)")
         return {"status": status, "reasons": reasons,
-                "workers": len(workers), "routable": len(routable)}
+                "workers": len(workers), "routable": len(routable),
+                "quarantined": len(quarantined)}
 
     def statz(self) -> dict:
         self.refresh_workers()
@@ -676,6 +707,8 @@ class Router:
                     ),
                     "healthz": (w.last_hb or {}).get("healthz"),
                     "qps": (w.last_hb or {}).get("qps"),
+                    "quarantined": w.quarantined,
+                    "audit": (w.last_hb or {}).get("audit"),
                 }
                 for h, w in self._workers.items()
             }

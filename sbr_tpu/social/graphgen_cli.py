@@ -24,13 +24,23 @@ def main(argv=None) -> int:
     parser.add_argument("--n", type=int, default=600)
     parser.add_argument("--deg", type=float, default=6.0)
     parser.add_argument("--seed", type=int, default=3)
+    parser.add_argument("--obs-dir", default=None,
+                        help="record the verdict as an audit probe event "
+                        "under this obs run root")
     args = parser.parse_args(argv)
     if not args.selfcheck:
         parser.print_help()
         return 2
+    from sbr_tpu.obs import audit
     from sbr_tpu.social.graphgen import _selfcheck
 
-    return _selfcheck(args.n, args.deg, args.seed)
+    # Legacy entrypoint, audit protocol (ISSUE 17): the selfcheck's nonzero
+    # return becomes a drift verdict + exit 1; output is unchanged.
+    return audit.run_legacy_cli(
+        "graphgen.layout",
+        lambda: _selfcheck(args.n, args.deg, args.seed),
+        obs_dir=args.obs_dir,
+    )
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -1,0 +1,577 @@
+"""Numerics audit observatory tests (ISSUE 17): classification tiers,
+golden-registry round-trips + LOUD version refusal, canary fault
+injection, scheduler drift latching, torn audit event lines, `report
+audit` exit codes, artifact GC retention, and the SBR_AUDIT=0
+structural-no-op witnesses (no scheduler, no module import, no
+`sbr_audit` metric lines, zero new XLA traces).
+
+Synthetic probes return their fingerprint/values dicts directly and the
+battery runs with an explicit environment key, so the registry tests
+never touch jax; only the engine/scheduler witnesses solve anything."""
+
+import json
+import math
+import os
+from pathlib import Path
+
+import pytest
+
+from sbr_tpu.obs import audit
+from sbr_tpu.obs.report import audit_doc
+from sbr_tpu.resilience import faults
+
+# Explicit environment key: registry tests stay jax-free.
+KEY = {"platform": "test", "x64": False, "jax": "0.0",
+       "grid_program": 0, "scenario_program": 0}
+
+
+def const_probe(name="synth.const", tier="bitwise", fingerprint="f" * 64,
+                values=None, ok=None, **kw):
+    """A synthetic probe returning a fixed result (no solve, no jax)."""
+    def fn():
+        out = {"fingerprint": fingerprint,
+               "values": dict(values or {"v": 1.5}), "meta": {}}
+        if ok is not None:
+            out["ok"] = ok
+        return out
+    return audit.Probe(name=name, tier=tier, fn=fn, **kw)
+
+
+def run(probe, reg_dir, update=False, **kw):
+    return audit.run_battery(probe_names=[probe], reg_dir=reg_dir,
+                             update=update, key=KEY, emit=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Classification tiers
+# ---------------------------------------------------------------------------
+
+
+class TestClassify:
+    def test_no_golden(self):
+        p = const_probe()
+        verdict, _ = audit.classify(p, {"fingerprint": "a", "values": {}}, None)
+        assert verdict == "no_golden"
+
+    def test_bitwise_pass_and_drift(self):
+        p = const_probe(tier="bitwise")
+        g = {"fingerprint": "abc", "values": {"v": 1.0}}
+        assert audit.classify(p, {"fingerprint": "abc", "values": {}}, g)[0] == "pass"
+        verdict, detail = audit.classify(p, {"fingerprint": "xyz", "values": {}}, g)
+        assert verdict == "drift" and "fingerprint" in detail
+
+    def test_ulp_tolerates_last_ulp(self):
+        import numpy as np
+
+        v = 0.37
+        bumped = float(np.nextafter(np.float64(v), np.float64(1.0)))
+        p = const_probe(tier="ulp", max_ulps=2)
+        g = {"fingerprint": "g", "values": {"xi": v}}
+        r = {"fingerprint": "other", "values": {"xi": bumped}}
+        assert audit.classify(p, r, g)[0] == "pass"
+
+    def test_ulp_drift_beyond_budget(self):
+        p = const_probe(tier="ulp", max_ulps=2)
+        g = {"fingerprint": "g", "values": {"xi": 0.37}}
+        r = {"fingerprint": "x", "values": {"xi": 0.37 + 1e-6}}
+        assert audit.classify(p, r, g)[0] == "drift"
+
+    def test_ulp_key_set_change_is_drift(self):
+        p = const_probe(tier="ulp")
+        g = {"fingerprint": "g", "values": {"xi": 0.37}}
+        r = {"fingerprint": "g", "values": {"xi": 0.37, "extra": 1.0}}
+        assert audit.classify(p, r, g)[0] == "drift"
+
+    def test_tolerance_pass_drift_and_selfcheck(self):
+        p = const_probe(tier="tolerance", tol=1e-5)
+        g = {"fingerprint": "g", "values": {"rel": 1.0}}
+        ok = {"fingerprint": "x", "values": {"rel": 1.0 + 1e-7}}
+        bad = {"fingerprint": "x", "values": {"rel": 1.1}}
+        assert audit.classify(p, ok, g)[0] == "pass"
+        assert audit.classify(p, bad, g)[0] == "drift"
+        failed = {"fingerprint": "x", "values": {"rel": 1.0}, "ok": False}
+        verdict, detail = audit.classify(p, failed, g)
+        assert verdict == "drift" and "self-check" in detail
+
+    def test_tolerance_nan_is_drift(self):
+        p = const_probe(tier="tolerance")
+        g = {"fingerprint": "g", "values": {"rel": 1.0}}
+        r = {"fingerprint": "x", "values": {"rel": float("nan")}}
+        assert audit.classify(p, r, g)[0] == "drift"
+
+    def test_bad_tier_rejected(self):
+        with pytest.raises(ValueError):
+            const_probe(tier="vibes")
+
+
+class TestUlpDiff:
+    def test_identical_and_adjacent(self):
+        import numpy as np
+
+        assert audit.ulp_diff(0.5, 0.5) == 0.0
+        nxt = float(np.nextafter(np.float64(0.5), np.float64(1.0)))
+        assert audit.ulp_diff(0.5, nxt) == 1.0
+
+    def test_nan_semantics(self):
+        # Both NaN: a legitimately-NaN ξ must equal its golden.
+        assert audit.ulp_diff(float("nan"), float("nan")) == 0.0
+        assert math.isinf(audit.ulp_diff(float("nan"), 0.5))
+
+    def test_sign_straddle_is_finite(self):
+        assert audit.ulp_diff(-1e-300, 1e-300) > 0
+
+
+# ---------------------------------------------------------------------------
+# Golden registry: round-trip, archiving, LOUD version refusal
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_update_then_pass(self, tmp_path):
+        p = const_probe()
+        rep = run(p, tmp_path, update=True)
+        assert rep["updated"] and Path(rep["golden_path"]).is_file()
+        rep2 = run(p, tmp_path)
+        assert rep2["ok"] and rep2["probes"][p.name]["verdict"] == "pass"
+
+    def test_no_goldens_reports_missing(self, tmp_path):
+        rep = run(const_probe(), tmp_path)
+        assert not rep["ok"] and rep["missing"] == ["synth.const"]
+
+    def test_changed_fingerprint_is_drift(self, tmp_path):
+        run(const_probe(fingerprint="a" * 64), tmp_path, update=True)
+        rep = run(const_probe(fingerprint="b" * 64), tmp_path)
+        assert rep["drift"] == ["synth.const"]
+
+    def test_rewrite_archives_previous_golden(self, tmp_path):
+        p = const_probe()
+        run(p, tmp_path, update=True)
+        run(p, tmp_path, update=True)
+        archives = list(tmp_path.glob("goldens_*.0*.json"))
+        assert len(archives) == 1
+        # The archive glob can never match an active golden (two dots).
+        active = audit.golden_path(tmp_path, KEY)
+        assert active.is_file() and active not in archives
+
+    def test_version_mismatch_refused_loudly(self, tmp_path):
+        p = const_probe()
+        run(p, tmp_path, update=True)
+        path = audit.golden_path(tmp_path, KEY)
+        doc = json.loads(path.read_text())
+        doc["registry_version"] = audit.AUDIT_REGISTRY_VERSION + 1
+        path.write_text(json.dumps(doc))
+        with pytest.raises(audit.AuditRegistryVersionError) as err:
+            run(p, tmp_path)
+        # The refusal must carry the regeneration hint, not just fail.
+        assert "--update-goldens" in str(err.value)
+
+    def test_skipped_probe_never_becomes_golden(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(audit, "_x64_enabled", lambda: False)
+        skip = const_probe(name="synth.x64only", requires_x64=True)
+        keep = const_probe(name="synth.keep")
+        audit.run_battery(probe_names=[skip, keep], reg_dir=tmp_path,
+                          update=True, key=KEY, emit=False)
+        doc = json.loads(audit.golden_path(tmp_path, KEY).read_text())
+        assert "synth.keep" in doc["probes"]
+        assert "synth.x64only" not in doc["probes"]
+
+    def test_probe_exception_is_error_verdict(self, tmp_path):
+        def boom():
+            raise RuntimeError("solver exploded")
+        p = audit.Probe(name="synth.boom", tier="bitwise", fn=boom)
+        rep = run(p, tmp_path)
+        entry = rep["probes"]["synth.boom"]
+        assert entry["verdict"] == "error" and "exploded" in entry["detail"]
+        assert rep["drift"] == ["synth.boom"]
+
+    def test_unknown_probe_name_raises(self, tmp_path):
+        with pytest.raises(KeyError):
+            audit.run_battery(probe_names=["no.such.probe"], reg_dir=tmp_path,
+                              key=KEY, emit=False)
+
+
+# ---------------------------------------------------------------------------
+# Canary fault injection (the chaos-testable detection path)
+# ---------------------------------------------------------------------------
+
+
+class TestCanaryFaults:
+    def teardown_method(self):
+        faults.reset()
+
+    def test_corrupt_rule_flags_drift(self, tmp_path):
+        p = const_probe()
+        run(p, tmp_path, update=True)
+        faults.install(faults.FaultPlan({
+            "seed": 7,
+            "rules": [{"point": "audit.canary", "kind": "corrupt",
+                       "match": "synth.const"}],
+        }))
+        rep = run(p, tmp_path)
+        assert rep["drift"] == ["synth.const"]
+        assert rep["probes"][p.name]["meta"]["injected_fault"] == "corrupt"
+
+    def test_nan_rule_flags_drift(self, tmp_path):
+        p = const_probe()
+        run(p, tmp_path, update=True)
+        faults.install(faults.FaultPlan({
+            "seed": 7,
+            "rules": [{"point": "audit.canary", "kind": "nan"}],
+        }))
+        rep = run(p, tmp_path)
+        assert rep["drift"] == ["synth.const"]
+
+    def test_match_restricts_to_one_probe(self, tmp_path):
+        a = const_probe(name="synth.a", fingerprint="a" * 64)
+        b = const_probe(name="synth.b", fingerprint="b" * 64)
+        audit.run_battery(probe_names=[a, b], reg_dir=tmp_path, update=True,
+                          key=KEY, emit=False)
+        faults.install(faults.FaultPlan({
+            "seed": 7,
+            "rules": [{"point": "audit.canary", "kind": "corrupt",
+                       "match": "synth.b"}],
+        }))
+        rep = audit.run_battery(probe_names=[a, b], reg_dir=tmp_path,
+                                key=KEY, emit=False)
+        assert rep["drift"] == ["synth.b"]
+        assert rep["probes"]["synth.a"]["verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# Audit events, torn lines, `report audit` gating
+# ---------------------------------------------------------------------------
+
+
+class TestReportAudit:
+    def _audited_run(self, tmp_path, probes_and_kwargs):
+        from sbr_tpu import obs
+
+        run_dir = tmp_path / "run"
+        r = obs.start_run(label="audit_test", run_dir=str(run_dir))
+        try:
+            for probe, kw in probes_and_kwargs:
+                audit.run_battery(probe_names=[probe], reg_dir=tmp_path / "reg",
+                                  key=KEY, **kw)
+        finally:
+            obs.end_run()
+        return r.run_dir
+
+    def test_clean_run_exit0(self, tmp_path):
+        p = const_probe()
+        audit.run_battery(probe_names=[p], reg_dir=tmp_path / "reg",
+                          update=True, key=KEY, emit=False)
+        run_dir = self._audited_run(tmp_path, [(p, {"cycle": 1})])
+        doc, code = audit_doc(run_dir)
+        assert code == 0 and not doc["breaches"]
+        assert doc["probes"]["synth.const"]["verdict"] == "pass"
+        assert doc["last_verdict"] == "pass"
+
+    def test_drifted_run_exit1(self, tmp_path):
+        audit.run_battery(probe_names=[const_probe(fingerprint="a" * 64)],
+                          reg_dir=tmp_path / "reg", update=True, key=KEY,
+                          emit=False)
+        run_dir = self._audited_run(
+            tmp_path, [(const_probe(fingerprint="b" * 64), {"cycle": 1})])
+        doc, code = audit_doc(run_dir)
+        assert code == 1
+        assert "synth.const" in doc["drifted_probes"]
+
+    def test_battery_artifact_written(self, tmp_path):
+        p = const_probe()
+        audit.run_battery(probe_names=[p], reg_dir=tmp_path / "reg",
+                          update=True, key=KEY, emit=False)
+        run_dir = self._audited_run(tmp_path, [(p, {"cycle": 1})])
+        arts = list((Path(run_dir) / "audit").glob("battery_*.json"))
+        assert len(arts) == 1
+        assert json.loads(arts[0].read_text())["probes"]["synth.const"]
+
+    def test_torn_audit_lines_tolerated(self, tmp_path):
+        p = const_probe()
+        audit.run_battery(probe_names=[p], reg_dir=tmp_path / "reg",
+                          update=True, key=KEY, emit=False)
+        run_dir = Path(self._audited_run(tmp_path, [(p, {"cycle": 1})]))
+        # A torn (truncated mid-write) trailing line must not take down
+        # the fold — counters still reflect every intact line.
+        with open(run_dir / "events.jsonl", "a") as fh:
+            fh.write('{"kind": "audit", "action": "probe", "pro')
+        doc, code = audit_doc(run_dir)
+        assert code == 0
+        assert doc["probes"]["synth.const"]["events"] == 1
+
+    def test_not_a_dir_exit2(self, tmp_path):
+        doc, code = audit_doc(tmp_path / "nope")
+        assert code == 2 and doc["error"] == "not a directory"
+
+    def test_unaudited_run_exit3(self, tmp_path):
+        from sbr_tpu import obs
+
+        run_dir = tmp_path / "run"
+        obs.start_run(label="plain", run_dir=str(run_dir))
+        obs.end_run()
+        doc, code = audit_doc(run_dir)
+        assert code == 3
+
+    def test_manifest_rollup_lands(self, tmp_path):
+        p = const_probe()
+        audit.run_battery(probe_names=[p], reg_dir=tmp_path / "reg",
+                          update=True, key=KEY, emit=False)
+        run_dir = Path(self._audited_run(tmp_path, [(p, {"cycle": 1})]))
+        manifest = json.loads((run_dir / "manifest.json").read_text())
+        blk = manifest["audit"]
+        assert blk["passed"] >= 1 and blk["last_verdict"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# Artifact GC (`report gc --audit-keep`)
+# ---------------------------------------------------------------------------
+
+
+class TestGcAuditFiles:
+    def test_battery_artifact_retention(self, tmp_path):
+        adir = tmp_path / "runs" / "run_a" / "audit"
+        adir.mkdir(parents=True)
+        for i in range(6):
+            (adir / f"battery_{i:04d}.json").write_text("{}")
+        removed = audit.gc_audit_files(tmp_path / "runs", keep=2,
+                                       reg_dir=tmp_path / "noreg")
+        assert len(removed) == 4
+        left = sorted(p.name for p in adir.glob("battery_*.json"))
+        assert left == ["battery_0004.json", "battery_0005.json"]
+
+    def test_live_run_untouched(self, tmp_path):
+        d = tmp_path / "runs" / "run_live"
+        (d / "audit").mkdir(parents=True)
+        for i in range(6):
+            (d / "audit" / f"battery_{i:04d}.json").write_text("{}")
+        (d / "manifest.json").write_text(json.dumps({"status": "running"}))
+        removed = audit.gc_audit_files(tmp_path / "runs", keep=2,
+                                       reg_dir=tmp_path / "noreg")
+        assert removed == []
+
+    def test_archived_goldens_pruned_active_kept(self, tmp_path):
+        reg = tmp_path / "reg"
+        reg.mkdir()
+        (reg / "goldens_abc.json").write_text("{}")
+        for i in range(5):
+            (reg / f"goldens_abc.{i:03d}.json").write_text("{}")
+        removed = audit.gc_audit_files(tmp_path / "noruns", keep=2, reg_dir=reg)
+        assert len(removed) == 3
+        assert (reg / "goldens_abc.json").is_file()
+        assert sorted(p.name for p in reg.glob("goldens_abc.*.json")) == [
+            "goldens_abc.003.json", "goldens_abc.004.json"]
+
+
+# ---------------------------------------------------------------------------
+# Env semantics + scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestEnvKnobs:
+    def test_enabled_default_off(self, monkeypatch):
+        monkeypatch.delenv("SBR_AUDIT", raising=False)
+        assert audit.enabled() is False
+        monkeypatch.setenv("SBR_AUDIT", "0")
+        assert audit.enabled() is False
+        monkeypatch.setenv("SBR_AUDIT", "1")
+        assert audit.enabled() is True
+
+    def test_interval_and_probe_filter(self, monkeypatch):
+        monkeypatch.setenv("SBR_AUDIT_INTERVAL_S", "2.5")
+        assert audit.interval_s() == 2.5
+        monkeypatch.setenv("SBR_AUDIT_INTERVAL_S", "garbage")
+        assert audit.interval_s() == audit.DEFAULT_INTERVAL_S
+        monkeypatch.setenv("SBR_AUDIT_PROBES", "a, b,")
+        assert audit.probe_filter() == ("a", "b")
+        monkeypatch.setenv("SBR_AUDIT_PROBES", "")
+        assert audit.probe_filter() is None
+
+
+class TestScheduler:
+    def _goldens(self, reg, probe):
+        audit.run_battery(probe_names=[probe], reg_dir=reg, update=True,
+                          emit=False)
+
+    def test_cycle_pass_then_drift_latches(self, tmp_path):
+        p = const_probe()
+        self._goldens(tmp_path, p)
+        s = audit.AuditScheduler(engine=None, reg_dir=tmp_path,
+                                 interval=3600.0, probe_names=[p])
+        s.run_cycle()
+        assert s.status == "pass" and s.status_gauge() == 1
+        assert s.heartbeat_block()["cycles"] == 1
+        faults.install(faults.FaultPlan({
+            "seed": 1,
+            "rules": [{"point": "audit.canary", "kind": "corrupt"}],
+        }))
+        try:
+            s.run_cycle()
+        finally:
+            faults.reset()
+        assert s.status == "drift" and s.drift_probes == [p.name]
+        # Drift LATCHES: a clean cycle after the corruption does not
+        # un-flag the worker — restart is the only way back.
+        s.run_cycle()
+        assert s.status == "drift" and s.status_gauge() == -1
+
+    def test_prometheus_lines(self, tmp_path):
+        p = const_probe()
+        self._goldens(tmp_path, p)
+        s = audit.AuditScheduler(engine=None, reg_dir=tmp_path,
+                                 interval=3600.0, probe_names=[p])
+        s.run_cycle()
+        text = "\n".join(s.prometheus_lines())
+        assert "sbr_audit_status 1" in text
+        assert "sbr_audit_probe_ms" in text
+
+    def test_cycle_error_recorded_not_raised(self, tmp_path):
+        def boom():
+            raise RuntimeError("registry on fire")
+        # A version-mismatched golden file makes run_battery RAISE (not
+        # classify) — the scheduler must swallow it into last_error.
+        p = const_probe()
+        self._goldens(tmp_path, p)
+        path = next(tmp_path.glob("goldens_*.json"))
+        doc = json.loads(path.read_text())
+        doc["registry_version"] = audit.AUDIT_REGISTRY_VERSION + 1
+        path.write_text(json.dumps(doc))
+        s = audit.AuditScheduler(engine=None, reg_dir=tmp_path,
+                                 interval=3600.0, probe_names=[p])
+        assert s.run_cycle() is None
+        assert s.status == "pending"
+        assert "AuditRegistryVersionError" in (s.snapshot()["last_error"] or "")
+
+
+# ---------------------------------------------------------------------------
+# SBR_AUDIT=0 structural no-op + engine wiring witnesses
+# ---------------------------------------------------------------------------
+
+
+class TestEngineWiring:
+    def _engine(self):
+        from sbr_tpu.models.params import SolverConfig
+        from sbr_tpu.serve.engine import Engine
+
+        return Engine(config=SolverConfig(n_grid=64, bisect_iters=20,
+                                          refine_crossings=False))
+
+    def test_off_is_structural_noop(self, monkeypatch):
+        import sys
+
+        from sbr_tpu.obs import prof
+
+        monkeypatch.delenv("SBR_AUDIT", raising=False)
+        sys.modules.pop("sbr_tpu.obs.audit", None)
+        traces_before = sum(prof.trace_counts().values())
+        eng = self._engine()
+        try:
+            eng.start()
+            assert eng.audit is None
+            # The audit module must not even be imported...
+            assert "sbr_tpu.obs.audit" not in sys.modules
+            # ...the exposition must be byte-free of audit metrics...
+            assert "sbr_audit" not in eng.prometheus()
+        finally:
+            eng.close()
+        # ...and zero new XLA programs traced by constructing the engine.
+        assert sum(prof.trace_counts().values()) == traces_before
+
+    def test_on_attaches_scheduler(self, tmp_path, monkeypatch):
+        p = const_probe()
+        audit.run_battery(probe_names=[p], reg_dir=tmp_path, update=True,
+                          emit=False)
+        monkeypatch.setenv("SBR_AUDIT", "1")
+        monkeypatch.setenv("SBR_AUDIT_REGISTRY_DIR", str(tmp_path))
+        monkeypatch.setenv("SBR_AUDIT_INTERVAL_S", "3600")
+        monkeypatch.setenv("SBR_AUDIT_PROBES", "graphgen.layout")
+        eng = self._engine()
+        try:
+            eng.start()
+            assert eng.audit is not None
+            assert "sbr_audit_status" in eng.prometheus()
+            # Drift flips /healthz degraded with the audit_drift reason.
+            eng.audit.status = "drift"
+            eng.audit.drift_probes = ["graphgen.layout"]
+            hz = eng.healthz()
+            assert hz["status"] == "degraded"
+            assert any("audit_drift" in r for r in hz["reasons"])
+        finally:
+            eng.close()
+
+
+class TestRouterQuarantine:
+    def _beat(self, ann, status):
+        ann.beat(audit={
+            "status": status, "cycles": 3,
+            "drift_probes": ["graphgen.layout"] if status == "drift" else [],
+        })
+
+    def test_drifted_heartbeat_quarantines_and_clears(self, tmp_path):
+        from sbr_tpu.serve.fleet import WorkerAnnouncer
+        from sbr_tpu.serve.router import Router
+
+        ann = WorkerAnnouncer(tmp_path, "http://127.0.0.1:1", host="w0")
+        self._beat(ann, "drift")
+        router = Router(tmp_path, poll_s=0.01)
+        router.refresh_workers(force=True)
+        w = router._workers["w0"]
+        assert w.quarantined
+        assert router._candidates() == []
+        # A clean heartbeat (worker restarted) re-admits it.
+        self._beat(ann, "pass")
+        router.refresh_workers(force=True)
+        assert not w.quarantined
+        assert len(router._candidates()) == 1
+
+    def test_healthz_reports_quarantine(self, tmp_path):
+        from sbr_tpu.serve.fleet import WorkerAnnouncer
+        from sbr_tpu.serve.router import Router
+
+        bad = WorkerAnnouncer(tmp_path, "http://127.0.0.1:1", host="w0")
+        good = WorkerAnnouncer(tmp_path, "http://127.0.0.1:2", host="w1")
+        self._beat(bad, "drift")
+        self._beat(good, "pass")
+        router = Router(tmp_path, poll_s=0.0)
+        doc = router.healthz()
+        assert doc["status"] == "degraded"
+        assert doc["quarantined"] == 1 and doc["routable"] == 1
+        assert any("quarantine" in r for r in doc.get("reasons", []))
+
+
+# ---------------------------------------------------------------------------
+# History schema 11
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema11:
+    def test_audit_metrics_whitelisted(self):
+        from sbr_tpu.obs import history
+
+        assert history.SCHEMA == 11
+        out = history.bench_metrics({
+            "value": 10.0,
+            "extra": {"audit_probes_per_sec": 2.5,
+                      "audit_overhead_ratio": 1.02},
+        })
+        assert out["audit_probes_per_sec"] == 2.5
+        assert out["audit_overhead_ratio"] == 1.02
+
+    def test_overhead_polarity_lower_better(self):
+        from sbr_tpu.obs import history
+
+        assert history.polarity("audit_overhead_ratio") == -1
+        assert history.polarity("audit_probes_per_sec") == 1
+
+    def test_old_schema_lines_still_load(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "perf_history.jsonl"
+        rows = [
+            {"ts": 1.0, "value": 10.0, "metrics": {"x": 1.0}},  # schema-less
+            {"ts": 2.0, "schema": 10, "value": 11.0,
+             "metrics": {"infomodel_belief_updates_per_sec": 5.0}},
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        loaded = history.load(path)
+        assert len(loaded) == 2
+        assert loaded[0]["schema"] == 1
+        assert loaded[1]["schema"] == 10

@@ -844,7 +844,7 @@ class TestHistorySchema10:
         assert metrics["infomodel_population_queries_per_sec"] == 2.5
         history.append(metrics, path=path)
         recs = history.load(path)
-        assert recs[-1]["schema"] == 10
+        assert recs[-1]["schema"] == history.SCHEMA
         assert recs[-1]["metrics"]["infomodel_population_queries_per_sec"] == 2.5
 
     def test_polarity_higher_better(self):
@@ -867,4 +867,4 @@ class TestHistorySchema10:
                 fh.write(json.dumps(rec) + "\n")
         history.append({"infomodel_belief_updates_per_sec": 5.0}, path=path)
         recs = history.load(path)
-        assert [r["schema"] for r in recs] == [1, 9, 10]
+        assert [r["schema"] for r in recs] == [1, 9, history.SCHEMA]
