@@ -1728,6 +1728,70 @@ def bench_audit(platform: str) -> dict:
     }
 
 
+def bench_demand(platform: str) -> dict:
+    """Workload-demand observatory (ISSUE 18): streaming sketch/histogram
+    update throughput + the router's fleet-merge cost.
+
+    Part 1 streams a seeded loadgen-shaped query mix through one
+    `DemandTracker.record` loop (per query: fixed-grid bin update +
+    Misra-Gries sketch update + source label) → demand_updates_per_sec —
+    the per-query cost the serving hot path pays at SBR_DEMAND=1. Part 2
+    builds W workers' compact heartbeat surfaces from disjoint mix shards
+    and times the router-side `merge_surfaces` fold → demand_merge_ms per
+    fleet merge (what every /statz scrape and fleet.json write costs).
+    Pure host bookkeeping — no engine, no device. History schema 12; tiny
+    dry-run shapes zero the gated keys so reduced-shape stats never seed
+    a baseline."""
+    from sbr_tpu.obs import demand as dm
+    from sbr_tpu.serve.loadgen import build_pool, query_mix
+
+    if _tiny():
+        pool_n, n_updates, workers, merges = 16, 2_000, 2, 5
+    else:
+        pool_n, n_updates, workers, merges = 256, 200_000, 8, 200
+
+    pool = build_pool(0, pool_n)
+    mix = query_mix(0, pool_n, n_updates)
+    coords = [(p.learning.beta, p.economic.u) for p in pool]
+    sources = ("computed", "lru", "disk", "tilecache")
+
+    tracker = dm.DemandTracker(window_s=3600.0, bins=16, topk_n=32)
+    t0 = time.perf_counter()
+    for qi, idx in enumerate(mix):
+        b, u = coords[idx]
+        tracker.record(b, u, scenario="mix", source=sources[qi & 3])
+    update_s = time.perf_counter() - t0
+    updates_per_sec = n_updates / update_s if update_s > 0 else 0.0
+
+    shard = max(len(mix) // workers, 1)
+    blocks = []
+    for w in range(workers):
+        wt = dm.DemandTracker(window_s=3600.0, bins=16, topk_n=32)
+        for qi, idx in enumerate(mix[w * shard : (w + 1) * shard]):
+            b, u = coords[idx]
+            wt.record(b, u, scenario="mix", source=sources[qi & 3])
+        blocks.append(wt.heartbeat_block())
+    t0 = time.perf_counter()
+    for _ in range(merges):
+        merged = dm.merge_surfaces(blocks)
+    merge_ms = (time.perf_counter() - t0) / merges * 1e3
+
+    _log(
+        f"demand: {n_updates} updates in {update_s:.3f}s "
+        f"({updates_per_sec:.0f}/s); {workers}-worker fleet merge "
+        f"{merge_ms:.3f}ms ({merged['queries']} queries, "
+        f"{len(merged['cells'])} cells)"
+    )
+    return {
+        "demand_updates": n_updates,
+        "demand_updates_per_sec": 0.0 if _tiny() else round(updates_per_sec, 1),
+        "demand_merge_ms": 0.0 if _tiny() else round(merge_ms, 4),
+        "demand_merge_workers": workers,
+        "demand_sketch_items": len(merged["sketch"]["items"]),
+        "demand_hot_cells": len(merged["cells"]),
+    }
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -1877,6 +1941,20 @@ def _measure_inner(platform: str) -> None:
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in aud.items() if v is not None},
         )
+    try:
+        with obs.span("bench.demand"):
+            dem = bench_demand(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the workload-demand bench fails.
+        _log(f"demand bench failed: {err!r}")
+        dem = None
+    if dem is not None:
+        obs.event(
+            "bench_demand",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in dem.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -2012,6 +2090,16 @@ def _measure_inner(platform: str) -> None:
                 out["extra"][k] = aud[k]
         out["extra"]["audit_probe_count"] = aud["audit_probe_count"]
         out["extra"]["audit_canary_cycles"] = aud["audit_canary_cycles"]
+    if dem is not None:
+        # Schema-12 history metrics (ISSUE 18): streaming demand-update
+        # throughput + router fleet-merge cost. Tiny shapes zero the
+        # gated keys (falsy → dropped here) so reduced-shape stats never
+        # seed baselines.
+        for k in ("demand_updates_per_sec", "demand_merge_ms"):
+            if dem.get(k):
+                out["extra"][k] = dem[k]
+        out["extra"]["demand_merge_workers"] = dem["demand_merge_workers"]
+        out["extra"]["demand_sketch_items"] = dem["demand_sketch_items"]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

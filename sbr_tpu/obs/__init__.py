@@ -35,6 +35,16 @@ per-step ABM counters are the design references from PAPERS.md):
   (`gc_audit_files`, ``report gc --audit-keep``). Kept OUT of this
   package's import graph so ``python -m`` runs exactly one module copy
   (the `graphgen_cli` rationale).
+- ``obs.demand``  — workload demand observatory (ISSUE 18): the rolling
+  (β, u) demand histogram on the fixed sweep-aligned grid, the mergeable
+  Misra-Gries heavy-hitter sketch over query fingerprints, per-bin
+  answer-source (warm/cold) labels, the deterministic prefetch advisor
+  (``advisor_plan.json``), offline trace replay
+  (``python -m sbr_tpu.obs.demand replay``), and demand-artifact
+  retention (`gc_demand_files`, ``report gc --demand-keep``). Also kept
+  OUT of this package's import graph — and out of the SERVE import graph
+  unless ``SBR_DEMAND=1`` (off is a structural no-op: module never
+  imported, ``/metrics`` byte-free of ``sbr_demand``).
 - ``obs.report``  — `python -m sbr_tpu.obs.report RUN_DIR [OTHER]` renders
   a run directory or diffs two runs; the `health` subcommand renders and
   gates on numerical health, `resilience` renders/gates the fault/retry/
@@ -84,6 +94,7 @@ from sbr_tpu.obs.runlog import (
     jit_call,
     log_audit,
     log_cache,
+    log_demand,
     log_fault,
     log_fleet,
     log_health,
@@ -118,6 +129,7 @@ __all__ = [
     "jit_call",
     "log_audit",
     "log_cache",
+    "log_demand",
     "log_fault",
     "log_fleet",
     "log_health",

@@ -91,10 +91,15 @@ from typing import Optional
 # audit_overhead_ratio (serve-loop steady-state latency with the idle-gated
 # audit scheduler enabled over the audit-off control; lower-better by the
 # overhead rule — ~1.0 means canaries are invisible to the hot path).
+# Schema 12 adds the workload-demand observatory (bench.py bench_demand):
+# demand_updates_per_sec (DemandTracker streaming-record throughput —
+# histogram bin + Misra-Gries sketch update per query; higher-better by
+# the per_sec rule) and demand_merge_ms (one fleet merge of the workers'
+# heartbeat demand surfaces at the router; lower-better by the _ms rule).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1..10 history keeps gating new schema-11 appends.
-SCHEMA = 11
+# schema-1..11 history keeps gating new schema-12 appends.
+SCHEMA = 12
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -232,6 +237,12 @@ def bench_metrics(result: dict) -> dict:
         # by the overhead rule)
         "audit_probes_per_sec",
         "audit_overhead_ratio",
+        # schema 12: the workload-demand observatory (bench.py
+        # bench_demand): streaming sketch/histogram update throughput
+        # (higher-better by the per_sec rule) and the router-side fleet
+        # merge cost (lower-better by the _ms rule)
+        "demand_updates_per_sec",
+        "demand_merge_ms",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):

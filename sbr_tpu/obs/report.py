@@ -52,6 +52,16 @@ Usage:
                                                     # exit 1 on any drift
                                                     # verdict, 3 when no
                                                     # audit data recorded
+    python -m sbr_tpu.obs.report demand DIR [DIR..] # workload-demand report
+                                                    # (rolling demand.json
+                                                    # surfaces: hot (beta,u)
+                                                    # bins, heavy hitters,
+                                                    # warm coverage, ranked
+                                                    # prefetch-advisor plan);
+                                                    # exit 1 when hot-region
+                                                    # warm coverage is under
+                                                    # the floor, 3 when no
+                                                    # demand data recorded
     python -m sbr_tpu.obs.report trace DIR [DIR..]  # fleet-wide trace join
                                                     # (router + worker run
                                                     # dirs): per-query span
@@ -80,7 +90,11 @@ Usage:
                                                     # --audit-keep N also
                                                     # prunes aged audit
                                                     # batteries + archived
-                                                    # goldens
+                                                    # goldens; with
+                                                    # --demand-keep N also
+                                                    # prunes rotated demand
+                                                    # snapshots + aged
+                                                    # advisor plans
 
 Every reporting subcommand (timing render, diff, health, trend) takes
 ``--json`` and then prints one machine-readable JSON document instead of
@@ -1275,6 +1289,211 @@ def _main_audit(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Workload-demand report (`demand` subcommand — ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def demand_doc(run_dirs, floor=None, cache_dir=None) -> tuple:
+    """Machine-readable workload-demand report (`sbr_tpu.obs.demand`):
+    merges the lifetime demand surfaces from each run's rolling
+    ``demand.json`` (worker and single-engine runs alike) into hot-region
+    tables, top-k heavy-hitter fingerprints, warm/cold coverage ratios,
+    and a freshly ranked advisor plan (against ``cache_dir``'s tile-cache
+    cell index when given). Returns (doc, exit_code).
+
+    Exit codes: 0 healthy; 1 when hot-region warm coverage is under the
+    floor (``--floor`` or ``SBR_DEMAND_COVERAGE_FLOOR``; no floor = gate
+    disarmed); 3 when no run recorded demand data (a coverage gate with
+    nothing to read must not pass silently); 2 when some ``run_dir`` is
+    not a directory."""
+    from sbr_tpu.obs import demand as _demand
+
+    if floor is None:
+        floor = _demand.coverage_floor()
+    surfaces, per_dir, bad = [], [], 0
+    for d in run_dirs:
+        d = Path(d)
+        if not d.is_dir():
+            return {"dir": str(d), "error": "not a directory", "exit": 2}, 2
+        snap_path = d / "demand.json"
+        if not snap_path.is_file():
+            per_dir.append({"dir": str(d), "queries": 0, "demand_json": False})
+            continue
+        try:
+            snap = json.loads(snap_path.read_text())
+            surface = snap["totals"]
+        except (OSError, ValueError, KeyError, TypeError):
+            bad += 1
+            per_dir.append({"dir": str(d), "queries": 0, "demand_json": False})
+            continue
+        surfaces.append(surface)
+        per_dir.append({
+            "dir": str(d),
+            "queries": int(surface.get("queries") or 0),
+            "demand_json": True,
+        })
+    merged = _demand.merge_surfaces(surfaces) if surfaces else None
+    if merged is None or not merged.get("queries"):
+        return {
+            "dirs": [str(d) for d in run_dirs],
+            "error": "no demand data (no demand.json with queries — was the "
+            "run served with SBR_DEMAND=1?)",
+            "bad_demand_files": bad,
+            "exit": 3,
+        }, 3
+    hot = _demand.hot_bins(merged)
+    hot_q = sum(h["count"] for h in hot)
+    hot_warm = sum(h["warm"] for h in hot)
+    hot_cov = round(hot_warm / hot_q, 4) if hot_q else 0.0
+    coverage = _demand.coverage_from_cache_dir(cache_dir) if cache_dir else None
+    plan = _demand.advisor_plan(merged, coverage, floor=floor)
+    sketch = _demand.MisraGries.from_doc(merged.get("sketch") or {})
+    top_fps = [
+        {
+            "fingerprint": item, "count": count,
+            **({k: payload.get(k) for k in ("beta", "u", "scenario", "kind")}
+               if isinstance(payload, dict) else {}),
+        }
+        for item, count, payload in sketch.top(_demand.topk())
+    ]
+    sources: dict = {}
+    for cell in (merged.get("cells") or {}).values():
+        for s, v in (cell.get("sources") or {}).items():
+            sources[s] = sources.get(s, 0) + int(v)
+    breaches = []
+    if floor is not None and hot_cov < floor:
+        breaches.append(
+            f"hot-region warm coverage {hot_cov:.3f} under floor {floor:g}"
+        )
+    code = 1 if breaches else 0
+    doc = {
+        "dirs": [str(d) for d in run_dirs],
+        "per_dir": per_dir,
+        "queries": int(merged["queries"]),
+        "bins": merged["bins"],
+        "hot_bins": hot,
+        "hot_warm_coverage": hot_cov,
+        "floor": floor,
+        "sources": {k: sources[k] for k in sorted(sources)},
+        "top_fingerprints": top_fps,
+        "advisor": plan,
+        "cache_dir": str(cache_dir) if cache_dir else None,
+        "bad_demand_files": bad,
+        "breaches": breaches,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_demand(doc: dict) -> str:
+    """Human-readable demand report; same exit contract as `demand_doc`."""
+    if doc["exit"] == 2:
+        return f"run      {doc['dir']}\n{doc.get('error', 'not a directory')}"
+    if doc["exit"] == 3:
+        out = [f"runs     {', '.join(doc['dirs'])}", doc.get("error", "no demand data")]
+        return "\n".join(out)
+    out = [f"runs     {', '.join(doc['dirs'])}"]
+    out.append(
+        f"demand   {doc['queries']} quer(ies) on a {doc['bins']}x{doc['bins']} "
+        f"(beta, u) grid; hot region {len(doc['hot_bins'])} bin(s), "
+        f"warm coverage {doc['hot_warm_coverage']:.3f}"
+        + (f" (floor {doc['floor']:g})" if doc.get("floor") is not None else "")
+    )
+    if doc.get("bad_demand_files"):
+        out.append(f"warning  {doc['bad_demand_files']} torn demand.json skipped")
+    if doc["sources"]:
+        out.append("sources  " + ", ".join(
+            f"{k}={v}" for k, v in doc["sources"].items()
+        ))
+    if doc["hot_bins"]:
+        out += ["", "HOT REGION (bins covering >= 50% of demand)"]
+        out.append(_table(
+            ["bin", "beta", "u", "count", "share", "warm", "coverage"],
+            [
+                [
+                    h["bin"],
+                    f"[{h['beta_lo']:g},{h['beta_hi']:g})",
+                    f"[{h['u_lo']:g},{h['u_hi']:g})",
+                    h["count"],
+                    f"{h['share']:.2f}",
+                    h["warm"],
+                    f"{h['warm_coverage']:.2f}",
+                ]
+                for h in doc["hot_bins"]
+            ],
+        ))
+    if doc["top_fingerprints"]:
+        out += ["", "TOP FINGERPRINTS (Misra-Gries heavy hitters)"]
+        out.append(_table(
+            ["fingerprint", "count", "beta", "u", "scenario", "kind"],
+            [
+                [
+                    f["fingerprint"],
+                    f["count"],
+                    "-" if f.get("beta") is None else f"{f['beta']:g}",
+                    "-" if f.get("u") is None else f"{f['u']:g}",
+                    f.get("scenario") or "-",
+                    f.get("kind") or "-",
+                ]
+                for f in doc["top_fingerprints"][:12]
+            ],
+        ))
+    plan = doc.get("advisor") or {}
+    if plan.get("tiles"):
+        out += ["", f"ADVISOR PLAN {plan.get('plan_fingerprint', '?')}"
+                + (f" (cache {doc['cache_dir']})" if doc.get("cache_dir") else "")]
+        out.append(_table(
+            ["rank", "bin", "score", "count", "cells", "tile cov"],
+            [
+                [
+                    t["rank"], t["bin"], f"{t['score']:g}", t["count"],
+                    t["cells"], f"{t['tile_coverage']:.2f}",
+                ]
+                for t in plan["tiles"]
+            ],
+        ))
+    out.append("")
+    if doc["breaches"]:
+        out.append("GATE: COLD HOT-REGION")
+        for b in doc["breaches"]:
+            out.append(f"  {b}")
+    else:
+        out.append("GATE: ok" + (
+            " (hot-region warm coverage clears the floor)"
+            if doc.get("floor") is not None else " (no coverage floor set)"
+        ))
+    return "\n".join(out)
+
+
+def _main_demand(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report demand",
+        description="Workload-demand report over one or more run dirs "
+        "(rolling demand.json surfaces from sbr_tpu.obs.demand): hot-region "
+        "tables, top-k heavy-hitter fingerprints, warm/cold coverage, and "
+        "the ranked prefetch-advisor plan; exit 1 when hot-region warm "
+        "coverage is under the floor, 3 when no demand data was recorded",
+    )
+    parser.add_argument("run_dirs", nargs="+",
+                        help="obs run director(ies) with demand.json")
+    parser.add_argument("--floor", type=float, default=None,
+                        help="warm-coverage gate floor (default "
+                        "SBR_DEMAND_COVERAGE_FLOOR; unset = gate disarmed)")
+    parser.add_argument("--cache-dir", default=None, dest="cache_dir",
+                        help="tile-cache root (SBR_TILE_CACHE_DIR) whose "
+                        "cell index feeds the advisor's coverage input")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = demand_doc(args.run_dirs, floor=args.floor,
+                           cache_dir=args.cache_dir)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_demand(doc))
+    return code
+
+
+# ---------------------------------------------------------------------------
 # Infomodel report (`infomodel` subcommand — information-model gate)
 # ---------------------------------------------------------------------------
 
@@ -2176,6 +2395,13 @@ def _main_gc(argv) -> int:
         "registry down to N per key; live runs and the active goldens "
         "are never touched",
     )
+    parser.add_argument(
+        "--demand-keep", type=int, default=None, metavar="N", dest="demand_keep",
+        help="also prune rotated demand snapshots (demand.NNN.json) and "
+        "aged advisor plans (advisor_plan.NNN.json) inside kept run dirs "
+        "down to the N most recent per dir; live runs and the active "
+        "demand.json / advisor_plan.json are never touched",
+    )
     args = parser.parse_args(argv)
     import os
 
@@ -2218,6 +2444,14 @@ def _main_gc(argv) -> int:
         pruned = gc_audit_files(root, keep=args.audit_keep)
         print(f"removed {len(pruned)} audit artifact file(s) "
               f"(keep {args.audit_keep} per run dir / golden key)")
+        for p in pruned:
+            print(f"  {p}")
+    if args.demand_keep is not None:
+        from sbr_tpu.obs.demand import gc_demand_files
+
+        pruned = gc_demand_files(root, keep=args.demand_keep)
+        print(f"removed {len(pruned)} demand artifact file(s) "
+              f"(keep {args.demand_keep} per run dir)")
         for p in pruned:
             print(f"  {p}")
     return 0
@@ -2732,6 +2966,8 @@ def main(argv=None) -> int:
         return _main_fleet(argv[1:])
     if argv and argv[0] == "audit":
         return _main_audit(argv[1:])
+    if argv and argv[0] == "demand":
+        return _main_demand(argv[1:])
     if argv and argv[0] == "grad":
         return _main_grad(argv[1:])
     if argv and argv[0] == "infomodel":
@@ -2752,8 +2988,8 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'audit' / 'grad' / 'infomodel' / 'trace' / 'slo' / 'trend' / 'gc' "
-        "subcommands",
+        "'audit' / 'demand' / 'grad' / 'infomodel' / 'trace' / 'slo' / "
+        "'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

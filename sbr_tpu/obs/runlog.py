@@ -201,6 +201,12 @@ class RunContext:
         # audit events plus the drift/pass probe tallies and last cycle —
         # what `report audit` gates on.
         self.audit: dict = {}
+        # Workload-demand roll-up (sbr_tpu.obs.demand): per-action counts
+        # of demand lifecycle events (snapshot rotations, advisor-plan
+        # writes) plus the last plan fingerprint. Deliberately NOT
+        # per-query — the demand tracker aggregates in memory and only
+        # its artifact writes land here.
+        self.demand: dict = {}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -601,6 +607,7 @@ class RunContext:
             "fleet": self.fleet or None,
             "infomodel": self.infomodel or None,
             "audit": self.audit or None,
+            "demand": self.demand or None,
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -750,6 +757,16 @@ class RunContext:
                 self.audit["last_cycle"] = fields["cycle"]
             if fields.get("verdict") is not None:
                 self.audit["last_verdict"] = fields["verdict"]
+
+    def log_demand(self, action: str = "?", **fields) -> None:
+        """Emit one workload-``demand`` event (`sbr_tpu.obs.demand`:
+        snapshot rotations, advisor-plan writes) and count it per action
+        in the manifest roll-up; a plan event's ``fingerprint`` is kept as
+        ``last_plan`` so the manifest names the artifact it produced."""
+        self.event("demand", action=action, **fields)
+        self.demand[action] = self.demand.get(action, 0) + 1
+        if action == "plan" and fields.get("fingerprint") is not None:
+            self.demand["last_plan"] = fields["fingerprint"]
 
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
@@ -1036,6 +1053,14 @@ def log_audit(action: str = "?", **fields) -> None:
     run = current_run()
     if run is not None and _trace_clean():
         run.log_audit(action, **fields)
+
+
+def log_demand(action: str = "?", **fields) -> None:
+    """Workload-demand event + manifest roll-up (no-op when telemetry is
+    off or while tracing) — the `sbr_tpu.obs.demand` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_demand(action, **fields)
 
 
 def interrupt_all() -> int:

@@ -443,6 +443,20 @@ class Engine:
 
             self.audit = _audit.AuditScheduler(engine=self)
 
+        # Workload demand observatory (ISSUE 18): the rolling (β, u)
+        # demand histogram + heavy-hitter sketch + answer-source labels
+        # feeding the prefetch advisor. Same structural-no-op contract as
+        # the audit gate above: SBR_DEMAND=0 (the default) never imports
+        # the module — no tracker, no events, /metrics byte-free of
+        # ``sbr_demand``, answers bit-identical.
+        self.demand = None
+        if os.environ.get("SBR_DEMAND", "").strip() not in ("", "0"):
+            from sbr_tpu.obs import demand as _demand
+
+            self.demand = _demand.DemandTracker(
+                run=self._run, coverage_fn=self._demand_coverage
+            )
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Engine":
         if self._thread is None:
@@ -478,6 +492,8 @@ class Engine:
                     t.event.set()
         if self.audit is not None:
             self.audit.close()
+        if self.demand is not None:
+            self.demand.close(self._run)
         w = self.live.window()
         self.live.maybe_write(self._run, self._live_extra(window=w), window=w, force=True)
         if self._run is not None:
@@ -753,6 +769,10 @@ class Engine:
         # the exposition is byte-free of sbr_audit when the audit is off.
         if self.audit is not None:
             hist_lines = list(hist_lines or []) + self.audit.prometheus_lines()
+        # Demand observatory gauges: same byte-free-when-off contract —
+        # SBR_DEMAND=0 engines have no tracker, so no sbr_demand_* lines.
+        if self.demand is not None:
+            hist_lines = list(hist_lines or []) + self.demand.prometheus_lines()
         if hist_lines:
             text = text.rstrip("\n") + "\n" + "\n".join(hist_lines) + "\n"
         return text
@@ -795,7 +815,18 @@ class Engine:
                 **self._exec_meta,
             },
             **({"audit": self.audit.snapshot()} if self.audit is not None else {}),
+            **({"demand": self.demand.snapshot()} if self.demand is not None else {}),
         }
+
+    def _demand_coverage(self) -> Optional[dict]:
+        """The advisor's tile-cache coverage input: the cell index of this
+        engine's configured global tile cache (None when no cache is
+        bridged — distinct from an empty cache)."""
+        if self.demand is None or not self.bridge.available:
+            return None
+        from sbr_tpu.obs import demand as _demand
+
+        return _demand.coverage_from_cache_dir(self.bridge.cache.root)
 
     # -- batcher loop --------------------------------------------------------
     def _loop(self) -> None:
@@ -812,6 +843,8 @@ class Engine:
                 if self._run is not None and self.live.write_due():
                     w = self.live.window()
                     self.live.maybe_write(self._run, self._live_extra(window=w), window=w)
+                    if self.demand is not None:
+                        self.demand.maybe_write(self._run)
                 continue
             batch, shutdown = [], item is _SHUTDOWN
             if not shutdown:
@@ -849,6 +882,8 @@ class Engine:
                 if self._run is not None and self.live.write_due():
                     w = self.live.window()
                     self.live.maybe_write(self._run, self._live_extra(window=w), window=w)
+                    if self.demand is not None:
+                        self.demand.maybe_write(self._run)
             if shutdown:
                 break
 
@@ -1061,6 +1096,10 @@ class Engine:
         self.live.record_query(
             latency, source, scenario=t.scenario, divergent=t.result.divergent
         )
+        if self.demand is not None:
+            self.demand.record_params(
+                t.params, scenario=t.scenario, source=source, grads=t.grads
+            )
         t.event.set()
 
     def _bucket_for(self, n: int) -> int:
@@ -1190,6 +1229,11 @@ class Engine:
             source = "computed"
         latency = time.monotonic() - t0
         self.live.record_query(latency, source, scenario=f"spec:{key[:12]}")
+        if self.demand is not None:
+            self.demand.record_params(
+                params, scenario=f"spec:{key[:12]}", source=source,
+                kind="scenario",
+            )
         return {**rec, "source": source, "latency_ms": round(latency * 1e3, 3)}
 
     def _solve_scenario(self, params, spec, key: str) -> dict:
@@ -1288,6 +1332,11 @@ class Engine:
             source = "computed"
         latency = time.monotonic() - t0
         self.live.record_query(latency, source, scenario=f"pop:{key[:12]}")
+        if self.demand is not None:
+            self.demand.record_params(
+                params, scenario=f"pop:{key[:12]}", source=source,
+                kind="population",
+            )
         return {**rec, "source": source, "latency_ms": round(latency * 1e3, 3)}
 
     @staticmethod

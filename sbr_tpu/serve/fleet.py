@@ -441,6 +441,12 @@ def _worker_stats(engine) -> dict:
         # SBR_AUDIT is off; the router quarantines on status "drift".
         **({"audit": engine.audit.heartbeat_block()}
            if getattr(engine, "audit", None) is not None else {}),
+        # Compact demand surface (ISSUE 18): this worker's rolling-window
+        # (β, u) histogram + heavy-hitter sketch, absent entirely when
+        # SBR_DEMAND is off; the router merges present blocks into the
+        # fleet demand surface.
+        **({"demand": engine.demand.heartbeat_block()}
+           if getattr(engine, "demand", None) is not None else {}),
     }
 
 

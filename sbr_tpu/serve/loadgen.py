@@ -37,8 +37,12 @@ beside it.
 query carries an ``X-SBR-Trace-Id`` (fleet mode: minted by the router;
 direct mode: minted here, with a ``loadgen.query`` root span committed to
 the engine's run dir), and ``--trace-out PATH`` writes one JSONL row per
-measured query — trace id, client latency, source, degraded, status —
-the client-side half a ``report trace`` waterfall joins against.
+measured query — trace id, client latency, source, degraded, status,
+plus the query's (β, u) coordinates and scenario/kind tags (ISSUE 18) —
+the client-side half a ``report trace`` waterfall joins against, and the
+replay input `python -m sbr_tpu.obs.demand replay` rebuilds demand
+surfaces from (its reader is backfill-tolerant: rows from older traces
+without (β, u) are counted as legacy and skipped).
 
 Exit codes: 0 ok, 1 failed assertion (--assert-warm / fleet loss), 2
 setup error.
@@ -308,6 +312,14 @@ def run_fleet(args) -> dict:
                 "status": code,
                 "source": doc.get("source") if isinstance(doc, dict) else None,
                 "degraded": bool(doc.get("degraded")) if isinstance(doc, dict) else None,
+                # Demand-replay input contract (ISSUE 18): the query's
+                # (β, u) coordinates + scenario/kind tags let
+                # `python -m sbr_tpu.obs.demand replay` rebuild the demand
+                # surface offline from this trace alone.
+                "beta": pool[pool_idx].learning.beta,
+                "u": pool[pool_idx].economic.u,
+                "scenario": "mix",
+                "kind": "plain",
             }
             completed[0] += 1
             maybe_kill()
@@ -426,8 +438,10 @@ def run_fleet(args) -> dict:
 
 def _write_trace_rows(path: str, rows: List[Optional[dict]]) -> None:
     """``--trace-out``: one JSONL row per measured query (trace id, client
-    latency, source, degraded, status) — the client-side half that joins a
-    loadgen run against ``report trace`` waterfalls by trace id."""
+    latency, source, degraded, status, (β, u) + scenario/kind demand
+    tags) — the client-side half that joins a loadgen run against
+    ``report trace`` waterfalls by trace id, and the offline input
+    ``obs.demand replay`` rebuilds demand surfaces from."""
     with open(path, "w") as fh:
         for row in rows:
             if row is not None:
@@ -605,6 +619,12 @@ def main(argv=None) -> int:
                     "query": p, "pool": mix[p], "trace_id": None,
                     "latency_ms": round(dur_g * 1e3, 3), "status": 200,
                     "source": r.source, "degraded": bool(r.degraded),
+                    # Demand-replay input contract (ISSUE 18) — see the
+                    # fleet-mode row builder.
+                    "beta": pool[mix[p]].learning.beta,
+                    "u": pool[mix[p]].economic.u,
+                    "scenario": "mix",
+                    "kind": "plain",
                 }
                 c = ctxs[k] if ctxs is not None else None
                 if c is not None:

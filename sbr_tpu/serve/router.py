@@ -712,6 +712,7 @@ class Router:
                 }
                 for h, w in self._workers.items()
             }
+        fleet_demand = self.fleet_demand()
         return {
             "schema": SCHEMA,
             "ts": round(time.time(), 3),
@@ -724,7 +725,34 @@ class Router:
             "healthz": self.healthz(),
             "hedge_ms": self.hedge_ms,
             "default_deadline_ms": self.default_deadline_ms,
+            # Fleet demand surface (ISSUE 18): absent — not null-valued —
+            # when no worker heartbeats a demand block, so a demand-off
+            # fleet's /statz and fleet.json stay byte-free of the key.
+            **({"demand": fleet_demand} if fleet_demand is not None else {}),
         }
+
+    def fleet_demand(self) -> "Optional[dict]":
+        """Merge the workers' heartbeat demand surfaces (ISSUE 18) into
+        the fleet demand surface — the prefetch advisor's fleet-wide
+        input. Workers are folded in sorted-host order, so the merged
+        sketch is deterministic for a given set of heartbeats. Returns
+        None (and, deliberately, does not even import `obs.demand`) when
+        no worker published a block: a demand-off fleet keeps the
+        structural no-op."""
+        with self._workers_lock:
+            blocks = [
+                ((w.last_hb or {}).get("demand"), h)
+                for h, w in sorted(self._workers.items())
+            ]
+        blocks = [(b, h) for b, h in blocks if isinstance(b, dict)]
+        if not blocks:
+            return None
+        from sbr_tpu.obs import demand as _demand
+
+        merged = _demand.merge_surfaces([b for b, _ in blocks])
+        merged["workers"] = [h for _, h in blocks]
+        merged["hot_bins"] = _demand.hot_bins(merged)
+        return merged
 
     def prometheus(self) -> str:
         lines = []
@@ -739,6 +767,25 @@ class Router:
         for h, w in sorted(workers.items()):
             lines.append(f'sbr_fleet_worker_ewma_ms{{worker="{h}"}} {w.ewma_ms:g}')
         lines += self.latency_hist.to_prometheus("sbr_fleet_latency_ms")
+        # Fleet demand surface gauges (ISSUE 18): appended only when some
+        # worker heartbeats a demand block — a demand-off fleet's
+        # exposition stays byte-free of sbr_demand.
+        demand = self.fleet_demand()
+        if demand is not None:
+            hot = demand.get("hot_bins") or []
+            hot_q = sum(h.get("count", 0) for h in hot)
+            hot_warm = sum(h.get("warm", 0) for h in hot)
+            cov = hot_warm / hot_q if hot_q else 0.0
+            lines += [
+                "# TYPE sbr_demand_fleet_window_queries gauge",
+                f"sbr_demand_fleet_window_queries {int(demand.get('queries') or 0)}",
+                "# TYPE sbr_demand_fleet_workers gauge",
+                f"sbr_demand_fleet_workers {len(demand.get('workers') or [])}",
+                "# TYPE sbr_demand_fleet_hot_bins gauge",
+                f"sbr_demand_fleet_hot_bins {len(hot)}",
+                "# TYPE sbr_demand_fleet_hot_warm_coverage gauge",
+                f"sbr_demand_fleet_hot_warm_coverage {cov:g}",
+            ]
         return "\n".join(lines) + "\n"
 
 

@@ -546,7 +546,7 @@ class TestHistorySchema11:
     def test_audit_metrics_whitelisted(self):
         from sbr_tpu.obs import history
 
-        assert history.SCHEMA == 11
+        assert history.SCHEMA >= 11  # ISSUE 18 bumped to 12 (demand workload)
         out = history.bench_metrics({
             "value": 10.0,
             "extra": {"audit_probes_per_sec": 2.5,

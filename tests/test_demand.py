@@ -1,0 +1,711 @@
+"""Workload-demand observatory tests (ISSUE 18): Misra-Gries sketch
+guarantees (merge commutativity item-for-item, associativity under
+capacity, deterministic top-k), fixed-grid binning, the streaming
+`DemandTracker` (window expiry on an injected clock, answer-source
+labels, compact heartbeat blocks), fleet merge through the router, the
+prefetch advisor (pure + byte-stable plans, cross-PROCESS determinism via
+the replay CLI), `report demand` gating, `report gc --demand-keep`
+retention, loadgen trace-row replay (backfill tolerance), the
+SBR_DEMAND=0 structural no-op witness (module never imported, /metrics
+byte-free, zero new XLA traces, bit-identical answers), history schema
+12, and the advisor-closes-the-loop e2e gate (plan tiles swept into the
+tile cache turn a red coverage gate green on a real engine replay).
+"""
+
+import hashlib
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.obs import demand as dm
+
+REPO = Path(__file__).resolve().parent.parent
+
+CFG = SolverConfig(n_grid=64, bisect_iters=20, refine_crossings=False)
+
+PAYLOAD = {"beta": 1.0, "u": 0.1, "scenario": "mix", "kind": "plain"}
+
+
+def _feq(a, b) -> bool:
+    """Bitwise float equality (NaN-safe): the byte-identity contract."""
+    return np.float64(a).tobytes() == np.float64(b).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Misra-Gries sketch guarantees
+# ---------------------------------------------------------------------------
+
+
+class TestMisraGries:
+    def test_heavy_hitter_guarantee(self):
+        # Any item with frequency > N/(k+1) must be tracked, with count
+        # undershooting by at most N/(k+1).
+        sk = dm.MisraGries(2)
+        stream = ["hot"] * 60 + ["a", "b", "c", "d"] * 10  # N=100, k=2
+        random.Random(0).shuffle(stream)
+        for item in stream:
+            sk.update(item, PAYLOAD)
+        assert "hot" in sk.counters
+        assert 60 - 100 / 3 <= sk.counters["hot"] <= 60
+
+    def test_merge_commutative_item_for_item(self):
+        a, b = dm.MisraGries(3), dm.MisraGries(3)
+        for item, n in [("x", 9), ("y", 4), ("z", 2)]:
+            a.update(item, PAYLOAD, n)
+        for item, n in [("x", 1), ("q", 7), ("r", 3), ("y", 2)]:
+            b.update(item, PAYLOAD, n)
+        ab, ba = a.merge(b), b.merge(a)
+        # The satellite contract: merge(a, b) == merge(b, a) ITEM FOR ITEM
+        # (same keys, same counts), not merely same top-k ordering.
+        assert ab.counters == ba.counters
+        assert ab.top() == ba.top()
+
+    def test_merge_associative_under_capacity(self):
+        # With capacity for the union (no decrement applied), merged counts
+        # are exact itemwise sums — fully associative.
+        sketches = []
+        for seed in range(3):
+            sk = dm.MisraGries(16)
+            rng = random.Random(seed)
+            for _ in range(50):
+                sk.update(f"item{rng.randrange(6)}", PAYLOAD)
+            sketches.append(sk)
+        a, b, c = sketches
+        left = a.merge(b).merge(c)
+        right = a.merge(b.merge(c))
+        assert left.counters == right.counters
+
+    def test_deterministic_topk_under_seeded_stream(self):
+        def run():
+            sk = dm.MisraGries(8)
+            rng = random.Random(1234)
+            for _ in range(5000):
+                # Zipf-ish skew so there ARE heavy hitters to rank.
+                item = f"q{min(rng.randrange(1, 40), rng.randrange(1, 40))}"
+                sk.update(item, PAYLOAD)
+            return sk.top()
+
+        assert run() == run()
+
+    def test_top_ties_break_by_item_key(self):
+        sk = dm.MisraGries(8)
+        for item in ("bb", "aa", "cc"):
+            sk.update(item, PAYLOAD, 5)
+        assert [i for i, _, _ in sk.top()] == ["aa", "bb", "cc"]
+
+    def test_doc_roundtrip(self):
+        sk = dm.MisraGries(4)
+        for item, n in [("x", 3), ("y", 1)]:
+            sk.update(item, {**PAYLOAD, "beta": float(n)}, n)
+        back = dm.MisraGries.from_doc(sk.to_doc())
+        assert back.counters == sk.counters
+        assert back.payloads == sk.payloads
+        # Torn docs degrade to empty, never raise.
+        assert dm.MisraGries.from_doc({"items": [["x"], None, 3]}).counters == {}
+
+
+# ---------------------------------------------------------------------------
+# Binning + fingerprints
+# ---------------------------------------------------------------------------
+
+
+class TestBinning:
+    def test_grid_aligned_to_sweep_ranges(self):
+        nb = 16
+        assert dm.bin_of(dm.BETA_RANGE[0], dm.U_RANGE[0], nb) == (0, 0)
+        # Upper edges (and anything beyond) clamp into the last bin.
+        assert dm.bin_of(dm.BETA_RANGE[1], dm.U_RANGE[1], nb) == (nb - 1, nb - 1)
+        assert dm.bin_of(99.0, -5.0, nb) == (nb - 1, 0)
+        b = dm.bin_bounds(0, 0, nb)
+        assert b["beta_lo"] == dm.BETA_RANGE[0] and b["u_lo"] == dm.U_RANGE[0]
+
+    def test_fingerprint_is_stable_hash_of_exact_coords(self):
+        fp = dm.query_fingerprint(1.25, 0.3, "mix", "plain")
+        expected = hashlib.sha256(
+            f"{1.25!r}|{0.3!r}|mix|plain".encode()
+        ).hexdigest()[:16]
+        assert fp == expected
+        # kind and scenario are part of the identity
+        assert fp != dm.query_fingerprint(1.25, 0.3, "mix", "grads")
+        assert fp != dm.query_fingerprint(1.25, 0.3, "other", "plain")
+
+
+# ---------------------------------------------------------------------------
+# DemandTracker (streaming, windowed)
+# ---------------------------------------------------------------------------
+
+
+class TestDemandTracker:
+    def _tracker(self, clock, window_s=12.0):
+        return dm.DemandTracker(window_s=window_s, bins=8, topk_n=8,
+                                time_fn=lambda: clock[0])
+
+    def test_sources_split_warm_and_cold(self):
+        clock = [100.0]
+        tr = self._tracker(clock)
+        for k in range(40):
+            tr.record(1.0, 0.1, source="lru" if k % 2 else "computed")
+        hot = tr.snapshot()["hot_bins"]
+        assert len(hot) == 1
+        assert hot[0]["count"] == 40 and hot[0]["warm"] == 20
+        assert hot[0]["warm_coverage"] == 0.5
+
+    def test_window_expires_but_totals_persist(self):
+        clock = [100.0]
+        tr = self._tracker(clock, window_s=12.0)
+        tr.record(1.0, 0.1)
+        tr.record(2.0, 0.5)
+        assert tr.window_surface()["queries"] == 2
+        clock[0] += 13.0  # one full window later: all slots stale
+        assert tr.window_surface()["queries"] == 0
+        assert tr.totals_surface()["queries"] == 2
+        assert tr.queries_total == 2
+
+    def test_record_never_raises(self):
+        clock = [0.0]
+        tr = self._tracker(clock)
+        tr.record("junk", None, scenario=object())  # type: ignore[arg-type]
+        tr.record_params(object())  # no .learning/.economic
+        assert tr.queries_total == 0
+
+    def test_heartbeat_block_caps_cells(self):
+        clock = [50.0]
+        tr = dm.DemandTracker(window_s=1000.0, bins=16, topk_n=4,
+                              time_fn=lambda: clock[0])
+        # Spread queries over >64 distinct bins of the 16x16 grid.
+        for i in range(16):
+            for j in range(6):
+                tr.record(0.51 + i * 0.218, 0.03 + j * 0.14)
+        hb = tr.heartbeat_block()
+        assert len(hb["cells"]) == 64
+        assert len(hb["sketch"]["items"]) <= 4
+        # The full window surface is uncapped (some pairs share a bin, so
+        # compare against the observed distinct-cell count, not 16*6).
+        assert len(tr.window_surface()["cells"]) > 64
+
+    def test_prometheus_lines(self):
+        clock = [5.0]
+        tr = self._tracker(clock)
+        tr.record(1.0, 0.1, source="lru")
+        text = "\n".join(tr.prometheus_lines())
+        assert "sbr_demand_queries_total 1" in text
+        assert "sbr_demand_window_queries 1" in text
+        assert "sbr_demand_hot_warm_coverage 1" in text
+
+
+# ---------------------------------------------------------------------------
+# Surface merge + fleet (router) merge
+# ---------------------------------------------------------------------------
+
+
+def _surface_from(counts_sources, bins=8, k=8):
+    """Tiny surface builder: {(beta, u, source): n} -> surface doc."""
+    tr = dm.DemandTracker(window_s=1000.0, bins=bins, topk_n=k,
+                          time_fn=lambda: 1.0)
+    for (beta, u, source), n in counts_sources.items():
+        for _ in range(n):
+            tr.record(beta, u, source=source)
+    return tr.heartbeat_block()
+
+
+class TestMergeSurfaces:
+    def test_merge_sums_cells_sources_and_sketch(self):
+        a = _surface_from({(1.0, 0.1, "computed"): 3, (2.0, 0.5, "lru"): 1})
+        b = _surface_from({(1.0, 0.1, "lru"): 2})
+        m = dm.merge_surfaces([a, b])
+        assert m["queries"] == 6
+        hot = dm.hot_bins(m)
+        assert hot[0]["count"] == 5 and hot[0]["warm"] == 2
+
+    def test_mismatched_binning_skipped_not_smeared(self):
+        a = _surface_from({(1.0, 0.1, "computed"): 2}, bins=8)
+        b = _surface_from({(1.0, 0.1, "computed"): 2}, bins=16)
+        m = dm.merge_surfaces([a, b])
+        assert m["queries"] == 2
+        assert m["skipped_surfaces"] == 1
+
+    def test_router_merges_heartbeat_blocks(self, tmp_path):
+        from sbr_tpu.serve.fleet import WorkerAnnouncer
+        from sbr_tpu.serve.router import Router
+
+        w0 = WorkerAnnouncer(tmp_path, "http://127.0.0.1:1", host="w0")
+        w1 = WorkerAnnouncer(tmp_path, "http://127.0.0.1:2", host="w1")
+        w0.beat(demand=_surface_from({(1.0, 0.1, "computed"): 3}))
+        w1.beat(demand=_surface_from({(1.0, 0.1, "lru"): 2,
+                                      (3.0, 0.8, "computed"): 1}))
+        router = Router(tmp_path, poll_s=0.01)
+        router.refresh_workers(force=True)
+        merged = router.fleet_demand()
+        assert merged is not None
+        assert merged["queries"] == 6
+        assert merged["workers"] == ["w0", "w1"]
+        assert router.statz()["demand"]["queries"] == 6
+        text = router.prometheus()
+        assert "sbr_demand_fleet_window_queries 6" in text
+        assert "sbr_demand_fleet_workers 2" in text
+
+    def test_router_without_demand_blocks_stays_byte_free(self, tmp_path):
+        from sbr_tpu.serve.fleet import WorkerAnnouncer
+        from sbr_tpu.serve.router import Router
+
+        WorkerAnnouncer(tmp_path, "http://127.0.0.1:1", host="w0").beat(qps=1.0)
+        router = Router(tmp_path, poll_s=0.01)
+        router.refresh_workers(force=True)
+        assert router.fleet_demand() is None
+        assert "demand" not in router.statz()
+        assert "sbr_demand" not in router.prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Prefetch advisor
+# ---------------------------------------------------------------------------
+
+
+class TestAdvisorPlan:
+    def test_plan_is_pure_and_byte_stable(self):
+        s = _surface_from({(1.0, 0.1, "computed"): 5, (2.0, 0.5, "computed"): 3})
+        p1 = dm.advisor_plan(s, None, floor=0.5)
+        p2 = dm.advisor_plan(s, None, floor=0.5)
+        assert dm.plan_bytes(p1) == dm.plan_bytes(p2)
+        assert p1["plan_fingerprint"] == p2["plan_fingerprint"]
+        assert p1["tiles"][0]["rank"] == 1
+        # The top tile names the exact hot coordinates to sweep.
+        assert p1["tiles"][0]["betas"] == [1.0] and p1["tiles"][0]["us"] == [0.1]
+
+    def test_covered_demand_scores_zero(self):
+        s = _surface_from({(1.0, 0.1, "computed"): 5})
+        cov = {"entries": 1, "pairs": [[1.0, 0.1]]}
+        plan = dm.advisor_plan(s, cov)
+        assert plan["tiles"][0]["tile_coverage"] == 1.0
+        assert plan["tiles"][0]["score"] == 0.0
+        # Uncovered: full demand weight.
+        assert dm.advisor_plan(s, None)["tiles"][0]["score"] == 5.0
+
+    def test_coverage_from_cache_dir_reads_meta_sidecars(self, tmp_path):
+        (tmp_path / "a.meta.json").write_text(json.dumps(
+            {"key": "k", "cell_tag": "t", "betas": [1.0, 2.0], "us": [0.1]}
+        ))
+        (tmp_path / "torn.meta.json").write_text("{nope")
+        cov = dm.coverage_from_cache_dir(tmp_path)
+        assert cov["entries"] == 1
+        assert cov["pairs"] == [[1.0, 0.1], [2.0, 0.1]]
+        # Missing root: None (no cache configured != empty cache).
+        assert dm.coverage_from_cache_dir(tmp_path / "nope") is None
+
+
+# ---------------------------------------------------------------------------
+# Offline replay (loadgen --trace-out rows) + the cross-process witness
+# ---------------------------------------------------------------------------
+
+
+def _trace_rows(n=30):
+    """A deterministic hot-stream trace: two hot cells + a cold tail."""
+    rows = []
+    for k in range(n):
+        if k % 3 == 0:
+            beta, u = 1.25, 0.3
+        elif k % 3 == 1:
+            beta, u = 1.25, 0.31
+        else:
+            beta, u = 0.6 + (k % 7) * 0.41, 0.8
+        rows.append({"query": k, "beta": beta, "u": u, "scenario": "mix",
+                     "kind": "plain", "source": "computed", "status": 200})
+    return rows
+
+
+class TestReplay:
+    def test_backfill_tolerant_reader(self):
+        rows = _trace_rows(12) + [
+            {"query": 99, "status": 200},            # pre-ISSUE-18 row
+            {"query": 98, "beta": float("nan"), "u": 0.1},
+            "not a dict",
+        ]
+        surface, stats = dm.replay_rows(rows)
+        assert stats == {"rows": 15, "replayed": 12, "legacy_rows": 2,
+                         "bad_rows": 1}
+        assert surface["queries"] == 12
+        # Sourceless rows would land under "unknown" (cold) — these carry it.
+        assert dm.hot_bins(surface)[0]["warm_coverage"] == 0.0
+
+    def test_replay_cli_cross_process_byte_identical_plans(self, tmp_path):
+        # THE determinism witness: two independent processes replaying the
+        # same trace write byte-identical advisor_plan.json.
+        trace = tmp_path / "trace.jsonl"
+        trace.write_text("".join(json.dumps(r) + "\n" for r in _trace_rows()))
+        plans = []
+        for name in ("a", "b"):
+            out = tmp_path / f"plan_{name}.json"
+            proc = subprocess.run(
+                [sys.executable, "-m", "sbr_tpu.obs.demand", "replay",
+                 str(trace), "--plan-out", str(out), "--json"],
+                capture_output=True, text=True, cwd=REPO,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            assert proc.returncode == 0, proc.stderr
+            doc = json.loads(proc.stdout)
+            assert doc["planned_tiles"] >= 1
+            plans.append(out.read_bytes())
+        assert plans[0] == plans[1]
+        plan = json.loads(plans[0])
+        assert plan["schema"] == dm.PLAN_SCHEMA
+        assert plan["plan_fingerprint"]
+
+    def test_replay_cli_exit_codes(self, tmp_path):
+        def replay(*argv):
+            return subprocess.run(
+                [sys.executable, "-m", "sbr_tpu.obs.demand", "replay", *argv],
+                capture_output=True, text=True, cwd=REPO,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            ).returncode
+
+        assert replay(str(tmp_path / "missing.jsonl")) == 2
+        legacy = tmp_path / "legacy.jsonl"
+        legacy.write_text('{"query": 0, "status": 200}\n')
+        assert replay(str(legacy)) == 3
+        trace = tmp_path / "t.jsonl"
+        trace.write_text("".join(json.dumps(r) + "\n" for r in _trace_rows()))
+        assert replay(str(trace)) == 0
+        # All-cold stream under a coverage floor: gate breach.
+        assert replay(str(trace), "--floor", "0.5") == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: SBR_DEMAND=0 structural no-op + on-path recording
+# ---------------------------------------------------------------------------
+
+
+class TestEngineWiring:
+    def _engine(self, **kw):
+        from sbr_tpu.serve.engine import Engine
+
+        return Engine(config=CFG, **kw)
+
+    def test_off_is_structural_noop_with_bit_identical_answers(self, monkeypatch):
+        from sbr_tpu.obs import prof
+
+        pool = [make_model_params(beta=1.2, u=0.25),
+                make_model_params(beta=2.1, u=0.6)]
+        monkeypatch.setenv("SBR_DEMAND", "1")
+        eng = self._engine()
+        try:
+            eng.start()
+            on_xi = [r.xi for r in eng.query_many(pool, scenario="mix")]
+            assert eng.demand is not None
+        finally:
+            eng.close()
+
+        monkeypatch.delenv("SBR_DEMAND", raising=False)
+        sys.modules.pop("sbr_tpu.obs.demand", None)
+        traces_before = sum(prof.trace_counts().values())
+        eng = self._engine()
+        try:
+            eng.start()
+            off_xi = [r.xi for r in eng.query_many(pool, scenario="mix")]
+            assert eng.demand is None
+            # The demand module must not even be imported...
+            assert "sbr_tpu.obs.demand" not in sys.modules
+            # ...the exposition must be byte-free of demand metrics...
+            assert "sbr_demand" not in eng.prometheus()
+            assert "demand" not in eng.statz()
+        finally:
+            eng.close()
+        # ...zero new XLA programs traced by running demand-off...
+        assert sum(prof.trace_counts().values()) == traces_before
+        # ...and answers bit-identical to the demand-on run.
+        assert all(_feq(a, b) for a, b in zip(on_xi, off_xi))
+        # (re-import for the rest of the module: `dm` stays bound)
+        import sbr_tpu.obs.demand  # noqa: F401
+
+    def test_on_records_and_lands_artifacts(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SBR_DEMAND", "1")
+        run_dir = tmp_path / "run"
+        eng = self._engine(run_dir=str(run_dir))
+        try:
+            eng.start()
+            pool = [make_model_params(beta=1.2, u=0.25),
+                    make_model_params(beta=2.1, u=0.6)]
+            eng.query_many(pool, scenario="mix")
+            eng.query_many(pool, scenario="mix")  # -> lru warm hits
+            snap = eng.demand.snapshot()
+            assert snap["queries_total"] == 4
+            assert "sbr_demand_queries_total 4" in eng.prometheus()
+            assert eng.statz()["demand"]["queries_total"] == 4
+        finally:
+            eng.close()
+        doc = json.loads((run_dir / "demand.json").read_text())
+        assert doc["totals"]["queries"] == 4
+        srcs = {}
+        for cell in doc["totals"]["cells"].values():
+            for s, v in cell["sources"].items():
+                srcs[s] = srcs.get(s, 0) + v
+        assert srcs == {"computed": 2, "lru": 2}
+        plan = json.loads((run_dir / "advisor_plan.json").read_text())
+        assert plan["schema"] == dm.PLAN_SCHEMA and plan["tiles"]
+        manifest = json.loads((run_dir / "manifest.json").read_text())
+        assert manifest["demand"]["plan"] == 1
+        assert manifest["demand"]["last_plan"] == plan["plan_fingerprint"]
+
+    def test_worker_stats_carry_demand_block_only_when_on(self, monkeypatch):
+        from sbr_tpu.serve.fleet import _worker_stats
+
+        monkeypatch.setenv("SBR_DEMAND", "1")
+        eng = self._engine()
+        try:
+            eng.start()
+            eng.query_many([make_model_params(beta=1.2, u=0.25)])
+            stats = _worker_stats(eng)
+            assert stats["demand"]["queries"] == 1
+        finally:
+            eng.close()
+        monkeypatch.delenv("SBR_DEMAND", raising=False)
+        eng = self._engine()
+        try:
+            eng.start()
+            assert "demand" not in _worker_stats(eng)
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# report demand (gate) + report gc --demand-keep (retention)
+# ---------------------------------------------------------------------------
+
+
+def _write_demand_run(tmp_path, name, counts_sources):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "demand.json").write_text(json.dumps({
+        "schema": dm.LIVE_SCHEMA,
+        "totals": _surface_from(counts_sources),
+    }))
+    return d
+
+
+class TestReportDemand:
+    def test_exit_2_bad_dir(self, tmp_path):
+        from sbr_tpu.obs.report import demand_doc
+
+        doc, code = demand_doc([tmp_path / "nope"])
+        assert code == 2 and doc["exit"] == 2
+
+    def test_exit_3_no_data(self, tmp_path):
+        from sbr_tpu.obs.report import demand_doc
+
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        doc, code = demand_doc([empty])
+        assert code == 3 and "no demand data" in doc["error"]
+
+    def test_gate_and_merge_across_runs(self, tmp_path):
+        from sbr_tpu.obs.report import demand_doc, render_demand
+
+        a = _write_demand_run(tmp_path, "a", {(1.0, 0.1, "computed"): 6})
+        b = _write_demand_run(tmp_path, "b", {(1.0, 0.1, "lru"): 4})
+        doc, code = demand_doc([a, b], floor=0.5)
+        assert code == 1  # warm coverage 0.4 under the 0.5 floor
+        assert doc["queries"] == 10
+        assert doc["hot_warm_coverage"] == 0.4
+        assert "COLD HOT-REGION" in render_demand(doc)
+        doc, code = demand_doc([a, b], floor=0.3)
+        assert code == 0
+        assert "GATE: ok" in render_demand(doc)
+        # No floor anywhere: the gate is disarmed.
+        doc, code = demand_doc([a, b])
+        assert code == 0 and doc["floor"] is None
+
+    def test_floor_env_default(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs.report import demand_doc
+
+        a = _write_demand_run(tmp_path, "a", {(1.0, 0.1, "computed"): 6})
+        monkeypatch.setenv("SBR_DEMAND_COVERAGE_FLOOR", "0.9")
+        doc, code = demand_doc([a])
+        assert code == 1 and doc["floor"] == 0.9
+
+    def test_cli_json_contract(self, tmp_path):
+        from sbr_tpu.obs import report
+
+        a = _write_demand_run(tmp_path, "a", {(1.0, 0.1, "lru"): 5})
+        code = report.main(["demand", str(a), "--floor", "0.5", "--json"])
+        assert code == 0
+
+
+class TestGcDemandKeep:
+    def _run_dir(self, root, name, status="done", rotated=3):
+        d = root / name
+        d.mkdir(parents=True)
+        (d / "manifest.json").write_text(json.dumps({"status": status}))
+        (d / "demand.json").write_text("{}")
+        (d / "advisor_plan.json").write_text("{}")
+        for i in range(rotated):
+            (d / f"demand.{i:03d}.json").write_text("{}")
+            (d / f"advisor_plan.{i:03d}.json").write_text("{}")
+        return d
+
+    def test_prunes_rotated_keeps_active_and_live_runs(self, tmp_path):
+        done = self._run_dir(tmp_path, "run_done")
+        live = self._run_dir(tmp_path, "run_live", status="running")
+        removed = dm.gc_demand_files(tmp_path, keep=1)
+        # done run: 2 of 3 rotated pruned per kind; active files untouched.
+        assert len(removed) == 4
+        assert (done / "demand.json").exists()
+        assert (done / "advisor_plan.json").exists()
+        assert not (done / "demand.000.json").exists()
+        assert (done / "demand.002.json").exists()
+        # live run (manifest "running", fresh mtime): never touched.
+        assert len(list(live.glob("demand.*.json"))) == 3
+
+    def test_report_gc_flag(self, tmp_path):
+        from sbr_tpu.obs import report
+
+        self._run_dir(tmp_path, "run_a")
+        code = report.main(["gc", str(tmp_path), "--keep", "99",
+                            "--demand-keep", "0"])
+        assert code == 0
+        assert not list((tmp_path / "run_a").glob("demand.0*.json"))
+        assert (tmp_path / "run_a" / "demand.json").exists()
+
+    def test_rotation_archives_snapshots(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs import runlog
+
+        monkeypatch.setenv("SBR_DEMAND_ROTATE_S", "5")
+        clock = [0.0]
+        run = runlog.RunContext(root=tmp_path, label="rot")
+        tr = dm.DemandTracker(window_s=60.0, bins=8, topk_n=4,
+                              time_fn=lambda: clock[0], run=run)
+        tr.record(1.0, 0.1)
+        assert tr.maybe_write(run, force=True)
+        clock[0] += 6.0
+        tr.record(2.0, 0.5)
+        assert tr.maybe_write(run, force=True)
+        run.finalize()
+        assert (Path(run.run_dir) / "demand.000.json").exists()
+        assert (Path(run.run_dir) / "demand.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# History schema 12
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema12:
+    def test_demand_metrics_whitelisted(self):
+        from sbr_tpu.obs import history
+
+        assert history.SCHEMA == 12
+        out = history.bench_metrics({
+            "value": 10.0,
+            "extra": {"demand_updates_per_sec": 5e5, "demand_merge_ms": 0.8},
+        })
+        assert out["demand_updates_per_sec"] == 5e5
+        assert out["demand_merge_ms"] == 0.8
+
+    def test_polarity(self):
+        from sbr_tpu.obs import history
+
+        assert history.polarity("demand_updates_per_sec") == 1
+        assert history.polarity("demand_merge_ms") == -1
+
+    def test_schema_1_to_11_lines_still_load_and_gate(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        rows = [{"ts": 1.0, "metrics": {"eq_per_sec": 10.0}}]  # schema-less
+        rows += [{"schema": s, "metrics": {"eq_per_sec": 10.0 + s / 10}}
+                 for s in range(2, 12)]
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        history.append({"eq_per_sec": 10.6}, path=path)
+        records = history.load(path)
+        assert [r["schema"] for r in records] == list(range(1, 13))
+        verdicts, status = history.check(records, tolerance=0.15)
+        assert status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# The advisor closes the loop (acceptance gate): plan -> sweep -> warm
+# ---------------------------------------------------------------------------
+
+
+class TestAdvisorClosesLoop:
+    def test_plan_tiles_turn_red_coverage_gate_green(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs.report import demand_doc
+        from sbr_tpu.resilience.elastic import TileCache, tile_meta
+        from sbr_tpu.serve.engine import Engine, ServeConfig
+
+        FLOOR = 0.6
+        base = make_model_params()
+        hot_cells = [(1.25, 0.3), (1.25, 0.31), (2.5, 0.55)]
+        stream = [hot_cells[k % 3] for k in range(18)]
+
+        # Phase 1 — the COLD run: every hot query computed, nothing warm.
+        # `report demand` must flag the hot region red under the floor.
+        cold = _write_demand_run(
+            tmp_path, "cold",
+            {(b, u, "computed"): sum(1 for c in stream if c == (b, u))
+             for b, u in hot_cells},
+        )
+        doc, code = demand_doc([cold], floor=FLOOR)
+        assert code == 1, "cold hot region must flag red"
+        plan = doc["advisor"]
+        assert plan["tiles"], "advisor must rank tiles for the hot region"
+
+        # Phase 2 — sweep the plan's top-ranked tiles into the tile cache:
+        # each tile's exact beta/u axes become one stored tile + cell-index
+        # sidecar (what a background elastic sweep would land).
+        cache = TileCache(tmp_path / "tile_cache")
+        for t in plan["tiles"]:
+            betas, us = t["betas"], t["us"]
+            assert betas and us, t
+            key = cache.key(base, CFG, "float64", betas, us)
+            shape = (len(betas), len(us))
+            arrays = {
+                "xi": np.full(shape, 0.25),
+                "max_aw": np.full(shape, 0.5),
+                "status": np.zeros(shape),
+            }
+            cache.store(key, arrays,
+                        meta=tile_meta(base, CFG, "float64", betas, us, key))
+
+        # Phase 3 — replay the hot stream (the stream cells the plan swept;
+        # the cold tail stays cold and unqueried) against a real engine
+        # whose only answer path is the tile cache (breaker forced open):
+        # the bridge's exact-membership lookup must serve every planned
+        # cell warm.
+        planned = {(b, u) for t in plan["tiles"]
+                   for b in t["betas"] for u in t["us"]}
+        hot_stream = [c for c in stream if c in planned]
+        assert hot_stream, (planned, stream)
+        monkeypatch.setenv("SBR_DEMAND", "1")
+        monkeypatch.setenv("SBR_TILE_CACHE_DIR", str(cache.root))
+        warm_dir = tmp_path / "warm"
+        eng = Engine(config=CFG, serve=ServeConfig(buckets=(1,)),
+                     run_dir=str(warm_dir))
+        try:
+            eng.start()
+            for _ in range(eng.breaker.threshold):
+                eng.breaker.record_failure()  # solver path DOWN
+            for b, u in hot_stream:
+                q = make_model_params(
+                    beta=b, u=u, eta=base.economic.eta,
+                    tspan=base.learning.tspan, x0=base.learning.x0,
+                )
+                res = eng.query_many([q])[0]
+                assert res.source == "tilecache", (b, u, res.source)
+        finally:
+            eng.close()
+
+        # The measured warm-hit rate on the hot region clears the floor
+        # the cold run flagged red — the loop is closed.
+        doc, code = demand_doc([warm_dir], floor=FLOOR)
+        assert code == 0, doc.get("breaches")
+        assert doc["hot_warm_coverage"] >= FLOOR
+        assert doc["queries"] == len(hot_stream)
