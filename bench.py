@@ -1212,7 +1212,7 @@ def bench_fleet(platform: str) -> dict:
         n_grid=n_grid, bisect_iters=40 if tiny else 60, seed=0,
         buckets="1,8" if tiny else "1,8,64", run_dir=None, cache_dir=None,
         platform="cpu" if platform == "cpu" else None, fleet_dir=None,
-        fleet_kill_after=None, answers_out=None,
+        fleet_kill_after=None, answers_out=None, trace_out=None,
     )
     summary = run_fleet(args)
     if summary["failures"] or summary.get("fleet_lost", 0):
@@ -1792,6 +1792,146 @@ def bench_demand(platform: str) -> dict:
     }
 
 
+def bench_prewarm(platform: str) -> dict:
+    """Self-healing prefetch workload (ISSUE 19): cold-outage vs
+    prefetched-outage warm hit rate + degraded-answer p99 + controller
+    sweep throughput.
+
+    A permanent ``serve.dispatch`` transient (the breaker-open outage
+    lever from the chaos drills) makes the solver path unavailable for
+    the whole bench. Phase 1 queries the seeded pool through an engine
+    bridged to an EMPTY tile cache — the cold outage, every hot query
+    503s. Phase 2 drains a hand-ranked advisor plan covering the pool
+    through a standalone `PrewarmController` (engine=None — always
+    admissible) → prewarm_tiles_per_sec. Phase 3 re-runs the outage
+    against the now-prefetched cache → prewarm_warm_hit_rate (fraction
+    answered ``source="tilecache"``) and prewarm_outage_p99_ms (p99 of
+    those degraded answers — the bridge's mtime-indexed sidecar lookup is
+    the outage hot path this gates). History schema 13; tiny dry-run
+    shapes zero the gated keys so reduced-shape stats never seed a
+    baseline."""
+    import hashlib
+    import tempfile
+
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.resilience import faults
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+    from sbr_tpu.serve.loadgen import build_pool
+    from sbr_tpu.serve.prewarm import PrewarmController
+
+    if _tiny():
+        pool_n, n_tiles, n_grid, n_rep = 4, 2, 64, 2
+    else:
+        pool_n, n_tiles, n_grid, n_rep = 12, 4, 128, 8
+    config = SolverConfig(n_grid=n_grid, bisect_iters=40, refine_crossings=False)
+    pool = build_pool(0, pool_n)
+
+    # A plan tile per pool chunk: the chunk's β/u axes cross-cover its
+    # points (what the demand advisor's bin tiles do at fleet scale).
+    chunk = max(pool_n // n_tiles, 1)
+    tiles = []
+    for i in range(n_tiles):
+        pts = pool[i * chunk : (i + 1) * chunk] or pool[-chunk:]
+        tiles.append({
+            "bin": f"{i},0",
+            "betas": sorted({float(p.learning.beta) for p in pts}),
+            "us": sorted({float(p.economic.u) for p in pts}),
+            "rank": i + 1,
+        })
+    plan = {"schema": "sbr-demand-advisor/1", "tiles": tiles}
+    plan["plan_fingerprint"] = hashlib.sha256(
+        json.dumps(plan, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("SBR_TILE_CACHE_DIR", "SBR_RETRY_BASE_DELAY_S",
+                  "SBR_RETRY_MAX_DELAY_S")
+    }
+    outage = {"rules": [{"point": "serve.dispatch", "kind": "transient", "p": 1.0}]}
+
+    def _outage_pass(label):
+        hits, lat_ms = 0, []
+        engine = Engine(config=config, serve=ServeConfig(buckets=(1,)))
+        try:
+            for p in pool:
+                try:
+                    r = engine.query(p, scenario=label)
+                except Exception:
+                    continue  # ladder exhausted: the 503 path
+                if r.source == "tilecache":
+                    hits += 1
+                    lat_ms.append(r.latency_s * 1e3)
+        finally:
+            engine.close()
+        return hits, lat_ms
+
+    with tempfile.TemporaryDirectory(prefix="sbr_bench_prewarm_") as tmp:
+        cache_dir = os.path.join(tmp, "tilecache")
+        plan_path = os.path.join(tmp, "advisor_plan.json")
+        with open(plan_path, "w") as fh:
+            json.dump(plan, fh)
+        try:
+            os.environ["SBR_TILE_CACHE_DIR"] = cache_dir
+            # The outage pass burns dispatch retries until the breaker
+            # opens; near-zero backoff keeps the bench honest about
+            # ladder cost rather than sleep cost.
+            os.environ["SBR_RETRY_BASE_DELAY_S"] = "0.01"
+            os.environ["SBR_RETRY_MAX_DELAY_S"] = "0.05"
+
+            faults.install(faults.FaultPlan(outage))
+            try:
+                cold_hits, _ = _outage_pass("prewarm-cold")
+            finally:
+                faults.reset()
+
+            ctl = PrewarmController(
+                engine=None, plan_file=plan_path,
+                state_root=os.path.join(tmp, "_prewarm"),
+                config=config, cache_dir=cache_dir,
+            )
+            t0 = time.perf_counter()
+            snap = ctl.drain(timeout_s=600.0)
+            drain_s = time.perf_counter() - t0
+            tiles_done = snap["counts"]["tiles_done"]
+            tiles_per_sec = tiles_done / drain_s if drain_s > 0 else 0.0
+
+            faults.install(faults.FaultPlan(outage))
+            try:
+                warm_hits, warm_lat = [], []
+                for _ in range(n_rep):
+                    h, lat = _outage_pass("prewarm-warm")
+                    warm_hits.append(h)
+                    warm_lat.extend(lat)
+            finally:
+                faults.reset()
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    total = n_rep * pool_n
+    hit_rate = sum(warm_hits) / total if total else 0.0
+    warm_lat.sort()
+    p99 = warm_lat[min(int(len(warm_lat) * 0.99), len(warm_lat) - 1)] if warm_lat else 0.0
+    _log(
+        f"prewarm: cold outage {cold_hits}/{pool_n} warm; drained "
+        f"{tiles_done} tile(s) in {drain_s:.2f}s ({snap['status']}); "
+        f"warm outage hit rate {hit_rate:.2f}, p99 {p99:.2f}ms"
+    )
+    return {
+        "prewarm_pool": pool_n,
+        "prewarm_tiles": tiles_done,
+        "prewarm_cold_hits": int(cold_hits),
+        "prewarm_plan_status": snap["status"],
+        "prewarm_warm_hit_rate": 0.0 if _tiny() else round(hit_rate, 4),
+        "prewarm_outage_p99_ms": 0.0 if _tiny() else round(p99, 3),
+        "prewarm_tiles_per_sec": 0.0 if _tiny() else round(tiles_per_sec, 3),
+    }
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -1955,6 +2095,20 @@ def _measure_inner(platform: str) -> None:
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in dem.items() if v is not None},
         )
+    try:
+        with obs.span("bench.prewarm"):
+            pw = bench_prewarm(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the self-healing prefetch workload fails.
+        _log(f"prewarm bench failed: {err!r}")
+        pw = None
+    if pw is not None:
+        obs.event(
+            "bench_prewarm",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in pw.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -2100,6 +2254,17 @@ def _measure_inner(platform: str) -> None:
                 out["extra"][k] = dem[k]
         out["extra"]["demand_merge_workers"] = dem["demand_merge_workers"]
         out["extra"]["demand_sketch_items"] = dem["demand_sketch_items"]
+    if pw is not None:
+        # Schema-13 history metrics (ISSUE 19): outage warm hit rate from
+        # prefetched tiles, degraded-answer p99, and controller sweep
+        # throughput. Tiny shapes zero the gated keys (falsy → dropped
+        # here) so reduced-shape stats never seed baselines.
+        for k in ("prewarm_warm_hit_rate", "prewarm_outage_p99_ms",
+                  "prewarm_tiles_per_sec"):
+            if pw.get(k):
+                out["extra"][k] = pw[k]
+        out["extra"]["prewarm_tiles"] = pw["prewarm_tiles"]
+        out["extra"]["prewarm_plan_status"] = pw["prewarm_plan_status"]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

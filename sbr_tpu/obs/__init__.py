@@ -99,6 +99,7 @@ from sbr_tpu.obs.runlog import (
     log_fleet,
     log_health,
     log_infomodel,
+    log_prewarm,
     log_repair,
     log_retry,
     log_scheduler,
@@ -134,6 +135,7 @@ __all__ = [
     "log_fleet",
     "log_health",
     "log_infomodel",
+    "log_prewarm",
     "log_repair",
     "log_retry",
     "log_scheduler",

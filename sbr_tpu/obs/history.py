@@ -96,10 +96,17 @@ from typing import Optional
 # histogram bin + Misra-Gries sketch update per query; higher-better by
 # the per_sec rule) and demand_merge_ms (one fleet merge of the workers'
 # heartbeat demand surfaces at the router; lower-better by the _ms rule).
+# Schema 13 adds the self-healing prefetch workload (bench.py
+# bench_prewarm): prewarm_warm_hit_rate (fraction of hot-region queries a
+# breaker-open outage answers from prefetched tiles — higher-better by
+# the hit_rate rule), prewarm_outage_p99_ms (p99 of those degraded
+# answers; lower-better by the _ms rule), and prewarm_tiles_per_sec
+# (controller sweep throughput draining an advisor plan; higher-better
+# by the per_sec rule).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1..11 history keeps gating new schema-12 appends.
-SCHEMA = 12
+# schema-1..12 history keeps gating new schema-13 appends.
+SCHEMA = 13
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -243,6 +250,14 @@ def bench_metrics(result: dict) -> dict:
         # merge cost (lower-better by the _ms rule)
         "demand_updates_per_sec",
         "demand_merge_ms",
+        # schema 13: the self-healing prefetch workload (bench.py
+        # bench_prewarm): outage warm hit rate from prefetched tiles
+        # (higher-better by the hit_rate rule), degraded-answer p99
+        # (lower-better by the _ms rule), and controller sweep throughput
+        # (higher-better by the per_sec rule)
+        "prewarm_warm_hit_rate",
+        "prewarm_outage_p99_ms",
+        "prewarm_tiles_per_sec",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):

@@ -1493,6 +1493,186 @@ def _main_demand(argv) -> int:
     return code
 
 
+def prewarm_doc(run_dir) -> tuple:
+    """Machine-readable prefetch-controller report
+    (`sbr_tpu.serve.prewarm`): folds the run's ``prewarm`` events (with
+    the manifest roll-up as fallback for a torn event log) into per-plan
+    progress, tile sources, abandonment by reason, and the final warm
+    verdict of every completed plan. Returns (doc, exit_code).
+
+    Exit codes: 0 healthy; 1 when tiles were abandoned over budget or a
+    plan completed COLD (``plan_done`` with warm < tiles — the sweep ran
+    but the hot region still can't be served from cache); 3 when the run
+    recorded no prewarm data (a prewarm gate with nothing to read must
+    not pass silently); 2 when ``run_dir`` is not a directory."""
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    try:
+        run = load_run(run_dir)
+    except (OSError, ValueError):
+        run = {"manifest": {}, "events": [], "bad_event_lines": 0}
+    events = [e for e in run["events"] if e.get("kind") == "prewarm"]
+    manifest_block = (run["manifest"] or {}).get("prewarm") or {}
+    if not events and not manifest_block:
+        return {
+            "dir": str(run_dir),
+            "error": "no prewarm data (no prewarm events or manifest "
+            "roll-up — was the run served with SBR_PREWARM=1?)",
+            "exit": 3,
+        }, 3
+
+    actions: dict = {}
+    abandoned = {"stale": 0, "budget": 0}
+    sources: dict = {}
+    plans: dict = {}
+    for e in events:
+        a = str(e.get("action") or "?")
+        actions[a] = actions.get(a, 0) + 1
+        fp = e.get("fingerprint")
+        if fp:
+            p = plans.setdefault(str(fp), {
+                "tiles": None, "tiles_done": 0, "failed_tiles": 0,
+                "adopted": 0, "warm": None, "done": False,
+                "rejected": False,
+            })
+        if a == "plan" and fp:
+            p["tiles"] = e.get("tiles")
+        elif a == "tile" and fp:
+            p["tiles_done"] += 1
+            src = str(e.get("source") or "?")
+            sources[src] = sources.get(src, 0) + 1
+        elif a == "tile_failed" and fp:
+            p["failed_tiles"] += 1
+        elif a == "adopt" and fp:
+            p["adopted"] += 1
+        elif a == "abandon":
+            reason = str(e.get("reason") or "unknown")
+            abandoned[reason] = abandoned.get(reason, 0) + int(e.get("count") or 1)
+        elif a == "plan_done" and fp:
+            p["done"] = True
+            p["warm"] = e.get("warm")
+            if e.get("tiles") is not None:
+                p["tiles"] = e.get("tiles")
+        elif a == "plan_reject" and fp:
+            p["rejected"] = True
+    if not events and manifest_block:
+        # Torn/absent event log: the manifest roll-up still gates.
+        actions = {k: v for k, v in manifest_block.items()
+                   if isinstance(v, int) and not k.startswith("abandoned_")
+                   and not k.startswith("last_")}
+        for reason in ("stale", "budget"):
+            abandoned[reason] = int(manifest_block.get(f"abandoned_{reason}") or 0)
+        fp = manifest_block.get("last_plan")
+        if fp:
+            plans[str(fp)] = {
+                "tiles": manifest_block.get("last_tiles"),
+                "tiles_done": int(manifest_block.get("tile") or 0),
+                "failed_tiles": int(manifest_block.get("tile_failed") or 0),
+                "adopted": int(manifest_block.get("adopt") or 0),
+                "warm": manifest_block.get("last_warm"),
+                "done": bool(manifest_block.get("plan_done")),
+                "rejected": bool(manifest_block.get("plan_reject")),
+            }
+
+    breaches = []
+    if abandoned.get("budget"):
+        breaches.append(
+            f"{abandoned['budget']} tile(s) abandoned over the work budget "
+            "(raise SBR_PREWARM_BUDGET_TILES/_SECONDS or shrink the plan)"
+        )
+    for fp, p in sorted(plans.items()):
+        if p["done"] and p["warm"] is not None and p["tiles"] is not None \
+                and int(p["warm"]) < int(p["tiles"]):
+            breaches.append(
+                f"plan {fp} completed cold: warm {p['warm']}/{p['tiles']} "
+                "tile(s) in the cache"
+            )
+    code = 1 if breaches else 0
+    doc = {
+        "dir": str(run_dir),
+        "actions": {k: actions[k] for k in sorted(actions)},
+        "plans": {k: plans[k] for k in sorted(plans)},
+        "sources": {k: sources[k] for k in sorted(sources)},
+        "abandoned": abandoned,
+        "bad_event_lines": run["bad_event_lines"],
+        "breaches": breaches,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_prewarm(doc: dict) -> str:
+    """Human-readable prewarm report; same exit contract as `prewarm_doc`."""
+    out = [f"run      {doc['dir']}"]
+    if doc["exit"] in (2, 3):
+        out.append(doc.get("error", "no prewarm data"))
+        return "\n".join(out)
+    plans = doc["plans"]
+    done = sum(1 for p in plans.values() if p["done"])
+    out.append(
+        f"prewarm  {len(plans)} plan(s) seen, {done} completed; "
+        f"{sum(p['tiles_done'] for p in plans.values())} tile(s) swept"
+    )
+    if doc["sources"]:
+        out.append("sources  " + ", ".join(
+            f"{k}={v}" for k, v in doc["sources"].items()
+        ))
+    if any(doc["abandoned"].values()):
+        out.append("abandoned " + ", ".join(
+            f"{k}={v}" for k, v in sorted(doc["abandoned"].items()) if v
+        ))
+    if doc.get("bad_event_lines"):
+        out.append(f"warning  {doc['bad_event_lines']} torn event line(s) skipped")
+    if plans:
+        out += ["", "PLANS"]
+        out.append(_table(
+            ["plan", "tiles", "done", "failed", "adopted", "warm", "status"],
+            [
+                [
+                    fp,
+                    "-" if p["tiles"] is None else p["tiles"],
+                    p["tiles_done"],
+                    p["failed_tiles"],
+                    p["adopted"],
+                    "-" if p["warm"] is None else p["warm"],
+                    "rejected" if p["rejected"]
+                    else ("done" if p["done"] else "in-flight"),
+                ]
+                for fp, p in sorted(plans.items())
+            ],
+        ))
+    out.append("")
+    if doc["breaches"]:
+        out.append("GATE: PREWARM DEGRADED")
+        for b in doc["breaches"]:
+            out.append(f"  {b}")
+    else:
+        out.append("GATE: ok (no budget abandonment, completed plans warm)")
+    return "\n".join(out)
+
+
+def _main_prewarm(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report prewarm",
+        description="Prefetch-controller report for one run dir "
+        "(prewarm events from sbr_tpu.serve.prewarm): per-plan sweep "
+        "progress, tile sources, adoption and abandonment; exit 1 when "
+        "tiles were abandoned over budget or a completed plan left the "
+        "hot region cold, 3 when the run recorded no prewarm data",
+    )
+    parser.add_argument("run_dir", help="obs run directory of a prewarm-enabled "
+                        "engine or standalone sweeper")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = prewarm_doc(args.run_dir)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_prewarm(doc))
+    return code
+
+
 # ---------------------------------------------------------------------------
 # Infomodel report (`infomodel` subcommand — information-model gate)
 # ---------------------------------------------------------------------------
@@ -2402,6 +2582,14 @@ def _main_gc(argv) -> int:
         "down to the N most recent per dir; live runs and the active "
         "demand.json / advisor_plan.json are never touched",
     )
+    parser.add_argument(
+        "--prewarm-keep", type=int, default=None, metavar="N", dest="prewarm_keep",
+        help="also prune completed prewarm plan-state dirs "
+        "(plan_<fingerprint>/ under SBR_PREWARM_STATE_DIR or the tile "
+        "cache's _prewarm/) down to the N most recent, plus leases whose "
+        "tile already carries a done marker; epochs with live leases or "
+        "sweeper heartbeats and the newest (active) plan are never touched",
+    )
     args = parser.parse_args(argv)
     import os
 
@@ -2452,6 +2640,14 @@ def _main_gc(argv) -> int:
         pruned = gc_demand_files(root, keep=args.demand_keep)
         print(f"removed {len(pruned)} demand artifact file(s) "
               f"(keep {args.demand_keep} per run dir)")
+        for p in pruned:
+            print(f"  {p}")
+    if args.prewarm_keep is not None:
+        from sbr_tpu.serve.prewarm import gc_prewarm_files
+
+        pruned = gc_prewarm_files(keep=args.prewarm_keep)
+        print(f"removed {len(pruned)} prewarm state path(s) "
+              f"(keep {args.prewarm_keep} plan epoch(s))")
         for p in pruned:
             print(f"  {p}")
     return 0
@@ -2968,6 +3164,8 @@ def main(argv=None) -> int:
         return _main_audit(argv[1:])
     if argv and argv[0] == "demand":
         return _main_demand(argv[1:])
+    if argv and argv[0] == "prewarm":
+        return _main_prewarm(argv[1:])
     if argv and argv[0] == "grad":
         return _main_grad(argv[1:])
     if argv and argv[0] == "infomodel":
@@ -2988,8 +3186,8 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'audit' / 'demand' / 'grad' / 'infomodel' / 'trace' / 'slo' / "
-        "'trend' / 'gc' subcommands",
+        "'audit' / 'demand' / 'prewarm' / 'grad' / 'infomodel' / 'trace' / "
+        "'slo' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

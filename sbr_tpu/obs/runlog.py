@@ -207,6 +207,10 @@ class RunContext:
         # per-query — the demand tracker aggregates in memory and only
         # its artifact writes land here.
         self.demand: dict = {}
+        # Prefetch-controller roll-up (sbr_tpu.serve.prewarm): per-action
+        # event counts, abandoned-tile counts by reason, and the last plan
+        # fingerprint acted on — what `report prewarm` gates.
+        self.prewarm: dict = {}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -608,6 +612,7 @@ class RunContext:
             "infomodel": self.infomodel or None,
             "audit": self.audit or None,
             "demand": self.demand or None,
+            "prewarm": self.prewarm or None,
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -767,6 +772,29 @@ class RunContext:
         self.demand[action] = self.demand.get(action, 0) + 1
         if action == "plan" and fields.get("fingerprint") is not None:
             self.demand["last_plan"] = fields["fingerprint"]
+
+    def log_prewarm(self, action: str = "?", **fields) -> None:
+        """Emit one prefetch-controller ``prewarm`` event
+        (`sbr_tpu.serve.prewarm`: plan adoption, tile completion,
+        abandonment, plan verdicts) and fold it into the manifest
+        roll-up: per-action counts, ``abandoned_<reason>`` tile totals
+        (what `report prewarm` gates on for reason "budget"), the last
+        plan fingerprint, and the final ``warm``/``tiles`` verdict of a
+        completed plan."""
+        self.event("prewarm", action=action, **fields)
+        self.prewarm[action] = self.prewarm.get(action, 0) + 1
+        if action == "abandon":
+            reason = str(fields.get("reason") or "unknown")
+            key = f"abandoned_{reason}"
+            self.prewarm[key] = self.prewarm.get(key, 0) + int(
+                fields.get("count") or 1
+            )
+        if action in ("plan", "plan_done") and fields.get("fingerprint"):
+            self.prewarm["last_plan"] = fields["fingerprint"]
+        if action == "plan_done":
+            for k in ("tiles", "warm", "failed"):
+                if fields.get(k) is not None:
+                    self.prewarm[f"last_{k}"] = fields[k]
 
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
@@ -1061,6 +1089,14 @@ def log_demand(action: str = "?", **fields) -> None:
     run = current_run()
     if run is not None and _trace_clean():
         run.log_demand(action, **fields)
+
+
+def log_prewarm(action: str = "?", **fields) -> None:
+    """Prefetch-controller event + manifest roll-up (no-op when telemetry
+    is off or while tracing) — the `sbr_tpu.serve.prewarm` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_prewarm(action, **fields)
 
 
 def interrupt_all() -> int:

@@ -43,6 +43,19 @@ construction — results are pure and fingerprint-keyed), the failover and
 breaker-open events are visible in the router's telemetry, and ``report
 fleet`` exits 0 on both router run dirs.
 
+``python -m sbr_tpu.resilience.chaos --prewarm`` runs the SELF-HEALING
+PREFETCH smoke (ISSUE 19): a single-worker fleet run under ``SBR_DEMAND=1``
+records ground-truth answers AND leaves a ranked ``advisor_plan.json``;
+two standalone prewarm sweepers then drain that plan into a shared tile
+cache — the first is hung mid-plan by a ``prewarm.sweep`` fault and
+SIGKILLed while holding a tile lease, and the second must ADOPT the
+stranded tile at the 2 s lease TTL and finish the plan warm. The payoff
+is a total solver outage (``serve.dispatch`` transient, p=1.0): every
+plan-covered pool point must be answered from the prefetched tile cache
+(source "tilecache"), byte-identical to the live ground truth. A final
+``SBR_PREWARM=0`` control proves the whole subsystem is a structural
+no-op when off (module never imported, ``/metrics`` byte-free).
+
 The driver itself never imports jax (workers are subprocesses), so it can
 run on a box whose accelerator stack is itself the thing being debugged.
 """
@@ -350,7 +363,9 @@ def _run_loadgen_fleet(out: Path, name: str, n_workers: int,
               "SBR_SERVE_CACHE_DIR", "SBR_TILE_CACHE_DIR",
               "SBR_TRACE_SAMPLE", "SBR_SERVE_SLO_MS",
               "SBR_AUDIT", "SBR_AUDIT_INTERVAL_S", "SBR_AUDIT_PROBES",
-              "SBR_AUDIT_REGISTRY_DIR"):
+              "SBR_AUDIT_REGISTRY_DIR",
+              "SBR_DEMAND", "SBR_PREWARM", "SBR_PREWARM_PLAN",
+              "SBR_PREWARM_STATE_DIR"):
         env.pop(k, None)
     env.update(extra_env or {})
     proc = subprocess.run(argv, env=env, timeout=timeout_s,
@@ -637,6 +652,373 @@ def main_audit(out: Path, as_json: bool) -> int:
     return 0 if ok else 1
 
 
+# Prewarm drill shape: the sweeper pool/mix mirrors _FLEET so the replay
+# can rebuild the ground-truth mapping with stdlib random alone.
+#: Hang the victim sweeper's SECOND tile attempt (after one clean tile):
+#: it dies by SIGKILL holding that tile's lease — the healer must adopt.
+_PREWARM_HANG_PLAN = {
+    "seed": 0,
+    "rules": [
+        {"point": "prewarm.sweep", "kind": "hang", "at_hits": [2],
+         "duration_s": 600},
+    ],
+}
+
+#: Total solver outage for the replay: every dispatch attempt fails, so
+#: ONLY the degradation ladder's tile-cache rung can answer.
+_PREWARM_OUTAGE_PLAN = {
+    "seed": 0,
+    "rules": [
+        {"point": "serve.dispatch", "kind": "transient", "p": 1.0},
+    ],
+}
+
+
+def _prewarm_pool_coords(seed: int, pool: int) -> list:
+    """`serve.loadgen.build_pool`'s (β, u) stream, replicated with stdlib
+    random so this jax-free driver can reason about plan coverage."""
+    import random
+
+    rng = random.Random(seed)
+    return [
+        (round(rng.uniform(0.5, 4.0), 6), round(rng.uniform(0.02, 0.9), 6))
+        for _ in range(pool)
+    ]
+
+
+def _prewarm_expanded_tiles(plan: dict) -> int:
+    """Executable (per-β) tile count of an advisor plan — the granularity
+    the prewarm controller claims leases at."""
+    return sum(
+        len({float(b) for b in (t.get("betas") or [])})
+        for t in plan.get("tiles") or []
+        if (t.get("betas") and t.get("us"))
+    )
+
+
+def _prewarm_env(cache: Path, fault_plan=None) -> dict:
+    """A scrubbed environment for prewarm-phase subprocesses: shared tile
+    cache, 2 s lease/heartbeat TTLs (adoption must happen in seconds, not
+    the production 900 s), fast retries."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SBR_TILE_CACHE_DIR": str(cache),
+        "SBR_STEAL_LEASE_TTL_S": "2",
+        "SBR_HEARTBEAT_TTL_S": "2",
+        "SBR_RETRY_BASE_DELAY_S": "0.05",
+        "SBR_PREWARM_RETRY_BASE_DELAY_S": "0.05",
+    }
+    for k in ("SBR_FAULT_PLAN", "SBR_PREWARM", "SBR_PREWARM_PLAN",
+              "SBR_PREWARM_STATE_DIR", "SBR_PREWARM_BUDGET_TILES",
+              "SBR_PREWARM_BUDGET_SECONDS", "SBR_DEMAND", "SBR_OBS"):
+        env.pop(k, None)
+    if fault_plan is not None:
+        env["SBR_FAULT_PLAN"] = json.dumps(fault_plan)
+    return env
+
+
+def _spawn_sweeper(plan_path: Path, cache: Path, run_dir=None,
+                   fault_plan=None, timeout_s: float = 60.0):
+    """Popen one ``python -m sbr_tpu.serve.prewarm --once`` sweeper and
+    wait for its readiness line; returns the process."""
+    import threading
+
+    argv = [
+        sys.executable, "-m", "sbr_tpu.serve.prewarm",
+        "--plan", str(plan_path),
+        "--n-grid", str(_FLEET["n_grid"]),
+        "--bisect-iters", str(_FLEET["bisect_iters"]),
+        "--platform", "cpu", "--once", "--json",
+        "--timeout-s", "600",
+    ]
+    if run_dir is not None:
+        argv += ["--run-dir", str(run_dir)]
+    proc = subprocess.Popen(
+        argv, env=_prewarm_env(cache, fault_plan),
+        stdout=subprocess.PIPE, stderr=sys.stderr.fileno(), text=True,
+    )
+    ready: dict = {}
+
+    def read_ready():
+        try:
+            ready.update(json.loads(proc.stdout.readline()))
+        except Exception:
+            pass
+
+    t = threading.Thread(target=read_ready, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if ready.get("role") != "prewarm":
+        proc.kill()
+        raise RuntimeError(f"sweeper failed to become ready in {timeout_s:.0f}s")
+    return proc
+
+
+def main_prewarm(out: Path, as_json: bool) -> int:
+    """The self-healing prefetch smoke (ISSUE 19): demand run leaves a
+    plan, a hung sweeper is SIGKILLed holding a lease, a peer adopts and
+    finishes warm, and a breaker-open outage is answered 100% warm,
+    byte-identical to the live ground truth. See the module docstring."""
+    import signal as _sig
+    import time as _time
+
+    checks: dict = {}
+    cache = out / "tile_cache"
+
+    def log(msg):
+        if not as_json:
+            print(msg)
+
+    log("phase 1/4: single-worker fleet under SBR_DEMAND=1 "
+        "(ground-truth answers + advisor plan) …")
+    rc1, sum1, _ans1, run1 = _run_loadgen_fleet(
+        out, "prewarm_demand", 1, extra_env={"SBR_DEMAND": "1"},
+    )
+    checks["demand_rc0"] = rc1 == 0
+    checks["demand_zero_lost"] = sum1.get("fleet_lost", 1) == 0
+    plan_path = run1.parent / (run1.name + "_workers") / "w0" / "advisor_plan.json"
+    try:
+        plan = json.loads(plan_path.read_text())
+    except (OSError, ValueError):
+        plan = {}
+    checks["advisor_plan_written"] = (
+        plan.get("schema") == "sbr-demand-advisor/1"
+        and bool(plan.get("plan_fingerprint"))
+        and bool(plan.get("tiles"))
+    )
+    # The drill needs >= 2 executable (per-β) tiles: one to land cleanly
+    # on the victim, one to be stranded under its lease and adopted.
+    n_tiles = _prewarm_expanded_tiles(plan)
+    checks["plan_multi_tile"] = n_tiles >= 2
+    pool_coords = _prewarm_pool_coords(0, _FLEET["pool"])
+    covered = set()
+    for t in plan.get("tiles") or []:
+        bs = {float(b) for b in (t.get("betas") or [])}
+        us = {float(u) for u in (t.get("us") or [])}
+        covered |= {i for i, (b, u) in enumerate(pool_coords)
+                    if b in bs and u in us}
+    checks["plan_covers_hot_points"] = len(covered) >= 1
+    if not all(checks.values()):
+        # The remaining phases would only cascade noise without a plan.
+        if as_json:
+            print(json.dumps({"ok": False, "checks": checks, "out": str(out)}))
+        else:
+            for name, passed in checks.items():
+                print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+            print(f"prewarm smoke: FAILED in phase 1 ({out})")
+        return 1
+
+    fp = plan["plan_fingerprint"]
+    plan_dir = cache / "_prewarm" / f"plan_{fp}"
+
+    log(f"phase 2/4: sweeper A drains the plan ({n_tiles} tile(s)) until a "
+        "prewarm.sweep hang, then SIGKILL while it holds a tile lease …")
+    victim = _spawn_sweeper(plan_path, cache, fault_plan=_PREWARM_HANG_PLAN,
+                            timeout_s=120.0)
+    deadline = _time.monotonic() + 600.0
+    stranded = False
+    while _time.monotonic() < deadline:
+        if victim.poll() is not None:
+            break  # finished without hanging — plan_multi_tile guard failed us
+        done = list(plan_dir.glob("done_*.json")) if plan_dir.is_dir() else []
+        leases = list(plan_dir.glob("tile_*.lease")) if plan_dir.is_dir() else []
+        if done and leases:
+            stranded = True
+            break
+        _time.sleep(0.25)
+    _time.sleep(1.0)  # let the hang engage inside the leased attempt
+    checks["victim_hung_holding_lease"] = stranded and victim.poll() is None
+    try:
+        os.kill(victim.pid, _sig.SIGKILL)
+    except OSError:
+        pass
+    victim.wait(timeout=30)
+    checks["victim_stranded_lease"] = bool(list(plan_dir.glob("tile_*.lease")))
+
+    log("phase 3/4: sweeper B adopts the stranded tile at the 2 s lease "
+        "TTL and finishes the plan warm …")
+    heal_run = out / "obs_prewarm_heal" / "run"
+    healer = _spawn_sweeper(plan_path, cache, run_dir=heal_run,
+                            timeout_s=120.0)
+    heal_out, _ = healer.communicate(timeout=900)
+    try:
+        snap = json.loads(heal_out.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        snap = {}
+    counts = snap.get("counts") or {}
+    checks["healer_rc0"] = healer.returncode == 0
+    checks["healer_plan_done"] = snap.get("status") == "done"
+    checks["healer_adopted_stranded_tile"] = counts.get("adopted", 0) >= 1
+    checks["healer_zero_failed"] = counts.get("failed", 0) == 0
+    checks["healer_all_warm"] = (
+        snap.get("warm") is not None
+        and snap.get("warm") == snap.get("tiles_total") == n_tiles
+    )
+    rc_pw, doc_pw = _report("prewarm", heal_run)
+    checks["report_prewarm_rc0"] = rc_pw == 0
+    checks["report_adoption_visible"] = any(
+        (p or {}).get("adopted", 0) >= 1
+        for p in (doc_pw.get("plans") or {}).values()
+    )
+
+    log("phase 4/4: total solver outage — every plan-covered pool point "
+        "must be served from the prefetched cache, byte-identical …")
+    replay_json = out / "prewarm_replay.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "sbr_tpu.resilience.chaos",
+         "--worker-prewarm-replay", str(plan_path),
+         str(out / "prewarm_demand_answers.json"), str(replay_json)],
+        env=_prewarm_env(cache, fault_plan=_PREWARM_OUTAGE_PLAN),
+        timeout=900, capture_output=True, text=True,
+    )
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    checks["replay_rc0"] = proc.returncode == 0
+    try:
+        replay = json.loads(replay_json.read_text())
+    except (OSError, ValueError):
+        replay = {}
+    checks["replay_all_covered_warm"] = (
+        bool(replay.get("covered"))
+        and replay.get("warm") == replay.get("covered")
+    )
+    checks["replay_answers_bit_identical"] = (
+        replay.get("compared", 0) >= 1 and not replay.get("mismatches")
+    )
+
+    # SBR_PREWARM=0 control: the subsystem off is a STRUCTURAL no-op.
+    ctrl = subprocess.run(
+        [sys.executable, "-c",
+         "from sbr_tpu.utils.platform import pin_cpu_platform;"
+         "pin_cpu_platform();"
+         "import sys;"
+         "from sbr_tpu.models.params import SolverConfig;"
+         "from sbr_tpu.serve.engine import Engine, ServeConfig;"
+         "eng = Engine(config=SolverConfig(n_grid=64, bisect_iters=30,"
+         " refine_crossings=False), serve=ServeConfig(buckets=(1,)));"
+         "assert eng.prewarm is None;"
+         "assert 'sbr_prewarm' not in eng.prometheus();"
+         "assert 'prewarm' not in eng.statz();"
+         "eng.close();"
+         "assert 'sbr_tpu.serve.prewarm' not in sys.modules;"
+         "print('structural-noop-ok')"],
+        env=_prewarm_env(cache), timeout=300, capture_output=True, text=True,
+    )
+    if ctrl.stderr:
+        sys.stderr.write(ctrl.stderr)
+    checks["prewarm_off_structural_noop"] = (
+        ctrl.returncode == 0 and "structural-noop-ok" in ctrl.stdout
+    )
+
+    ok = all(checks.values())
+    if as_json:
+        print(json.dumps({"ok": ok, "checks": checks, "out": str(out)}))
+    else:
+        for name, passed in checks.items():
+            print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+        print(
+            "prewarm smoke: "
+            + ("OK — a killed sweeper's tile was adopted and the outage "
+               "was answered 100% warm, byte-identical" if ok else "FAILED")
+            + f" ({out})"
+        )
+        print(f"prefetch story: python -m sbr_tpu.obs.report prewarm {heal_run}")
+    return 0 if ok else 1
+
+
+def _worker_prewarm_replay(plan_path: str, answers_path: str,
+                           out_json: str) -> int:
+    """Hidden worker: breaker-open replay against the prefetched cache.
+
+    Queries every loadgen pool point through an engine whose EVERY solver
+    dispatch fails (the parent plants the serve.dispatch transient), and
+    records, per plan-covered point, whether the degradation ladder
+    answered it from the tile cache and whether (xi, aw_max, status)
+    equal the fault-free fleet ground truth. NaN is normalized to None on
+    both sides (the answers-JSON convention), so equality of the parsed
+    values IS bit equality of the served doubles."""
+    import math
+
+    from sbr_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform()
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+    from sbr_tpu.serve.loadgen import build_pool, query_mix
+
+    plan = json.loads(Path(plan_path).read_text())
+    answers = json.loads(Path(answers_path).read_text())
+    pool = build_pool(0, _FLEET["pool"])
+    mix = query_mix(0, _FLEET["pool"], _FLEET["queries"])
+    truth: dict = {}
+    for pos, idx in enumerate(mix):
+        a = answers[pos] if pos < len(answers) else None
+        if isinstance(a, dict) and "xi" in a and not a.get("degraded"):
+            truth.setdefault(idx, a)
+
+    covered = set()
+    for t in plan.get("tiles") or []:
+        bs = {float(b) for b in (t.get("betas") or [])}
+        us = {float(u) for u in (t.get("us") or [])}
+        covered |= {
+            i for i, p in enumerate(pool)
+            if float(p.learning.beta) in bs and float(p.economic.u) in us
+        }
+
+    def norm(v):
+        if v is None:
+            return None
+        f = float(v)
+        return None if math.isnan(f) else f
+
+    config = SolverConfig(
+        n_grid=_FLEET["n_grid"], bisect_iters=_FLEET["bisect_iters"],
+        refine_crossings=False,
+    )
+    results: dict = {}
+    eng = Engine(config=config, serve=ServeConfig(buckets=(1,)))
+    try:
+        for i, p in enumerate(pool):
+            try:
+                r = eng.query(p)
+            except Exception as err:  # noqa: BLE001 — a cold point, recorded
+                results[i] = {"error": repr(err)}
+                continue
+            results[i] = {
+                "xi": norm(r.xi), "aw_max": norm(r.aw_max),
+                "status": int(r.status), "source": r.source,
+                "degraded": bool(r.degraded),
+            }
+    finally:
+        eng.close()
+
+    doc = {
+        "covered": sorted(covered),
+        "warm": sorted(
+            i for i in covered if (results.get(i) or {}).get("source") == "tilecache"
+        ),
+        "compared": 0,
+        "mismatches": [],
+        "results": {str(i): r for i, r in results.items()},
+    }
+    for i in sorted(covered):
+        t = truth.get(i)
+        r = results.get(i) or {}
+        if t is None or "error" in r:
+            continue
+        doc["compared"] += 1
+        for field in ("xi", "aw_max", "status"):
+            tv = t.get(field) if field == "status" else norm(t.get(field))
+            rv = r.get(field)
+            if tv != rv:
+                doc["mismatches"].append(
+                    {"pool": i, "field": field, "truth": tv, "replay": rv}
+                )
+    Path(out_json).write_text(json.dumps(doc, indent=2, sort_keys=True))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sbr_tpu.resilience.chaos",
@@ -665,14 +1047,28 @@ def main(argv=None) -> int:
         "within 2 cycles, worker quarantined by the router, zero lost, "
         "answers byte-identical to the audit-off control (ISSUE 17)",
     )
+    parser.add_argument(
+        "--prewarm", action="store_true",
+        help="run the SELF-HEALING-PREFETCH smoke instead: demand run "
+        "leaves an advisor plan, a sweeper is SIGKILLed mid-plan holding "
+        "a tile lease, a peer adopts and finishes warm, and a total "
+        "solver outage is answered 100%% from the prefetched cache, "
+        "byte-identical to the live ground truth (ISSUE 19)",
+    )
     parser.add_argument("--worker", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
     parser.add_argument("--worker-elastic", nargs=2, metavar=("CKPT", "NPZ"), help=argparse.SUPPRESS)
+    parser.add_argument(
+        "--worker-prewarm-replay", nargs=3, metavar=("PLAN", "ANSWERS", "OUT"),
+        help=argparse.SUPPRESS,
+    )
     args = parser.parse_args(argv)
 
     if args.worker:
         return _worker(*args.worker)
     if args.worker_elastic:
         return _worker_elastic(*args.worker_elastic)
+    if args.worker_prewarm_replay:
+        return _worker_prewarm_replay(*args.worker_prewarm_replay)
 
     out = Path(args.out)
     if out.exists():
@@ -685,6 +1081,8 @@ def main(argv=None) -> int:
         return main_fleet(out, args.json)
     if args.audit:
         return main_audit(out, args.json)
+    if args.prewarm:
+        return main_prewarm(out, args.json)
 
     checks: dict = {}
 

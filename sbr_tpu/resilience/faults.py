@@ -37,6 +37,14 @@ point                  planted in
                        result — the audit MUST flag drift and the router
                        MUST quarantine the worker; use ``match`` to hit
                        one probe)
+``prewarm.plan_load``  `serve.prewarm.load_plan`, before every advisor-plan
+                       read/parse (a fired transient = a torn/unreadable
+                       plan; the controller must keep its current epoch)
+``prewarm.sweep``      `serve.prewarm.PrewarmController._run_tile`, per
+                       tile attempt with the tile id as target (inside the
+                       retry scope; ``hang`` + SIGKILL is how the chaos
+                       ``--prewarm`` drill strands a lease for a peer to
+                       adopt)
 =====================  ====================================================
 
 Fault kinds:

@@ -457,6 +457,20 @@ class Engine:
                 run=self._run, coverage_fn=self._demand_coverage
             )
 
+        # Self-healing prefetch controller (ISSUE 19): consumes the demand
+        # advisor's ranked tile plan and pre-warms the tile cache in the
+        # idle windows, so the degradation ladder answers outages warm.
+        # Same structural-no-op contract: SBR_PREWARM=0 (the default)
+        # never imports the module — no thread, no leases, /metrics
+        # byte-free of ``sbr_prewarm``, answers bit-identical.
+        self.prewarm = None
+        if os.environ.get("SBR_PREWARM", "").strip() not in ("", "0"):
+            from sbr_tpu.serve import prewarm as _prewarm
+
+            self.prewarm = _prewarm.PrewarmController(
+                engine=self, config=self.config, dtype=self.dtype,
+            )
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Engine":
         if self._thread is None:
@@ -466,6 +480,8 @@ class Engine:
             self._thread.start()
         if self.audit is not None:
             self.audit.start()
+        if self.prewarm is not None:
+            self.prewarm.start()
         return self
 
     def close(self) -> None:
@@ -490,6 +506,8 @@ class Engine:
                 if t is not _SHUTDOWN:
                     t.error = RuntimeError("engine closed before the query was served")
                     t.event.set()
+        if self.prewarm is not None:
+            self.prewarm.close()
         if self.audit is not None:
             self.audit.close()
         if self.demand is not None:
@@ -773,6 +791,9 @@ class Engine:
         # SBR_DEMAND=0 engines have no tracker, so no sbr_demand_* lines.
         if self.demand is not None:
             hist_lines = list(hist_lines or []) + self.demand.prometheus_lines()
+        # Prefetch controller gauges: byte-free when SBR_PREWARM=0.
+        if self.prewarm is not None:
+            hist_lines = list(hist_lines or []) + self.prewarm.prometheus_lines()
         if hist_lines:
             text = text.rstrip("\n") + "\n" + "\n".join(hist_lines) + "\n"
         return text
@@ -816,6 +837,7 @@ class Engine:
             },
             **({"audit": self.audit.snapshot()} if self.audit is not None else {}),
             **({"demand": self.demand.snapshot()} if self.demand is not None else {}),
+            **({"prewarm": self.prewarm.snapshot()} if self.prewarm is not None else {}),
         }
 
     def _demand_coverage(self) -> Optional[dict]:

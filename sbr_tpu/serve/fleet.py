@@ -257,12 +257,22 @@ class TileCacheBridge:
     The cache's entries are content-addressed whole tiles; what makes them
     addressable per-cell is the ``<key>.meta.json`` sidecar every store
     now writes (`resilience.elastic.TileCache.store`): the cell tag plus
-    the tile's actual β/u axes. The bridge scans those sidecars lazily
-    (re-scanned when older than ``refresh_s``), indexes them by tag, and
-    on `lookup` returns the verified entry's exact (β, u) cell — or None
-    on any miss, mismatch, or corruption (the ladder then falls through
-    to 503). All reads go through `TileCache.load`, so sha256
+    the tile's actual β/u axes. The bridge keeps an mtime-invalidated
+    in-memory index of those sidecars (ISSUE 19 satellite — prefetch
+    makes the cache dir large, and the previous full rescan-and-parse
+    per refresh put an O(files) cost on the outage hot path): only shard
+    directories whose mtime moved since the last refresh are re-listed,
+    and only new/rewritten sidecar files re-parsed. On `lookup` it
+    returns the verified entry's exact (β, u) cell — or None on any
+    miss, mismatch, or corruption (the ladder then falls through to
+    503). All reads go through `TileCache.load`, so sha256
     verify-on-read and quarantine-on-mismatch apply unchanged."""
+
+    #: Directories whose mtime is within this many seconds of "now" are
+    #: re-listed even when the mtime looks unchanged — a store landing in
+    #: the same filesystem-mtime-granularity tick as a scan must not be
+    #: missed forever.
+    MTIME_SLACK_S = 3.0
 
     def __init__(self, cache_dir=None, refresh_s: float = 5.0) -> None:
         from sbr_tpu.resilience.elastic import default_tile_cache
@@ -271,25 +281,78 @@ class TileCacheBridge:
         self.refresh_s = refresh_s
         self._index: Dict[str, list] = {}  # cell_tag -> [meta, ...]
         self._scanned_at: Optional[float] = None
+        self._dir_mtimes: Dict[str, float] = {}  # dir -> mtime at last list
+        # sidecar path -> {"mtime", "tag", "meta"} (tag None = torn/alien,
+        # cached so the file is only re-parsed when its mtime moves)
+        self._entries: Dict[str, dict] = {}
 
     @property
     def available(self) -> bool:
         return self.cache is not None
 
     def _scan(self) -> None:
-        index: Dict[str, list] = {}
-        for meta_path in self.cache.root.rglob("*.meta.json"):
+        now_wall = time.time()
+        root = self.cache.root
+        dirs = [root]
+        try:
+            dirs += [p for p in root.iterdir() if p.is_dir()]
+        except OSError:
+            dirs = [root]
+        seen_dirs = set()
+        for d in dirs:
+            dkey = str(d)
+            seen_dirs.add(dkey)
             try:
-                meta = json.loads(meta_path.read_text())
-                tag = meta["cell_tag"]
-                betas = [float(b) for b in meta["betas"]]
-                us = [float(u) for u in meta["us"]]
-                key = str(meta["key"])
-            except (OSError, ValueError, KeyError, TypeError):
-                continue  # torn/alien sidecar: not an index entry
-            index.setdefault(tag, []).append(
-                {"key": key, "betas": betas, "us": us}
-            )
+                mtime = d.stat().st_mtime
+            except OSError:
+                continue
+            prev = self._dir_mtimes.get(dkey)
+            if prev is not None and mtime == prev \
+                    and now_wall - mtime > self.MTIME_SLACK_S:
+                continue  # nothing stored/removed here since the last list
+            self._dir_mtimes[dkey] = mtime
+            try:
+                files = list(d.glob("*.meta.json"))
+            except OSError:
+                continue
+            live = set()
+            for meta_path in files:
+                fkey = str(meta_path)
+                live.add(fkey)
+                try:
+                    fm = meta_path.stat().st_mtime
+                except OSError:
+                    continue
+                ent = self._entries.get(fkey)
+                if ent is not None and ent["mtime"] == fm:
+                    continue
+                try:
+                    meta = json.loads(meta_path.read_text())
+                    parsed = {
+                        "key": str(meta["key"]),
+                        "betas": [float(b) for b in meta["betas"]],
+                        "us": [float(u) for u in meta["us"]],
+                    }
+                    tag = str(meta["cell_tag"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    tag, parsed = None, None  # torn/alien sidecar
+                self._entries[fkey] = {"mtime": fm, "tag": tag, "meta": parsed}
+            for fkey in [
+                k for k in self._entries
+                if os.path.dirname(k) == dkey and k not in live
+            ]:
+                del self._entries[fkey]  # sidecar removed (gc/quarantine)
+        for dkey in [k for k in self._dir_mtimes if k not in seen_dirs]:
+            del self._dir_mtimes[dkey]
+            for fkey in [
+                k for k in self._entries if os.path.dirname(k) == dkey
+            ]:
+                del self._entries[fkey]
+        index: Dict[str, list] = {}
+        for fkey in sorted(self._entries):
+            ent = self._entries[fkey]
+            if ent["tag"] is not None:
+                index.setdefault(ent["tag"], []).append(ent["meta"])
         self._index = index
         self._scanned_at = time.monotonic()
 
@@ -447,6 +510,12 @@ def _worker_stats(engine) -> dict:
         # fleet demand surface.
         **({"demand": engine.demand.heartbeat_block()}
            if getattr(engine, "demand", None) is not None else {}),
+        # Prefetch-controller progress (ISSUE 19): plan fingerprint +
+        # tiles done/abandoned, absent entirely when SBR_PREWARM is off;
+        # the router rolls present blocks up into the /statz prewarm
+        # summary.
+        **({"prewarm": engine.prewarm.heartbeat_block()}
+           if getattr(engine, "prewarm", None) is not None else {}),
     }
 
 

@@ -427,9 +427,10 @@ def run_fleet(args) -> dict:
     if args.answers_out:
         with open(args.answers_out, "w") as fh:
             json.dump(answers, fh)
-    if args.trace_out:
-        _write_trace_rows(args.trace_out, trace_rows)
-        summary["trace_out"] = args.trace_out
+    trace_out = getattr(args, "trace_out", None)  # optional, like audit_fault
+    if trace_out:
+        _write_trace_rows(trace_out, trace_rows)
+        summary["trace_out"] = trace_out
         summary["traced_queries"] = sum(
             1 for r in trace_rows if r is not None and r.get("trace_id")
         )

@@ -709,10 +709,12 @@ class Router:
                     "qps": (w.last_hb or {}).get("qps"),
                     "quarantined": w.quarantined,
                     "audit": (w.last_hb or {}).get("audit"),
+                    "prewarm": (w.last_hb or {}).get("prewarm"),
                 }
                 for h, w in self._workers.items()
             }
         fleet_demand = self.fleet_demand()
+        fleet_prewarm = self.fleet_prewarm()
         return {
             "schema": SCHEMA,
             "ts": round(time.time(), 3),
@@ -729,6 +731,49 @@ class Router:
             # when no worker heartbeats a demand block, so a demand-off
             # fleet's /statz and fleet.json stay byte-free of the key.
             **({"demand": fleet_demand} if fleet_demand is not None else {}),
+            # Fleet prewarm roll-up (ISSUE 19): same absent-when-off
+            # contract — a prewarm-off fleet's /statz stays byte-free.
+            **({"prewarm": fleet_prewarm} if fleet_prewarm is not None else {}),
+        }
+
+    def fleet_prewarm(self) -> "Optional[dict]":
+        """Roll the workers' heartbeat prewarm blocks (ISSUE 19) up into
+        one fleet view: per-plan progress summed across sweepers plus the
+        worst per-worker status. Returns None when no worker published a
+        block — a prewarm-off fleet keeps the structural no-op."""
+        with self._workers_lock:
+            blocks = [
+                ((w.last_hb or {}).get("prewarm"), h)
+                for h, w in sorted(self._workers.items())
+            ]
+        blocks = [(b, h) for b, h in blocks if isinstance(b, dict)]
+        if not blocks:
+            return None
+        plans: Dict[str, dict] = {}
+        worst, worst_rank = "idle", 0
+        ranks = {"rejected": 3, "budget_exhausted": 3, "sweeping": 2,
+                 "done": 1, "no_cache": 1, "idle": 0}
+        for b, host in blocks:
+            status = str(b.get("status") or "idle")
+            if ranks.get(status, 0) > worst_rank:
+                worst, worst_rank = status, ranks.get(status, 0)
+            fp = b.get("plan")
+            if fp:
+                p = plans.setdefault(
+                    str(fp),
+                    {"tiles_done": 0, "tiles_total": 0, "abandoned": 0,
+                     "workers": []},
+                )
+                p["tiles_done"] += int(b.get("tiles_done") or 0)
+                p["tiles_total"] = max(
+                    p["tiles_total"], int(b.get("tiles_total") or 0)
+                )
+                p["abandoned"] += int(b.get("abandoned") or 0)
+                p["workers"].append(host)
+        return {
+            "status": worst,
+            "workers": [h for _, h in blocks],
+            "plans": plans,
         }
 
     def fleet_demand(self) -> "Optional[dict]":
@@ -785,6 +830,20 @@ class Router:
                 f"sbr_demand_fleet_hot_bins {len(hot)}",
                 "# TYPE sbr_demand_fleet_hot_warm_coverage gauge",
                 f"sbr_demand_fleet_hot_warm_coverage {cov:g}",
+            ]
+        # Fleet prewarm gauges (ISSUE 19): same byte-free-when-off rule.
+        prewarm = self.fleet_prewarm()
+        if prewarm is not None:
+            plans = prewarm.get("plans") or {}
+            lines += [
+                "# TYPE sbr_prewarm_fleet_workers gauge",
+                f"sbr_prewarm_fleet_workers {len(prewarm.get('workers') or [])}",
+                "# TYPE sbr_prewarm_fleet_tiles_done gauge",
+                "sbr_prewarm_fleet_tiles_done "
+                f"{sum(p['tiles_done'] for p in plans.values())}",
+                "# TYPE sbr_prewarm_fleet_tiles_abandoned gauge",
+                "sbr_prewarm_fleet_tiles_abandoned "
+                f"{sum(p['abandoned'] for p in plans.values())}",
             ]
         return "\n".join(lines) + "\n"
 

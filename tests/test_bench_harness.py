@@ -11,6 +11,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -36,6 +38,7 @@ def _run(script: str) -> dict:
     return json.loads(lines[0])
 
 
+@pytest.mark.slow
 def test_bench_emits_contract_json():
     d = _run("bench.py")
     assert d["metric"] == "beta_u_grid_equilibria_per_sec"
@@ -258,6 +261,7 @@ def _run_ablation(script: str, args, tmp_path, timeout=560, extra_env=None) -> d
     return json.loads(art.read_text())
 
 
+@pytest.mark.slow
 def test_ablate_compaction_contract(tmp_path):
     d = _run_ablation("benchmarks/ablate_compaction.py", [20000, 8, 12], tmp_path)
     assert set(d["parts_ms"]) >= {
@@ -275,6 +279,7 @@ def test_ablate_compaction_contract(tmp_path):
     assert d["verdict"] in e2e or d["verdict"] == "scatter_b1x"
 
 
+@pytest.mark.slow
 def test_ablate_max_degree_contract(tmp_path):
     d = _run_ablation("benchmarks/ablate_max_degree.py", [20000, 12], tmp_path)
     per = d["per_max_degree"]
@@ -284,6 +289,7 @@ def test_ablate_max_degree_contract(tmp_path):
     assert d["best_max_degree"] in (64, 256, 512, 1024)
 
 
+@pytest.mark.slow
 def test_census_calibration_contract(tmp_path):
     d = _run_ablation(
         "benchmarks/census_calibration.py", ["--quick"], tmp_path, timeout=560

@@ -42,17 +42,17 @@ def test_append_load_round_trip(tmp_path):
     )
     assert out == p
     (rec,) = history.load(p)
-    # schema 12 (ISSUE 18): the workload-demand observatory joined the
-    # record (11 added the numerics audit, 10 information models, 9
-    # composable scenarios, 8 differentiable equilibria, 7 the fleet SLO
-    # split, 6 mega-agents generation, 5 adaptive numerics, 4 elastic
-    # sweeps, 3 serving, 2 memory); the key set only grew, and
-    # schema-1..11/-less lines still load (tests/test_mem.py,
-    # tests/test_serve.py, tests/test_elastic.py, tests/test_numerics.py,
-    # tests/test_graphgen.py, tests/test_fleet.py, tests/test_grad.py,
-    # tests/test_scenario.py, tests/test_infomodels.py,
-    # tests/test_audit.py, tests/test_demand.py).
-    assert rec["schema"] == history.SCHEMA == 12
+    # schema 13 (ISSUE 19): the self-healing prefetch workload joined the
+    # record (12 added the demand observatory, 11 the numerics audit, 10
+    # information models, 9 composable scenarios, 8 differentiable
+    # equilibria, 7 the fleet SLO split, 6 mega-agents generation, 5
+    # adaptive numerics, 4 elastic sweeps, 3 serving, 2 memory); the key
+    # set only grew, and schema-1..12/-less lines still load
+    # (tests/test_mem.py, tests/test_serve.py, tests/test_elastic.py,
+    # tests/test_numerics.py, tests/test_graphgen.py, tests/test_fleet.py,
+    # tests/test_grad.py, tests/test_scenario.py, tests/test_infomodels.py,
+    # tests/test_audit.py, tests/test_demand.py, tests/test_prewarm.py).
+    assert rec["schema"] == history.SCHEMA == 13
     assert rec["label"] == "x" and rec["platform"] == "cpu"
     # only finite numerics survive; bools coerce to gateable ints
     assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}

@@ -1,0 +1,535 @@
+"""Self-healing prefetch controller tests (ISSUE 19): plan-driven sweeps
+through the elastic lease substrate, the per-β tile expansion that makes
+prefetched cells tag-match live pool queries, epoch staleness, work
+budgets, fail-closed program versioning, `report prewarm` gating, prewarm
+state gc, the TileCacheBridge incremental sidecar index, the scenario
+(non-baseline) sidecar refusal, and the SBR_PREWARM=0 structural no-op.
+
+The expensive part is the one real sweep in the module-scoped `drained`
+fixture (one (1, 2)-tile compile, reused by the re-drain / adoption /
+bridge tests via the shared global tile cache — re-sweeps are "cache"
+hits, not compiles).
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.obs.report import prewarm_doc
+from sbr_tpu.resilience import faults
+from sbr_tpu.scenario.spec import ScenarioSpec
+from sbr_tpu.serve import prewarm
+from sbr_tpu.serve.fleet import TileCacheBridge
+from sbr_tpu.serve.prewarm import (
+    PLAN_SCHEMA,
+    PrewarmController,
+    _plan_tiles,
+    gc_prewarm_files,
+    load_plan,
+)
+
+CFG = SolverConfig(n_grid=96, bisect_iters=30, refine_crossings=False)
+
+BETAS = (0.8, 1.6)
+US = (0.1, 0.3)
+FP = "feedbeefcafe0119"
+
+
+def _plan(tiles, fp=FP, **extra) -> dict:
+    return {"schema": PLAN_SCHEMA, "plan_fingerprint": fp,
+            "tiles": tiles, **extra}
+
+
+def _hot_tile(betas=BETAS, us=US, rank=1) -> dict:
+    return {"bin": "3,1", "betas": list(betas), "us": list(us), "rank": rank}
+
+
+def _write_plan(path: Path, plan: dict) -> Path:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(plan))
+    return path
+
+
+def _controller(plan_path, cache_dir, **kw) -> PrewarmController:
+    kw.setdefault("config", CFG)
+    kw.setdefault("ttl_s", 60)
+    return PrewarmController(plan_file=plan_path, cache_dir=str(cache_dir), **kw)
+
+
+@pytest.fixture(scope="module")
+def drained(tmp_path_factory):
+    """One drained two-tile plan (per-β expansion of a single hot bin)
+    and the global tile cache it prefetched into."""
+    tmp = tmp_path_factory.mktemp("prewarm")
+    cache_dir = tmp / "tile_cache"
+    plan_path = _write_plan(tmp / "advisor_plan.json", _plan([_hot_tile()]))
+    ctl = _controller(plan_path, cache_dir)
+    snap = ctl.drain(timeout_s=600)
+    ctl.close()
+    return tmp, cache_dir, plan_path, snap
+
+
+# ---------------------------------------------------------------------------
+# Plan loading + per-β expansion
+# ---------------------------------------------------------------------------
+
+
+class TestPlanLoading:
+    def test_load_plan_validates(self, tmp_path):
+        ok = _write_plan(tmp_path / "ok.json", _plan([_hot_tile()]))
+        assert load_plan(ok)["plan_fingerprint"] == FP
+        assert load_plan(tmp_path / "missing.json") is None
+        torn = tmp_path / "torn.json"
+        torn.write_text('{"schema": "sbr-demand-adv')
+        assert load_plan(torn) is None
+        alien = _write_plan(tmp_path / "alien.json",
+                            {"schema": "other/9", "plan_fingerprint": "x",
+                             "tiles": []})
+        assert load_plan(alien) is None
+
+    def test_plan_load_fault_point_returns_none(self, tmp_path):
+        ok = _write_plan(tmp_path / "ok.json", _plan([_hot_tile()]))
+        faults.install(faults.FaultPlan(
+            {"rules": [{"point": "prewarm.plan_load", "kind": "transient"}]}
+        ))
+        try:
+            assert load_plan(ok) is None
+        finally:
+            faults.reset()
+
+    def test_per_beta_expansion_and_lease_order(self):
+        # One hot bin with two βs MUST become two executable tiles — the
+        # cell tag embeds the β-derived η/tspan, so a single-base sweep
+        # could only ever match one β's queries.
+        tiles = _plan_tiles(_plan([
+            {"bin": "3,1", "betas": [1.6, 0.8], "us": [0.3, 0.1], "rank": 2},
+            {"bin": "0,0", "betas": [2.4], "us": [0.5], "rank": 1},
+            {"bin": "junk"},  # malformed: skipped, never fatal
+        ]))
+        assert [t["id"] for t in tiles] == [
+            "t00000_00000", "t00001_00000", "t00002_00000"
+        ]
+        assert [t["lease"] for t in tiles] == [(0, 0), (1, 0), (2, 0)]
+        # rank order first, then sorted β within a bin.
+        assert tiles[0]["betas"] == [2.4]
+        assert tiles[1]["betas"] == [0.8] and tiles[2]["betas"] == [1.6]
+        assert tiles[1]["us"] == [0.1, 0.3]  # axes sorted per tile
+
+
+# ---------------------------------------------------------------------------
+# Drain → warm bridge (the tentpole end-to-end)
+# ---------------------------------------------------------------------------
+
+
+class TestDrainAndBridge:
+    def test_drain_completes_warm(self, drained):
+        _, _, _, snap = drained
+        assert snap["status"] == "done"
+        assert snap["tiles_total"] == 2  # per-β expansion of one hot bin
+        assert snap["tiles_done"] == 2
+        assert snap["warm"] == 2
+        assert snap["counts"]["failed"] == 0
+
+    def test_bridge_serves_pool_style_queries(self, drained):
+        # THE coverage contract: a loadgen pool point is
+        # make_model_params(beta=β, u=u) — β-derived η/tspan, NOT a pinned
+        # base — and every plan cell must tag-match such a query.
+        _, cache_dir, _, _ = drained
+        bridge = TileCacheBridge(cache_dir)
+        for b in BETAS:
+            for u in US:
+                rec = bridge.lookup(make_model_params(beta=b, u=u), CFG,
+                                    "float64")
+                assert rec is not None, f"cold cell ({b}, {u})"
+        # Off-plan β: no tile covers it, the bridge must refuse.
+        assert bridge.lookup(make_model_params(beta=3.3, u=US[0]), CFG,
+                             "float64") is None
+
+    def test_done_markers_and_no_leases_left(self, drained):
+        _, cache_dir, _, _ = drained
+        plan_dir = cache_dir / "_prewarm" / f"plan_{FP}"
+        done = sorted(p.name for p in plan_dir.glob("done_*.json"))
+        assert done == ["done_t00000_00000.json", "done_t00001_00000.json"]
+        doc = json.loads((plan_dir / done[0]).read_text())
+        assert doc["plan"] == FP and "program_version" in doc
+        assert not list(plan_dir.glob("*.lease"))
+
+    def test_second_sweeper_skips_done_tiles(self, drained):
+        # Same rendezvous dir: done markers make a re-drain a no-op sweep.
+        tmp, cache_dir, plan_path, _ = drained
+        ctl = _controller(plan_path, cache_dir)
+        snap = ctl.drain(timeout_s=60)
+        ctl.close()
+        assert snap["status"] == "done"
+        assert snap["tiles_done"] == 0  # nothing re-run
+        assert snap["warm"] == 2  # warm verdict re-verified from the cache
+
+    def test_expired_lease_is_adopted(self, drained, tmp_path):
+        # Fresh rendezvous dir + a stale lease from a "dead" sweeper on
+        # tile 0: the drain must adopt it (takeover, counted) and finish;
+        # both tiles come back as free cache hits — no recompute.
+        _, cache_dir, plan_path, _ = drained
+        state_root = tmp_path / "state"
+        plan_dir = state_root / f"plan_{FP}"
+        plan_dir.mkdir(parents=True)
+        (plan_dir / "tile_b00000_u00000.lease").write_text(json.dumps({
+            "pid": 0, "host": "dead-host", "nonce": "stale",
+            "ts": time.time() - 9999.0, "ttl_s": 5.0,
+        }))
+        ctl = _controller(plan_path, cache_dir, state_root=state_root)
+        snap = ctl.drain(timeout_s=120)
+        ctl.close()
+        assert snap["status"] == "done"
+        assert snap["counts"]["adopted"] == 1
+        assert snap["counts"]["cache"] == 2  # global tile cache, not solver
+        assert snap["counts"]["computed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Epochs, budgets, fail-closed versioning
+# ---------------------------------------------------------------------------
+
+
+class TestEpochsAndBudgets:
+    def test_new_fingerprint_abandons_stale_epoch(self, tmp_path):
+        plan_path = _write_plan(tmp_path / "plan.json", _plan([_hot_tile()]))
+        ctl = _controller(plan_path, tmp_path / "cache")
+        assert ctl.poll_plan() and ctl.status == "sweeping"
+        assert len(ctl._tiles) == 2
+        _write_plan(plan_path, _plan([_hot_tile(betas=(2.4,), us=(0.5,))],
+                                     fp="aa" * 8))
+        os.utime(plan_path, (time.time() + 5, time.time() + 5))
+        ctl.poll_plan()
+        ctl.close()
+        assert ctl.counts["abandoned_stale"] == 2
+        assert ctl.counts["plans"] == 2
+        assert ctl._plan_fp == "aa" * 8 and len(ctl._tiles) == 1
+
+    def test_torn_rewrite_keeps_current_epoch(self, tmp_path):
+        plan_path = _write_plan(tmp_path / "plan.json", _plan([_hot_tile()]))
+        ctl = _controller(plan_path, tmp_path / "cache")
+        assert ctl.poll_plan()
+        plan_path.write_text('{"schema": "sbr-d')  # torn mid-rewrite
+        os.utime(plan_path, (time.time() + 5, time.time() + 5))
+        assert ctl.poll_plan()  # still active on the old epoch
+        ctl.close()
+        assert ctl.counts["plan_errors"] == 1
+        assert ctl._plan_fp == FP and ctl.status == "sweeping"
+
+    def test_budget_exhaustion_gates_report_exit1(self, tmp_path):
+        from sbr_tpu import obs
+
+        run_dir = tmp_path / "run"
+        run = obs.start_run(label="prewarm_budget", run_dir=str(run_dir))
+        try:
+            plan_path = _write_plan(tmp_path / "plan.json",
+                                    _plan([_hot_tile()]))
+            ctl = _controller(plan_path, tmp_path / "cache",
+                              max_seconds=0.001)
+            assert ctl.poll_plan()
+            time.sleep(0.01)
+            assert ctl.step() is None  # budget closed the plan
+            ctl.close()
+            assert ctl.status == "budget_exhausted"
+            assert ctl.counts["abandoned_budget"] == 2
+            assert ctl.status_gauge() == -1
+        finally:
+            obs.end_run()
+        doc, code = prewarm_doc(run.run_dir)
+        assert code == 1
+        assert any("budget" in b for b in doc["breaches"])
+
+    def test_program_version_mismatch_fails_closed(self, tmp_path):
+        plan_path = _write_plan(
+            tmp_path / "plan.json",
+            _plan([_hot_tile()], program_version=999999),
+        )
+        ctl = _controller(plan_path, tmp_path / "cache")
+        ctl.poll_plan()
+        ctl.close()
+        assert ctl.status == "rejected"
+        assert ctl.counts["plans_rejected"] == 1
+        assert ctl._tiles == [] and ctl.step() is None
+        assert ctl.status_gauge() == -1
+
+    def test_stale_program_version_done_marker_reruns(self, drained, tmp_path):
+        # A done marker from another solver generation describes cache
+        # entries this generation can't serve: the tile must NOT count as
+        # done.
+        _, cache_dir, plan_path, _ = drained
+        state_root = tmp_path / "state"
+        plan_dir = state_root / f"plan_{FP}"
+        plan_dir.mkdir(parents=True)
+        (plan_dir / "done_t00000_00000.json").write_text(json.dumps(
+            {"tile": "t00000_00000", "program_version": -1}
+        ))
+        ctl = _controller(plan_path, cache_dir, state_root=state_root)
+        assert ctl.poll_plan()
+        assert not ctl._tile_done(ctl._tiles[0])
+
+
+# ---------------------------------------------------------------------------
+# report prewarm exit contract
+# ---------------------------------------------------------------------------
+
+
+class TestReportPrewarm:
+    def _run(self, tmp_path, emits):
+        from sbr_tpu import obs
+
+        run = obs.start_run(label="prewarm_report",
+                            run_dir=str(tmp_path / "run"))
+        try:
+            for action, kw in emits:
+                obs.log_prewarm(action, **kw)
+        finally:
+            obs.end_run()
+        return run.run_dir
+
+    def test_healthy_run_exit0(self, tmp_path):
+        run_dir = self._run(tmp_path, [
+            ("plan", {"fingerprint": "f1", "tiles": 2}),
+            ("tile", {"tile": "t00000_00000", "source": "computed",
+                      "fingerprint": "f1"}),
+            ("adopt", {"tile": "t00001_00000", "fingerprint": "f1"}),
+            ("tile", {"tile": "t00001_00000", "source": "cache",
+                      "fingerprint": "f1"}),
+            ("plan_done", {"fingerprint": "f1", "tiles": 2, "warm": 2}),
+        ])
+        doc, code = prewarm_doc(run_dir)
+        assert code == 0 and not doc["breaches"]
+        p = doc["plans"]["f1"]
+        assert p["done"] and p["tiles_done"] == 2 and p["adopted"] == 1
+        assert doc["sources"] == {"cache": 1, "computed": 1}
+
+    def test_cold_completion_exit1(self, tmp_path):
+        run_dir = self._run(tmp_path, [
+            ("plan", {"fingerprint": "f1", "tiles": 2}),
+            ("plan_done", {"fingerprint": "f1", "tiles": 2, "warm": 1}),
+        ])
+        doc, code = prewarm_doc(run_dir)
+        assert code == 1
+        assert any("cold" in b for b in doc["breaches"])
+
+    def test_no_data_exit3_and_not_a_dir_exit2(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert prewarm_doc(empty)[1] == 3
+        assert prewarm_doc(tmp_path / "missing")[1] == 2
+
+
+# ---------------------------------------------------------------------------
+# Retention: report gc --prewarm-keep (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestGcRetention:
+    def test_keeps_recent_live_and_active_epochs(self, tmp_path):
+        root = tmp_path / "_prewarm"
+        now = time.time()
+        for i in range(5):
+            (root / f"plan_{i:02d}").mkdir(parents=True)
+        # Oldest epoch has a LIVE lease: a sweeper still drains there.
+        live = root / "plan_00" / "tile_b00000_u00000.lease"
+        live.write_text(json.dumps({"ts": now, "ttl_s": 600.0, "nonce": "n"}))
+        # The newest epoch carries lease debris for a tile already done.
+        debris = root / "plan_04" / "tile_b00001_u00000.lease"
+        debris.write_text(json.dumps({"ts": now - 9999, "ttl_s": 1.0}))
+        (root / "plan_04" / "done_t00001_00000.json").write_text("{}")
+        for i in range(5):  # stagger AFTER the writes that bump dir mtimes
+            t = now - 1000 + i
+            os.utime(root / f"plan_{i:02d}", (t, t))
+
+        removed = gc_prewarm_files(state_root=root, keep=2, ttl_s=60)
+        kept = sorted(p.name for p in root.iterdir())
+        # plan_00 survives (live lease), 01/02 pruned, 03/04 kept (keep=2).
+        assert kept == ["plan_00", "plan_03", "plan_04"]
+        assert live.exists()
+        assert not debris.exists() and str(debris) in removed
+
+    def test_no_state_root_is_noop(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("SBR_PREWARM_STATE_DIR", raising=False)
+        monkeypatch.delenv("SBR_TILE_CACHE_DIR", raising=False)
+        assert gc_prewarm_files(state_root=tmp_path / "nope") == []
+        assert gc_prewarm_files() == []
+
+
+# ---------------------------------------------------------------------------
+# TileCacheBridge incremental sidecar index (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestBridgeIncrementalIndex:
+    def _lookup_until(self, bridge, params, want_hit, timeout_s=10.0):
+        """Poll across the bridge's mtime slack window for the index to
+        converge on the expected verdict."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            rec = bridge.lookup(params, CFG, "float64")
+            if (rec is not None) == want_hit or time.monotonic() >= deadline:
+                return rec
+
+    def test_index_tracks_stores_torn_sidecars_and_deletions(self, drained,
+                                                             tmp_path):
+        _, cache_dir, _, _ = drained
+        cache = tmp_path / "cache_copy"
+        shutil.copytree(cache_dir, cache)
+        bridge = TileCacheBridge(cache)
+        hot = make_model_params(beta=BETAS[0], u=US[0])
+        assert bridge.lookup(hot, CFG, "float64") is not None
+
+        # A torn sidecar appearing later must be skipped, not fatal.
+        shard = next(p for p in cache.rglob("*.meta.json")).parent
+        (shard / "torn.meta.json").write_text('{"key": "x", "cell_t')
+        assert self._lookup_until(bridge, hot, want_hit=True) is not None
+
+        # A NEW store after the first lookup (another sweeper prefetching
+        # into the shared cache) must become visible without a new bridge.
+        new_q = make_model_params(beta=2.4, u=US[0])
+        assert bridge.lookup(new_q, CFG, "float64") is None
+        plan_path = _write_plan(
+            tmp_path / "plan_b.json",
+            _plan([_hot_tile(betas=(2.4,))], fp="bb" * 8),
+        )
+        ctl = _controller(plan_path, cache)
+        snap = ctl.drain(timeout_s=600)
+        ctl.close()
+        assert snap["status"] == "done" and snap["warm"] == 1
+        assert self._lookup_until(bridge, new_q, want_hit=True) is not None
+
+        # Deleting a cell's tile + sidecar must evict it from the index.
+        meta = next(
+            m for m in cache.rglob("*.meta.json")
+            if m.name != "torn.meta.json"
+            and json.loads(m.read_text())["betas"] == [2.4]
+        )
+        npz = meta.with_name(meta.name[: -len(".meta.json")] + ".npz")
+        meta.unlink()
+        if npz.exists():
+            npz.unlink()
+        assert self._lookup_until(bridge, new_q, want_hit=False) is None
+        # ...while untouched cells keep serving.
+        assert bridge.lookup(hot, CFG, "float64") is not None
+
+
+# ---------------------------------------------------------------------------
+# Scenario tiles: no sidecars, bridge refuses composed cells (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioSidecarRefusal:
+    def test_scenario_sweep_writes_no_meta_and_bridge_refuses(self, tmp_path):
+        # A composed-scenario surface is NOT the baseline answer for its
+        # (β, u): prewarming it must never leave a sidecar the bridge
+        # could mistake for a servable baseline cell.
+        cache = tmp_path / "cache"
+        plan_path = _write_plan(
+            tmp_path / "plan.json",
+            _plan([_hot_tile(betas=(1.0,), us=(0.1,))]),
+        )
+        spec = ScenarioSpec(modifiers=("insurance_cap",))
+        ctl = _controller(plan_path, cache, scenario_spec=spec)
+        snap = ctl.drain(timeout_s=600)
+        ctl.close()
+        assert snap["tiles_done"] == 1 and snap["counts"]["failed"] == 0
+        assert cache.is_dir()  # the scenario tile DID land in the cache...
+        assert not list(cache.rglob("*.meta.json"))  # ...without a sidecar
+        bridge = TileCacheBridge(cache)
+        assert bridge.lookup(make_model_params(beta=1.0, u=0.1), CFG,
+                             "float64") is None
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: SBR_PREWARM=0 structural no-op (the control surface)
+# ---------------------------------------------------------------------------
+
+
+class TestEngineWiring:
+    def _engine(self):
+        from sbr_tpu.serve.engine import Engine
+
+        return Engine(config=SolverConfig(n_grid=64, bisect_iters=20,
+                                          refine_crossings=False))
+
+    def test_off_is_structural_noop(self, monkeypatch):
+        from sbr_tpu.obs import prof
+
+        monkeypatch.delenv("SBR_PREWARM", raising=False)
+        sys.modules.pop("sbr_tpu.serve.prewarm", None)
+        traces_before = sum(prof.trace_counts().values())
+        eng = self._engine()
+        try:
+            assert eng.prewarm is None
+            # The module must not even be imported...
+            assert "sbr_tpu.serve.prewarm" not in sys.modules
+            # ...the exposition must be byte-free of prewarm metrics...
+            assert "sbr_prewarm" not in eng.prometheus()
+            assert "prewarm" not in eng.statz()
+        finally:
+            eng.close()
+        # ...and zero new XLA programs traced by wiring the engine.
+        assert sum(prof.trace_counts().values()) == traces_before
+
+    def test_on_attaches_controller(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SBR_PREWARM", "1")
+        monkeypatch.setenv("SBR_TILE_CACHE_DIR", str(tmp_path / "cache"))
+        monkeypatch.setenv("SBR_PREWARM_PLAN", str(tmp_path / "nope.json"))
+        eng = self._engine()
+        try:
+            assert eng.prewarm is not None
+            assert "sbr_prewarm_status" in eng.prometheus()
+            hb = eng.prewarm.heartbeat_block()
+            assert set(hb) == {"status", "plan", "tiles_done", "tiles_total",
+                               "abandoned"}
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# History schema 13
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema13:
+    def test_prewarm_metrics_whitelisted(self):
+        from sbr_tpu.obs import history
+
+        assert history.SCHEMA == 13
+        out = history.bench_metrics({
+            "value": 10.0,
+            "extra": {"prewarm_warm_hit_rate": 1.0,
+                      "prewarm_outage_p99_ms": 64.1,
+                      "prewarm_tiles_per_sec": 5.4},
+        })
+        assert out["prewarm_warm_hit_rate"] == 1.0
+        assert out["prewarm_outage_p99_ms"] == 64.1
+        assert out["prewarm_tiles_per_sec"] == 5.4
+
+    def test_polarity(self):
+        from sbr_tpu.obs import history
+
+        assert history.polarity("prewarm_warm_hit_rate") == 1
+        assert history.polarity("prewarm_tiles_per_sec") == 1
+        assert history.polarity("prewarm_outage_p99_ms") == -1
+
+    def test_schema_1_to_12_lines_still_load_and_gate(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        rows = [{"ts": 1.0, "metrics": {"eq_per_sec": 10.0}}]  # schema-less
+        rows += [{"schema": s, "metrics": {"eq_per_sec": 10.0 + s / 10}}
+                 for s in range(2, 13)]
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        history.append({"eq_per_sec": 10.7}, path=path)
+        records = history.load(path)
+        assert [r["schema"] for r in records] == list(range(1, 14))
+        verdicts, status = history.check(records, tolerance=0.15)
+        assert status == "ok"

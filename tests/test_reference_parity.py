@@ -139,6 +139,7 @@ class TestNoRunFrontier:
         assert solve_reference_baseline(beta=beta, u=u_star - tol, tspan_end=30.0).bankrun
         assert not solve_reference_baseline(beta=beta, u=u_star + tol, tspan_end=30.0).bankrun
 
+    @pytest.mark.slow
     def test_band_statuses_agree(self):
         """Across a band straddling the β=1 frontier, run/no-run decisions
         agree point for point except within a hair of the boundary."""

@@ -339,6 +339,7 @@ class TestClosure:
         with pytest.raises(ValueError, match="no bank run"):
             equilibrium_window(fp.equilibrium)
 
+    @pytest.mark.slow
     def test_agent_sim_converges_to_fixed_point(self):
         """withdrawn_frac → AW(t) and informed_frac → G(t) as (N, degree)
         grow toward the mean-field limit; absolute error at the large
@@ -1028,6 +1029,7 @@ class TestCounterRng:
 
 
 class TestMeasuredEngine:
+    @pytest.mark.slow
     def test_measure_tries_wider_cap_on_heavy_tails(self):
         """When the census predicts a recount-heavy run and max_degree was
         not pinned, engine='measure' adds an 8x-wider cap candidate; the
