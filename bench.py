@@ -1932,6 +1932,114 @@ def bench_prewarm(platform: str) -> dict:
     }
 
 
+def bench_flight(platform: str) -> dict:
+    """Flight-recorder workload (ISSUE 20): recorder-on vs recorder-off
+    serve time at the standard serve shape → the overhead ratio the
+    ≤1.05 acceptance gate judges, plus the recorder-on pass's measured
+    device-busy / host-gap fractions — the baseline ruler the ROADMAP
+    item-1 async-dispatch work must move (its acceptance criterion is
+    "flight_host_gap_frac drops on the same bench").
+
+    Both engines serve IDENTICAL per-rep query pools (unique params per
+    rep, so every rep dispatches instead of replaying the LRU) after an
+    untimed warm-up rep that absorbs compiles. The two engines stay warm
+    side by side and each rep pool is timed back-to-back on both —
+    off-first on even reps, on-first on odd — with the headline ratio
+    the MEDIAN of per-pair on/off ratios. Pairing is what makes the
+    gate resolvable: a rep pool serves in single-digit milliseconds, so
+    sequential whole-pass timing lets any background stall (the obs
+    writer thread, a GC pass, another tenant on a small box) land in
+    one mode's window and read as 20% "overhead" where the true
+    recording cost is microseconds; adjacent paired reps see the same
+    machine state and the median ignores the odd poisoned pair.
+    History schema 14; tiny dry-run shapes zero the gated keys so
+    reduced-shape stats never seed a baseline."""
+    from sbr_tpu.models.params import SolverConfig
+    from sbr_tpu.serve.engine import Engine, ServeConfig
+    from sbr_tpu.serve.loadgen import build_pool
+
+    if _tiny():
+        pool_n, n_grid, n_rep = 4, 64, 2
+    else:
+        pool_n, n_grid, n_rep = 12, 128, 40
+    config = SolverConfig(n_grid=n_grid, bisect_iters=40, refine_crossings=False)
+    # Per-rep pools with distinct seeds: distinct params per rep, so each
+    # timed rep pays a real dispatch; rep pools are shared between the on
+    # and off engines so both serve byte-identical work.
+    warm_pool = build_pool(999, pool_n)
+    rep_pools = [build_pool(seed, pool_n) for seed in range(n_rep)]
+
+    saved = os.environ.get("SBR_FLIGHT")
+
+    def _make_engine(flight_on):
+        if flight_on:
+            os.environ["SBR_FLIGHT"] = "1"
+        else:
+            os.environ.pop("SBR_FLIGHT", None)
+        engine = Engine(config=config, serve=ServeConfig(buckets=(1, 8)))
+        engine.start()
+        engine.query_many(warm_pool)  # compiles, untimed
+        return engine
+
+    def _timed_rep(engine, rep_pool):
+        t0 = time.perf_counter()
+        engine.query_many(rep_pool)
+        return time.perf_counter() - t0
+
+    eng_off = eng_on = None
+    try:
+        eng_off = _make_engine(False)
+        eng_on = _make_engine(True)
+        # The measured window starts clean: compile shadow must not
+        # pollute the busy/gap fractions.
+        eng_on.flight.reset()
+        pair_ratios, off_times, on_times = [], [], []
+        for i, rep_pool in enumerate(rep_pools):
+            if i % 2 == 0:
+                off_t = _timed_rep(eng_off, rep_pool)
+                on_t = _timed_rep(eng_on, rep_pool)
+            else:
+                on_t = _timed_rep(eng_on, rep_pool)
+                off_t = _timed_rep(eng_off, rep_pool)
+            off_times.append(off_t)
+            on_times.append(on_t)
+            if off_t > 0:
+                pair_ratios.append(on_t / off_t)
+        from sbr_tpu.obs import flight as _flight
+
+        util = _flight.derive_utilization(eng_on.flight.snapshot())
+    finally:
+        for engine in (eng_off, eng_on):
+            if engine is not None:
+                engine.close()
+        if saved is None:
+            os.environ.pop("SBR_FLIGHT", None)
+        else:
+            os.environ["SBR_FLIGHT"] = saved
+
+    import statistics
+
+    ratio = statistics.median(pair_ratios) if pair_ratios else 0.0
+    off_s, on_s = min(off_times), min(on_times)
+    busy = util.get("device_busy_frac") or 0.0
+    gap = util.get("host_gap_frac") or 0.0
+    _log(
+        f"flight: off {off_s * 1e3:.1f}ms on {on_s * 1e3:.1f}ms "
+        f"(median paired ratio {ratio:.3f} over {len(pair_ratios)} "
+        f"rep pair(s)); busy {busy:.4f} gap {gap:.4f} over "
+        f"{util.get('dispatches', 0)} dispatch(es)"
+    )
+    return {
+        "flight_pool": pool_n,
+        "flight_reps": n_rep,
+        "flight_dispatches": int(util.get("dispatches") or 0),
+        "flight_records": int(util.get("records") or 0),
+        "flight_overhead_ratio": 0.0 if _tiny() else round(ratio, 4),
+        "flight_device_busy_frac": 0.0 if _tiny() else round(busy, 4),
+        "flight_host_gap_frac": 0.0 if _tiny() else round(gap, 4),
+    }
+
+
 def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -2109,6 +2217,20 @@ def _measure_inner(platform: str) -> None:
             **{k: round(v, 6) if isinstance(v, float) else v
                for k, v in pw.items() if v is not None},
         )
+    try:
+        with obs.span("bench.flight"):
+            flt = bench_flight(platform)
+    except Exception as err:
+        # Same graceful degradation: the primary metric must land even
+        # when the flight-recorder workload fails.
+        _log(f"flight bench failed: {err!r}")
+        flt = None
+    if flt is not None:
+        obs.event(
+            "bench_flight",
+            **{k: round(v, 6) if isinstance(v, float) else v
+               for k, v in flt.items() if v is not None},
+        )
 
     eq_per_sec = grid["eq_per_sec"]
     out = {
@@ -2265,6 +2387,18 @@ def _measure_inner(platform: str) -> None:
                 out["extra"][k] = pw[k]
         out["extra"]["prewarm_tiles"] = pw["prewarm_tiles"]
         out["extra"]["prewarm_plan_status"] = pw["prewarm_plan_status"]
+    if flt is not None:
+        # Schema-14 history metrics (ISSUE 20): recorder-on/off serve
+        # overhead ratio + the device-busy / host-gap baseline the
+        # async-dispatch work will be gated against. Tiny shapes zero the
+        # gated keys (falsy → dropped here) so reduced-shape stats never
+        # seed baselines.
+        for k in ("flight_overhead_ratio", "flight_device_busy_frac",
+                  "flight_host_gap_frac"):
+            if flt.get(k):
+                out["extra"][k] = flt[k]
+        out["extra"]["flight_dispatches"] = flt["flight_dispatches"]
+        out["extra"]["flight_records"] = flt["flight_records"]
     obs.end_run()
     out["extra"]["obs"] = obs_run.summary()
     _log(f"obs run dir: {obs_run.run_dir}")

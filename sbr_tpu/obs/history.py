@@ -103,10 +103,18 @@ from typing import Optional
 # answers; lower-better by the _ms rule), and prewarm_tiles_per_sec
 # (controller sweep throughput draining an advisor plan; higher-better
 # by the per_sec rule).
+# Schema 14 adds the dispatch-pipeline flight recorder (bench.py
+# bench_flight): flight_overhead_ratio (steady-state serve latency with
+# the recorder armed over the recorder-off control; lower-better by the
+# overhead rule — ~1.0 means instrumentation is invisible to the hot
+# path), flight_device_busy_frac (fraction of the engine window covered
+# by dispatch spans; higher-better — more overlap means fewer host
+# bubbles), and flight_host_gap_frac (its complement; lower-better by
+# the host_gap rule).
 # Readers accept every version: the key set only grows, and
 # `load` stamps schema-less legacy lines as 1, so a committed
-# schema-1..12 history keeps gating new schema-13 appends.
-SCHEMA = 13
+# schema-1..13 history keeps gating new schema-14 appends.
+SCHEMA = 14
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -258,6 +266,14 @@ def bench_metrics(result: dict) -> dict:
         "prewarm_warm_hit_rate",
         "prewarm_outage_p99_ms",
         "prewarm_tiles_per_sec",
+        # schema 14: the dispatch-pipeline flight recorder (bench.py
+        # bench_flight): recorder-on over recorder-off serve latency
+        # (lower-better by the overhead rule), device-busy fraction of
+        # the engine window (higher-better — default polarity), and the
+        # host-gap complement (lower-better by the host_gap rule)
+        "flight_overhead_ratio",
+        "flight_device_busy_frac",
+        "flight_host_gap_frac",
     ):
         v = extra.get(key)
         if isinstance(v, (int, float)):
@@ -316,6 +332,9 @@ def polarity(metric: str) -> int:
         # schema 9: a composed pipeline's cost over its legacy control —
         # growing overhead is a regression even though it's a ratio
         or "overhead" in m
+        # schema 14: the host-side bubble fraction of the engine window —
+        # a rising gap means the device is starving behind the host
+        or "host_gap" in m
     ):
         return -1
     return 1

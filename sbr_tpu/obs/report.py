@@ -1674,6 +1674,189 @@ def _main_prewarm(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Pipeline-utilization report (`util` subcommand — ISSUE 20 flight gate)
+# ---------------------------------------------------------------------------
+
+
+def util_doc(run_dir, floor=None, min_disp=None) -> tuple:
+    """Machine-readable pipeline-utilization report (`sbr_tpu.obs.flight`):
+    reads the run's rolling ``flight.json`` and derives (or re-derives,
+    when only raw records landed) the device-busy / host-gap surface with
+    per-cause bubble attribution. Returns (doc, exit_code).
+
+    Exit codes: 0 healthy; 1 when the device-busy fraction is under the
+    floor (``--floor`` or ``SBR_FLIGHT_UTIL_FLOOR``) over a measured
+    window with at least ``--min-dispatches`` dispatches (fewer disarms
+    the gate — a one-dispatch window is compile shadow, not utilization);
+    3 when the run recorded no flight data (a gate with nothing to read
+    must not pass silently); 2 when ``run_dir`` is not a directory."""
+    from sbr_tpu.obs import flight as _flight
+
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    if floor is None:
+        floor = _flight.util_floor()
+    if min_disp is None:
+        min_disp = _flight.min_dispatches()
+    try:
+        snap = json.loads((run_dir / "flight.json").read_text())
+    except (OSError, json.JSONDecodeError, ValueError):
+        snap = None
+    if not isinstance(snap, dict) or not snap.get("records"):
+        return {
+            "dir": str(run_dir),
+            "error": "no flight data (no flight.json with records — was "
+            "the run served with SBR_FLIGHT=1?)",
+            "exit": 3,
+        }, 3
+    # Re-derive from the raw ring rather than trusting the embedded util
+    # block: the gate must judge with ITS deriver, and a snapshot written
+    # by an older process stays readable.
+    util = _flight.derive_utilization(snap)
+
+    breaches = []
+    notes = []
+    busy = util.get("device_busy_frac")
+    dispatches = int(util.get("dispatches") or 0)
+    if util.get("dropped_records"):
+        notes.append(
+            f"{util['dropped_records']} record(s) overwritten in the ring "
+            "(raise SBR_FLIGHT_CAP for a wider window)"
+        )
+    if floor is not None:
+        if dispatches < int(min_disp):
+            notes.append(
+                f"floor gate disarmed: {dispatches} dispatch(es) in the "
+                f"window (< {int(min_disp)})"
+            )
+        elif busy is not None and busy < float(floor):
+            causes = util.get("gap_causes") or {}
+            top = max(causes.items(), key=lambda kv: kv[1]["s"])[0] \
+                if causes else "?"
+            breaches.append(
+                f"device-busy fraction {busy:.4f} under floor "
+                f"{float(floor):g} over {dispatches} dispatch(es) "
+                f"(dominant gap cause: {top})"
+            )
+    code = 1 if breaches else 0
+    doc = {
+        "dir": str(run_dir),
+        "floor": float(floor) if floor is not None else None,
+        "min_dispatches": int(min_disp),
+        "ts": snap.get("ts"),
+        "util": util,
+        "notes": notes,
+        "breaches": breaches,
+        "exit": code,
+    }
+    return doc, code
+
+
+def render_util(doc: dict) -> str:
+    """Human-readable utilization report; same exit contract as `util_doc`."""
+    out = [f"run      {doc['dir']}"]
+    if doc["exit"] in (2, 3):
+        out.append(doc.get("error", "no flight data"))
+        return "\n".join(out)
+    u = doc["util"]
+    busy = u.get("device_busy_frac")
+    gap = u.get("host_gap_frac")
+    out.append(
+        f"flight   {u.get('records', 0)} record(s), "
+        f"{u.get('dispatches', 0)} dispatch(es), "
+        f"window {u.get('window_s') or 0:g} s"
+    )
+    out.append(
+        "util     device-busy "
+        + ("-" if busy is None else f"{busy:.4f}")
+        + "  host-gap "
+        + ("-" if gap is None else f"{gap:.4f}")
+        + (f"  (floor {doc['floor']:g})" if doc.get("floor") is not None
+           else "  (no floor set)")
+    )
+    causes = u.get("gap_causes") or {}
+    if causes:
+        out += ["", "GAP ATTRIBUTION"]
+        out.append(_table(
+            ["cause", "seconds", "share"],
+            [
+                [c, f"{v['s']:.6f}", f"{v['frac']:.4f}"]
+                for c, v in sorted(causes.items(),
+                                   key=lambda kv: -kv[1]["s"])
+            ],
+        ))
+    qd = u.get("queue_depth")
+    if qd:
+        out.append(
+            f"queue    p50={qd['p50']:g} p95={qd['p95']:g} "
+            f"p99={qd['p99']:g} max={qd['max']:g} "
+            f"({qd['samples']} sample(s))"
+        )
+    occ = u.get("occupancy")
+    if occ:
+        out.append(
+            f"occupancy mean={occ['mean']:g} " + " ".join(
+                f"{b}={v:g}" for b, v in occ["by_bucket"].items()
+            )
+        )
+    sw = u.get("sweeps")
+    if sw:
+        out.append(
+            f"sweeps   {sw['tiles']} tile(s), "
+            + ", ".join(f"{k}={v:g}ms" for k, v in sw["by_kind_ms"].items())
+            + f"; bubbles {sw['bubble_total_ms']:g} ms total"
+        )
+    col = u.get("collectives")
+    if col:
+        out.append("collectives " + ", ".join(
+            f"{k}: {v['count']}x/{v['total_ms']:g}ms" for k, v in col.items()
+        ))
+    for n in doc.get("notes") or []:
+        out.append(f"note     {n}")
+    out.append("")
+    if doc["breaches"]:
+        out.append("GATE: UTILIZATION DEGRADED")
+        for b in doc["breaches"]:
+            out.append(f"  {b}")
+    else:
+        out.append("GATE: ok (device-busy fraction at or above floor)")
+    return "\n".join(out)
+
+
+def _main_util(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report util",
+        description="Pipeline-utilization report for one run dir "
+        "(flight.json from sbr_tpu.obs.flight): device-busy fraction, "
+        "host-gap attribution, queue depth, batch occupancy; exit 1 when "
+        "device-busy is under the floor over a measured window, 3 when "
+        "the run recorded no flight data",
+    )
+    parser.add_argument("run_dir", help="obs run directory of a "
+                        "flight-enabled (SBR_FLIGHT=1) engine")
+    parser.add_argument(
+        "--floor", type=float, default=None,
+        help="device-busy floor (default: SBR_FLIGHT_UTIL_FLOOR; "
+        "unset = gate disarmed)",
+    )
+    parser.add_argument(
+        "--min-dispatches", type=int, default=None, dest="min_dispatches",
+        help="dispatches required before the floor gate arms "
+        "(default: SBR_FLIGHT_MIN_DISPATCHES or 3)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = util_doc(args.run_dir, floor=args.floor,
+                         min_disp=args.min_dispatches)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_util(doc))
+    return code
+
+
+# ---------------------------------------------------------------------------
 # Infomodel report (`infomodel` subcommand — information-model gate)
 # ---------------------------------------------------------------------------
 
@@ -2590,6 +2773,12 @@ def _main_gc(argv) -> int:
         "tile already carries a done marker; epochs with live leases or "
         "sweeper heartbeats and the newest (active) plan are never touched",
     )
+    parser.add_argument(
+        "--flight-keep", type=int, default=None, metavar="N", dest="flight_keep",
+        help="also prune rotated flight-recorder snapshots "
+        "(flight.NNN.json) inside kept run dirs down to the N most recent "
+        "per dir; live runs and the active flight.json are never touched",
+    )
     args = parser.parse_args(argv)
     import os
 
@@ -2648,6 +2837,14 @@ def _main_gc(argv) -> int:
         pruned = gc_prewarm_files(keep=args.prewarm_keep)
         print(f"removed {len(pruned)} prewarm state path(s) "
               f"(keep {args.prewarm_keep} plan epoch(s))")
+        for p in pruned:
+            print(f"  {p}")
+    if args.flight_keep is not None:
+        from sbr_tpu.obs.flight import gc_flight_files
+
+        pruned = gc_flight_files(root, keep=args.flight_keep)
+        print(f"removed {len(pruned)} flight artifact file(s) "
+              f"(keep {args.flight_keep} per run dir)")
         for p in pruned:
             print(f"  {p}")
     return 0
@@ -3144,6 +3341,119 @@ def _main_slo(argv) -> int:
     return code
 
 
+# ---------------------------------------------------------------------------
+# Meta-gate (`summary` subcommand — ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+#: The subgates `report summary` folds, in display order. Each entry maps
+#: the gate name to a callable of one run_dir returning (doc, code) —
+#: kept lazy (lambdas) so a crashing gate is contained per-row.
+_SUMMARY_GATES = (
+    ("health", lambda d: health_json(load_run(d))),
+    ("serve", lambda d: serve_doc(d)),
+    ("fleet", lambda d: fleet_doc(d)),
+    ("trace", lambda d: trace_doc([d])),
+    ("slo", lambda d: slo_doc([d])),
+    ("audit", lambda d: audit_doc(d)),
+    ("demand", lambda d: demand_doc([d])),
+    ("prewarm", lambda d: prewarm_doc(d)),
+    ("util", lambda d: util_doc(d)),
+)
+
+
+def _gate_reason(doc, code: int) -> str:
+    """One-line reason for a subgate row: 'ok' on 0, else the first
+    breach/error the gate reported (truncated for the table)."""
+    if code == 0:
+        return "ok"
+    reason = None
+    if isinstance(doc, dict):
+        for key in ("breaches", "reasons"):
+            vals = doc.get(key)
+            if vals:
+                reason = str(vals[0])
+                break
+        if reason is None and doc.get("error"):
+            reason = str(doc["error"])
+        if reason is None and code == 1 and doc.get("total_divergent"):
+            reason = f"{doc['total_divergent']} divergent cell(s)"
+    if reason is None:
+        reason = f"exit {code}"
+    return reason if len(reason) <= 90 else reason[:87] + "..."
+
+
+def summary_doc(run_dir) -> tuple:
+    """The meta-gate (ISSUE 20 satellite): every observatory gate —
+    health, serve, fleet, trace, slo, audit, demand, prewarm, util — run
+    against ONE run dir, folded into a single table. Returns
+    (doc, exit_code) where the merged exit is the MAX of the subgate
+    exits (so a single breach (1) outranks ok (0), and a bad dir (2) /
+    no-data (3) surfaces as itself — observatories that simply were not
+    enabled show their honest 3 rather than silently passing). A subgate
+    that CRASHES reads as exit 2 with the error as its reason: a broken
+    gate must not read as clean."""
+    run_dir = Path(run_dir)
+    if not run_dir.is_dir():
+        return {"dir": str(run_dir), "error": "not a directory", "exit": 2}, 2
+    gates = {}
+    for name, fn in _SUMMARY_GATES:
+        try:
+            doc, code = fn(str(run_dir))
+        except Exception as err:
+            doc, code = {"error": f"{type(err).__name__}: {err}"}, 2
+        gates[name] = {"exit": code, "reason": _gate_reason(doc, code)}
+    merged = max(g["exit"] for g in gates.values())
+    doc = {
+        "dir": str(run_dir),
+        "gates": gates,
+        "exit": merged,
+    }
+    return doc, merged
+
+
+def render_summary(doc: dict) -> str:
+    """Human-readable meta-gate table; same exit contract as `summary_doc`."""
+    out = [f"run      {doc['dir']}"]
+    if "gates" not in doc:
+        out.append(doc.get("error", "no data"))
+        return "\n".join(out)
+    out += ["", "GATES"]
+    out.append(_table(
+        ["gate", "exit", "reason"],
+        [[name, g["exit"], g["reason"]]
+         for name, g in doc["gates"].items()],
+    ))
+    out.append("")
+    worst = doc["exit"]
+    if worst == 0:
+        out.append("GATE: ok (every subgate passed)")
+    else:
+        failing = [n for n, g in doc["gates"].items() if g["exit"] == worst]
+        out.append(
+            f"GATE: exit {worst} (worst subgate(s): {', '.join(failing)})"
+        )
+    return "\n".join(out)
+
+
+def _main_summary(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sbr_tpu.obs.report summary",
+        description="Meta-gate: run every observatory gate (health, serve, "
+        "fleet, trace, slo, audit, demand, prewarm, util) against one run "
+        "dir and fold them into a single table; the merged exit code is "
+        "the max of the subgate exits",
+    )
+    parser.add_argument("run_dir", help="obs run directory")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    doc, code = summary_doc(args.run_dir)
+    if args.json:
+        print(json.dumps(doc, default=str))
+        return code
+    print(render_summary(doc))
+    return code
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # Subcommand dispatch; a bare run-dir path keeps the legacy render/diff
@@ -3166,6 +3476,10 @@ def main(argv=None) -> int:
         return _main_demand(argv[1:])
     if argv and argv[0] == "prewarm":
         return _main_prewarm(argv[1:])
+    if argv and argv[0] == "util":
+        return _main_util(argv[1:])
+    if argv and argv[0] == "summary":
+        return _main_summary(argv[1:])
     if argv and argv[0] == "grad":
         return _main_grad(argv[1:])
     if argv and argv[0] == "infomodel":
@@ -3186,8 +3500,8 @@ def main(argv=None) -> int:
         prog="python -m sbr_tpu.obs.report",
         description="Render an obs run directory, diff two runs, or run the "
         "'health' / 'resilience' / 'memory' / 'elastic' / 'serve' / 'fleet' / "
-        "'audit' / 'demand' / 'prewarm' / 'grad' / 'infomodel' / 'trace' / "
-        "'slo' / 'trend' / 'gc' subcommands",
+        "'audit' / 'demand' / 'prewarm' / 'util' / 'summary' / 'grad' / "
+        "'infomodel' / 'trace' / 'slo' / 'trend' / 'gc' subcommands",
     )
     parser.add_argument("run_dir", help="run directory (contains manifest.json)")
     parser.add_argument("other_dir", nargs="?", help="second run directory to diff against")

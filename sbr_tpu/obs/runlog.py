@@ -211,6 +211,11 @@ class RunContext:
         # event counts, abandoned-tile counts by reason, and the last plan
         # fingerprint acted on — what `report prewarm` gates.
         self.prewarm: dict = {}
+        # Flight-recorder roll-up (sbr_tpu.obs.flight): per-action counts
+        # of flight lifecycle events (snapshot rotations, the final write)
+        # plus the headline utilization fractions of the final snapshot —
+        # what `report util` falls back on for a torn flight.json.
+        self.flight: dict = {}
         self._aot_cache: dict = {}
         # Performance observatory (obs.prof): XLA compile attribution from
         # the jax.monitoring listeners, per-run retrace accounting, and
@@ -613,6 +618,7 @@ class RunContext:
             "audit": self.audit or None,
             "demand": self.demand or None,
             "prewarm": self.prewarm or None,
+            "flight": self.flight or None,
             "metrics": metrics().summary() if metrics().enabled else None,
             "xla": self._xla_manifest(),
             "retraces": self._retrace_summary() or None,
@@ -795,6 +801,19 @@ class RunContext:
             for k in ("tiles", "warm", "failed"):
                 if fields.get(k) is not None:
                     self.prewarm[f"last_{k}"] = fields[k]
+
+    def log_flight(self, action: str = "?", **fields) -> None:
+        """Emit one flight-recorder ``flight`` event (`sbr_tpu.obs.flight`:
+        snapshot rotations, the final close write) and fold it into the
+        manifest roll-up: per-action counts plus the final snapshot's
+        headline utilization numbers as ``last_*`` fields."""
+        self.event("flight", action=action, **fields)
+        self.flight[action] = self.flight.get(action, 0) + 1
+        if action == "final":
+            for k in ("records", "dispatches", "dropped_records",
+                      "device_busy_frac", "host_gap_frac"):
+                if fields.get(k) is not None:
+                    self.flight[f"last_{k}"] = fields[k]
 
     def _resilience_manifest(self) -> Optional[dict]:
         if not any(self.resilience.values()):
@@ -1097,6 +1116,14 @@ def log_prewarm(action: str = "?", **fields) -> None:
     run = current_run()
     if run is not None and _trace_clean():
         run.log_prewarm(action, **fields)
+
+
+def log_flight(action: str = "?", **fields) -> None:
+    """Flight-recorder event + manifest roll-up (no-op when telemetry is
+    off or while tracing) — the `sbr_tpu.obs.flight` emission hook."""
+    run = current_run()
+    if run is not None and _trace_clean():
+        run.log_flight(action, **fields)
 
 
 def interrupt_all() -> int:

@@ -58,6 +58,19 @@ from sbr_tpu.models.params import ModelParams, SolverConfig
 from sbr_tpu.resilience import faults
 
 
+def _flight_recorder():
+    """Process-wide flight recorder when ``SBR_FLIGHT`` is on, else None
+    (env check before import — the structural-no-op contract)."""
+    if os.environ.get("SBR_FLIGHT", "").strip() in ("", "0"):
+        return None
+    try:
+        from sbr_tpu.obs import flight
+
+        return flight.shared()
+    except Exception:
+        return None
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -373,7 +386,12 @@ def run_tiled_grid_multihost(
                 )
             if verbose:
                 print(f"  waiting on {len(missing)} peer tiles …")
+            _fl = _flight_recorder()
+            _t0 = time.monotonic()
             time.sleep(poll_s)
+            if _fl is not None:
+                _fl.mark("collectives", "barrier_poll", _t0, time.monotonic(),
+                         tag=f"missing={len(missing)}")
 
     # Assembly: all tiles cached on disk — a pure read, no recompute.
     _cleanup_leases(ckpt)

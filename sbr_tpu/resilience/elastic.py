@@ -751,6 +751,10 @@ def run_elastic_grid(
         try:
             while remaining:
                 faults.fire("barrier.poll", target=f"missing={len(remaining)}")
+                _fl = ckpt_mod._flight_recorder()
+                if _fl is not None:
+                    _fl.point("collectives", "barrier_poll",
+                              tag=f"missing={len(remaining)}")
                 if next_in_order >= len(order) or claims_since_plan >= REPLAN_EVERY:
                     hosts = live_hosts(ckpt)
                     rates = {

@@ -471,6 +471,21 @@ class Engine:
                 engine=self, config=self.config, dtype=self.dtype,
             )
 
+        # Dispatch-pipeline flight recorder (ISSUE 20): ring-buffer
+        # begin/end records across admission → queue → batch formation →
+        # dispatch → unpack, folded into device-busy / host-gap fractions
+        # (the baseline ruler for the ROADMAP item-1 async-dispatch work).
+        # Same structural-no-op contract: SBR_FLIGHT=0 (the default) never
+        # imports the module — no recorder, /metrics byte-free of
+        # ``sbr_flight``, zero new XLA traces, answers bit-identical.
+        self.flight = None
+        if os.environ.get("SBR_FLIGHT", "").strip() not in ("", "0"):
+            from sbr_tpu.obs import flight as _flight
+
+            # The PROCESS-WIDE recorder: sweeps/collectives records from
+            # in-process prewarm sweepers land on the same timeline.
+            self.flight = _flight.shared()
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Engine":
         if self._thread is None:
@@ -512,6 +527,8 @@ class Engine:
             self.audit.close()
         if self.demand is not None:
             self.demand.close(self._run)
+        if self.flight is not None:
+            self.flight.close(self._run)
         w = self.live.window()
         self.live.maybe_write(self._run, self._live_extra(window=w), window=w, force=True)
         if self._run is not None:
@@ -560,6 +577,8 @@ class Engine:
         retry_after = round(max(est or 0.05, 0.05), 3)
         if deadline_ms <= 0:
             self.live.record_shed()
+            if self.flight is not None:
+                self.flight.point("engine", "shed", tag="expired")
             if self._run is not None:
                 try:
                     self._run.log_fleet("shed", reason="expired",
@@ -572,6 +591,8 @@ class Engine:
             )
         if est is not None and deadline_ms / 1e3 < est:
             self.live.record_shed()
+            if self.flight is not None:
+                self.flight.point("engine", "shed", tag="unmeetable")
             if self._run is not None:
                 try:
                     self._run.log_fleet("shed", reason="unmeetable",
@@ -614,6 +635,8 @@ class Engine:
         if trace is not None:
             trace.add("engine.admission", t_adm_w, time.monotonic() - t_adm,
                       parent=ticket.span_id)
+        if self.flight is not None:
+            self.flight.mark("engine", "admission", t_adm, time.monotonic())
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("engine is closed")
@@ -672,6 +695,8 @@ class Engine:
             for t in tickets:
                 if t.trace is not None:
                     t.trace.add("engine.admission", t_adm_w, dur, parent=t.span_id)
+        if self.flight is not None:
+            self.flight.mark("engine", "admission", t_adm, time.monotonic())
         if self._thread is None:
             self._process(tickets)
         else:
@@ -794,6 +819,10 @@ class Engine:
         # Prefetch controller gauges: byte-free when SBR_PREWARM=0.
         if self.prewarm is not None:
             hist_lines = list(hist_lines or []) + self.prewarm.prometheus_lines()
+        # Flight-recorder utilization gauges + per-stream latency
+        # histograms: byte-free when SBR_FLIGHT=0.
+        if self.flight is not None:
+            hist_lines = list(hist_lines or []) + self.flight.prometheus_lines()
         if hist_lines:
             text = text.rstrip("\n") + "\n" + "\n".join(hist_lines) + "\n"
         return text
@@ -838,6 +867,7 @@ class Engine:
             **({"audit": self.audit.snapshot()} if self.audit is not None else {}),
             **({"demand": self.demand.snapshot()} if self.demand is not None else {}),
             **({"prewarm": self.prewarm.snapshot()} if self.prewarm is not None else {}),
+            **({"flight": self.flight.heartbeat_block()} if self.flight is not None else {}),
         }
 
     def _demand_coverage(self) -> Optional[dict]:
@@ -867,6 +897,8 @@ class Engine:
                     self.live.maybe_write(self._run, self._live_extra(window=w), window=w)
                     if self.demand is not None:
                         self.demand.maybe_write(self._run)
+                    if self.flight is not None:
+                        self.flight.maybe_write(self._run)
                 continue
             batch, shutdown = [], item is _SHUTDOWN
             if not shutdown:
@@ -895,6 +927,9 @@ class Engine:
                         nxt.t_popped = time.monotonic()
                         batch.append(nxt)
             self.live.queue_depth = self._queue.qsize()
+            if self.flight is not None:
+                self.flight.point("engine", "queue_depth",
+                                  val=self.live.queue_depth)
             if batch:
                 self.live.inflight = len(batch)
                 try:
@@ -906,6 +941,8 @@ class Engine:
                     self.live.maybe_write(self._run, self._live_extra(window=w), window=w)
                     if self.demand is not None:
                         self.demand.maybe_write(self._run)
+                    if self.flight is not None:
+                        self.flight.maybe_write(self._run)
             if shutdown:
                 break
 
@@ -919,7 +956,11 @@ class Engine:
         tickets."""
         groups: "OrderedDict[str, List[_Ticket]]" = OrderedDict()
         t_proc = time.monotonic()
+        fl = self.flight
         for t in tickets:
+            if fl is not None:
+                popped = t.t_popped if t.t_popped is not None else t_proc
+                fl.mark("engine", "queue", t.t0, popped)
             if t.trace is not None:
                 # Queue wait: enqueue → the batcher taking the ticket
                 # (inline query_many never queues: ~0).
@@ -928,6 +969,8 @@ class Engine:
                             parent=t.span_id)
             t_lk = time.monotonic()
             rec, source = self._lookup(t.key)
+            if fl is not None:
+                fl.mark("engine", "cache", t_lk, time.monotonic(), tag=source or "miss")
             if t.trace is not None:
                 # Per-layer cache outcome: LRU always probed; disk only on
                 # an LRU miss (attr omitted when not consulted, "off" when
@@ -954,6 +997,8 @@ class Engine:
                 # can. (A deadline expiring once the batch IS dispatched
                 # still returns: that compute is already paid for.)
                 self.live.record_shed()
+                if fl is not None:
+                    fl.point("engine", "shed", tag="queue-expired")
                 if self._run is not None:
                     try:
                         self._run.log_fleet("shed", reason="queue-expired",
@@ -987,11 +1032,20 @@ class Engine:
             self._process_chunks(part, groups, max_bucket)
 
     def _process_chunks(self, unique: List[_Ticket], groups, max_bucket: int) -> None:
+        fl = self.flight
         for i in range(0, len(unique), max_bucket):
             chunk = unique[i : i + max_bucket]
             n = len(chunk)
             bucket = self._bucket_for(n)
             t_d0w, t_d0m = time.time(), time.monotonic()
+            if fl is not None:
+                # Batch formation: first pop → dispatch start (what the
+                # device waits out between consecutive dispatches).
+                popped0 = min(
+                    (t.t_popped for t in chunk if t.t_popped is not None),
+                    default=t_d0m,
+                )
+                fl.mark("engine", "batch", popped0, t_d0m, tag=f"b{bucket}")
             try:
                 # Positional call for the plain path: `_dispatch(params)` is
                 # a stubbing point (tests monkeypatch it for failure
@@ -1036,6 +1090,7 @@ class Engine:
                             dup.error = err
                             dup.event.set()
                 continue
+            t_up = time.monotonic()
             for t, rec in zip(chunk, records):
                 # A divergent result (DIVERGENT_MASK flag) is served — the
                 # caller sees the flags and decides — but never CACHED: a
@@ -1067,6 +1122,10 @@ class Engine:
                             padded_share=round((bucket - n) / bucket, 4),
                         )
                     self._fulfill(dup, rec, "computed" if j == 0 else "coalesced")
+            if fl is not None:
+                # Result unpack: store + fulfill after the device fence.
+                fl.mark("engine", "unpack", t_up, time.monotonic(),
+                        tag=f"b{bucket}")
 
     def _degraded_rec(self, t: _Ticket) -> Optional[dict]:
         """The tile-cache rung of the degradation ladder for one ticket
@@ -1197,6 +1256,15 @@ class Engine:
             dur if self._service_ewma_s is None
             else 0.3 * dur + 0.7 * self._service_ewma_s
         )
+        if self.flight is not None:
+            # The honest device span: run() only returns after np.asarray
+            # forces every output, so [t_disp, t_disp+dur] covers transfer
+            # + compute + fetch (+ retry backoff — what the device path
+            # actually occupied).
+            self.flight.mark("engine", "dispatch", t_disp, t_disp + dur,
+                             tag=f"b{bucket}")
+            self.flight.point("engine", "occupancy", tag=f"b{bucket}",
+                              val=round(n / bucket, 4))
         self.live.record_batch(n, bucket)
         if self._run is not None:
             try:

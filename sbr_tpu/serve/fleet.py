@@ -516,6 +516,11 @@ def _worker_stats(engine) -> dict:
         # summary.
         **({"prewarm": engine.prewarm.heartbeat_block()}
            if getattr(engine, "prewarm", None) is not None else {}),
+        # Pipeline utilization (ISSUE 20): device-busy / host-gap fractions
+        # from the flight recorder, absent entirely when SBR_FLIGHT is off;
+        # the router rolls present blocks up into the fleet util surface.
+        **({"flight": engine.flight.heartbeat_block()}
+           if getattr(engine, "flight", None) is not None else {}),
     }
 
 

@@ -710,11 +710,13 @@ class Router:
                     "quarantined": w.quarantined,
                     "audit": (w.last_hb or {}).get("audit"),
                     "prewarm": (w.last_hb or {}).get("prewarm"),
+                    "flight": (w.last_hb or {}).get("flight"),
                 }
                 for h, w in self._workers.items()
             }
         fleet_demand = self.fleet_demand()
         fleet_prewarm = self.fleet_prewarm()
+        fleet_flight = self.fleet_flight()
         return {
             "schema": SCHEMA,
             "ts": round(time.time(), 3),
@@ -734,6 +736,44 @@ class Router:
             # Fleet prewarm roll-up (ISSUE 19): same absent-when-off
             # contract — a prewarm-off fleet's /statz stays byte-free.
             **({"prewarm": fleet_prewarm} if fleet_prewarm is not None else {}),
+            # Fleet utilization roll-up (ISSUE 20): same absent-when-off
+            # contract — a flight-off fleet's /statz stays byte-free.
+            **({"flight": fleet_flight} if fleet_flight is not None else {}),
+        }
+
+    def fleet_flight(self) -> "Optional[dict]":
+        """Roll the workers' heartbeat flight blocks (ISSUE 20) up into
+        one fleet utilization view: dispatch-weighted mean device-busy /
+        host-gap fractions plus summed dispatch and dropped-record
+        counts. Returns None when no worker published a block — a
+        flight-off fleet keeps the structural no-op."""
+        with self._workers_lock:
+            blocks = [
+                ((w.last_hb or {}).get("flight"), h)
+                for h, w in sorted(self._workers.items())
+            ]
+        blocks = [(b, h) for b, h in blocks if isinstance(b, dict)]
+        if not blocks:
+            return None
+        dispatches = sum(int(b.get("dispatches") or 0) for b, _ in blocks)
+        dropped = sum(int(b.get("dropped_records") or 0) for b, _ in blocks)
+        weighted = [
+            (float(b["device_busy_frac"]),
+             max(int(b.get("dispatches") or 0), 1))
+            for b, _ in blocks if b.get("device_busy_frac") is not None
+        ]
+        busy = None
+        if weighted:
+            wsum = sum(w for _, w in weighted)
+            busy = round(sum(f * w for f, w in weighted) / wsum, 4)
+        return {
+            "workers": [h for _, h in blocks],
+            "dispatches": dispatches,
+            "dropped_records": dropped,
+            "device_busy_frac": busy,
+            "host_gap_frac": (
+                round(1.0 - busy, 4) if busy is not None else None
+            ),
         }
 
     def fleet_prewarm(self) -> "Optional[dict]":
@@ -844,6 +884,22 @@ class Router:
                 "# TYPE sbr_prewarm_fleet_tiles_abandoned gauge",
                 "sbr_prewarm_fleet_tiles_abandoned "
                 f"{sum(p['abandoned'] for p in plans.values())}",
+            ]
+        # Fleet utilization gauges (ISSUE 20): same byte-free-when-off rule.
+        flight = self.fleet_flight()
+        if flight is not None:
+            busy = flight.get("device_busy_frac")
+            lines += [
+                "# TYPE sbr_flight_fleet_workers gauge",
+                f"sbr_flight_fleet_workers {len(flight.get('workers') or [])}",
+                "# TYPE sbr_flight_fleet_dispatches gauge",
+                f"sbr_flight_fleet_dispatches {int(flight.get('dispatches') or 0)}",
+                "# TYPE sbr_flight_fleet_dropped_records gauge",
+                "sbr_flight_fleet_dropped_records "
+                f"{int(flight.get('dropped_records') or 0)}",
+                "# TYPE sbr_flight_fleet_device_busy_frac gauge",
+                f"sbr_flight_fleet_device_busy_frac "
+                f"{busy if busy is not None else 0:g}",
             ]
         return "\n".join(lines) + "\n"
 

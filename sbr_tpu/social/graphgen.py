@@ -980,7 +980,24 @@ def _prepare_mesh(
         out_ptr_g = jnp.asarray(
             np.concatenate([[0], np.cumsum(outdeg_h)]).astype(np.int32)
         )
-        dst2_sh, lstart_sh, ldeg_sh = inc_p(src_sh, row_ptr_g, out_ptr_g)
+        if os.environ.get("SBR_FLIGHT", "").strip() not in ("", "0"):
+            # Flight-recorded launch of the exclusive_psum-bearing program
+            # (collectives stream): block_until_ready gives the span an
+            # honest device fence; the VALUES are untouched, so answers
+            # stay bit-identical with the recorder on.
+            import time as _time
+
+            from sbr_tpu.obs import flight as _flight
+
+            _t0 = _time.monotonic()
+            dst2_sh, lstart_sh, ldeg_sh = jax.block_until_ready(
+                inc_p(src_sh, row_ptr_g, out_ptr_g)
+            )
+            _flight.shared().mark(
+                "collectives", "psum", _t0, _time.monotonic(), tag="inc"
+            )
+        else:
+            dst2_sh, lstart_sh, ldeg_sh = inc_p(src_sh, row_ptr_g, out_ptr_g)
         nb = n_gl // n_dev
         budget = incremental_budget or A._default_incremental_budget(nb, floor=512)
         inc = (dst2_sh, lstart_sh, ldeg_sh)

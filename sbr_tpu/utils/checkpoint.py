@@ -379,6 +379,20 @@ def _record_repairs(ckpt: Path, repairs: list) -> None:
     os.replace(tmp, manifest)
 
 
+def _flight_recorder():
+    """The process-wide flight recorder when ``SBR_FLIGHT`` is on, else
+    None. The env check comes FIRST so the default path never imports
+    `sbr_tpu.obs.flight` — the structural-no-op contract (ISSUE 20)."""
+    if os.environ.get("SBR_FLIGHT", "").strip() in ("", "0"):
+        return None
+    try:
+        from sbr_tpu.obs import flight
+
+        return flight.shared()
+    except Exception:
+        return None
+
+
 class TileRunner:
     """Per-tile production engine shared by `run_tiled_grid`'s loop and the
     elastic scheduler (`resilience.elastic`): produce ONE tile's arrays via
@@ -474,23 +488,41 @@ class TileRunner:
         with source in {"local", "cache", "computed"}. ``skip_local`` skips
         the local read when the caller already checked (the sweep loop)."""
         path = self.path(bi, ui)
+        fl = _flight_recorder()
+        tid = self.tile_id(bi, ui)
         if not skip_local:
+            t0 = time.monotonic()
             cached = self.load_local(bi, ui)
+            if fl is not None:
+                fl.mark("sweeps", "ckpt_load", t0, time.monotonic(), tag=tid)
             if cached is not None:
                 self.counts["local"] += 1
                 return "local", cached
         key = self.cache_key(bi, ui)
         if key is not None:
-            arrays = self.tile_cache.load(key, tile=self.tile_id(bi, ui))
+            t0 = time.monotonic()
+            arrays = self.tile_cache.load(key, tile=tid)
+            if fl is not None:
+                fl.mark("sweeps", "cache_io", t0, time.monotonic(), tag=tid)
             if arrays is not None:
                 self.counts["cache"] += 1
                 if path is not None:
+                    t0 = time.monotonic()
                     _save_atomic(path, arrays)
+                    if fl is not None:
+                        fl.mark("sweeps", "ckpt_save", t0, time.monotonic(),
+                                tag=tid)
                 return "cache", arrays
+        t0 = time.monotonic()
         arrays = self._compute(bi, ui)
+        if fl is not None:
+            fl.mark("sweeps", "compute", t0, time.monotonic(), tag=tid)
         self.counts["computed"] += 1
         if path is not None:
+            t0 = time.monotonic()
             _save_atomic(path, arrays)
+            if fl is not None:
+                fl.mark("sweeps", "ckpt_save", t0, time.monotonic(), tag=tid)
             # Chaos hook: a ``corrupt`` rule on checkpoint.save tears the
             # file AFTER the save (and its sidecar) landed — exactly the
             # torn-write mode verify-on-load must catch on the next read.
@@ -516,9 +548,12 @@ class TileRunner:
                     self.base, self.config, self.dtype,
                     self.beta_values[bs], self.u_values[us], key,
                 )
+            t0 = time.monotonic()
             self.tile_cache.store(
-                key, arrays, tile=self.tile_id(bi, ui), meta=meta,
+                key, arrays, tile=tid, meta=meta,
             )
+            if fl is not None:
+                fl.mark("sweeps", "cache_io", t0, time.monotonic(), tag=tid)
         return "computed", arrays
 
     def _compute(self, bi: int, ui: int) -> dict:

@@ -1,0 +1,812 @@
+"""Dispatch-pipeline flight recorder tests (ISSUE 20): lock-free ring
+contract (concurrent writers bounded loss, no torn records under active
+snapshots, per-stream seq uniqueness/monotonicity, dropped-records
+accounting), the pure `derive_utilization` fold on hand-built synthetic
+records (busy fraction, gap attribution by cause, queue percentiles,
+occupancy, sweep bubbles, collectives), engine wiring (SBR_FLIGHT=0
+structural no-op witness with bit-identical answers; on-path artifacts:
+flight.json, manifest roll-up, /metrics, /statz, worker stats), the
+synthetically starved pipeline acceptance gate (injected batch-formation
+sleep -> attribution shifts and the floor gate trips), `report util`
+exits, the `report summary` meta-gate, `report gc --flight-keep`
+retention + rotation, and history schema 14.
+"""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from sbr_tpu.models.params import SolverConfig, make_model_params
+from sbr_tpu.obs import flight as fl
+
+REPO = Path(__file__).resolve().parent.parent
+
+CFG = SolverConfig(n_grid=64, bisect_iters=20, refine_crossings=False)
+
+
+def _feq(a, b) -> bool:
+    """Bitwise float equality (NaN-safe): the byte-identity contract."""
+    return np.float64(a).tobytes() == np.float64(b).tobytes()
+
+
+def _rec(t_s, stream, kind, seq, phase, tag="", val=None):
+    """One serialized ring record, as flight.json carries them."""
+    return [int(t_s * 1e9), stream, kind, tag, seq, phase, val]
+
+
+def _span(t0, t1, stream, kind, seq, tag=""):
+    """A closed begin/end pair sharing a seq."""
+    return [_rec(t0, stream, kind, seq, "b", tag),
+            _rec(t1, stream, kind, seq, "e", tag)]
+
+
+def _snap(records, dropped=0):
+    return {"schema": fl.LIVE_SCHEMA, "cap": 4096,
+            "writes_total": len(records) + dropped,
+            "dropped_records": dropped, "records": records}
+
+
+# ---------------------------------------------------------------------------
+# Ring contract
+# ---------------------------------------------------------------------------
+
+
+class TestRingContract:
+    def test_overflow_overwrites_oldest_and_counts_drops(self):
+        rec = fl.FlightRecorder(cap=64)
+        for k in range(100):
+            rec.point("engine", "queue_depth", val=k)
+        snap = rec.snapshot()
+        assert len(snap["records"]) == 64
+        assert snap["writes_total"] == 100
+        assert snap["dropped_records"] == 36
+        # The retained window is the NEWEST 64 (overwrite-oldest).
+        assert sorted(r[6] for r in snap["records"]) == list(range(36, 100))
+
+    def test_concurrent_writers_lose_at_most_overflow(self):
+        # 8 threads x 250 points = 2000 writes into a 512-slot ring: after
+        # the writers quiesce, exactly cap records are retained and the
+        # dropped counter accounts for the rest — no record vanishes
+        # unaccounted, none tears.
+        rec = fl.FlightRecorder(cap=512)
+
+        def writer(tid):
+            for k in range(250):
+                rec.point("engine", "queue_depth", tag=f"w{tid}", val=k)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap["records"]) == 512
+        assert snap["writes_total"] == 2000
+        assert snap["dropped_records"] == 2000 - 512
+        for r in snap["records"]:
+            assert len(r) == 7  # whole tuples only — no partial writes
+
+    def test_seq_unique_per_stream_under_concurrency(self):
+        # Pair identity rests on per-stream seqs: 8 threads marking the
+        # same stream must never mint a duplicate (itertools.count.next is
+        # GIL-atomic), and every begin must carry its matching end.
+        rec = fl.FlightRecorder(cap=8192)
+
+        def writer(tid):
+            for k in range(100):
+                rec.mark("engine", "dispatch", k * 1e-3, k * 1e-3 + 5e-4,
+                         tag=f"w{tid}")
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = rec.snapshot()
+        begins = [r[4] for r in snap["records"] if r[5] == "b"]
+        assert len(begins) == 800
+        assert len(set(begins)) == 800
+        util = fl.derive_utilization(snap)
+        assert util["unpaired"] == 0
+        assert util["dispatches"] == 800
+
+    def test_seq_monotone_in_record_order_single_writer(self):
+        clock = [0.0]
+        rec = fl.FlightRecorder(cap=256, time_fn=lambda: clock[0])
+        for k in range(20):
+            clock[0] += 0.01
+            rec.mark("engine", "dispatch", clock[0], clock[0] + 0.001)
+            rec.point("sweeps", "tick")
+        snap = rec.snapshot()
+        for stream in ("engine", "sweeps"):
+            seqs = [r[4] for r in snap["records"]
+                    if r[1] == stream and r[5] in ("b", "p")]
+            assert seqs == sorted(seqs)
+
+    def test_snapshot_under_active_writes_never_tears(self):
+        # Snapshots race live writers: every retained record must still be
+        # a complete 7-tuple and derive_utilization must fold it without
+        # raising — torn PAIRS are allowed (counted as unpaired), torn
+        # RECORDS are not.
+        rec = fl.FlightRecorder(cap=128)
+        stop = threading.Event()
+
+        def writer():
+            k = 0
+            while not stop.is_set():
+                rec.mark("engine", "dispatch", k * 1e-4, k * 1e-4 + 5e-5)
+                k += 1
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(50):
+                snap = rec.snapshot()
+                for r in snap["records"]:
+                    assert len(r) == 7
+                    assert r[5] in ("b", "e", "p")
+                util = fl.derive_utilization(snap)
+                assert util["records"] == len(snap["records"])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+    def test_reset_drops_everything(self):
+        rec = fl.FlightRecorder(cap=64)
+        rec.mark("engine", "dispatch", 0.0, 1.0)
+        rec.reset()
+        snap = rec.snapshot()
+        assert snap["records"] == [] and snap["dropped_records"] == 0
+
+    def test_record_paths_never_raise(self):
+        rec = fl.FlightRecorder(cap=64)
+        rec.mark("nonexistent-stream", "x", 0.0, 1.0)  # bad stream: dropped
+        rec.point("also-bad", "y")
+        assert rec.snapshot()["records"] == []
+
+
+# ---------------------------------------------------------------------------
+# derive_utilization (pure fold on synthetic records)
+# ---------------------------------------------------------------------------
+
+
+class TestDeriveUtilization:
+    def test_busy_fraction_is_dispatch_union_over_window(self):
+        # Window 0..2 s (admission opens it, unpack closes it); one 1 s
+        # dispatch => busy exactly 0.5.
+        records = (_span(0.0, 0.05, "engine", "admission", 1)
+                   + _span(0.5, 1.5, "engine", "dispatch", 2, tag="b1")
+                   + _span(1.9, 2.0, "engine", "unpack", 3, tag="b1"))
+        util = fl.derive_utilization(_snap(records))
+        assert util["dispatches"] == 1
+        assert util["window_s"] == 2.0
+        assert util["device_busy_frac"] == 0.5
+        assert util["host_gap_frac"] == 0.5
+
+    def test_overlapping_dispatches_union_not_sum(self):
+        records = (_span(0.0, 1.0, "engine", "dispatch", 1)
+                   + _span(0.5, 1.5, "engine", "dispatch", 2)
+                   + _span(1.5, 2.0, "engine", "unpack", 3))
+        util = fl.derive_utilization(_snap(records))
+        # Two overlapping 1 s dispatches cover 1.5 s of a 2 s window.
+        assert util["device_busy_frac"] == 0.75
+
+    def test_gap_attribution_priority_batch_then_cache_then_rest(self):
+        # Gap 0..1 s before a 1 s dispatch: 0.4 s batch formation, 0.3 s
+        # cache I/O, 0.3 s unexplained (no shed point -> queue starvation).
+        records = (_span(0.0, 0.4, "engine", "batch", 1, tag="b1")
+                   + _span(0.4, 0.7, "engine", "cache", 2)
+                   + _span(1.0, 2.0, "engine", "dispatch", 3, tag="b1"))
+        util = fl.derive_utilization(_snap(records))
+        causes = util["gap_causes"]
+        assert causes["batch_formation"]["s"] == pytest.approx(0.4)
+        assert causes["cache_io"]["s"] == pytest.approx(0.3)
+        assert causes["queue_starvation"]["s"] == pytest.approx(0.3)
+        assert causes["batch_formation"]["frac"] == pytest.approx(0.4)
+        assert "admission_shed" not in causes
+
+    def test_shed_point_in_gap_attributes_admission_shed(self):
+        records = (_span(1.0, 2.0, "engine", "dispatch", 1)
+                   + [_rec(0.5, "engine", "shed", 2, "p", "expired")])
+        util = fl.derive_utilization(_snap(records))
+        causes = util["gap_causes"]
+        assert set(causes) == {"admission_shed"}
+        assert causes["admission_shed"]["frac"] == 1.0
+        assert util["sheds"] == {"expired": 1}
+
+    def test_unpaired_ends_and_begins_counted_not_crashed(self):
+        records = ([_rec(1.0, "engine", "dispatch", 9, "e")]     # lost begin
+                   + [_rec(2.0, "engine", "batch", 10, "b")]     # lost end
+                   + _span(3.0, 4.0, "engine", "dispatch", 11))
+        util = fl.derive_utilization(_snap(records))
+        assert util["unpaired"] == 2
+        assert util["dispatches"] == 1
+
+    def test_queue_depth_percentiles_and_occupancy(self):
+        records = [_rec(0.1 * k, "engine", "queue_depth", k + 1, "p",
+                        val=float(k + 1)) for k in range(10)]
+        records += [_rec(1.1, "engine", "occupancy", 20, "p", "b8", 0.5),
+                    _rec(1.2, "engine", "occupancy", 21, "p", "b8", 1.0)]
+        util = fl.derive_utilization(_snap(records))
+        qd = util["queue_depth"]
+        assert qd["samples"] == 10 and qd["max"] == 10.0
+        assert qd["p50"] == 6.0
+        occ = util["occupancy"]
+        assert occ["mean"] == 0.75
+        assert occ["by_bucket"] == {"b8": 0.75}
+
+    def test_sweeps_bubbles_between_tiles(self):
+        records = (_span(0.0, 1.0, "sweeps", "compute", 1, tag="t0")
+                   + _span(1.5, 2.0, "sweeps", "compute", 2, tag="t1")
+                   + _span(2.0, 2.1, "sweeps", "ckpt_save", 3, tag="t1"))
+        util = fl.derive_utilization(_snap(records))
+        sw = util["sweeps"]
+        assert sw["tiles"] == 2
+        assert sw["by_kind_ms"]["compute"] == pytest.approx(1500.0)
+        assert sw["by_kind_ms"]["ckpt_save"] == pytest.approx(100.0)
+        assert sw["bubbles_ms"] == [pytest.approx(500.0)]
+        assert sw["bubble_total_ms"] == pytest.approx(500.0)
+
+    def test_collectives_fold_spans_and_points(self):
+        records = (_span(0.0, 0.1, "collectives", "barrier_poll", 1)
+                   + _span(0.2, 0.3, "collectives", "barrier_poll", 2)
+                   + [_rec(0.4, "collectives", "psum", 3, "p", "inc")])
+        util = fl.derive_utilization(_snap(records))
+        col = util["collectives"]
+        assert col["barrier_poll"]["count"] == 2
+        assert col["barrier_poll"]["total_ms"] == pytest.approx(200.0)
+        assert col["psum"]["count"] == 1
+
+    def test_malformed_rows_skipped(self):
+        records = [["junk"], None, 42] + _span(0.0, 1.0, "engine",
+                                               "dispatch", 1)
+        records += _span(1.0, 2.0, "engine", "unpack", 2)
+        util = fl.derive_utilization(_snap(records))
+        assert util["records"] == 4  # only the well-formed rows counted
+        assert util["dispatches"] == 1
+
+    def test_empty_snapshot_yields_none_fractions(self):
+        util = fl.derive_utilization(_snap([]))
+        assert util["device_busy_frac"] is None
+        assert util["host_gap_frac"] is None
+        assert util["dispatches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Recorder surfaces (heartbeat block, /metrics lines)
+# ---------------------------------------------------------------------------
+
+
+class TestRecorderSurfaces:
+    def test_heartbeat_block_is_compact(self):
+        rec = fl.FlightRecorder(cap=128)
+        rec.mark("engine", "admission", 0.0, 0.05)
+        rec.mark("engine", "dispatch", 0.5, 1.5, tag="b1")
+        rec.mark("engine", "unpack", 1.9, 2.0, tag="b1")
+        rec.point("engine", "queue_depth", val=3.0)
+        hb = rec.heartbeat_block()
+        assert hb["dispatches"] == 1
+        assert hb["device_busy_frac"] is not None
+        assert hb["dropped_records"] == 0
+        assert hb["queue_p99"] == 3.0
+
+    def test_prometheus_lines_expose_flight_gauges(self):
+        rec = fl.FlightRecorder(cap=128)
+        rec.mark("engine", "dispatch", 0.5, 1.5, tag="b1")
+        rec.mark("engine", "unpack", 1.5, 2.0, tag="b1")
+        text = "\n".join(rec.prometheus_lines())
+        assert "sbr_flight_dispatches 1" in text
+        assert "sbr_flight_device_busy_frac" in text
+        assert "sbr_flight_dropped_records 0" in text
+        assert "sbr_flight_engine_ms" in text
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: SBR_FLIGHT=0 structural no-op + on-path recording
+# ---------------------------------------------------------------------------
+
+
+class TestEngineWiring:
+    def _engine(self, **kw):
+        from sbr_tpu.serve.engine import Engine
+
+        return Engine(config=CFG, **kw)
+
+    def test_off_is_structural_noop_with_bit_identical_answers(self, monkeypatch):
+        from sbr_tpu.obs import prof
+
+        pool = [make_model_params(beta=1.2, u=0.25),
+                make_model_params(beta=2.1, u=0.6)]
+        monkeypatch.setenv("SBR_FLIGHT", "1")
+        eng = self._engine()
+        try:
+            eng.start()
+            on_xi = [r.xi for r in eng.query_many(pool, scenario="mix")]
+            assert eng.flight is not None
+        finally:
+            eng.close()
+
+        monkeypatch.delenv("SBR_FLIGHT", raising=False)
+        sys.modules.pop("sbr_tpu.obs.flight", None)
+        traces_before = sum(prof.trace_counts().values())
+        eng = self._engine()
+        try:
+            eng.start()
+            off_xi = [r.xi for r in eng.query_many(pool, scenario="mix")]
+            assert eng.flight is None
+            # The flight module must not even be imported...
+            assert "sbr_tpu.obs.flight" not in sys.modules
+            # ...the exposition must be byte-free of flight metrics...
+            assert "sbr_flight" not in eng.prometheus()
+            assert "flight" not in eng.statz()
+        finally:
+            eng.close()
+        # ...zero new XLA programs traced by running flight-off...
+        assert sum(prof.trace_counts().values()) == traces_before
+        # ...and answers bit-identical to the flight-on run.
+        assert all(_feq(a, b) for a, b in zip(on_xi, off_xi))
+        # (re-import for the rest of the module: `fl` stays bound)
+        import sbr_tpu.obs.flight  # noqa: F401
+
+    def test_on_records_and_lands_artifacts(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs import flight as flight_mod
+
+        flight_mod.reset_shared()
+        monkeypatch.setenv("SBR_FLIGHT", "1")
+        run_dir = tmp_path / "run"
+        eng = self._engine(run_dir=str(run_dir))
+        try:
+            eng.start()
+            pool = [make_model_params(beta=1.2, u=0.25),
+                    make_model_params(beta=2.1, u=0.6)]
+            eng.query_many(pool, scenario="mix")
+            eng.query_many(pool, scenario="mix")  # -> lru warm hits
+            snap = eng.flight.snapshot()
+            assert snap["records"]
+            assert "sbr_flight_records" in eng.prometheus()
+            statz = eng.statz()
+            assert statz["flight"]["records"] > 0
+            assert statz["flight"]["dispatches"] >= 1
+        finally:
+            eng.close()
+        doc = json.loads((run_dir / "flight.json").read_text())
+        assert doc["schema"] == fl.LIVE_SCHEMA
+        assert doc["records"]
+        assert doc["util"]["schema"] == fl.UTIL_SCHEMA
+        assert doc["util"]["dispatches"] >= 1
+        manifest = json.loads((run_dir / "manifest.json").read_text())
+        assert manifest["flight"]["final"] == 1
+        assert manifest["flight"]["last_records"] > 0
+        assert manifest["flight"]["last_dispatches"] >= 1
+
+    def test_worker_stats_carry_flight_block_only_when_on(self, monkeypatch):
+        from sbr_tpu.obs import flight as flight_mod
+        from sbr_tpu.serve.fleet import _worker_stats
+
+        flight_mod.reset_shared()
+        monkeypatch.setenv("SBR_FLIGHT", "1")
+        eng = self._engine()
+        try:
+            eng.start()
+            eng.query_many([make_model_params(beta=1.2, u=0.25)])
+            stats = _worker_stats(eng)
+            assert stats["flight"]["dispatches"] >= 1
+            assert "device_busy_frac" in stats["flight"]
+        finally:
+            eng.close()
+        monkeypatch.delenv("SBR_FLIGHT", raising=False)
+        eng = self._engine()
+        try:
+            eng.start()
+            assert "flight" not in _worker_stats(eng)
+        finally:
+            eng.close()
+
+    def test_router_rolls_up_fleet_flight(self, tmp_path):
+        from sbr_tpu.serve.fleet import WorkerAnnouncer
+        from sbr_tpu.serve.router import Router
+
+        blk = {"device_busy_frac": 0.4, "host_gap_frac": 0.6,
+               "dispatches": 10, "queue_p99": 2.0,
+               "dropped_records": 1, "records": 50}
+        blk2 = {"device_busy_frac": 0.8, "host_gap_frac": 0.2,
+                "dispatches": 30, "queue_p99": 4.0,
+                "dropped_records": 0, "records": 70}
+        w0 = WorkerAnnouncer(tmp_path, "http://127.0.0.1:1", host="w0")
+        w1 = WorkerAnnouncer(tmp_path, "http://127.0.0.1:2", host="w1")
+        w0.beat(flight=blk)
+        w1.beat(flight=blk2)
+        router = Router(tmp_path, poll_s=0.01)
+        router.refresh_workers(force=True)
+        merged = router.fleet_flight()
+        assert merged is not None
+        assert merged["workers"] == ["w0", "w1"]
+        assert merged["dispatches"] == 40
+        assert merged["dropped_records"] == 1
+        # Dispatch-weighted mean: (0.4*10 + 0.8*30) / 40 = 0.7.
+        assert merged["device_busy_frac"] == pytest.approx(0.7)
+        assert router.statz()["flight"]["dispatches"] == 40
+        text = router.prometheus()
+        assert "sbr_flight_fleet_workers 2" in text
+        assert "sbr_flight_fleet_dispatches 40" in text
+
+    def test_router_without_flight_blocks_stays_byte_free(self, tmp_path):
+        from sbr_tpu.serve.fleet import WorkerAnnouncer
+        from sbr_tpu.serve.router import Router
+
+        WorkerAnnouncer(tmp_path, "http://127.0.0.1:1", host="w0").beat(qps=1.0)
+        router = Router(tmp_path, poll_s=0.01)
+        router.refresh_workers(force=True)
+        assert router.fleet_flight() is None
+        assert "flight" not in router.statz()
+        assert "sbr_flight" not in router.prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Sweep instrumentation (TileRunner.produce)
+# ---------------------------------------------------------------------------
+
+
+class TestSweepWiring:
+    def test_produce_lands_sweep_spans_when_on(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs import flight as flight_mod
+        from sbr_tpu.utils.checkpoint import tile_runner
+
+        flight_mod.reset_shared()
+        monkeypatch.setenv("SBR_FLIGHT", "1")
+        base = make_model_params()
+        runner = tile_runner([1.0, 1.5], [0.1, 0.2], base,
+                             str(tmp_path / "ckpt"), config=CFG,
+                             tile_shape=(2, 2))
+        source, _ = runner.produce(0, 0)
+        assert source == "computed"
+        snap = flight_mod.shared().snapshot()
+        kinds = {r[2] for r in snap["records"] if r[1] == "sweeps"}
+        assert "compute" in kinds
+        assert "ckpt_save" in kinds
+        util = fl.derive_utilization(snap)
+        assert util["sweeps"]["tiles"] >= 1
+
+    def test_produce_records_nothing_when_off(self, tmp_path, monkeypatch):
+        from sbr_tpu.utils.checkpoint import _flight_recorder
+
+        monkeypatch.delenv("SBR_FLIGHT", raising=False)
+        assert _flight_recorder() is None
+
+
+# ---------------------------------------------------------------------------
+# The starved-pipeline acceptance gate
+# ---------------------------------------------------------------------------
+
+
+class TestStarvedPipeline:
+    def test_injected_batch_stall_shifts_attribution_and_trips_floor(
+            self, tmp_path, monkeypatch):
+        from sbr_tpu.obs import flight as flight_mod
+        from sbr_tpu.obs import report
+        from sbr_tpu.obs.report import util_doc
+        from sbr_tpu.serve import engine as engine_mod
+
+        flight_mod.reset_shared()
+        monkeypatch.setenv("SBR_FLIGHT", "1")
+        run_dir = tmp_path / "run"
+        eng = engine_mod.Engine(config=CFG, run_dir=str(run_dir))
+        orig = engine_mod.Engine._process_chunks
+
+        def slow_chunks(self, unique, groups, max_bucket):
+            # The synthetic stall: the host dawdles forming the batch while
+            # the device sits idle. Lands between t_popped and the
+            # batch-formation close, so the gap must attribute there.
+            time.sleep(0.05)
+            return orig(self, unique, groups, max_bucket)
+
+        try:
+            eng.start()
+            eng.query_many([make_model_params(beta=1.2, u=0.25)])  # warm-up
+            monkeypatch.setattr(engine_mod.Engine, "_process_chunks",
+                                slow_chunks)
+            eng.flight.reset()  # compile shadow out of the measured window
+            for beta in (1.3, 1.4, 1.5, 1.6):
+                eng.query_many([make_model_params(beta=beta, u=0.25)])
+        finally:
+            eng.close()
+
+        doc, code = util_doc(run_dir, floor=0.8)
+        assert code == 1, doc
+        assert "under floor 0.8" in doc["breaches"][0]
+        # The injected stall guarantees >=0.05s of batch-formation time
+        # per measured dispatch — assert the ABSOLUTE attribution, not
+        # which cause wins overall: on a loaded single-core runner the
+        # blocking client's inter-query gaps stretch arbitrarily and are
+        # (correctly) booked as queue starvation, so the dominant cause
+        # is a race while the stall's own share is deterministic.
+        causes = doc["util"]["gap_causes"]
+        assert causes["batch_formation"]["s"] >= 0.15, causes
+        assert (causes["batch_formation"]["s"]
+                > causes.get("cache_io", {}).get("s", 0.0)), causes
+        assert doc["util"]["dispatches"] >= 4
+        # CLI contract: same breach through the subcommand.
+        assert report.main(["util", str(run_dir), "--floor", "0.8",
+                            "--json"]) == 1
+        # The same window passes a floor it actually clears: the gate
+        # judges utilization, not existence.
+        doc, code = util_doc(run_dir, floor=1e-9)
+        assert code == 0, doc
+
+
+# ---------------------------------------------------------------------------
+# report util (gate) exits
+# ---------------------------------------------------------------------------
+
+
+def _write_flight_run(tmp_path, name, records, dropped=0):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    doc = _snap(records, dropped=dropped)
+    doc["ts"] = 1.0
+    (d / "flight.json").write_text(json.dumps(doc))
+    return d
+
+
+class TestReportUtil:
+    def test_exit_2_bad_dir(self, tmp_path):
+        from sbr_tpu.obs.report import util_doc
+
+        doc, code = util_doc(tmp_path / "nope")
+        assert code == 2 and doc["exit"] == 2
+
+    def test_exit_3_no_data(self, tmp_path):
+        from sbr_tpu.obs.report import util_doc
+
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        doc, code = util_doc(empty)
+        assert code == 3 and "no flight data" in doc["error"]
+        # A flight.json with no records is still "nothing to judge".
+        (empty / "flight.json").write_text(json.dumps(_snap([])))
+        doc, code = util_doc(empty)
+        assert code == 3
+
+    def test_floor_gate_and_disarm(self, tmp_path):
+        from sbr_tpu.obs.report import render_util, util_doc
+
+        # 3 dispatches covering 0.3 s of a 2 s window: busy 0.15.
+        records = []
+        for k in range(3):
+            records += _span(0.5 * k, 0.5 * k + 0.1, "engine", "dispatch",
+                             k + 1, tag="b1")
+        records += _span(1.9, 2.0, "engine", "unpack", 9)
+        d = _write_flight_run(tmp_path, "a", records)
+        doc, code = util_doc(d, floor=0.5)
+        assert code == 1
+        assert "under floor 0.5" in doc["breaches"][0]
+        assert "UTILIZATION DEGRADED" in render_util(doc)
+        doc, code = util_doc(d, floor=0.1)
+        assert code == 0
+        assert "GATE: ok" in render_util(doc)
+        # Below min dispatches the floor gate disarms with a note.
+        doc, code = util_doc(d, floor=0.5, min_disp=5)
+        assert code == 0
+        assert any("disarmed" in n for n in doc["notes"])
+        # No floor: never gates.
+        doc, code = util_doc(d)
+        assert code == 0 and doc["floor"] is None
+
+    def test_floor_env_default(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs.report import util_doc
+
+        records = (_span(0.0, 0.1, "engine", "dispatch", 1)
+                   + _span(0.1, 0.2, "engine", "dispatch", 2)
+                   + _span(0.2, 0.3, "engine", "dispatch", 3)
+                   + _span(1.9, 2.0, "engine", "unpack", 4))
+        d = _write_flight_run(tmp_path, "a", records)
+        monkeypatch.setenv("SBR_FLIGHT_UTIL_FLOOR", "0.9")
+        doc, code = util_doc(d)
+        assert code == 1 and doc["floor"] == 0.9
+
+    def test_dropped_records_surfaced_as_note(self, tmp_path):
+        from sbr_tpu.obs.report import render_util, util_doc
+
+        records = (_span(0.0, 1.0, "engine", "dispatch", 1)
+                   + _span(1.0, 1.1, "engine", "unpack", 2))
+        d = _write_flight_run(tmp_path, "a", records, dropped=7)
+        doc, code = util_doc(d)
+        assert code == 0
+        assert any("7 record(s) overwritten" in n for n in doc["notes"])
+        assert "SBR_FLIGHT_CAP" in render_util(doc)
+
+    def test_cli_json_contract(self, tmp_path):
+        from sbr_tpu.obs import report
+
+        records = (_span(0.0, 1.0, "engine", "dispatch", 1)
+                   + _span(1.0, 1.1, "engine", "unpack", 2))
+        d = _write_flight_run(tmp_path, "a", records)
+        assert report.main(["util", str(d), "--json"]) == 0
+        assert report.main(["util", str(tmp_path / "gone"), "--json"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# report summary (meta-gate)
+# ---------------------------------------------------------------------------
+
+
+class TestReportSummary:
+    def test_exit_2_bad_dir(self, tmp_path):
+        from sbr_tpu.obs.report import summary_doc
+
+        doc, code = summary_doc(tmp_path / "nope")
+        assert code == 2
+
+    def test_merged_exit_is_max_of_subgates_on_real_run(self, tmp_path,
+                                                        monkeypatch):
+        from sbr_tpu.obs import flight as flight_mod
+        from sbr_tpu.obs.report import render_summary, summary_doc
+        from sbr_tpu.serve.engine import Engine
+
+        flight_mod.reset_shared()
+        monkeypatch.setenv("SBR_FLIGHT", "1")
+        monkeypatch.setenv("SBR_DEMAND", "1")
+        run_dir = tmp_path / "run"
+        eng = Engine(config=CFG, run_dir=str(run_dir))
+        try:
+            eng.start()
+            pool = [make_model_params(beta=1.2, u=0.25),
+                    make_model_params(beta=2.1, u=0.6)]
+            eng.query_many(pool, scenario="mix")
+            eng.query_many(pool, scenario="mix")
+        finally:
+            eng.close()
+        doc, code = summary_doc(run_dir)
+        gates = doc["gates"]
+        assert set(gates) == {"health", "serve", "fleet", "trace", "slo",
+                              "audit", "demand", "prewarm", "util"}
+        # The merged exit IS the max of the subgate exits.
+        assert code == max(g["exit"] for g in gates.values())
+        assert doc["exit"] == code
+        # This run exercised >= 3 observatories end to end.
+        passing = [n for n, g in gates.items() if g["exit"] == 0]
+        assert {"serve", "demand", "util"} <= set(passing)
+        for name in passing:
+            assert gates[name]["reason"] == "ok"
+        # Observatories that were not enabled surface their honest
+        # no-data exits rather than silently passing.
+        assert gates["audit"]["exit"] == 3
+        assert code == 3
+        text = render_summary(doc)
+        assert "GATE: exit 3" in text and "audit" in text
+
+    def test_crashing_subgate_reads_exit_2(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs import report
+
+        d = tmp_path / "run"
+        d.mkdir()
+
+        def boom(run_dir):
+            raise RuntimeError("gate exploded")
+
+        monkeypatch.setattr(report, "_SUMMARY_GATES",
+                            (("health", boom),) + report._SUMMARY_GATES[1:])
+        doc, code = report.summary_doc(d)
+        assert doc["gates"]["health"]["exit"] == 2
+        assert "gate exploded" in doc["gates"]["health"]["reason"]
+        assert code >= 2
+
+    def test_cli_json_contract(self, tmp_path):
+        from sbr_tpu.obs import report
+
+        d = tmp_path / "run"
+        d.mkdir()
+        # An empty run dir: every gate reads its no-data exit; merged != 0.
+        code = report.main(["summary", str(d), "--json"])
+        assert code == 3
+
+
+# ---------------------------------------------------------------------------
+# report gc --flight-keep (retention) + rotation
+# ---------------------------------------------------------------------------
+
+
+class TestGcFlightKeep:
+    def _run_dir(self, root, name, status="done", rotated=3):
+        d = root / name
+        d.mkdir(parents=True)
+        (d / "manifest.json").write_text(json.dumps({"status": status}))
+        (d / "flight.json").write_text("{}")
+        for i in range(rotated):
+            (d / f"flight.{i:03d}.json").write_text("{}")
+        return d
+
+    def test_prunes_rotated_keeps_active_and_live_runs(self, tmp_path):
+        done = self._run_dir(tmp_path, "run_done")
+        live = self._run_dir(tmp_path, "run_live", status="running")
+        removed = fl.gc_flight_files(tmp_path, keep=1)
+        assert len(removed) == 2
+        assert (done / "flight.json").exists()
+        assert not (done / "flight.000.json").exists()
+        assert (done / "flight.002.json").exists()
+        # live run (manifest "running", fresh mtime): never touched.
+        assert len(list(live.glob("flight.*.json"))) == 3
+
+    def test_report_gc_flag(self, tmp_path):
+        from sbr_tpu.obs import report
+
+        self._run_dir(tmp_path, "run_a")
+        code = report.main(["gc", str(tmp_path), "--keep", "99",
+                            "--flight-keep", "0"])
+        assert code == 0
+        assert not list((tmp_path / "run_a").glob("flight.0*.json"))
+        assert (tmp_path / "run_a" / "flight.json").exists()
+
+    def test_rotation_archives_snapshots(self, tmp_path, monkeypatch):
+        from sbr_tpu.obs import runlog
+
+        monkeypatch.setenv("SBR_FLIGHT_ROTATE_S", "5")
+        clock = [0.0]
+        run = runlog.RunContext(root=tmp_path, label="rot")
+        rec = fl.FlightRecorder(cap=64, time_fn=lambda: clock[0])
+        rec.mark("engine", "dispatch", 0.0, 0.5)
+        assert rec.maybe_write(run, force=True)
+        clock[0] += 6.0
+        rec.mark("engine", "dispatch", 6.0, 6.5)
+        assert rec.maybe_write(run, force=True)
+        run.finalize()
+        assert (Path(run.run_dir) / "flight.000.json").exists()
+        assert (Path(run.run_dir) / "flight.json").exists()
+        manifest = json.loads(
+            (Path(run.run_dir) / "manifest.json").read_text())
+        assert manifest["flight"]["rotate"] == 1
+
+
+# ---------------------------------------------------------------------------
+# History schema 14
+# ---------------------------------------------------------------------------
+
+
+class TestHistorySchema14:
+    def test_flight_metrics_whitelisted(self):
+        from sbr_tpu.obs import history
+
+        assert history.SCHEMA >= 14
+        out = history.bench_metrics({
+            "value": 10.0,
+            "extra": {"flight_overhead_ratio": 1.02,
+                      "flight_device_busy_frac": 0.31,
+                      "flight_host_gap_frac": 0.69},
+        })
+        assert out["flight_overhead_ratio"] == 1.02
+        assert out["flight_device_busy_frac"] == 0.31
+        assert out["flight_host_gap_frac"] == 0.69
+
+    def test_polarity(self):
+        from sbr_tpu.obs import history
+
+        # busy higher-better; gap and the on/off overhead lower-better.
+        assert history.polarity("flight_device_busy_frac") == 1
+        assert history.polarity("flight_host_gap_frac") == -1
+        assert history.polarity("flight_overhead_ratio") == -1
+
+    def test_schema_1_to_13_lines_still_load_and_gate(self, tmp_path):
+        from sbr_tpu.obs import history
+
+        path = tmp_path / "hist.jsonl"
+        rows = [{"ts": 1.0, "metrics": {"eq_per_sec": 10.0}}]  # schema-less
+        rows += [{"schema": s, "metrics": {"eq_per_sec": 10.0 + s / 10}}
+                 for s in range(2, 14)]
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        history.append({"eq_per_sec": 10.6}, path=path)
+        records = history.load(path)
+        assert ([r["schema"] for r in records]
+                == list(range(1, 14)) + [history.SCHEMA])
+        verdicts, status = history.check(records, tolerance=0.15)
+        assert status == "ok"

@@ -51,8 +51,9 @@ def test_append_load_round_trip(tmp_path):
     # (tests/test_mem.py, tests/test_serve.py, tests/test_elastic.py,
     # tests/test_numerics.py, tests/test_graphgen.py, tests/test_fleet.py,
     # tests/test_grad.py, tests/test_scenario.py, tests/test_infomodels.py,
-    # tests/test_audit.py, tests/test_demand.py, tests/test_prewarm.py).
-    assert rec["schema"] == history.SCHEMA == 13
+    # tests/test_audit.py, tests/test_demand.py, tests/test_prewarm.py,
+    # tests/test_flight.py).
+    assert rec["schema"] == history.SCHEMA == 14
     assert rec["label"] == "x" and rec["platform"] == "cpu"
     # only finite numerics survive; bools coerce to gateable ints
     assert rec["metrics"] == {"eq_per_sec": 10.0, "flag": 1}
